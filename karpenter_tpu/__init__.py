@@ -1,0 +1,32 @@
+"""karpenter_tpu — a TPU-native node-provisioning framework.
+
+A from-scratch rebuild of the capabilities of Karpenter (reference:
+aws/karpenter-provider-aws + sigs.k8s.io/karpenter): watch unschedulable pods,
+solve their scheduling constraints against a large instance-type catalog,
+launch exactly the nodes needed, and continuously disrupt (consolidate / drift
+/ expire) nodes to minimize cost.
+
+The architectural twist vs the reference: the two hot paths — the
+provisioner's first-fit-decreasing bin-packing loop
+(reference: designs/bin-packing.md) and the disruption controller's
+consolidation simulator (reference: designs/consolidation.md) — are not
+sequential CPU heuristics but a batched pods×instance-types assignment solve
+in JAX/XLA on TPU, behind the same CloudProvider / Solver seams the reference
+uses, with a feature-gated CPU fallback (`karpenter_tpu.scheduling.oracle`).
+
+Package layout:
+  models/       data model: resources, label-requirement algebra, taints,
+                Pod/Node/NodePool/NodeClaim/NodeClass/InstanceType objects
+  scheduling/   CPU oracle scheduler (fallback + parity reference) and
+                shared scheduling semantics
+  solver/       the TPU solver: tensor encoding + jitted FFD solve/simulate
+  ops/          low-level JAX/Pallas tensor ops used by the solver
+  parallel/     device-mesh sharding of the solver (pods axis over ICI)
+  cloudprovider/ the CloudProvider seam + drift detection
+  providers/    instance-type catalog, pricing, fake cloud backend
+  controllers/  provisioning, disruption, lifecycle, termination,
+                interruption, garbage-collection reconcilers
+  utils/        batcher, TTL caches, events, metrics, clock
+"""
+
+__version__ = "0.1.0"

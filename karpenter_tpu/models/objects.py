@@ -1,0 +1,389 @@
+"""CRD-shaped objects.
+
+NodePool / NodeClaim mirror the core CRDs
+(pkg/apis/crds/karpenter.sh_nodepools.yaml, karpenter.sh_nodeclaims.yaml);
+NodeClass is the provider CRD analogue of EC2NodeClass
+(pkg/apis/v1/ec2nodeclass.go:29-128) with TPU/GCE-shaped fields; InstanceType
+and Offering mirror cloudprovider.InstanceType
+(consumed at pkg/cloudprovider/cloudprovider.go:172-193 and built by
+pkg/providers/instancetype/types.go:51-210).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from karpenter_tpu.models import wellknown
+from karpenter_tpu.models.requirements import Requirement, Requirements
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.models.taints import Taint, Toleration
+
+_uid_counter = itertools.count(1)
+
+
+def new_uid() -> str:
+    return f"uid-{next(_uid_counter)}"
+
+
+@dataclass
+class ObjectMeta:
+    name: str
+    uid: str = field(default_factory=new_uid)
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    finalizers: List[str] = field(default_factory=list)
+    creation_time: float = 0.0
+    deletion_time: Optional[float] = None  # set => being deleted (finalizing)
+    resource_version: int = 0
+
+    @property
+    def deleting(self) -> bool:
+        return self.deletion_time is not None
+
+
+# ---------------------------------------------------------------------------
+# Workloads
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TopologySpreadConstraint:
+    topology_key: str
+    max_skew: int = 1
+    when_unsatisfiable: str = "DoNotSchedule"  # or ScheduleAnyway
+    label_selector: Dict[str, str] = field(default_factory=dict)
+    min_domains: Optional[int] = None
+
+
+@dataclass
+class PodAffinityTerm:
+    """Required/preferred pod (anti-)affinity over a topology domain."""
+    label_selector: Dict[str, str]
+    topology_key: str
+    anti: bool = False
+    required: bool = True
+    weight: int = 100  # for preferred terms
+
+
+@dataclass
+class Pod:
+    meta: ObjectMeta
+    requests: Resources = field(default_factory=Resources)
+    # hard node constraints: nodeSelector + requiredDuringScheduling node
+    # affinity, already folded into one Requirements conjunction
+    requirements: Requirements = field(default_factory=Requirements)
+    # preferredDuringScheduling node affinity: (weight, requirements) terms
+    preferences: List[Tuple[int, Requirements]] = field(default_factory=list)
+    tolerations: List[Toleration] = field(default_factory=list)
+    topology_spread: List[TopologySpreadConstraint] = field(default_factory=list)
+    pod_affinities: List[PodAffinityTerm] = field(default_factory=list)
+    priority: int = 0
+    # binding / lifecycle
+    node_name: Optional[str] = None
+    phase: str = "Pending"
+    # "has a controller owner" — pods without one block consolidation
+    # (designs/consolidation.md:46-52)
+    owner_kind: Optional[str] = "ReplicaSet"
+    is_daemonset: bool = False
+
+    @property
+    def name(self) -> str:
+        return self.meta.name
+
+    @property
+    def scheduled(self) -> bool:
+        return self.node_name is not None
+
+    def deletion_cost(self) -> float:
+        raw = self.meta.annotations.get(wellknown.POD_DELETION_COST_ANNOTATION)
+        try:
+            return float(raw) if raw is not None else 0.0
+        except ValueError:
+            return 0.0
+
+    def do_not_disrupt(self) -> bool:
+        return self.meta.annotations.get(wellknown.DO_NOT_DISRUPT_ANNOTATION) == "true"
+
+    def scheduling_key(self) -> tuple:
+        """Equivalence-class key: pods with equal keys are interchangeable to
+        the scheduler. The reference exploits the same equivalence when
+        batching identical pods; the TPU grouped solver depends on it.
+        """
+        return (
+            self.requests,
+            self.requirements,
+            tuple(sorted(self.tolerations, key=str)),
+            tuple(
+                (c.topology_key, c.max_skew, c.when_unsatisfiable,
+                 tuple(sorted(c.label_selector.items())), c.min_domains)
+                for c in self.topology_spread
+            ),
+            tuple(
+                (t.topology_key, t.anti, t.required,
+                 tuple(sorted(t.label_selector.items())))
+                for t in self.pod_affinities
+            ),
+            tuple((w, reqs) for w, reqs in self.preferences),
+            tuple(sorted(self.meta.labels.items())),
+            self.priority,
+            self.is_daemonset,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Instance types
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Offering:
+    """One purchasable (zone × capacity-type) variant of an instance type with
+    a price (reference: createOfferings,
+    pkg/providers/instancetype/instancetype.go:264-315).
+    """
+    zone: str
+    capacity_type: str
+    price: float
+    available: bool = True
+
+    def requirements(self) -> Requirements:
+        return Requirements(
+            Requirement.single(wellknown.ZONE_LABEL, self.zone),
+            Requirement.single(wellknown.CAPACITY_TYPE_LABEL, self.capacity_type),
+        )
+
+
+@dataclass
+class InstanceType:
+    """A machine shape: capacity, overhead, static label requirements, and
+    offerings (reference: cloudprovider.InstanceType built at
+    pkg/providers/instancetype/types.go:51-210).
+    """
+    name: str
+    capacity: Resources
+    requirements: Requirements  # single-valued label reqs + zone/captype In[...]
+    offerings: List[Offering] = field(default_factory=list)
+    overhead: Resources = field(default_factory=Resources)  # kube-reserved + eviction
+
+    _allocatable: Optional[Resources] = field(default=None, repr=False, compare=False)
+
+    def allocatable(self) -> Resources:
+        if self._allocatable is None:
+            self._allocatable = self.capacity - self.overhead
+        return self._allocatable
+
+    def available_offerings(self, reqs: Optional[Requirements] = None) -> List[Offering]:
+        """Offerings compatible with the zone / capacity-type constraints in
+        `reqs`. Only those two keys are consulted — other keys in `reqs`
+        (arch, instance-type, …) are about the instance type itself, not the
+        offering, and are open-world here (reference: offering filtering in
+        pkg/cloudprovider/cloudprovider.go:276-281 checks offering
+        requirements only).
+        """
+        zone_req = reqs.get(wellknown.ZONE_LABEL) if reqs is not None else None
+        ct_req = reqs.get(wellknown.CAPACITY_TYPE_LABEL) if reqs is not None else None
+        out = []
+        for o in self.offerings:
+            if not o.available:
+                continue
+            if zone_req is not None and not zone_req.matches(o.zone):
+                continue
+            if ct_req is not None and not ct_req.matches(o.capacity_type):
+                continue
+            out.append(o)
+        return out
+
+    def cheapest_offering(self, reqs: Optional[Requirements] = None) -> Optional[Offering]:
+        offs = self.available_offerings(reqs)
+        return min(offs, key=lambda o: o.price) if offs else None
+
+
+# ---------------------------------------------------------------------------
+# Nodes & claims
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Node:
+    meta: ObjectMeta
+    provider_id: Optional[str] = None
+    capacity: Resources = field(default_factory=Resources)
+    allocatable: Resources = field(default_factory=Resources)
+    taints: List[Taint] = field(default_factory=list)
+    ready: bool = False
+
+    @property
+    def name(self) -> str:
+        return self.meta.name
+
+    @property
+    def labels(self) -> Dict[str, str]:
+        return self.meta.labels
+
+    @property
+    def nodepool(self) -> Optional[str]:
+        return self.meta.labels.get(wellknown.NODEPOOL_LABEL)
+
+    @property
+    def zone(self) -> Optional[str]:
+        return self.meta.labels.get(wellknown.ZONE_LABEL)
+
+    @property
+    def capacity_type(self) -> Optional[str]:
+        return self.meta.labels.get(wellknown.CAPACITY_TYPE_LABEL)
+
+    @property
+    def instance_type(self) -> Optional[str]:
+        return self.meta.labels.get(wellknown.INSTANCE_TYPE_LABEL)
+
+
+# NodeClaim status conditions (karpenter.sh_nodeclaims.yaml status.conditions;
+# lifecycle per SURVEY §2.2 "Node lifecycle").
+COND_LAUNCHED = "Launched"
+COND_REGISTERED = "Registered"
+COND_INITIALIZED = "Initialized"
+
+
+@dataclass
+class NodeClaim:
+    meta: ObjectMeta
+    nodepool: str
+    node_class_ref: str
+    requirements: Requirements = field(default_factory=Requirements)
+    resource_requests: Resources = field(default_factory=Resources)  # aggregate of packed pods
+    taints: List[Taint] = field(default_factory=list)
+    startup_taints: List[Taint] = field(default_factory=list)
+    # ranked candidate instance types (cheapest-first), as the reference's
+    # NodeClaim carries instance-type requirements ranked by price
+    instance_type_options: List[str] = field(default_factory=list)
+    # status
+    provider_id: Optional[str] = None
+    node_name: Optional[str] = None
+    capacity: Resources = field(default_factory=Resources)
+    allocatable: Resources = field(default_factory=Resources)
+    conditions: Dict[str, bool] = field(default_factory=dict)
+    launch_time: Optional[float] = None
+
+    @property
+    def name(self) -> str:
+        return self.meta.name
+
+    def is_(self, cond: str) -> bool:
+        return self.conditions.get(cond, False)
+
+    def set_condition(self, cond: str, val: bool = True) -> None:
+        self.conditions[cond] = val
+
+
+# ---------------------------------------------------------------------------
+# NodePool & NodeClass
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Budget:
+    """Disruption budget (karpenter.sh_nodepools.yaml spec.disruption.budgets).
+    nodes: "10%" or "5"; reasons limits which disruption reasons it caps.
+    """
+    nodes: str = "10%"
+    schedule: Optional[str] = None  # cron; None = always active
+    duration: Optional[float] = None  # seconds the window stays open
+    reasons: Optional[List[str]] = None  # None = all reasons
+
+    def allowed_disruptions(self, total_nodes: int) -> int:
+        if self.nodes.endswith("%"):
+            pct = float(self.nodes[:-1]) / 100.0
+            # floor, but immune to binary-float error (29% of 100 is 29, not 28)
+            return int(pct * total_nodes + 1e-9)
+        return int(self.nodes)
+
+
+CONSOLIDATE_WHEN_EMPTY = "WhenEmpty"
+CONSOLIDATE_WHEN_EMPTY_OR_UNDERUTILIZED = "WhenEmptyOrUnderutilized"
+CONSOLIDATE_WHEN_UNDERUTILIZED = "WhenUnderutilized"
+
+
+@dataclass
+class Disruption:
+    consolidation_policy: str = CONSOLIDATE_WHEN_EMPTY_OR_UNDERUTILIZED
+    consolidate_after: float = 0.0  # seconds; 0 = immediately
+    budgets: List[Budget] = field(default_factory=lambda: [Budget(nodes="10%")])
+
+
+@dataclass
+class NodePool:
+    """karpenter.sh/NodePool (karpenter.sh_nodepools.yaml): a template for
+    nodes plus disruption policy, limits, and weight.
+    """
+    meta: ObjectMeta
+    node_class_ref: str = "default"
+    requirements: Requirements = field(default_factory=Requirements)
+    taints: List[Taint] = field(default_factory=list)
+    startup_taints: List[Taint] = field(default_factory=list)
+    labels: Dict[str, str] = field(default_factory=dict)       # template labels
+    annotations: Dict[str, str] = field(default_factory=dict)
+    expire_after: Optional[float] = None  # seconds; None = Never
+    termination_grace_period: Optional[float] = None
+    disruption: Disruption = field(default_factory=Disruption)
+    limits: Optional[Resources] = None
+    weight: int = 0  # higher = tried first (nodepools.md:525-529)
+
+    @property
+    def name(self) -> str:
+        return self.meta.name
+
+    def template_requirements(self) -> Requirements:
+        """Full requirement set a node from this pool will satisfy."""
+        reqs = Requirements.from_labels(self.labels)
+        reqs.update(self.requirements)
+        reqs.add(Requirement.single(wellknown.NODEPOOL_LABEL, self.name))
+        return reqs
+
+    def static_hash(self) -> str:
+        """Drift-detection hash over the template's static fields
+        (reference: NodePool hash annotation mechanism,
+        pkg/controllers/nodeclass/hash/controller.go:48-128 analogue).
+        """
+        payload = json.dumps({
+            "labels": sorted(self.labels.items()),
+            "annotations": sorted(self.annotations.items()),
+            "taints": sorted(str(t) for t in self.taints),
+            "startup_taints": sorted(str(t) for t in self.startup_taints),
+            "requirements": sorted(repr(r) for r in self.requirements),
+            "node_class_ref": self.node_class_ref,
+            "expire_after": self.expire_after,
+        }, sort_keys=True)
+        return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+@dataclass
+class NodeClass:
+    """Provider node configuration — the EC2NodeClass analogue
+    (pkg/apis/v1/ec2nodeclass.go). For the TPU/GCE-shaped provider this
+    carries zone/network/boot configuration rather than AMI/subnet/SG
+    selectors; `ready` gates Create() exactly as the reference's readiness
+    condition does (pkg/cloudprovider/cloudprovider.go:99-102).
+    """
+    meta: ObjectMeta
+    zones: List[str] = field(default_factory=list)
+    capacity_types: List[str] = field(
+        default_factory=lambda: [wellknown.CAPACITY_TYPE_ON_DEMAND,
+                                 wellknown.CAPACITY_TYPE_SPOT])
+    boot_config: Dict[str, str] = field(default_factory=dict)  # userdata analogue
+    instance_families: Optional[List[str]] = None  # None = all
+    ready: bool = True
+    # status (mirrors EC2NodeClass.status discovered resources)
+    discovered_zones: List[str] = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return self.meta.name
+
+    def static_hash(self) -> str:
+        payload = json.dumps({
+            "zones": sorted(self.zones),
+            "capacity_types": sorted(self.capacity_types),
+            "boot_config": sorted(self.boot_config.items()),
+            "instance_families": sorted(self.instance_families or []),
+        }, sort_keys=True)
+        return hashlib.sha256(payload.encode()).hexdigest()[:16]

@@ -1,0 +1,162 @@
+from karpenter_tpu.models import Requirement, Requirements
+from karpenter_tpu.models.requirements import Operator
+
+
+def req(key, op, *vals, **kw):
+    return Requirement.make(key, op, *vals, **kw)
+
+
+class TestRequirement:
+    def test_in_matches(self):
+        r = req("zone", "In", "a", "b")
+        assert r.matches("a") and r.matches("b")
+        assert not r.matches("c")
+        assert not r.matches_absent()
+        assert r.values() == {"a", "b"}
+
+    def test_not_in(self):
+        r = req("zone", "NotIn", "a")
+        assert not r.matches("a")
+        assert r.matches("b")
+        assert r.matches_absent()
+
+    def test_exists_and_does_not_exist(self):
+        e = req("gpu", "Exists")
+        assert e.matches("anything") and not e.matches_absent()
+        d = req("gpu", "DoesNotExist")
+        assert not d.matches("anything") and d.matches_absent()
+        assert d.is_empty()  # no concrete value satisfies it
+
+    def test_gt_lt(self):
+        g = req("cpu", "Gt", "4")
+        assert g.matches("8") and not g.matches("4") and not g.matches("2")
+        assert not g.matches("abc")
+        lt = req("cpu", "Lt", "16")
+        both = g.intersect(lt)
+        assert both.matches("8") and not both.matches("16") and not both.matches("4")
+
+    def test_gt_lt_empty_range(self):
+        r = req("n", "Gt", "4").intersect(req("n", "Lt", "5"))
+        assert r.is_empty()
+
+    def test_intersections(self):
+        a, b = req("k", "In", "x", "y"), req("k", "In", "y", "z")
+        assert a.intersect(b).values() == {"y"}
+        # In ∩ NotIn
+        assert req("k", "In", "x", "y").intersect(req("k", "NotIn", "x")).values() == {"y"}
+        # NotIn ∩ NotIn stays complement
+        nn = req("k", "NotIn", "x").intersect(req("k", "NotIn", "y"))
+        assert nn.complement and not nn.matches("x") and not nn.matches("y") and nn.matches("z")
+        # In ∩ Exists keeps the finite set
+        ie = req("k", "In", "x").intersect(req("k", "Exists"))
+        assert ie.values() == {"x"} and ie.requires_existence
+        # disjoint In sets → empty
+        assert req("k", "In", "x").intersect(req("k", "In", "y")).is_empty()
+
+    def test_in_with_bounds_filters_values(self):
+        r = req("cpu", "In", "2", "8", "32").intersect(req("cpu", "Gt", "4"))
+        assert r.values() == {"8", "32"}
+
+    def test_min_values_carried(self):
+        r = req("family", "In", "a", "b", "c", min_values=2)
+        assert r.min_values == 2
+        assert r.intersect(req("family", "Exists")).min_values == 2
+
+
+class TestRequirements:
+    def test_add_tightens(self):
+        rs = Requirements(req("zone", "In", "a", "b"))
+        rs.add(req("zone", "In", "b", "c"))
+        assert rs.get("zone").values() == {"b"}
+
+    def test_compatible_open_world(self):
+        pool = Requirements(req("arch", "In", "amd64"))
+        pod = Requirements(req("zone", "In", "a"))  # pool says nothing about zone
+        assert pool.compatible(pod)
+        pod2 = Requirements(req("arch", "In", "arm64"))
+        assert not pool.compatible(pod2)
+
+    def test_conflict_key(self):
+        pool = Requirements(req("arch", "In", "amd64"))
+        assert pool.conflict_key(Requirements(req("arch", "In", "arm64"))) == "arch"
+        assert pool.conflict_key(Requirements(req("zone", "In", "a"))) is None
+
+    def test_matched_by_labels_closed_world(self):
+        rs = Requirements(req("zone", "In", "a"), req("ssd", "NotIn", "false"))
+        assert rs.matched_by_labels({"zone": "a"})          # ssd absent: NotIn ok
+        assert not rs.matched_by_labels({"zone": "b"})
+        assert not rs.matched_by_labels({})                  # zone In requires presence
+        rs2 = Requirements(req("gpu", "Exists"))
+        assert not rs2.matched_by_labels({})
+        assert rs2.matched_by_labels({"gpu": "t4"})
+
+    def test_intersection_and_hash(self):
+        a = Requirements(req("zone", "In", "a", "b"))
+        b = Requirements(req("zone", "In", "b"), req("arch", "In", "amd64"))
+        c = a.intersection(b)
+        assert c.get("zone").values() == {"b"}
+        assert c.get("arch").values() == {"amd64"}
+        # a unchanged (copy semantics)
+        assert a.get("zone").values() == {"a", "b"}
+        assert hash(Requirements(req("k", "In", "x"))) == hash(Requirements(req("k", "In", "x")))
+
+    def test_from_labels(self):
+        rs = Requirements.from_labels({"zone": "a"})
+        assert rs.matched_by_labels({"zone": "a", "extra": "y"})
+        assert not rs.matched_by_labels({"zone": "b"})
+
+
+def test_operator_enum_roundtrip():
+    for op in Operator:
+        r = Requirement.make("k", op, "1")
+        assert isinstance(r, Requirement)
+
+
+class TestReviewRegressions:
+    """Regressions from the round-1 code review findings."""
+
+    def test_does_not_exist_is_satisfiable_by_absence(self):
+        pod = Requirements(req("gpu", "DoesNotExist"))
+        pool = Requirements()
+        assert pool.compatible(pod)
+        assert pod.compatible(pod)
+        assert pod.conflict_key(Requirements()) is None
+        # but a template that pins the label IS incompatible
+        pinned = Requirements(req("gpu", "In", "t4"))
+        assert not pinned.compatible(pod)
+
+    def test_does_not_exist_intersect_not_in_still_satisfiable(self):
+        r = req("k", "DoesNotExist").intersect(req("k", "NotIn", "x"))
+        assert r.is_empty() and not r.is_unsatisfiable()
+
+    def test_in_intersect_does_not_exist_unsatisfiable(self):
+        r = req("k", "In", "a").intersect(req("k", "DoesNotExist"))
+        assert r.is_unsatisfiable()
+
+
+def test_budget_percentage_float_exact():
+    from karpenter_tpu.models import Budget
+    assert Budget(nodes="29%").allowed_disruptions(100) == 29
+    assert Budget(nodes="10%").allowed_disruptions(25) == 2   # floor
+    assert Budget(nodes="5").allowed_disruptions(100) == 5
+
+
+def test_offerings_open_world_on_non_offering_keys():
+    from karpenter_tpu.models import InstanceType, Offering, Resources, wellknown
+    it = InstanceType(
+        name="n2",
+        capacity=Resources.of(cpu=4000),
+        requirements=Requirements(req("kubernetes.io/arch", "In", "amd64")),
+        offerings=[Offering("zone-a", "on-demand", 0.2)],
+    )
+    reqs = Requirements(req("kubernetes.io/arch", "In", "amd64"),
+                        req(wellknown.ZONE_LABEL, "In", "zone-a"))
+    assert len(it.available_offerings(reqs)) == 1
+    assert it.cheapest_offering(reqs).price == 0.2
+
+
+def test_resources_hash_eq_consistent():
+    from karpenter_tpu.models import Resources
+    a = Resources.of(cpu=0.4999995)
+    b = Resources.of(cpu=0.49999950000000004)
+    assert (a == b) == (hash(a) == hash(b))

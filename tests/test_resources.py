@@ -1,0 +1,49 @@
+from karpenter_tpu.models import Resources, parse_quantity
+from karpenter_tpu.models.resources import merge
+
+
+def test_parse_quantity():
+    assert parse_quantity("100m") == 0.1
+    assert parse_quantity("2") == 2.0
+    assert parse_quantity("1Gi") == 2**30
+    assert parse_quantity("1.5Gi") == 1.5 * 2**30
+    assert parse_quantity("2T") == 2e12
+    assert parse_quantity(5) == 5.0
+    assert parse_quantity("1e3") == 1000.0
+
+
+def test_parse_resources_solver_units():
+    r = Resources.parse({"cpu": "1500m", "memory": "2Gi", "pods": 10})
+    assert r.cpu == 1500.0          # millicores
+    assert r.memory == 2048.0       # MiB
+    assert r.pods == 10.0
+
+
+def test_gpu_alias():
+    r = Resources.parse({"nvidia.com/gpu": 4})
+    assert r.get("gpu") == 4.0
+
+
+def test_arithmetic_and_fits():
+    a = Resources.of(cpu=1000, memory=1024)
+    b = Resources.of(cpu=500, memory=512)
+    assert (a + b).cpu == 1500
+    assert (a - b).memory == 512
+    assert b.fits(a)
+    assert not a.fits(b)
+    assert (b - a).any_negative()
+    assert (a - a).is_zero()
+
+
+def test_merge_and_roundtrip():
+    total = merge([Resources.of(cpu=100)] * 3)
+    assert total.cpu == 300
+    d = Resources.parse({"cpu": "2", "memory": "1Gi"}).to_dict()
+    assert d["cpu"] == 2.0
+    assert d["memory"] == 2**30
+
+
+def test_sort_key_ordering():
+    big = Resources.of(cpu=4000, memory=1024)
+    small = Resources.of(cpu=100, memory=8192)
+    assert big.sort_key() > small.sort_key()  # cpu-major

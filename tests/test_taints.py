@@ -1,0 +1,40 @@
+from karpenter_tpu.models import Taint, Toleration
+from karpenter_tpu.models.taints import (
+    NO_EXECUTE,
+    NO_SCHEDULE,
+    PREFER_NO_SCHEDULE,
+    tolerates_all,
+    untolerated,
+)
+
+
+def test_equal_toleration():
+    t = Taint("team", "ml", NO_SCHEDULE)
+    assert Toleration(key="team", operator="Equal", value="ml").tolerates(t)
+    assert not Toleration(key="team", operator="Equal", value="web").tolerates(t)
+
+
+def test_exists_toleration():
+    t = Taint("team", "ml", NO_SCHEDULE)
+    assert Toleration(key="team", operator="Exists").tolerates(t)
+    assert Toleration(key="", operator="Exists").tolerates(t)  # tolerate-everything
+    assert not Toleration(key="", operator="Equal").tolerates(t)
+
+
+def test_effect_scoping():
+    t = Taint("k", "v", NO_EXECUTE)
+    assert Toleration(key="k", operator="Exists", effect=NO_EXECUTE).tolerates(t)
+    assert not Toleration(key="k", operator="Exists", effect=NO_SCHEDULE).tolerates(t)
+    assert Toleration(key="k", operator="Exists").tolerates(t)  # "" = all effects
+
+
+def test_prefer_no_schedule_is_soft():
+    taints = [Taint("k", "v", PREFER_NO_SCHEDULE)]
+    assert tolerates_all(taints, [])
+
+
+def test_untolerated():
+    taints = [Taint("a", "1"), Taint("b", "2")]
+    tols = [Toleration(key="a", operator="Exists")]
+    assert not tolerates_all(taints, tols)
+    assert [t.key for t in untolerated(taints, tols)] == ["b"]
