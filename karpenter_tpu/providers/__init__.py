@@ -1,0 +1,20 @@
+"""Cloud-resource providers: catalog, instance types, pricing, fake cloud.
+
+Mirrors the provider layer of the reference (pkg/providers/*): each provider
+wraps one slice of cloud state behind caches, and the fake cloud backend
+replaces AWS for tests exactly the way pkg/fake does.
+"""
+
+from karpenter_tpu.providers.catalog import generate_catalog, CatalogSpec
+from karpenter_tpu.providers.pricing import PricingProvider
+from karpenter_tpu.providers.instancetype import InstanceTypeProvider
+from karpenter_tpu.providers.fake_cloud import FakeCloud, CloudInstance
+
+__all__ = [
+    "generate_catalog",
+    "CatalogSpec",
+    "PricingProvider",
+    "InstanceTypeProvider",
+    "FakeCloud",
+    "CloudInstance",
+]

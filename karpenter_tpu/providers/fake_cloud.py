@@ -1,0 +1,226 @@
+"""The fake cloud — an in-memory machine fleet API.
+
+This is both the test backend (the role of pkg/fake/ec2api.go: canned
+behaviors, call capture, error injection, per-pool insufficient-capacity
+simulation honored by CreateFleet, pkg/fake/ec2api.go:40-199) and, for now,
+the only cloud implementation. The CloudProvider seam talks to this
+interface; a real GCE/TPU-pool backend would implement the same methods.
+
+Semantics mirrored from the reference:
+  * create_fleet walks the ranked candidate list and launches the first
+    (type, zone, capacity_type) not in an insufficient-capacity pool,
+    returning per-pool errors for the ones it skipped
+    (pkg/fake/ec2api.go:112-199).
+  * instances carry tags; list/describe filters by tag — recovery after
+    restart is re-listing by tag, there is no other persistent state
+    (pkg/providers/instance/instance.go:140-160).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from karpenter_tpu.models.objects import InstanceType
+from karpenter_tpu.providers.catalog import CatalogSpec, generate_catalog
+from karpenter_tpu.utils.clock import Clock, RealClock
+
+# tag keys (reference: cluster-discovery tags on instances,
+# pkg/providers/instance/instance.go:140-160)
+TAG_CLUSTER = "karpenter.sh/discovery"
+TAG_NODEPOOL = "karpenter.sh/nodepool"
+TAG_NODECLAIM = "karpenter.sh/nodeclaim"
+TAG_NODECLASS = "karpenter.tpu/nodeclass"
+
+INSTANCE_RUNNING = "running"
+INSTANCE_TERMINATED = "terminated"
+
+
+class CloudAPIError(Exception):
+    pass
+
+
+@dataclass
+class FleetCandidate:
+    instance_type: str
+    zone: str
+    capacity_type: str
+    price: float
+
+
+@dataclass
+class CloudInstance:
+    instance_id: str
+    instance_type: str
+    zone: str
+    capacity_type: str
+    tags: Dict[str, str]
+    state: str = INSTANCE_RUNNING
+    launch_time: float = 0.0
+    interrupted: bool = False
+
+
+class FakeCloud:
+    def __init__(
+        self,
+        catalog: Optional[List[InstanceType]] = None,
+        clock: Optional[Clock] = None,
+        spec: Optional[CatalogSpec] = None,
+    ):
+        self.clock = clock or RealClock()
+        self._spec = spec or CatalogSpec()
+        self._catalog = catalog if catalog is not None else generate_catalog(self._spec)
+        self.catalog_seqnum = 1
+        self.zones = self._catalog_zones()
+        self._id_counter = itertools.count(1)
+        self.instances: Dict[str, CloudInstance] = {}
+        # fault injection (role of EC2Behavior: pkg/fake/ec2api.go:40-109)
+        self.insufficient_capacity_pools: Set[Tuple[str, str, str]] = set()
+        self.next_error: Optional[Exception] = None
+        self.api_calls: List[Tuple[str, object]] = []
+        self._alive = True
+        # interruption queue (EventBridge→SQS analogue)
+        self.interruption_queue: List[dict] = []
+
+    def _catalog_zones(self) -> List[str]:
+        """Zones are derived from the catalog's offerings (not the spec) so an
+        explicitly supplied catalog defines the cloud's geography."""
+        zones = sorted({o.zone for it in self._catalog for o in it.offerings})
+        return zones or list(self._spec.zones)
+
+    # -- behavior controls (tests) --------------------------------------
+    def set_catalog(self, catalog: List[InstanceType]) -> None:
+        self._catalog = catalog
+        self.zones = self._catalog_zones()
+        self.catalog_seqnum += 1
+
+    def fail_next(self, err: Exception) -> None:
+        self.next_error = err
+
+    def set_alive(self, alive: bool) -> None:
+        self._alive = alive
+
+    def _check_fault(self, api: str, arg: object = None) -> None:
+        self.api_calls.append((api, arg))
+        if not self._alive:
+            raise CloudAPIError(f"{api}: cloud unreachable")
+        if self.next_error is not None:
+            err, self.next_error = self.next_error, None
+            raise err
+
+    # -- catalog APIs ----------------------------------------------------
+    def describe_instance_types(self) -> List[InstanceType]:
+        self._check_fault("DescribeInstanceTypes")
+        return self._catalog
+
+    def live(self) -> bool:
+        return self._alive
+
+    # -- fleet APIs ------------------------------------------------------
+    def create_fleet(
+        self,
+        candidates: List[FleetCandidate],
+        tags: Dict[str, str],
+    ) -> Tuple[Optional[CloudInstance], List[Tuple[str, str, str]]]:
+        """Launch one instance from a ranked candidate list. Returns
+        (instance | None, ice_pools_hit). Walks candidates in order and
+        takes the first whose (capacity_type, type, zone) pool has capacity —
+        the single-instance analogue of CreateFleet type=instant with
+        price-capacity-optimized allocation over ranked overrides
+        (pkg/providers/instance/instance.go:203-259, pkg/fake/ec2api.go:112-199).
+        """
+        self._check_fault("CreateFleet", (candidates, tags))
+        ice: List[Tuple[str, str, str]] = []
+        for cand in candidates:
+            pool = (cand.capacity_type, cand.instance_type, cand.zone)
+            if pool in self.insufficient_capacity_pools:
+                ice.append(pool)
+                continue
+            inst = CloudInstance(
+                instance_id=f"i-{next(self._id_counter):08d}",
+                instance_type=cand.instance_type,
+                zone=cand.zone,
+                capacity_type=cand.capacity_type,
+                tags=dict(tags),
+                state=INSTANCE_RUNNING,
+                launch_time=self.clock.now(),
+            )
+            self.instances[inst.instance_id] = inst
+            return inst, ice
+        return None, ice
+
+    def describe_instances(
+        self,
+        tag_filter: Optional[Dict[str, str]] = None,
+        states: Tuple[str, ...] = (INSTANCE_RUNNING,),
+    ) -> List[CloudInstance]:
+        self._check_fault("DescribeInstances", tag_filter)
+        out = []
+        for inst in self.instances.values():
+            if inst.state not in states:
+                continue
+            if tag_filter and any(
+                inst.tags.get(k) != v for k, v in tag_filter.items()
+            ):
+                continue
+            out.append(inst)
+        return out
+
+    def get_instance(self, instance_id: str) -> Optional[CloudInstance]:
+        self._check_fault("GetInstance", instance_id)
+        return self.instances.get(instance_id)
+
+    def terminate_instances(self, instance_ids: List[str]) -> List[str]:
+        """Returns the ids actually terminated; unknown ids are skipped
+        (NotFound is a success for delete — pkg/errors/errors.go:57-100)."""
+        self._check_fault("TerminateInstances", instance_ids)
+        done = []
+        for iid in instance_ids:
+            inst = self.instances.get(iid)
+            if inst is not None and inst.state != INSTANCE_TERMINATED:
+                inst.state = INSTANCE_TERMINATED
+                done.append(iid)
+        return done
+
+    def create_tags(self, instance_id: str, tags: Dict[str, str]) -> bool:
+        self._check_fault("CreateTags", (instance_id, tags))
+        inst = self.instances.get(instance_id)
+        if inst is None:
+            return False
+        inst.tags.update(tags)
+        return True
+
+    # -- interruption (EventBridge→SQS analogue) -------------------------
+    def interrupt_spot(self, instance_id: str) -> None:
+        """Simulate a spot interruption warning for tests/chaos."""
+        inst = self.instances.get(instance_id)
+        if inst is None:
+            return
+        inst.interrupted = True
+        self.interruption_queue.append({
+            "kind": "spot_interruption",
+            "instance_id": instance_id,
+            "time": self.clock.now(),
+        })
+
+    def send_state_change(self, instance_id: str, state: str) -> None:
+        self.interruption_queue.append({
+            "kind": "state_change",
+            "instance_id": instance_id,
+            "state": state,
+            "time": self.clock.now(),
+        })
+
+    def receive_messages(self, max_messages: int = 20) -> List[dict]:
+        """Long-poll receive (pkg/providers/sqs/sqs.go:53-73)."""
+        self._check_fault("ReceiveMessages")
+        out = self.interruption_queue[:max_messages]
+        return out
+
+    def delete_message(self, msg: dict) -> None:
+        self._check_fault("DeleteMessage")
+        try:
+            self.interruption_queue.remove(msg)
+        except ValueError:
+            pass

@@ -1,0 +1,101 @@
+"""Instance-type provider — the hot-path input.
+
+Builds `List[InstanceType]` per NodeClass with per-offering price and
+availability, behind a composite cache key that folds in every upstream
+seqnum, mirroring pkg/providers/instancetype/instancetype.go:100-175 (List +
+the cache-key discipline at :127-136: nodeclass hash ⊕ unavailable-offerings
+seqnum ⊕ pricing seqnum ⊕ catalog seqnum). A change anywhere upstream — an
+ICE marking, a price refresh, a catalog update — invalidates exactly the
+affected entries; otherwise the same list object is returned so the solver's
+device-resident encoding can be reused call-over-call.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, TYPE_CHECKING
+
+from karpenter_tpu.models import wellknown
+from karpenter_tpu.models.objects import InstanceType, NodeClass, Offering
+from karpenter_tpu.providers.pricing import PricingProvider
+from karpenter_tpu.utils.cache import (
+    INSTANCE_TYPES_ZONES_TTL,
+    TTLCache,
+    UnavailableOfferings,
+)
+from karpenter_tpu.utils.clock import Clock
+
+if TYPE_CHECKING:
+    from karpenter_tpu.providers.fake_cloud import FakeCloud
+
+
+class InstanceTypeProvider:
+    def __init__(
+        self,
+        cloud: "FakeCloud",
+        pricing: PricingProvider,
+        unavailable: UnavailableOfferings,
+        clock: Optional[Clock] = None,
+    ):
+        self._cloud = cloud
+        self.pricing = pricing
+        self.unavailable = unavailable
+        self._cache = TTLCache(ttl=INSTANCE_TYPES_ZONES_TTL, clock=clock)
+
+    def _cache_key(self, node_class: NodeClass) -> tuple:
+        return (
+            node_class.name,
+            node_class.static_hash(),
+            self.unavailable.seqnum,
+            self.pricing.seqnum,
+            self._cloud.catalog_seqnum,
+        )
+
+    def list(self, node_class: NodeClass) -> List[InstanceType]:
+        key = self._cache_key(node_class)
+        # cache is keyed by nodeclass name, validated by the composite key, so
+        # superseded entries are replaced rather than orphaned (a seqnum bump
+        # per ICE/price change would otherwise leak one full catalog each)
+        cached = self._cache.get(node_class.name)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+
+        zones = set(node_class.zones or self._cloud.zones)
+        families = set(node_class.instance_families or [])
+        cap_types = set(node_class.capacity_types)
+
+        out: List[InstanceType] = []
+        for shape in self._cloud.describe_instance_types():
+            if families:
+                fam = shape.requirements.get(wellknown.INSTANCE_FAMILY_LABEL)
+                # unlabeled shapes are excluded: a family restriction is a
+                # whitelist, not a hint
+                if fam is None or not (fam.values() & families):
+                    continue
+            offerings = []
+            for o in shape.offerings:
+                if o.zone not in zones or o.capacity_type not in cap_types:
+                    continue
+                price = self.pricing.price(shape.name, o.zone, o.capacity_type)
+                offerings.append(Offering(
+                    zone=o.zone,
+                    capacity_type=o.capacity_type,
+                    price=price if price is not None else o.price,
+                    available=not self.unavailable.is_unavailable(
+                        o.capacity_type, shape.name, o.zone),
+                ))
+            if not offerings:
+                continue
+            out.append(InstanceType(
+                name=shape.name,
+                capacity=shape.capacity,
+                requirements=shape.requirements,
+                offerings=offerings,
+                overhead=shape.overhead,
+            ))
+        self._cache.set(node_class.name, (key, out))
+        return out
+
+    def live(self) -> bool:
+        """Liveness aggregation (reference: instancetype.go:177-182 folds
+        subnet+pricing liveness into the cloudprovider probe)."""
+        return self.pricing.live() and self._cloud.live()

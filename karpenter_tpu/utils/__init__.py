@@ -1,0 +1,6 @@
+"""Shared runtime utilities: clocks, TTL caches, the ICE feedback cache."""
+
+from karpenter_tpu.utils.clock import Clock, FakeClock, RealClock
+from karpenter_tpu.utils.cache import TTLCache, UnavailableOfferings
+
+__all__ = ["Clock", "FakeClock", "RealClock", "TTLCache", "UnavailableOfferings"]

@@ -1,0 +1,209 @@
+import pytest
+
+from karpenter_tpu.models import NodeClass, ObjectMeta, Resources, wellknown
+from karpenter_tpu.providers import (
+    FakeCloud,
+    InstanceTypeProvider,
+    PricingProvider,
+    generate_catalog,
+)
+from karpenter_tpu.providers.catalog import CatalogSpec
+from karpenter_tpu.providers.fake_cloud import (
+    CloudAPIError,
+    FleetCandidate,
+    INSTANCE_TERMINATED,
+)
+from karpenter_tpu.utils import FakeClock, UnavailableOfferings
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture
+def cloud(clock):
+    return FakeCloud(clock=clock)
+
+
+@pytest.fixture
+def provider(cloud, clock):
+    pricing = PricingProvider(cloud)
+    unavailable = UnavailableOfferings(clock=clock)
+    return InstanceTypeProvider(cloud, pricing, unavailable, clock=clock)
+
+
+class TestCatalog:
+    def test_size_and_determinism(self):
+        cat = generate_catalog()
+        # realistically sized fleet, ~700 types like EC2's catalog
+        assert 600 <= len(cat) <= 900
+        cat2 = generate_catalog()
+        assert [it.name for it in cat] == [it.name for it in cat2]
+        assert cat[0].offerings[0].price == cat2[0].offerings[0].price
+
+    def test_shapes(self):
+        cat = {it.name: it for it in generate_catalog()}
+        m = cat["m6.2xlarge"]
+        assert m.capacity.cpu == 8000
+        assert 8 * 4 * 1024 * 0.9 < m.capacity.memory < 8 * 4 * 1024  # vm overhead applied
+        assert m.capacity.pods == 58
+        alloc = m.allocatable()
+        assert alloc.cpu < m.capacity.cpu  # kube-reserved subtracted
+        # 3 zones × {spot, od}
+        assert len(m.offerings) == 6
+        spot = [o for o in m.offerings if o.capacity_type == "spot"]
+        od = [o for o in m.offerings if o.capacity_type == "on-demand"]
+        assert all(s.price < min(o.price for o in od) for s in spot)
+
+    def test_labels_and_requirements(self):
+        cat = {it.name: it for it in generate_catalog()}
+        g = cat["g5.xlarge"]
+        assert g.capacity.get("gpu") == 1
+        assert g.requirements.get(wellknown.INSTANCE_GPU_NAME_LABEL).values() == {"a10g"}
+        arm = cat["m6g.large"]
+        assert arm.requirements.get(wellknown.ARCH_LABEL).values() == {"arm64"}
+        assert cat["m6.large"].requirements.get(wellknown.ZONE_LABEL).values() == {
+            "tpu-west-1a", "tpu-west-1b", "tpu-west-1c"}
+
+    def test_shrunk_catalog(self):
+        assert len(generate_catalog(CatalogSpec(max_types=30))) == 30
+
+
+class TestInstanceTypeProvider:
+    def test_list_caches_until_seqnum_changes(self, provider):
+        nc = NodeClass(meta=ObjectMeta(name="default"))
+        a = provider.list(nc)
+        assert a is provider.list(nc)  # same object: cache hit
+        provider.unavailable.mark_unavailable("spot", a[0].name, "tpu-west-1a")
+        b = provider.list(nc)
+        assert b is not a
+        off = [o for o in next(it for it in b if it.name == a[0].name).offerings
+               if o.capacity_type == "spot" and o.zone == "tpu-west-1a"]
+        assert off and not off[0].available
+
+    def test_zone_filtering(self, provider):
+        nc = NodeClass(meta=ObjectMeta(name="z"), zones=["tpu-west-1b"])
+        types = provider.list(nc)
+        assert types
+        for it in types:
+            assert {o.zone for o in it.offerings} == {"tpu-west-1b"}
+
+    def test_family_filtering(self, provider):
+        nc = NodeClass(meta=ObjectMeta(name="fam"), instance_families=["m6", "c6"])
+        types = provider.list(nc)
+        assert types
+        assert {it.name.split(".")[0] for it in types} == {"m6", "c6"}
+
+    def test_capacity_type_filtering(self, provider):
+        nc = NodeClass(meta=ObjectMeta(name="od"), capacity_types=["on-demand"])
+        types = provider.list(nc)
+        assert all(o.capacity_type == "on-demand" for it in types for o in it.offerings)
+
+    def test_ttl_expiry(self, provider, clock):
+        nc = NodeClass(meta=ObjectMeta(name="default"))
+        a = provider.list(nc)
+        clock.step(301)
+        assert provider.list(nc) is not a
+
+
+class TestFakeCloud:
+    def test_create_fleet_honors_ice_pools(self, cloud):
+        cloud.insufficient_capacity_pools.add(("spot", "m6.large", "tpu-west-1a"))
+        inst, ice = cloud.create_fleet(
+            [FleetCandidate("m6.large", "tpu-west-1a", "spot", 0.02),
+             FleetCandidate("m6.large", "tpu-west-1b", "spot", 0.021)],
+            tags={"karpenter.sh/nodeclaim": "nc-1"},
+        )
+        assert inst is not None and inst.zone == "tpu-west-1b"
+        assert ice == [("spot", "m6.large", "tpu-west-1a")]
+
+    def test_create_fleet_all_ice(self, cloud):
+        cloud.insufficient_capacity_pools.add(("spot", "m6.large", "tpu-west-1a"))
+        inst, ice = cloud.create_fleet(
+            [FleetCandidate("m6.large", "tpu-west-1a", "spot", 0.02)], tags={})
+        assert inst is None and len(ice) == 1
+
+    def test_describe_by_tag_and_terminate(self, cloud):
+        inst, _ = cloud.create_fleet(
+            [FleetCandidate("m6.large", "tpu-west-1a", "on-demand", 0.1)],
+            tags={"karpenter.sh/nodepool": "np"},
+        )
+        assert [i.instance_id for i in cloud.describe_instances(
+            tag_filter={"karpenter.sh/nodepool": "np"})] == [inst.instance_id]
+        assert cloud.terminate_instances([inst.instance_id, "i-missing"]) == [inst.instance_id]
+        assert cloud.instances[inst.instance_id].state == INSTANCE_TERMINATED
+        assert cloud.describe_instances(
+            tag_filter={"karpenter.sh/nodepool": "np"}) == []
+
+    def test_fault_injection(self, cloud):
+        cloud.fail_next(CloudAPIError("throttled"))
+        with pytest.raises(CloudAPIError):
+            cloud.describe_instance_types()
+        cloud.describe_instance_types()  # next call succeeds
+
+    def test_interruption_queue(self, cloud):
+        inst, _ = cloud.create_fleet(
+            [FleetCandidate("m6.large", "tpu-west-1a", "spot", 0.02)], tags={})
+        cloud.interrupt_spot(inst.instance_id)
+        msgs = cloud.receive_messages()
+        assert msgs[0]["kind"] == "spot_interruption"
+        cloud.delete_message(msgs[0])
+        assert cloud.receive_messages() == []
+
+
+class TestPricing:
+    def test_prices_and_seqnum(self, cloud):
+        pricing = PricingProvider(cloud)
+        assert pricing.live()
+        p = pricing.on_demand_price("m6.large", "tpu-west-1a")
+        s = pricing.spot_price("m6.large", "tpu-west-1a")
+        assert p and s and s < p
+        seq = pricing.seqnum
+        assert not pricing.update()  # no change
+        assert pricing.seqnum == seq
+
+
+def test_ice_expiry_restores_availability(provider, clock):
+    """Regression: ICE entries aging out must invalidate the instance-type
+    cache (seqnum bump on eviction), restoring offering availability."""
+    nc = NodeClass(meta=ObjectMeta(name="default"))
+    provider.unavailable.mark_unavailable("spot", "c7.large", "tpu-west-1a")
+    types = provider.list(nc)
+    c7 = next(it for it in types if it.name == "c7.large")
+    assert any(not o.available for o in c7.offerings)
+    clock.step(181)  # past the 3-min ICE TTL
+    types = provider.list(nc)
+    c7 = next(it for it in types if it.name == "c7.large")
+    assert all(o.available for o in c7.offerings)
+
+
+def test_custom_catalog_defines_zones(clock):
+    """Regression: an explicitly supplied catalog defines the cloud's zones."""
+    cat = generate_catalog(CatalogSpec(zones=["moon-1a"], max_types=10))
+    cloud = FakeCloud(catalog=cat, clock=clock)
+    assert cloud.zones == ["moon-1a"]
+    prov = InstanceTypeProvider(cloud, PricingProvider(cloud),
+                                UnavailableOfferings(clock=clock), clock=clock)
+    types = prov.list(NodeClass(meta=ObjectMeta(name="d")))
+    assert types and all(o.zone == "moon-1a" for it in types for o in it.offerings)
+
+
+def test_instance_ids_deterministic_per_cloud(clock):
+    """Regression: id counter is per-FakeCloud, not process-global."""
+    ids = []
+    for _ in range(2):
+        c = FakeCloud(clock=clock, spec=CatalogSpec(max_types=5))
+        inst, _ = c.create_fleet(
+            [FleetCandidate("c4.2xlarge", "tpu-west-1a", "on-demand", 0.1)], tags={})
+        ids.append(inst.instance_id)
+    assert ids[0] == ids[1] == "i-00000001"
+
+
+def test_itp_cache_bounded(provider):
+    """Regression: seqnum churn replaces cache entries instead of leaking them."""
+    nc = NodeClass(meta=ObjectMeta(name="default"))
+    for i in range(5):
+        provider.unavailable.mark_unavailable("spot", f"fake-{i}", "tpu-west-1a")
+        provider.list(nc)
+    assert len(provider._cache._items) == 1
