@@ -117,6 +117,23 @@ class Resources:
         return r
 
     @classmethod
+    def limits(cls, spec: "Mapping[str, str | int | float] | None" = None,
+               **kw: float) -> "Resources":
+        """A limits vector: axes not named are unconstrained (+inf), so a
+        cpu-only NodePool limit doesn't implicitly zero out memory
+        (reference: NodePool.spec.limits constrains only listed resources).
+        Named axes may be zero to forbid a resource entirely.
+        """
+        r = cls([float("inf")] * len(RESOURCE_AXIS))
+        if spec:
+            for name, q in spec.items():
+                canon = _ALIASES.get(name, name)
+                r.v[AXIS_INDEX[canon]] = _to_solver_units(canon, parse_quantity(q))
+        for name, val in kw.items():
+            r.v[AXIS_INDEX[name.replace("_", "-")]] = float(val)
+        return r
+
+    @classmethod
     def of(cls, **kw: float) -> "Resources":
         """From solver units directly: Resources.of(cpu=2000, memory=4096)."""
         r = cls()

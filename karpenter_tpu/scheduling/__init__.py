@@ -1,0 +1,25 @@
+"""Scheduling: shared semantics + the CPU oracle scheduler.
+
+The oracle (`karpenter_tpu.scheduling.oracle`) is the reference FFD
+bin-packer — the role the Go scheduler plays in the reference
+(sigs.k8s.io/karpenter provisioning/scheduling; algorithm per
+designs/bin-packing.md). It is the feature-gated fallback when the TPU
+solver is off or unreachable, and the parity oracle the TPU solver is
+tested against (node count ≤ oracle, constraint-validity ==).
+"""
+
+from karpenter_tpu.scheduling.types import (
+    ExistingNode,
+    NewNodeClaim,
+    ScheduleInput,
+    ScheduleResult,
+)
+from karpenter_tpu.scheduling.oracle import Scheduler
+
+__all__ = [
+    "ExistingNode",
+    "NewNodeClaim",
+    "ScheduleInput",
+    "ScheduleResult",
+    "Scheduler",
+]

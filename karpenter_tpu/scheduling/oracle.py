@@ -1,0 +1,483 @@
+"""The CPU oracle scheduler — first-fit-decreasing with Karpenter semantics.
+
+Algorithm (reference: designs/bin-packing.md:28-42 + core scheduler behavior
+per SURVEY §2.2):
+  1. Sort pending pods by requested resources, non-increasing (cpu-major).
+  2. Per pod: try existing cluster nodes, then in-flight simulated nodes
+     opened earlier in this solve, then open a new simulated node from the
+     highest-weight compatible NodePool.
+  3. A new sim-node starts with every instance type that is compatible with
+     (template ∩ pod) requirements, fits the pod plus daemonset overhead, and
+     has an available offering; each later pod added to the node re-filters
+     that candidate list (so the node's type set only narrows).
+  4. At the end each sim-node ranks its surviving types cheapest-offering
+     first — the NodeClaim's ranked launch list.
+
+Topology spread, pod (anti-)affinity, taints, and NodePool weight/limits are
+honored; `minValues` is enforced at finalize. This implementation is the
+correctness reference and the fallback path; the TPU solver replicates its
+decisions in tensor form (solver-unavailable ⇒ fall back here, never fail
+provisioning — SURVEY §5 failure-detection).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Set, Tuple
+
+from karpenter_tpu.models import wellknown
+from karpenter_tpu.models.objects import InstanceType, NodePool, Pod
+from karpenter_tpu.models.requirements import Requirement, Requirements
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.models.taints import tolerates_all, untolerated
+from karpenter_tpu.scheduling.topology import TopologyTracker, node_domains_for
+from karpenter_tpu.scheduling.types import (
+    ExistingNode,
+    NewNodeClaim,
+    ScheduleInput,
+    ScheduleResult,
+)
+
+_sim_counter = itertools.count(1)
+
+# topology keys the scheduler narrows on new nodes (hostname is always
+# per-node-unique and handled separately)
+_NARROWABLE_KEYS = (wellknown.ZONE_LABEL, wellknown.CAPACITY_TYPE_LABEL)
+
+
+def _effective_requests(pod: Pod) -> Resources:
+    r = pod.requests.copy()
+    r.set("pods", r.get("pods") + 1.0)  # every pod consumes one pod slot
+    return r
+
+
+class _ExistingSim:
+    def __init__(self, en: ExistingNode):
+        self.en = en
+        self.remaining = en.available.copy()
+        self.hostname = en.node.name
+        self.domains = node_domains_for(en.node.labels, en.node.name)
+        # pod equivalence classes that failed against this node since its
+        # last mutation — identical pods skip the full re-check (the same
+        # memoization the reference gets from batching identical pods)
+        self.failed_keys: set = set()
+
+    @property
+    def name(self) -> str:
+        return self.en.name
+
+
+class _NewSim:
+    def __init__(
+        self,
+        pool: NodePool,
+        requirements: Requirements,
+        candidates: List[InstanceType],
+        daemon_overhead: Resources,
+    ):
+        self.pool = pool
+        self.requirements = requirements
+        self.candidates = candidates
+        self.requests = daemon_overhead.copy()
+        self.pods: List[Pod] = []
+        self.failed_keys: set = set()
+        self.last_key = None  # scheduling key of the last pod added
+        self.hostname = f"new-node-{next(_sim_counter)}"
+        # topology domains already determined for this node
+        self.domains: Dict[str, str] = {
+            wellknown.HOSTNAME_LABEL: self.hostname,
+            wellknown.NODEPOOL_LABEL: pool.name,
+        }
+        self._sync_fixed_domains()
+
+    def _sync_fixed_domains(self) -> None:
+        """A requirement narrowed to a single value fixes that domain."""
+        for key in _NARROWABLE_KEYS:
+            req = self.requirements.get(key)
+            if req is not None and req.is_finite() and len(req.values()) == 1:
+                (v,) = req.values()
+                self.domains[key] = v
+
+    def finite_values(self, key: str, fallback: Set[str]) -> Set[str]:
+        req = self.requirements.get(key)
+        if req is not None and req.is_finite():
+            return set(req.values())
+        return set(fallback)
+
+
+class Scheduler:
+    def __init__(self, inp: ScheduleInput):
+        self.inp = inp
+        self.tracker = TopologyTracker()
+        self.existing = [_ExistingSim(en) for en in inp.existing_nodes]
+        self.new_sims: List[_NewSim] = []
+        self.result = ScheduleResult()
+        self._remaining_limits: Dict[str, Optional[Resources]] = {
+            np.name: (inp.remaining_limits.get(np.name).copy()
+                      if inp.remaining_limits.get(np.name) is not None else None)
+            for np in inp.nodepools
+        }
+        # seed topology state from resident pods and cluster geography
+        for sim in self.existing:
+            for pod in sim.en.pods:
+                self.tracker.register(pod, sim.domains)
+        zones: Set[str] = set()
+        for types in inp.instance_types.values():
+            for it in types:
+                for o in it.offerings:
+                    if o.available:
+                        zones.add(o.zone)
+        self.tracker.observe_domains(wellknown.ZONE_LABEL, zones)
+        self.tracker.observe_domains(
+            wellknown.CAPACITY_TYPE_LABEL,
+            {o.capacity_type for types in inp.instance_types.values()
+             for it in types for o in it.offerings if o.available})
+        self._all_zones = zones
+
+    # ------------------------------------------------------------------
+    def solve(self) -> ScheduleResult:
+        pods = sorted(
+            self.inp.pods,
+            key=lambda p: (p.requests.sort_key(), p.meta.name),
+            reverse=True,
+        )
+        for pod in pods:
+            self._schedule_one(pod)
+        self._finalize()
+        return self.result
+
+    # ------------------------------------------------------------------
+    def _schedule_one(self, pod: Pod) -> None:
+        req = _effective_requests(pod)
+        key = pod.scheduling_key()
+        # topology-sensitive pods can't reuse failure memos: the tracker
+        # state they were checked against changes with every placement
+        stateful = bool(pod.topology_spread or pod.pod_affinities
+                        or self.tracker.anti_topology_keys())
+
+        # negative memos stay valid across placements: capacity only shrinks
+        # and requirements only narrow, so a failed class can only fail harder
+        for sim in self.existing:
+            if not stateful and key in sim.failed_keys:
+                continue
+            if self._fits_existing(pod, req, sim):
+                sim.remaining = sim.remaining - req
+                self.result.existing_assignments[pod.meta.name] = sim.name
+                self.tracker.register(pod, sim.domains)
+                return
+            sim.failed_keys.add(key)
+
+        for sim in self.new_sims:
+            if not stateful and key in sim.failed_keys:
+                continue
+            if self._try_add_to_new(pod, req, sim, commit=True):
+                return
+            sim.failed_keys.add(key)
+
+        reason = self._open_new(pod, req)
+        if reason is not None:
+            self.result.unschedulable[pod.meta.name] = reason
+
+    # -- existing nodes --------------------------------------------------
+    def _fits_existing(self, pod: Pod, req: Resources, sim: _ExistingSim) -> bool:
+        node = sim.en.node
+        if node.meta.deleting or not node.ready:
+            return False
+        if not tolerates_all(node.taints, pod.tolerations):
+            return False
+        if not pod.requirements.matched_by_labels(node.labels):
+            return False
+        if not req.fits(sim.remaining):
+            return False
+        return self._topology_ok_fixed(pod, sim.domains, sim)
+
+    def _topology_ok_fixed(self, pod: Pod, domains: Dict[str, str],
+                           sim: object) -> bool:
+        """Topology checks when every relevant domain is already determined
+        (existing nodes, or new sims whose keys are narrowed)."""
+        for c in pod.topology_spread:
+            if c.when_unsatisfiable != "DoNotSchedule":
+                continue  # ScheduleAnyway is best-effort, never blocks
+            d = domains.get(c.topology_key)
+            if d is None:
+                return False  # DoNotSchedule requires the topology key
+            if d not in self.tracker.spread_allowed_domains(pod, c, {d}):
+                return False
+        return self._affinity_ok(pod, domains)
+
+    def _affinity_ok(self, pod: Pod, domains: Dict[str, str]) -> bool:
+        for term in pod.pod_affinities:
+            if not term.required:
+                continue
+            d = domains.get(term.topology_key)
+            if d is None:
+                return False
+            if term.anti:
+                if d in self.tracker.anti_affinity_blocked_domains(
+                        pod, term.topology_key, term.label_selector):
+                    return False
+            else:
+                if d not in self.tracker.affinity_allowed_domains(
+                        pod, {d}, term.topology_key, term.label_selector):
+                    return False
+        # symmetry: placed pods' anti-affinity blocks this pod
+        for tkey in self.tracker.anti_topology_keys():
+            d = domains.get(tkey)
+            if d is not None and d in self.tracker.symmetric_anti_blocked_domains(pod, tkey):
+                return False
+        return True
+
+    # -- in-flight new nodes ---------------------------------------------
+    def _try_add_to_new(self, pod: Pod, req: Resources, sim: _NewSim,
+                        commit: bool) -> bool:
+        key = pod.scheduling_key()
+        stateful = bool(pod.topology_spread or pod.pod_affinities
+                        or self.tracker.anti_topology_keys())
+        total = sim.requests + req
+        limit = self._remaining_limits.get(sim.pool.name)
+        if limit is not None and not req.fits(limit):
+            return False
+
+        if key == sim.last_key and not stateful:
+            # identical pod, no topology state: requirements can't change,
+            # only capacity can — re-check fit alone
+            merged = sim.requirements
+            survivors = [it for it in sim.candidates
+                         if total.fits(it.allocatable())]
+            if not survivors:
+                return False
+        else:
+            if not tolerates_all(sim.pool.taints, pod.tolerations):
+                return False
+            if not sim.requirements.compatible(pod.requirements):
+                return False
+            merged = sim.requirements.intersection(pod.requirements)
+            survivors = self._filter_types(sim.candidates, merged, total)
+            if not survivors:
+                return False
+            narrowed = self._resolve_topology(pod, sim, merged, survivors)
+            if narrowed is None:
+                return False
+            merged, survivors = narrowed
+
+        if not commit:
+            return True
+
+        sim.requirements = merged
+        sim.candidates = survivors
+        sim.requests = total
+        sim.pods.append(pod)
+        sim.last_key = key
+        sim._sync_fixed_domains()
+        self.tracker.register(pod, sim.domains)
+        if limit is not None:
+            self._remaining_limits[sim.pool.name] = limit - req
+        return True
+
+    def _resolve_topology(
+        self, pod: Pod, sim: _NewSim, merged: Requirements,
+        survivors: List[InstanceType],
+    ) -> Optional[Tuple[Requirements, List[InstanceType]]]:
+        """Check spread/affinity for a candidate placement on a new node,
+        narrowing the claim's zone/capacity-type requirement when a
+        constraint forces a single domain. Returns updated (requirements,
+        candidates) or None if no domain works.
+        """
+        # start from the claim's currently-possible domains per key
+        offer_zones = {o.zone for it in survivors for o in it.offerings if o.available}
+        offer_cts = {o.capacity_type for it in survivors for o in it.offerings if o.available}
+        possible: Dict[str, Set[str]] = {
+            wellknown.ZONE_LABEL: sim.finite_values(wellknown.ZONE_LABEL, offer_zones) & offer_zones,
+            wellknown.CAPACITY_TYPE_LABEL: sim.finite_values(
+                wellknown.CAPACITY_TYPE_LABEL, offer_cts) & offer_cts,
+            wellknown.HOSTNAME_LABEL: {sim.hostname},
+            wellknown.NODEPOOL_LABEL: {sim.pool.name},
+        }
+        for key in _NARROWABLE_KEYS:
+            preq = merged.get(key)
+            if preq is not None:
+                # filter by the requirement whatever its form — a complement
+                # (NotIn/Gt/Lt) must also exclude domains, or spread could
+                # pin the claim to a forbidden zone
+                possible[key] = {d for d in possible[key] if preq.matches(d)}
+            if not possible[key]:
+                return None
+
+        constrained_keys: Set[str] = set()
+        for c in pod.topology_spread:
+            if c.when_unsatisfiable != "DoNotSchedule":
+                continue  # best-effort
+            key = c.topology_key
+            if key not in possible:
+                return None  # unknown topology key on a new node
+            allowed = self.tracker.spread_allowed_domains(pod, c, possible[key])
+            if not allowed:
+                return None
+            possible[key] = allowed
+            if key != wellknown.HOSTNAME_LABEL:
+                constrained_keys.add(key)
+        for term in pod.pod_affinities:
+            if not term.required:
+                continue
+            key = term.topology_key
+            if key not in possible:
+                return None
+            if term.anti:
+                blocked = self.tracker.anti_affinity_blocked_domains(
+                    pod, key, term.label_selector)
+                # a new sim node holding a matching pod blocks via register()
+                allowed = possible[key] - blocked
+            else:
+                allowed = self.tracker.affinity_allowed_domains(
+                    pod, possible[key], key, term.label_selector)
+            if not allowed:
+                return None
+            possible[key] = allowed
+            if key != wellknown.HOSTNAME_LABEL:
+                constrained_keys.add(key)
+        for tkey in self.tracker.anti_topology_keys():
+            if tkey in possible:
+                blocked = self.tracker.symmetric_anti_blocked_domains(pod, tkey)
+                remaining = possible[tkey] - blocked
+                if not remaining:
+                    return None
+                if remaining != possible[tkey]:
+                    possible[tkey] = remaining
+                    if tkey != wellknown.HOSTNAME_LABEL:
+                        constrained_keys.add(tkey)
+
+        # narrow the claim where a constraint engaged: pick the least-loaded
+        # allowed domain so spreading continues to balance
+        out_reqs = merged
+        for key in constrained_keys & set(_NARROWABLE_KEYS):
+            cur = out_reqs.get(key)
+            if cur is not None and cur.is_finite() and cur.values() <= possible[key] \
+                    and len(cur.values()) == 1:
+                continue  # already pinned to an allowed domain
+            counts = None
+            for c in pod.topology_spread:
+                if c.topology_key == key:
+                    counts = self.tracker.ensure_spread_counter(c)
+                    break
+            chosen = min(
+                sorted(possible[key]),
+                key=lambda d: (counts.get(d, 0) if counts is not None else 0, d),
+            )
+            out_reqs = out_reqs.intersection(
+                Requirements(Requirement.make(key, "In", chosen)))
+
+        survivors = self._filter_types(survivors, out_reqs, None)
+        if not survivors:
+            return None
+        return out_reqs, survivors
+
+    # -- opening a new node ----------------------------------------------
+    def _open_new(self, pod: Pod, req: Resources) -> Optional[str]:
+        reasons: List[str] = []
+        pools = sorted(self.inp.nodepools,
+                       key=lambda np: (-np.weight, np.meta.name))
+        for pool in pools:
+            types = self.inp.instance_types.get(pool.name, [])
+            if not types:
+                reasons.append(f"nodepool {pool.name}: no instance types")
+                continue
+            if not tolerates_all(pool.taints, pod.tolerations):
+                reasons.append(f"nodepool {pool.name}: taints not tolerated")
+                continue
+            template = pool.template_requirements()
+            if not template.compatible(pod.requirements):
+                key = template.conflict_key(pod.requirements)
+                reasons.append(f"nodepool {pool.name}: incompatible on {key}")
+                continue
+            merged = template.intersection(pod.requirements)
+            daemon = self.inp.daemon_overhead.get(pool.name, Resources())
+            total = daemon + req
+            limit = self._remaining_limits.get(pool.name)
+            # a new node charges pod + daemonset overhead against the limit
+            if limit is not None and not total.fits(limit):
+                reasons.append(f"nodepool {pool.name}: limits exceeded")
+                continue
+            survivors = self._filter_types(types, merged, total)
+            if not survivors:
+                reasons.append(
+                    f"nodepool {pool.name}: no instance type fits/compatible")
+                continue
+            sim = _NewSim(pool, merged, survivors, daemon)
+            narrowed = self._resolve_topology(pod, sim, merged, survivors)
+            if narrowed is None:
+                reasons.append(f"nodepool {pool.name}: topology unsatisfiable")
+                continue
+            sim.requirements, sim.candidates = narrowed
+            sim.requests = total
+            sim.pods.append(pod)
+            sim._sync_fixed_domains()
+            self.new_sims.append(sim)
+            self.tracker.register(pod, sim.domains)
+            if limit is not None:
+                self._remaining_limits[pool.name] = limit - total
+            return None
+        detail = "; ".join(reasons) if reasons else "no nodepools configured"
+        return f"no nodepool can schedule pod: {detail}"
+
+    # -- shared filters ---------------------------------------------------
+    @staticmethod
+    def _filter_types(
+        types: List[InstanceType],
+        reqs: Requirements,
+        total_requests: Optional[Resources],
+    ) -> List[InstanceType]:
+        out = []
+        for it in types:
+            if not it.requirements.compatible(reqs):
+                continue
+            if total_requests is not None and not total_requests.fits(it.allocatable()):
+                continue
+            if not it.available_offerings(reqs):
+                continue
+            out.append(it)
+        return out
+
+    # -- finalize ----------------------------------------------------------
+    def _finalize(self) -> None:
+        for sim in self.new_sims:
+            reqs = sim.requirements
+            ranked = sorted(
+                sim.candidates,
+                key=lambda it: (it.cheapest_offering(reqs).price, it.name),
+            )
+            violation = self._min_values_violation(reqs, ranked)
+            if violation is not None:
+                for pod in sim.pods:
+                    self.result.unschedulable[pod.meta.name] = violation
+                continue
+            cheapest = ranked[0].cheapest_offering(reqs)
+            self.result.new_claims.append(NewNodeClaim(
+                nodepool=sim.pool.name,
+                node_class_ref=sim.pool.node_class_ref,
+                requirements=reqs,
+                pods=list(sim.pods),
+                requests=sim.requests.copy(),
+                instance_type_names=[it.name for it in ranked],
+                price=cheapest.price,
+                taints=list(sim.pool.taints),
+                startup_taints=list(sim.pool.startup_taints),
+                hostname=sim.hostname,
+            ))
+
+    @staticmethod
+    def _min_values_violation(reqs: Requirements,
+                              types: List[InstanceType]) -> Optional[str]:
+        """NodePool minValues: the surviving type set must expose ≥ N
+        distinct values for the keyed label (nodepools.md:240-304)."""
+        for r in reqs:
+            if r.min_values is None:
+                continue
+            seen: Set[str] = set()
+            for it in types:
+                tr = it.requirements.get(r.key)
+                if tr is not None and tr.is_finite():
+                    seen |= tr.values()
+            if len(seen) < r.min_values:
+                return (f"minValues violated for {r.key}: "
+                        f"{len(seen)} < {r.min_values}")
+        return None

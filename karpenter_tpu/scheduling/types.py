@@ -1,0 +1,76 @@
+"""Scheduler input/output contracts — the `Solve(pods, stateNodes,
+instanceTypes)` seam (SURVEY §3.2) shared by the CPU oracle and the TPU
+solver so they are drop-in interchangeable behind the provisioner.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from karpenter_tpu.models.objects import InstanceType, Node, NodePool, Pod
+from karpenter_tpu.models.requirements import Requirements
+from karpenter_tpu.models.resources import Resources
+
+
+@dataclass
+class ExistingNode:
+    """A live node as the scheduler sees it: identity + headroom + resident
+    pods (for topology/affinity accounting). Mirrors the cluster-state
+    `StateNode` consumed by the core scheduler (SURVEY §2.2 Cluster state).
+    """
+    node: Node
+    available: Resources            # allocatable − Σ(resident pod requests)
+    pods: List[Pod] = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+
+@dataclass
+class ScheduleInput:
+    pods: List[Pod]
+    nodepools: List[NodePool]
+    # nodepool name → instance types (already filtered per its NodeClass)
+    instance_types: Dict[str, List[InstanceType]]
+    existing_nodes: List[ExistingNode] = field(default_factory=list)
+    # nodepool name → aggregate daemonset requests a new node must reserve
+    # (reference: daemonset overhead accounting,
+    # test/suites/scale/provisioning_test.go:74-75)
+    daemon_overhead: Dict[str, Resources] = field(default_factory=dict)
+    # nodepool name → resources still allowed under NodePool.spec.limits
+    # (None = unlimited)
+    remaining_limits: Dict[str, Optional[Resources]] = field(default_factory=dict)
+
+
+@dataclass
+class NewNodeClaim:
+    """A planned node: which pool, the accumulated requirement intersection,
+    the ranked instance-type candidates, and the pods packed onto it."""
+    nodepool: str
+    node_class_ref: str
+    requirements: Requirements
+    pods: List[Pod] = field(default_factory=list)
+    requests: Resources = field(default_factory=Resources)  # incl. daemon overhead
+    # candidate types that still fit everything, ranked cheapest-first
+    instance_type_names: List[str] = field(default_factory=list)
+    # cheapest viable (type, zone, capacity_type, price) — the simulation's
+    # cost estimate; launch may pick differently under live capacity
+    price: float = 0.0
+    taints: List = field(default_factory=list)
+    startup_taints: List = field(default_factory=list)
+    hostname: str = ""  # synthetic hostname domain for topology
+
+
+@dataclass
+class ScheduleResult:
+    new_claims: List[NewNodeClaim] = field(default_factory=list)
+    existing_assignments: Dict[str, str] = field(default_factory=dict)  # pod → node
+    unschedulable: Dict[str, str] = field(default_factory=dict)         # pod → reason
+
+    def node_count(self) -> int:
+        return len(self.new_claims)
+
+    def total_price(self) -> float:
+        return sum(c.price for c in self.new_claims)

@@ -1,0 +1,352 @@
+"""Oracle scheduler tests — the behavioral contract the TPU solver must match.
+
+Scenario style mirrors the reference's suite pattern: real scheduler, fake
+cloud data (SURVEY §4: "fake the cloud, never the scheduler").
+"""
+
+import pytest
+
+from karpenter_tpu.models import (
+    Node,
+    NodePool,
+    ObjectMeta,
+    Pod,
+    Requirement,
+    Requirements,
+    Resources,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+    wellknown,
+)
+from karpenter_tpu.models.objects import PodAffinityTerm
+from karpenter_tpu.providers import generate_catalog
+from karpenter_tpu.providers.catalog import CatalogSpec
+from karpenter_tpu.scheduling import ExistingNode, ScheduleInput, Scheduler
+
+
+CATALOG = generate_catalog()
+SMALL_CATALOG = generate_catalog(CatalogSpec(max_types=40, include_gpu=False))
+
+
+def mkpod(name, cpu="500m", mem="1Gi", **kw):
+    return Pod(
+        meta=ObjectMeta(name=name, labels=kw.pop("labels", {})),
+        requests=Resources.parse({"cpu": cpu, "memory": mem}),
+        **kw,
+    )
+
+
+def mkpool(name="default", **kw):
+    return NodePool(meta=ObjectMeta(name=name), **kw)
+
+
+def solve(pods, pools=None, types=None, **kw):
+    pools = pools or [mkpool()]
+    types = types if types is not None else CATALOG
+    inp = ScheduleInput(
+        pods=pods,
+        nodepools=pools,
+        instance_types={p.name: types for p in pools},
+        **kw,
+    )
+    return Scheduler(inp).solve()
+
+
+class TestBasicPacking:
+    def test_one_pod_one_node_cheapest(self):
+        res = solve([mkpod("p1")])
+        assert res.node_count() == 1 and not res.unschedulable
+        claim = res.new_claims[0]
+        assert claim.pods[0].meta.name == "p1"
+        # ranked list is cheapest-first
+        prices = []
+        by_name = {it.name: it for it in CATALOG}
+        for tn in claim.instance_type_names[:10]:
+            prices.append(by_name[tn].cheapest_offering(claim.requirements).price)
+        assert prices == sorted(prices)
+        assert claim.price == prices[0]
+
+    def test_identical_pods_pack_densely(self):
+        # BASELINE config #1 shape: 100 identical pods
+        res = solve([mkpod(f"p{i}") for i in range(100)])
+        assert not res.unschedulable
+        # 100 × (500m, 1Gi) packs onto one large machine
+        assert res.node_count() == 1
+        assert len(res.new_claims[0].pods) == 100
+
+    def test_overflow_opens_second_node(self):
+        # each pod ~1/3 of the largest machine's cpu → >1 node for 4 pods
+        big = Resources.parse({"cpu": "64", "memory": "128Gi"})
+        pods = [Pod(meta=ObjectMeta(name=f"b{i}"), requests=big) for i in range(4)]
+        res = solve(pods)
+        assert not res.unschedulable
+        assert res.node_count() == 2
+
+    def test_pods_slot_limit_respected(self):
+        # tiny pods: the pods-capacity axis (not cpu) must cap packing
+        pods = [mkpod(f"t{i}", cpu="1m", mem="1Mi") for i in range(1000)]
+        res = solve(pods, types=SMALL_CATALOG)
+        assert not res.unschedulable
+        max_pods = max(it.capacity.pods for it in SMALL_CATALOG)
+        for claim in res.new_claims:
+            assert len(claim.pods) <= max_pods
+        assert res.node_count() >= 1000 / max_pods
+
+    def test_ffd_orders_big_pods_first(self):
+        res = solve([mkpod("small", cpu="100m"), mkpod("huge", cpu="180")])
+        # both schedule; huge pod forces a big machine; small piggybacks
+        assert not res.unschedulable
+        assert res.node_count() == 1
+
+
+class TestConstraints:
+    def test_node_selector_zone(self):
+        pod = mkpod("z")
+        pod.requirements = Requirements(
+            Requirement.make(wellknown.ZONE_LABEL, "In", "tpu-west-1b"))
+        res = solve([pod])
+        claim = res.new_claims[0]
+        assert claim.requirements.get(wellknown.ZONE_LABEL).values() == {"tpu-west-1b"}
+
+    def test_arch_selector_restricts_types(self):
+        pod = mkpod("arm")
+        pod.requirements = Requirements(
+            Requirement.make(wellknown.ARCH_LABEL, "In", "arm64"))
+        res = solve([pod])
+        claim = res.new_claims[0]
+        assert all("g." in n or n.split(".")[0].endswith(("g", "gd"))
+                   for n in claim.instance_type_names)
+
+    def test_incompatible_requirement_unschedulable(self):
+        pod = mkpod("bad")
+        pod.requirements = Requirements(
+            Requirement.make(wellknown.ARCH_LABEL, "In", "riscv"))
+        res = solve([pod])
+        assert "bad" in res.unschedulable
+        assert "incompatible" in res.unschedulable["bad"] or "no instance type" in res.unschedulable["bad"]
+
+    def test_pool_taints_need_toleration(self):
+        tainted = mkpool("tainted", taints=[Taint("team", "ml")])
+        pod = mkpod("p")
+        res = solve([pod], pools=[tainted])
+        assert "p" in res.unschedulable and "taints" in res.unschedulable["p"]
+        pod2 = mkpod("p2", tolerations=[Toleration(key="team", operator="Exists")])
+        res2 = solve([pod2], pools=[tainted])
+        assert not res2.unschedulable
+
+    def test_pool_weight_priority(self):
+        heavy = mkpool("heavy", weight=10,
+                       requirements=Requirements(
+                           Requirement.make(wellknown.ZONE_LABEL, "In", "tpu-west-1a")))
+        light = mkpool("light")
+        res = solve([mkpod("p")], pools=[light, heavy])
+        assert res.new_claims[0].nodepool == "heavy"
+
+    def test_pool_fallback_when_incompatible(self):
+        heavy = mkpool("heavy", weight=10, requirements=Requirements(
+            Requirement.make(wellknown.ARCH_LABEL, "In", "arm64")))
+        light = mkpool("light")
+        pod = mkpod("amd")
+        pod.requirements = Requirements(
+            Requirement.make(wellknown.ARCH_LABEL, "In", "amd64"))
+        res = solve([pod], pools=[light, heavy])
+        assert res.new_claims[0].nodepool == "light"
+
+    def test_limits_block_scheduling(self):
+        pool = mkpool("limited")
+        res = solve([mkpod("p", cpu="2")], pools=[pool],
+                    remaining_limits={"limited": Resources.of(cpu=1000)})
+        assert "p" in res.unschedulable and "limits" in res.unschedulable["p"]
+
+    def test_min_values_flexibility(self):
+        pool = mkpool("flex", requirements=Requirements(
+            Requirement.make(wellknown.INSTANCE_FAMILY_LABEL, "In",
+                             "m6", "c6", min_values=2)))
+        res = solve([mkpod("p")], pools=[pool])
+        assert not res.unschedulable
+        fams = {n.split(".")[0] for n in res.new_claims[0].instance_type_names}
+        assert fams == {"m6", "c6"}
+        # impossible minValues → unschedulable
+        pool2 = mkpool("broken", requirements=Requirements(
+            Requirement.make(wellknown.INSTANCE_FAMILY_LABEL, "In",
+                             "m6", min_values=2)))
+        res2 = solve([mkpod("q")], pools=[pool2])
+        assert "q" in res2.unschedulable and "minValues" in res2.unschedulable["q"]
+
+    def test_gpu_pod_gets_gpu_node(self):
+        pod = mkpod("g")
+        pod.requests = Resources.parse({"cpu": "2", "nvidia.com/gpu": 1})
+        res = solve([pod])
+        assert not res.unschedulable
+        assert all(n.startswith(("g4", "g5", "p3", "p4"))
+                   for n in res.new_claims[0].instance_type_names)
+
+
+class TestExistingNodes:
+    def _node(self, name="n1", cpu=4000, mem=8192, zone="tpu-west-1a"):
+        node = Node(
+            meta=ObjectMeta(name=name, labels={
+                wellknown.ZONE_LABEL: zone,
+                wellknown.CAPACITY_TYPE_LABEL: "on-demand",
+                wellknown.NODEPOOL_LABEL: "default",
+                wellknown.ARCH_LABEL: "amd64",
+                wellknown.OS_LABEL: "linux",
+                wellknown.HOSTNAME_LABEL: name,
+            }),
+            capacity=Resources.of(cpu=cpu, memory=mem, pods=58),
+            allocatable=Resources.of(cpu=cpu, memory=mem, pods=58),
+            ready=True,
+        )
+        return ExistingNode(node=node, available=node.allocatable.copy())
+
+    def test_prefers_existing_capacity(self):
+        en = self._node()
+        res = solve([mkpod("p")], existing_nodes=[en])
+        assert res.node_count() == 0
+        assert res.existing_assignments == {"p": "n1"}
+
+    def test_existing_full_opens_new(self):
+        en = self._node(cpu=300)  # not enough for a 500m pod
+        res = solve([mkpod("p")], existing_nodes=[en])
+        assert res.node_count() == 1 and not res.existing_assignments
+
+    def test_existing_taint_respected(self):
+        en = self._node()
+        en.node.taints = [Taint("dedicated", "db")]
+        res = solve([mkpod("p")], existing_nodes=[en])
+        assert res.node_count() == 1
+        pod = mkpod("p2", tolerations=[Toleration(key="dedicated", operator="Exists")])
+        res2 = solve([pod], existing_nodes=[en])
+        assert res2.existing_assignments == {"p2": "n1"}
+
+    def test_existing_label_mismatch(self):
+        en = self._node(zone="tpu-west-1a")
+        pod = mkpod("p")
+        pod.requirements = Requirements(
+            Requirement.make(wellknown.ZONE_LABEL, "In", "tpu-west-1b"))
+        res = solve([pod], existing_nodes=[en])
+        assert res.node_count() == 1
+        assert res.new_claims[0].requirements.get(
+            wellknown.ZONE_LABEL).values() == {"tpu-west-1b"}
+
+
+class TestTopology:
+    def test_zone_spread_across_new_nodes(self):
+        spread = TopologySpreadConstraint(
+            topology_key=wellknown.ZONE_LABEL, max_skew=1,
+            label_selector={"app": "web"})
+        pods = [mkpod(f"w{i}", labels={"app": "web"},
+                      topology_spread=[spread]) for i in range(6)]
+        res = solve(pods)
+        assert not res.unschedulable
+        zones = []
+        for c in res.new_claims:
+            zr = c.requirements.get(wellknown.ZONE_LABEL)
+            assert zr is not None and len(zr.values()) == 1
+            zones.extend(list(zr.values()) * len(c.pods))
+        from collections import Counter
+        counts = Counter(zones)
+        assert len(counts) == 3  # all three zones used
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+    def test_hostname_anti_affinity_one_per_node(self):
+        anti = PodAffinityTerm(label_selector={"app": "solo"},
+                               topology_key=wellknown.HOSTNAME_LABEL, anti=True)
+        pods = [mkpod(f"s{i}", labels={"app": "solo"},
+                      pod_affinities=[anti]) for i in range(5)]
+        res = solve(pods)
+        assert not res.unschedulable
+        assert res.node_count() == 5
+        assert all(len(c.pods) == 1 for c in res.new_claims)
+
+    def test_zone_affinity_colocates(self):
+        aff = PodAffinityTerm(label_selector={"app": "pair"},
+                              topology_key=wellknown.ZONE_LABEL, anti=False)
+        pods = [mkpod(f"a{i}", labels={"app": "pair"},
+                      pod_affinities=[aff]) for i in range(4)]
+        res = solve(pods)
+        assert not res.unschedulable
+        zones = set()
+        for c in res.new_claims:
+            zones |= c.requirements.get(wellknown.ZONE_LABEL).values()
+        assert len(zones) == 1  # all in the same zone
+
+    def test_symmetric_anti_affinity(self):
+        # resident pod with anti-affinity against app=web blocks new web pods
+        anti = PodAffinityTerm(label_selector={"app": "web"},
+                               topology_key=wellknown.HOSTNAME_LABEL, anti=True)
+        resident = mkpod("resident", labels={"app": "db"}, pod_affinities=[anti])
+        en = TestExistingNodes()._node()
+        en.pods = [resident]
+        web = mkpod("web", labels={"app": "web"})
+        res = solve([web], existing_nodes=[en])
+        # must NOT land on n1 despite capacity
+        assert res.existing_assignments == {}
+        assert res.node_count() == 1
+
+    def test_spread_with_existing_nodes_counts_residents(self):
+        spread = TopologySpreadConstraint(
+            topology_key=wellknown.ZONE_LABEL, max_skew=1,
+            label_selector={"app": "web"})
+        helper = TestExistingNodes()
+        en_a = helper._node("na", zone="tpu-west-1a")
+        en_a.pods = [mkpod("r1", labels={"app": "web"}, topology_spread=[spread]),
+                     mkpod("r2", labels={"app": "web"}, topology_spread=[spread])]
+        new = mkpod("w", labels={"app": "web"}, topology_spread=[spread])
+        res = solve([new], existing_nodes=[en_a])
+        # zone a has 2; a new pod must go to b or c
+        claim = res.new_claims[0]
+        assert claim.requirements.get(wellknown.ZONE_LABEL).values() != {"tpu-west-1a"}
+
+
+class TestDaemonOverhead:
+    def test_daemon_resources_reserved(self):
+        # daemonset eats 1 cpu per node → fewer pods per node
+        pods = [mkpod(f"d{i}", cpu="1", mem="1Gi") for i in range(8)]
+        res_without = solve(pods, types=SMALL_CATALOG)
+        res_with = solve(pods, types=SMALL_CATALOG,
+                         daemon_overhead={"default": Resources.of(cpu=7000, pods=1)})
+        total_without = sum(c.requests.cpu for c in res_without.new_claims)
+        total_with = sum(c.requests.cpu for c in res_with.new_claims)
+        assert total_with > total_without
+
+
+class TestReviewRegressions:
+    def test_schedule_anyway_is_soft(self):
+        soft = TopologySpreadConstraint(
+            topology_key="example.com/rack", max_skew=1,
+            when_unsatisfiable="ScheduleAnyway", label_selector={"app": "w"})
+        res = solve([mkpod("p", labels={"app": "w"}, topology_spread=[soft])])
+        assert not res.unschedulable and res.node_count() == 1
+
+    def test_partial_limits_unconstrained_axes(self):
+        pool = mkpool("cpu-only")
+        res = solve([mkpod("p")], pools=[pool],
+                    remaining_limits={"cpu-only": Resources.limits(cpu=100000)})
+        assert not res.unschedulable
+
+    def test_limits_enforced_on_inflight_adds(self):
+        pool = mkpool("tight")
+        pods = [mkpod(f"p{i}", cpu="800m", mem="128Mi") for i in range(2)]
+        res = solve(pods, pools=[pool],
+                    remaining_limits={"tight": Resources.limits(cpu=1000)})
+        # only one 800m pod fits under a 1-core limit, even on the same node
+        assert len(res.unschedulable) == 1
+        total = sum(len(c.pods) for c in res.new_claims)
+        assert total == 1
+
+    def test_spread_respects_not_in_zone(self):
+        spread = TopologySpreadConstraint(
+            topology_key=wellknown.ZONE_LABEL, max_skew=3,
+            label_selector={"app": "w"})
+        pods = []
+        for i in range(3):
+            p = mkpod(f"p{i}", labels={"app": "w"}, topology_spread=[spread])
+            p.requirements = Requirements(
+                Requirement.make(wellknown.ZONE_LABEL, "NotIn", "tpu-west-1a"))
+            pods.append(p)
+        res = solve(pods)
+        assert not res.unschedulable
+        for c in res.new_claims:
+            assert "tpu-west-1a" not in c.requirements.get(wellknown.ZONE_LABEL).values()
