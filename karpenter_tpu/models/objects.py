@@ -23,6 +23,10 @@ from karpenter_tpu.models.resources import Resources
 from karpenter_tpu.models.taints import Taint, Toleration
 
 _uid_counter = itertools.count(1)
+_SCHED_KEY_INTERN: Dict[tuple, int] = {}
+_INTERN_LIMIT = 100_000
+# group ids are globally unique (never reused across intern-table resets)
+_sched_gid_counter = itertools.count(1)
 
 
 def new_uid() -> str:
@@ -97,6 +101,10 @@ class Pod:
     def scheduled(self) -> bool:
         return self.node_name is not None
 
+# class attrs (deliberately unannotated: not dataclass fields)
+    _sched_key_cache = None
+    _sched_group_id = None
+
     def deletion_cost(self) -> float:
         raw = self.meta.annotations.get(wellknown.POD_DELETION_COST_ANNOTATION)
         try:
@@ -111,8 +119,11 @@ class Pod:
         """Equivalence-class key: pods with equal keys are interchangeable to
         the scheduler. The reference exploits the same equivalence when
         batching identical pods; the TPU grouped solver depends on it.
+        Cached — pod specs are immutable once submitted for scheduling.
         """
-        return (
+        if self._sched_key_cache is not None:
+            return self._sched_key_cache
+        self._sched_key_cache = (
             self.requests,
             self.requirements,
             tuple(sorted(self.tolerations, key=str)),
@@ -131,6 +142,27 @@ class Pod:
             self.priority,
             self.is_daemonset,
         )
+        return self._sched_key_cache
+
+    def scheduling_group_id(self) -> int:
+        """Interned integer id of the scheduling_key — deep-tuple hashing is
+        the grouping hot path at 50k pods, so equal keys are mapped to one
+        int once per pod and grouped by int thereafter. Pod specs must not
+        mutate after this is first called (k8s pod specs are immutable
+        post-admission; the cache relies on it). The intern table is bounded:
+        it resets once it exceeds _INTERN_LIMIT distinct keys — group ids
+        from different epochs are never mixed because pods cache their id.
+        """
+        if self._sched_group_id is None:
+            if len(_SCHED_KEY_INTERN) > _INTERN_LIMIT:
+                _SCHED_KEY_INTERN.clear()
+            key = self.scheduling_key()
+            gid = _SCHED_KEY_INTERN.get(key)
+            if gid is None:
+                gid = next(_sched_gid_counter)
+                _SCHED_KEY_INTERN[key] = gid
+            self._sched_group_id = gid
+        return self._sched_group_id
 
 
 # ---------------------------------------------------------------------------

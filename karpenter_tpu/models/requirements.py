@@ -44,7 +44,7 @@ class Operator(str, enum.Enum):
 
 class Requirement:
     __slots__ = ("key", "vals", "complement", "greater_than", "less_than",
-                 "requires_existence", "min_values")
+                 "requires_existence", "min_values", "_h")
 
     def __init__(
         self,
@@ -63,6 +63,7 @@ class Requirement:
         self.less_than = less_than
         self.requires_existence = requires_existence
         self.min_values = min_values
+        self._h: Optional[int] = None  # Requirement is immutable; hash cached
 
     # -- constructors ----------------------------------------------------
     @classmethod
@@ -181,7 +182,9 @@ class Requirement:
         return isinstance(other, Requirement) and self._identity() == other._identity()
 
     def __hash__(self) -> int:
-        return hash(self._identity())
+        if self._h is None:
+            self._h = hash(self._identity())
+        return self._h
 
     def __repr__(self) -> str:
         if self.complement and not self.vals and self.greater_than is None \
@@ -209,10 +212,11 @@ class Requirements:
     shared keys), Intersects.
     """
 
-    __slots__ = ("_reqs",)
+    __slots__ = ("_reqs", "_hash")
 
     def __init__(self, *reqs: Requirement):
         self._reqs: Dict[str, Requirement] = {}
+        self._hash: Optional[int] = None
         for r in reqs:
             self.add(r)
 
@@ -245,6 +249,7 @@ class Requirements:
         """Tighten: intersect with any existing requirement on the same key."""
         cur = self._reqs.get(req.key)
         self._reqs[req.key] = cur.intersect(req) if cur is not None else req
+        self._hash = None
 
     def update(self, other: "Requirements") -> None:
         for r in other:
@@ -253,6 +258,7 @@ class Requirements:
     def copy(self) -> "Requirements":
         out = Requirements()
         out._reqs = dict(self._reqs)
+        out._hash = self._hash
         return out
 
     # -- algebra ---------------------------------------------------------
@@ -311,7 +317,9 @@ class Requirements:
         return isinstance(other, Requirements) and self._reqs == other._reqs
 
     def __hash__(self) -> int:
-        return hash(frozenset(self._reqs.values()))
+        if self._hash is None:
+            self._hash = hash(frozenset(self._reqs.values()))
+        return self._hash
 
     def __repr__(self) -> str:
         return f"Requirements({', '.join(map(repr, self._reqs.values()))})"

@@ -99,10 +99,11 @@ class Resources:
     (sigs.k8s.io/karpenter/pkg/utils/resources: Merge, Subtract, Fits).
     """
 
-    __slots__ = ("v",)
+    __slots__ = ("v", "_cached_key")
 
     def __init__(self, v: "list[float] | None" = None):
         self.v = list(v) if v is not None else [0.0] * len(RESOURCE_AXIS)
+        self._cached_key = None
 
     # -- constructors ----------------------------------------------------
     @classmethod
@@ -155,6 +156,7 @@ class Resources:
     def __iadd__(self, other: "Resources") -> "Resources":
         for i, b in enumerate(other.v):
             self.v[i] += b
+        self._cached_key = None
         return self
 
     def __mul__(self, k: float) -> "Resources":
@@ -176,6 +178,7 @@ class Resources:
 
     def set(self, name: str, val: float) -> None:
         self.v[AXIS_INDEX[_ALIASES.get(name, name)]] = float(val)
+        self._cached_key = None
 
     @property
     def cpu(self) -> float:
@@ -204,8 +207,12 @@ class Resources:
 
     # eq/hash quantize to 1e-6 solver units so the pair is consistent
     # (Resources participates in Pod.scheduling_key equivalence classes).
+    # Cached: grouping 50k pods hashes/compares these in the hot path; every
+    # mutating method below invalidates.
     def _key(self) -> tuple:
-        return tuple(round(a, 6) for a in self.v)
+        if self._cached_key is None:
+            self._cached_key = tuple(round(a, 6) for a in self.v)
+        return self._cached_key
 
     def __eq__(self, other: object) -> bool:
         return isinstance(other, Resources) and self._key() == other._key()

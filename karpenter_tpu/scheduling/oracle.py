@@ -36,6 +36,7 @@ from karpenter_tpu.scheduling.types import (
     NewNodeClaim,
     ScheduleInput,
     ScheduleResult,
+    effective_request,
 )
 
 _sim_counter = itertools.count(1)
@@ -43,12 +44,6 @@ _sim_counter = itertools.count(1)
 # topology keys the scheduler narrows on new nodes (hostname is always
 # per-node-unique and handled separately)
 _NARROWABLE_KEYS = (wellknown.ZONE_LABEL, wellknown.CAPACITY_TYPE_LABEL)
-
-
-def _effective_requests(pod: Pod) -> Resources:
-    r = pod.requests.copy()
-    r.set("pods", r.get("pods") + 1.0)  # every pod consumes one pod slot
-    return r
 
 
 class _ExistingSim:
@@ -148,7 +143,7 @@ class Scheduler:
 
     # ------------------------------------------------------------------
     def _schedule_one(self, pod: Pod) -> None:
-        req = _effective_requests(pod)
+        req = effective_request(pod)
         key = pod.scheduling_key()
         # topology-sensitive pods can't reuse failure memos: the tracker
         # state they were checked against changes with every placement

@@ -13,6 +13,15 @@ from karpenter_tpu.models.requirements import Requirements
 from karpenter_tpu.models.resources import Resources
 
 
+def effective_request(pod: Pod) -> Resources:
+    """A pod's packing footprint: declared requests plus the one pod slot it
+    occupies. Shared by the oracle and the solver encoder — parity depends
+    on them agreeing."""
+    r = pod.requests.copy()
+    r.set("pods", r.get("pods") + 1.0)
+    return r
+
+
 @dataclass
 class ExistingNode:
     """A live node as the scheduler sees it: identity + headroom + resident

@@ -1,0 +1,29 @@
+"""The TPU solver — the provisioner's hot path as a batched tensor solve.
+
+Replaces the reference's sequential Go FFD loop
+(designs/bin-packing.md:28-42, HOT LOOP #1 in SURVEY §3.2) with a
+`lax.scan` over *pod equivalence classes* whose inner step vectorizes the
+entire nodes×offerings fill on the MXU-friendly dense arrays built by
+`encode.py`:
+
+  * columns — the flattened (nodepool × instance-type × zone × capacity-type)
+    offering axis. Labels of a column are single-valued, which makes
+    requirement conjunction decomposable: a column is compatible with a
+    node's accumulated requirements iff it is compatible with every pod
+    group on the node individually. That property is what lets node state
+    live as a boolean column mask updated by pure AND — no label algebra on
+    device.
+  * groups — pods deduplicated by scheduling_key (identical pods are
+    interchangeable; the reference exploits the same equivalence when
+    batching). 50k pods typically collapse to O(10-100) groups, so the
+    sequential scan axis is short while every inner operation is a wide
+    vectorized fill.
+
+Pods with topology spread / pod-affinity constraints are not yet encoded;
+`TPUSolver.solve` raises `UnsupportedPods` and the provisioner falls back to
+the CPU oracle (solver-unavailable ⇒ fall back, never fail — SURVEY §5).
+"""
+
+from karpenter_tpu.solver.solve import TPUSolver, UnsupportedPods
+
+__all__ = ["TPUSolver", "UnsupportedPods"]

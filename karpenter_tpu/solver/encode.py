@@ -1,0 +1,337 @@
+"""Host-side tensor encoding of a scheduling problem.
+
+Turns a `ScheduleInput` into dense numpy arrays for the device kernel:
+
+  columns  [O]    one per (nodepool, instance type, zone, capacity-type)
+                  offering, ordered by nodepool priority (weight desc) —
+                  column order IS pool preference order
+  groups   [G]    pod equivalence classes in FFD order (size desc)
+  group_mask [G,O]  label/taint compatibility of a group's pods with each
+                  column (vectorized over the interned label vocabulary —
+                  the Python set algebra runs once per (group × key), not
+                  per (group × column))
+  exist_mask [G,E]  same against existing nodes
+  + capacity/price/limit arrays
+
+The encoding is cached against the instance-type list identity and catalog
+seqnums by the caller; only group/existing arrays change call to call.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from karpenter_tpu.models import wellknown
+from karpenter_tpu.models.objects import InstanceType, NodePool, Pod
+from karpenter_tpu.models.requirements import Requirements
+from karpenter_tpu.models.resources import RESOURCE_AXIS, Resources
+from karpenter_tpu.models.taints import tolerates_all
+from karpenter_tpu.scheduling.types import (
+    ExistingNode,
+    ScheduleInput,
+    effective_request,
+)
+
+R = len(RESOURCE_AXIS)
+_ABSENT = -1
+
+
+@dataclass
+class Column:
+    pool: str
+    pool_idx: int
+    type_name: str
+    zone: str
+    capacity_type: str
+    price: float
+    labels: Dict[str, str]
+    allocatable: Resources
+    instance_type: InstanceType
+
+
+@dataclass
+class EncodedProblem:
+    # device inputs
+    group_req: np.ndarray       # [G, R] f32 — effective per-pod request
+    group_count: np.ndarray     # [G] i32
+    group_mask: np.ndarray      # [G, O] bool
+    exist_mask: np.ndarray      # [G, E] bool
+    exist_remaining: np.ndarray # [E, R] f32
+    col_alloc: np.ndarray       # [O, R] f32
+    col_daemon: np.ndarray      # [O, R] f32 — pool daemonset overhead per column
+    col_price: np.ndarray       # [O] f32
+    col_pool: np.ndarray        # [O] i32
+    pool_limit: np.ndarray      # [P, R] f32 (inf = unlimited)
+    # host metadata for decode
+    groups: List[List[Pod]] = field(default_factory=list)
+    columns: List[Column] = field(default_factory=list)
+    existing: List[ExistingNode] = field(default_factory=list)
+    pools: List[NodePool] = field(default_factory=list)
+    merged_reqs: List[List[Optional[Requirements]]] = field(default_factory=list)  # [G][P]
+
+    @property
+    def n_groups(self) -> int:
+        return len(self.groups)
+
+    @property
+    def n_columns(self) -> int:
+        return len(self.columns)
+
+
+class _Vocab:
+    """Interns label strings per key into dense int arrays."""
+
+    def __init__(self) -> None:
+        self._ids: Dict[str, Dict[str, int]] = {}
+        self._rev_cache: Dict[str, Dict[int, str]] = {}
+
+    def id(self, key: str, value: str) -> int:
+        vals = self._ids.setdefault(key, {})
+        out = vals.get(value)
+        if out is None:
+            out = len(vals)
+            vals[value] = out
+            self._rev_cache.pop(key, None)
+        return out
+
+    def lookup(self, key: str, value: str) -> int:
+        return self._ids.get(key, {}).get(value, _ABSENT - 1)  # never matches
+
+    def reverse(self, key: str) -> Dict[int, str]:
+        rev = self._rev_cache.get(key)
+        if rev is None:
+            rev = {i: v for v, i in self._ids.get(key, {}).items()}
+            self._rev_cache[key] = rev
+        return rev
+
+
+def _label_matrix(
+    vocab: _Vocab, keys: Sequence[str], label_dicts: Sequence[Dict[str, str]]
+) -> Dict[str, np.ndarray]:
+    out = {}
+    for key in keys:
+        out[key] = np.array(
+            [vocab.id(key, d[key]) if key in d else _ABSENT for d in label_dicts],
+            dtype=np.int32,
+        )
+    return out
+
+
+def _eval_requirements(
+    reqs: Requirements,
+    vocab: _Vocab,
+    matrices: Dict[str, np.ndarray],
+    n: int,
+) -> np.ndarray:
+    """Vectorized `matched_by_labels` over n label-dicts (closed world)."""
+    ok = np.ones(n, dtype=bool)
+    for req in reqs:
+        vals = matrices.get(req.key)
+        if vals is None:
+            # key absent from every candidate
+            if not req.matches_absent():
+                return np.zeros(n, dtype=bool)
+            continue
+        absent = vals == _ABSENT
+        if req.is_finite():
+            allowed = np.array(
+                sorted(vocab.lookup(req.key, v) for v in req.values()),
+                dtype=np.int32,
+            )
+            match = np.isin(vals, allowed)
+        else:
+            # complement / bounds: evaluate per distinct id (few)
+            ids = np.unique(vals[~absent])
+            rev = vocab.reverse(req.key)
+            good = np.array(
+                [i for i in ids if i in rev and req.matches(rev[i])],
+                dtype=np.int32,
+            )
+            match = np.isin(vals, good)
+        if req.matches_absent():
+            match = match | absent
+        else:
+            match = match & ~absent
+        ok &= match
+    return ok
+
+
+def group_pods(pods: List[Pod]) -> List[List[Pod]]:
+    """Equivalence classes in FFD order (size desc, then name for stability)."""
+    byid: Dict[int, List[Pod]] = {}
+    for pod in pods:
+        byid.setdefault(pod.scheduling_group_id(), []).append(pod)
+    groups = list(byid.values())
+    for g in groups:
+        g.sort(key=lambda p: p.meta.name)
+    groups.sort(key=lambda g: (g[0].requests.sort_key(), g[0].meta.name),
+                reverse=True)
+    return groups
+
+
+@dataclass
+class CatalogEncoding:
+    """The catalog-side (per-call-invariant) half of the encoding: columns,
+    interned label matrices, and capacity/price arrays. Cached by the solver
+    across calls — it only changes when the instance-type provider's seqnum
+    discipline hands out a new list (SURVEY §7 step 2: uploaded once per
+    change, not per call)."""
+    pools: List[NodePool]
+    columns: List[Column]
+    vocab: _Vocab
+    col_matrices: Dict[str, np.ndarray]
+    col_alloc: np.ndarray
+    col_daemon: np.ndarray
+    col_price: np.ndarray
+    col_pool: np.ndarray
+    pool_daemon: np.ndarray
+    templates: List[Requirements]
+    device_args: Optional[dict] = None  # device-resident padded arrays
+
+
+def encode_catalog(inp: ScheduleInput) -> CatalogEncoding:
+    pools = sorted(inp.nodepools, key=lambda np_: (-np_.weight, np_.meta.name))
+    vocab = _Vocab()
+    columns: List[Column] = []
+    for pidx, pool in enumerate(pools):
+        for it in inp.instance_types.get(pool.name, []):
+            base_labels: Dict[str, str] = {}
+            for req in it.requirements:
+                if req.is_finite() and len(req.values()) == 1:
+                    (base_labels[req.key],) = req.values()
+            for o in it.offerings:
+                if not o.available:
+                    continue
+                labels = dict(base_labels)
+                labels[wellknown.ZONE_LABEL] = o.zone
+                labels[wellknown.CAPACITY_TYPE_LABEL] = o.capacity_type
+                labels[wellknown.NODEPOOL_LABEL] = pool.name
+                labels.update(pool.labels)
+                columns.append(Column(
+                    pool=pool.name, pool_idx=pidx, type_name=it.name,
+                    zone=o.zone, capacity_type=o.capacity_type, price=o.price,
+                    labels=labels, allocatable=it.allocatable(),
+                    instance_type=it,
+                ))
+    col_keys = sorted({k for c in columns for k in c.labels})
+    col_matrices = _label_matrix(vocab, col_keys, [c.labels for c in columns])
+    O = len(columns)
+    col_alloc = np.array([c.allocatable.v for c in columns],
+                         dtype=np.float32).reshape(O, R)
+    col_daemon = np.zeros((O, R), dtype=np.float32)
+    for ci, c in enumerate(columns):
+        d = inp.daemon_overhead.get(c.pool)
+        if d is not None:
+            col_daemon[ci] = np.array(d.v, dtype=np.float32)
+    col_price = np.array([c.price for c in columns], dtype=np.float32)
+    col_pool = np.array([c.pool_idx for c in columns], dtype=np.int32)
+    pool_daemon = np.stack([
+        np.array(inp.daemon_overhead.get(p.name, Resources()).v, dtype=np.float32)
+        for p in pools]) if pools else np.zeros((1, R), np.float32)
+    return CatalogEncoding(
+        pools=pools, columns=columns, vocab=vocab, col_matrices=col_matrices,
+        col_alloc=col_alloc, col_daemon=col_daemon, col_price=col_price,
+        col_pool=col_pool, pool_daemon=pool_daemon,
+        templates=[p.template_requirements() for p in pools],
+    )
+
+
+def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None) -> EncodedProblem:
+    cat = cat or encode_catalog(inp)
+    pools = cat.pools
+    vocab = cat.vocab
+    columns = cat.columns
+    col_matrices = cat.col_matrices
+    groups = group_pods(inp.pods)
+
+    O = len(columns)
+    E = len(inp.existing_nodes)
+    G = len(groups)
+
+    exist_keys = sorted({k for en in inp.existing_nodes for k in en.node.labels})
+    exist_matrices = _label_matrix(
+        vocab, exist_keys, [en.node.labels for en in inp.existing_nodes])
+
+    group_req = np.zeros((G, R), dtype=np.float32)
+    group_count = np.zeros(G, dtype=np.int32)
+    group_mask = np.zeros((G, O), dtype=bool)
+    exist_mask = np.zeros((G, E), dtype=bool)
+    merged_reqs: List[List[Optional[Requirements]]] = []
+
+    pool_col = cat.col_pool
+
+    for gi, g in enumerate(groups):
+        rep = g[0]
+        group_req[gi] = np.array(effective_request(rep).v, dtype=np.float32)
+        group_count[gi] = len(g)
+
+        merged_per_pool: List[Optional[Requirements]] = []
+        gmask = np.zeros(O, dtype=bool)
+        for pidx, pool in enumerate(pools):
+            if not tolerates_all(pool.taints, rep.tolerations):
+                merged_per_pool.append(None)
+                continue
+            template = cat.templates[pidx]
+            if not template.compatible(rep.requirements):
+                merged_per_pool.append(None)
+                continue
+            merged = template.intersection(rep.requirements)
+            merged_per_pool.append(merged)
+            sel = pool_col == pidx
+            if sel.any():
+                ok = _eval_requirements(merged, vocab, col_matrices, O)
+                gmask |= ok & sel
+        group_mask[gi] = gmask
+        merged_reqs.append(merged_per_pool)
+
+        if E:
+            ok = _eval_requirements(rep.requirements, vocab, exist_matrices, E)
+            for ei, en in enumerate(inp.existing_nodes):
+                if not ok[ei]:
+                    continue
+                node = en.node
+                if node.meta.deleting or not node.ready:
+                    ok[ei] = False
+                elif not tolerates_all(node.taints, rep.tolerations):
+                    ok[ei] = False
+            exist_mask[gi] = ok
+
+    exist_remaining = np.array(
+        [en.available.v for en in inp.existing_nodes], dtype=np.float32
+    ).reshape(E, R)
+
+    pool_limit = np.full((max(len(pools), 1), R), np.inf, dtype=np.float32)
+    for pidx, pool in enumerate(pools):
+        lim = inp.remaining_limits.get(pool.name)
+        if lim is not None:
+            pool_limit[pidx] = np.array(lim.v, dtype=np.float32)
+
+    return EncodedProblem(
+        group_req=group_req,
+        group_count=group_count,
+        group_mask=group_mask,
+        exist_mask=exist_mask,
+        exist_remaining=exist_remaining,
+        col_alloc=cat.col_alloc,
+        col_daemon=cat.col_daemon,
+        col_price=cat.col_price,
+        col_pool=pool_col,
+        pool_limit=pool_limit,
+        groups=groups,
+        columns=columns,
+        existing=list(inp.existing_nodes),
+        pools=pools,
+        merged_reqs=merged_reqs,
+    )
+
+
+def bucket(n: int, buckets: Tuple[int, ...]) -> int:
+    """Round up to a fixed shape tier to avoid XLA recompiles
+    (ragged-size discipline per SURVEY §7 hard-parts)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return int(2 ** np.ceil(np.log2(max(n, 1))))

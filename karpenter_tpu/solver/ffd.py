@@ -1,0 +1,203 @@
+"""The jitted grouped-FFD kernel.
+
+One `lax.scan` step consumes one pod equivalence class (already in FFD
+order) and performs three vectorized fills, mirroring the oracle's
+existing → in-flight → open-new cascade exactly but over whole groups:
+
+  1. existing nodes: per-node pod capacity via elementwise floor-division,
+     greedy prefix fill in node order (= sequential first-fit for identical
+     pods)
+  2. in-flight nodes: per-(node × column) capacity, max over each node's
+     surviving columns, prefix fill; survivors' column masks AND-ed with the
+     group's compatibility row
+  3. open new nodes: best pods-per-node over feasible columns of the
+     highest-priority compatible pool, ceil-divide to get node count,
+     activate slots
+
+Everything is static-shaped (`G × E × O × N` padded to buckets by the
+caller); control flow is masked arithmetic, no data-dependent branching —
+the whole solve is one XLA program (SURVEY §7: compiler-friendly control
+flow, no recompiles inside the latency budget).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+EPS = 1e-3
+BIG = jnp.int32(2**30)
+
+
+def _fit_count(avail: jnp.ndarray, req: jnp.ndarray) -> jnp.ndarray:
+    """How many pods of per-pod request `req` [R] fit in `avail` [..., R]."""
+    safe = jnp.where(req > 0, req, 1.0)
+    counts = jnp.floor((avail + EPS) / safe)
+    counts = jnp.where(req > 0, counts, jnp.float32(2**30))
+    c = jnp.min(counts, axis=-1)
+    return jnp.clip(c, 0, 2**30).astype(jnp.int32)
+
+
+def _prefix_fill(cap: jnp.ndarray, want: jnp.ndarray) -> jnp.ndarray:
+    """Greedy fill in index order: take as much as each slot holds until
+    `want` is exhausted — identical to sequential first-fit for
+    interchangeable pods."""
+    cum = jnp.cumsum(cap)
+    before = cum - cap
+    return jnp.clip(jnp.minimum(cap, want - before), 0, None)
+
+
+@partial(jax.jit, static_argnames=("max_nodes",))
+def solve_ffd(
+    group_req: jnp.ndarray,       # [G, R]
+    group_count: jnp.ndarray,     # [G]
+    group_mask: jnp.ndarray,      # [G, O] bool
+    exist_mask: jnp.ndarray,      # [G, E] bool
+    exist_remaining: jnp.ndarray, # [E, R]
+    col_alloc: jnp.ndarray,       # [O, R]
+    col_daemon: jnp.ndarray,      # [O, R]
+    col_pool: jnp.ndarray,        # [O] i32
+    pool_daemon: jnp.ndarray,     # [P, R]
+    pool_limit: jnp.ndarray,      # [P, R]
+    max_nodes: int = 1024,
+):
+    G, RDIM = group_req.shape
+    E = exist_remaining.shape[0]
+    O = col_alloc.shape[0]
+    P = pool_limit.shape[0]
+    N = max_nodes
+
+    init = dict(
+        exist_rem=exist_remaining,
+        used=jnp.zeros((N, RDIM), jnp.float32),
+        colmask=jnp.zeros((N, O), bool),
+        active=jnp.zeros((N,), bool),
+        node_pool=jnp.zeros((N,), jnp.int32),
+        num_active=jnp.int32(0),
+        limits=pool_limit,
+    )
+
+    def step(carry, xs):
+        req, cnt, gmask, emask = xs
+        exist_rem = carry["exist_rem"]
+        used = carry["used"]
+        colmask = carry["colmask"]
+        active = carry["active"]
+        node_pool = carry["node_pool"]
+        num_active = carry["num_active"]
+        limits = carry["limits"]
+
+        # -- 1. existing nodes ------------------------------------------
+        cap_e = jnp.where(emask, _fit_count(exist_rem, req), 0) if E else jnp.zeros((0,), jnp.int32)
+        take_e = _prefix_fill(cap_e, cnt) if E else cap_e
+        exist_rem = exist_rem - take_e[:, None] * req if E else exist_rem
+        c1 = cnt - (take_e.sum() if E else 0)
+
+        # -- 2. in-flight nodes -----------------------------------------
+        avail = col_alloc[None, :, :] - used[:, None, :]           # [N,O,R]
+        cap_no = _fit_count(avail, req)                            # [N,O]
+        cap_no = jnp.where(colmask & gmask[None, :], cap_no, 0)
+        cap_n = jnp.where(active, cap_no.max(axis=1), 0)
+        # pool limits are COLLECTIVE: clamp each node's cap by what the
+        # pool's budget leaves after earlier (lower-index) nodes of the same
+        # pool take theirs — per-node clamping alone would let several nodes
+        # of one pool jointly overrun the limit (P is static → unrolled)
+        limit_cap = _fit_count(limits, req)                        # [P]
+        for p in range(P):
+            mask_p = node_pool == p
+            cap_p = jnp.where(mask_p, cap_n, 0)
+            before_p = jnp.cumsum(cap_p) - cap_p
+            allowed = jnp.clip(limit_cap[p] - before_p, 0, None)
+            cap_n = jnp.where(mask_p, jnp.minimum(cap_p, allowed), cap_n)
+        take_n = _prefix_fill(cap_n, c1)
+        used = used + take_n[:, None] * req
+        touched = take_n > 0
+        colmask = jnp.where(touched[:, None], colmask & gmask[None, :], colmask)
+        col_ok = jnp.all(col_alloc[None, :, :] - used[:, None, :] >= -EPS, axis=-1)
+        colmask = colmask & col_ok
+        pool_take = jax.ops.segment_sum(take_n.astype(jnp.float32), node_pool,
+                                        num_segments=P)
+        limits = limits - pool_take[:, None] * req
+        c2 = c1 - take_n.sum()
+
+        # -- 3. open new nodes ------------------------------------------
+        # Unrolled over pools in priority order (P is static): a pool whose
+        # limit or catalog can't absorb the remaining pods falls through to
+        # the next pool, exactly like the oracle's per-pod pool cascade.
+        per_col = _fit_count(col_alloc - col_daemon, req)          # [O]
+        col_feas = gmask & (per_col >= 1)
+        idx = jnp.arange(N, dtype=jnp.int32)
+        c_rem = c2
+        k_new_total = jnp.zeros((N,), jnp.int32)
+        for p in range(P):
+            cols_p = col_feas & (col_pool == p)
+            k_full = jnp.max(jnp.where(cols_p, per_col, 0))
+            pool_room = jnp.all(limits[p] - pool_daemon[p] - req >= -EPS)
+            can = cols_p.any() & pool_room & (c_rem > 0) & (k_full > 0)
+            m_need = jnp.where(can, -(-c_rem // jnp.maximum(k_full, 1)), 0)
+            # per-node charge against the pool limit (full-node approximation)
+            charge = pool_daemon[p] + k_full.astype(jnp.float32) * req
+            m_limit = _fit_count(limits[p][None, :], charge)[0]
+            m = jnp.minimum(jnp.minimum(m_need, m_limit), N - num_active)
+            newmask = (idx >= num_active) & (idx < num_active + m)
+            pos = idx - num_active
+            taken_new = jnp.minimum(c_rem, m * k_full)
+            k_node = jnp.where(
+                newmask,
+                jnp.where(pos == m - 1, taken_new - (m - 1) * k_full, k_full),
+                0)
+            new_used = pool_daemon[p][None, :] + k_node[:, None].astype(jnp.float32) * req
+            used = jnp.where(newmask[:, None], new_used, used)
+            new_colmask = cols_p[None, :] & jnp.all(
+                col_alloc[None, :, :] - new_used[:, None, :] >= -EPS, axis=-1)
+            colmask = jnp.where(newmask[:, None], new_colmask, colmask)
+            active = active | newmask
+            node_pool = jnp.where(newmask, jnp.int32(p), node_pool)
+            num_active = num_active + m
+            limits = limits.at[p].add(
+                -(m.astype(jnp.float32) * pool_daemon[p]
+                  + taken_new.astype(jnp.float32) * req))
+            k_new_total = k_new_total + k_node
+            c_rem = c_rem - taken_new
+        unsched = c_rem
+
+        carry = dict(exist_rem=exist_rem, used=used, colmask=colmask,
+                     active=active, node_pool=node_pool,
+                     num_active=num_active, limits=limits)
+        out = dict(take_exist=take_e, take_new=take_n + k_new_total,
+                   unsched=unsched)
+        return carry, out
+
+    xs = (group_req, group_count, group_mask, exist_mask)
+    final, outs = jax.lax.scan(step, init, xs)
+    # Results are packed into ONE flat f32 buffer: each host pull pays a
+    # full round trip on the device link, so six small arrays cost six RTTs
+    # — one concatenated buffer costs one. colmask [N,O] stays on device
+    # entirely; the host reconstructs it from (take_new, used, group_mask).
+    packed = jnp.concatenate([
+        outs["take_exist"].astype(jnp.float32).reshape(-1),  # G*E
+        outs["take_new"].astype(jnp.float32).reshape(-1),    # G*N
+        outs["unsched"].astype(jnp.float32).reshape(-1),     # G
+        final["used"].reshape(-1),                            # N*R
+        final["node_pool"].astype(jnp.float32),               # N
+        final["num_active"][None].astype(jnp.float32),        # 1
+    ])
+    return packed
+
+
+def unpack(packed, G: int, E: int, N: int, RDIM: int):
+    """Split the flat result buffer back into named host arrays."""
+    import numpy as np
+    flat = np.asarray(packed)
+    sizes = [G * E, G * N, G, N * RDIM, N, 1]
+    offs = np.cumsum([0] + sizes)
+    return dict(
+        take_exist=flat[offs[0]:offs[1]].reshape(G, E),
+        take_new=flat[offs[1]:offs[2]].reshape(G, N),
+        unsched=flat[offs[2]:offs[3]],
+        used=flat[offs[3]:offs[4]].reshape(N, RDIM),
+        node_pool=flat[offs[4]:offs[5]].astype(np.int32),
+        num_active=flat[offs[5]],
+    )

@@ -1,0 +1,279 @@
+"""TPUSolver — drop-in replacement for the oracle behind the Solve() seam.
+
+encode (host, numpy) → solve_ffd (device, one XLA program) → decode (host).
+Shapes are padded to buckets so repeat calls hit the jit cache.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from karpenter_tpu.models import wellknown
+from karpenter_tpu.models.objects import Pod
+from karpenter_tpu.models.requirements import Requirement, Requirements
+from karpenter_tpu.models.resources import RESOURCE_AXIS, Resources
+from karpenter_tpu.scheduling.types import (
+    NewNodeClaim,
+    ScheduleInput,
+    ScheduleResult,
+)
+from karpenter_tpu.solver import ffd
+from karpenter_tpu.solver.encode import EncodedProblem, bucket, encode
+
+R = len(RESOURCE_AXIS)
+
+G_BUCKETS = (8, 32, 128, 512, 2048)
+E_BUCKETS = (0, 64, 512, 4096)
+O_ALIGN = 512
+
+
+class UnsupportedPods(Exception):
+    """Raised when the encoding can't express some pods' constraints yet;
+    the provisioner falls back to the CPU oracle for this batch."""
+
+
+def _supported(pod: Pod) -> bool:
+    if pod.topology_spread:
+        return False
+    if any(t.required for t in pod.pod_affinities):
+        return False
+    return True
+
+
+def _min_values_violation(reqs: Requirements, types) -> Optional[str]:
+    for r in reqs:
+        if r.min_values is None:
+            continue
+        seen = set()
+        for it in types:
+            tr = it.requirements.get(r.key)
+            if tr is not None and tr.is_finite():
+                seen |= tr.values()
+        if len(seen) < r.min_values:
+            return f"minValues violated for {r.key}: {len(seen)} < {r.min_values}"
+    return None
+
+
+class TPUSolver:
+    def __init__(self, max_nodes: int = 1024):
+        self.max_nodes = max_nodes
+        self._cat_key = None
+        self._cat = None
+
+    def _catalog_encoding(self, inp: ScheduleInput):
+        """Cache the catalog-side encoding + its device-resident padded
+        arrays. The instance-type provider returns the identical list object
+        until a seqnum changes (instancetype.py cache discipline), so object
+        identity is the invalidation signal."""
+        from karpenter_tpu.solver.encode import encode_catalog
+        pools = sorted(inp.nodepools, key=lambda p: (-p.weight, p.meta.name))
+        # hold STRONG references to the cached lists: identity (`is`) is then
+        # a sound invalidation signal — a freed list's address could be
+        # recycled, but a referenced one cannot be
+        lists = tuple(inp.instance_types.get(p.name) for p in pools)
+        key = (
+            lists,
+            tuple(p.static_hash() for p in pools),
+            tuple(sorted((k, tuple(v.v)) for k, v in inp.daemon_overhead.items())),
+        )
+        def _same(a, b):
+            return (a is not None and b is not None
+                    and len(a[0]) == len(b[0])
+                    and all(x is y for x, y in zip(a[0], b[0]))
+                    and a[1:] == b[1:])
+        if not _same(key, self._cat_key):
+            self._cat = encode_catalog(inp)
+            self._cat_key = key
+            cat = self._cat
+            O = -(-len(cat.columns) // O_ALIGN) * O_ALIGN
+            import jax
+            cat.device_args = dict(
+                col_alloc=jax.device_put(self._pad(cat.col_alloc, 0, O)),
+                col_daemon=jax.device_put(self._pad(cat.col_daemon, 0, O)),
+                col_pool=jax.device_put(self._pad(cat.col_pool, 0, O)),
+                pool_daemon=jax.device_put(cat.pool_daemon),
+                O=O,
+            )
+        return self._cat
+
+    # -- padding ---------------------------------------------------------
+    @staticmethod
+    def _pad(arr: np.ndarray, axis: int, to: int, value=0) -> np.ndarray:
+        pad = to - arr.shape[axis]
+        if pad <= 0:
+            return arr
+        widths = [(0, 0)] * arr.ndim
+        widths[axis] = (0, pad)
+        return np.pad(arr, widths, constant_values=value)
+
+    def solve(self, inp: ScheduleInput) -> ScheduleResult:
+        unsupported = [p for p in inp.pods if not _supported(p)]
+        if unsupported:
+            raise UnsupportedPods(
+                f"{len(unsupported)} pods carry topology/affinity constraints "
+                "not yet encoded for the device solver")
+
+        cat = self._catalog_encoding(inp)
+        enc = encode(inp, cat)
+        if enc.n_groups == 0:
+            return ScheduleResult()
+        if enc.n_columns == 0:
+            # no purchasable capacity — but existing nodes can still absorb
+            # pods, exactly as the oracle fills them first
+            return self._existing_only(enc)
+
+        G = bucket(enc.n_groups, G_BUCKETS)
+        E = bucket(len(enc.existing), E_BUCKETS)
+        dev = cat.device_args
+        O = dev["O"]
+
+        packed = ffd.solve_ffd(
+            self._pad(enc.group_req, 0, G),
+            self._pad(enc.group_count, 0, G),
+            self._pad(self._pad(enc.group_mask, 1, O), 0, G),
+            self._pad(self._pad(enc.exist_mask, 1, E), 0, G),
+            self._pad(enc.exist_remaining, 0, E),
+            dev["col_alloc"],
+            dev["col_daemon"],
+            dev["col_pool"],
+            dev["pool_daemon"],
+            enc.pool_limit,
+            max_nodes=self.max_nodes,
+        )
+        out = ffd.unpack(packed, G, E, self.max_nodes, R)
+        return self._decode(enc, out)
+
+    def _existing_only(self, enc: EncodedProblem) -> ScheduleResult:
+        """Host-side step-1-only fill when there are no columns to buy."""
+        res = ScheduleResult()
+        remaining = enc.exist_remaining.copy()
+        for gi, pods in enumerate(enc.groups):
+            req = enc.group_req[gi]
+            cursor = 0
+            for ei in range(len(enc.existing)):
+                if cursor >= len(pods) or not enc.exist_mask[gi, ei]:
+                    continue
+                with np.errstate(divide="ignore", invalid="ignore"):
+                    per = np.where(req > 0, np.floor((remaining[ei] + 1e-3) / np.where(req > 0, req, 1)), np.inf)
+                k = int(min(np.min(per), len(pods) - cursor))
+                if k <= 0:
+                    continue
+                for pod in pods[cursor:cursor + k]:
+                    res.existing_assignments[pod.meta.name] = enc.existing[ei].name
+                remaining[ei] -= k * req
+                cursor += k
+            for pod in pods[cursor:]:
+                res.unschedulable[pod.meta.name] = "no instance types available"
+        return res
+
+    # -- decode ----------------------------------------------------------
+    def _decode(self, enc: EncodedProblem, out: Dict[str, np.ndarray]) -> ScheduleResult:
+        res = ScheduleResult()
+        Gr = enc.n_groups
+        Er = len(enc.existing)
+        num_active = int(out["num_active"])
+
+        take_exist = out["take_exist"][:Gr, :Er].astype(int)
+        take_new = out["take_new"][:Gr, : self.max_nodes].astype(int)
+        unsched = out["unsched"][:Gr].astype(int)
+        node_pool = out["node_pool"]
+        used = out["used"]
+        # reconstruct each active node's surviving-column mask host-side
+        # (cheap numpy; saves shipping the [N,O] device array back):
+        #   columns of the node's pool ∩ every resident group's label mask
+        #   ∩ capacity ≥ final used
+        col_pool = enc.col_pool
+        col_alloc = enc.col_alloc
+
+        # distribute each group's pods: existing nodes first (scan order),
+        # then new nodes, then unschedulable — matching kernel accounting
+        node_pods: Dict[int, List[Pod]] = {}
+        node_groups: Dict[int, List[int]] = {}
+        for gi, pods in enumerate(enc.groups):
+            cursor = 0
+            for ei in range(Er):
+                k = take_exist[gi, ei]
+                for pod in pods[cursor:cursor + k]:
+                    res.existing_assignments[pod.meta.name] = enc.existing[ei].name
+                cursor += k
+            for ni in range(num_active):
+                k = take_new[gi, ni]
+                if k:
+                    node_pods.setdefault(ni, []).extend(pods[cursor:cursor + k])
+                    node_groups.setdefault(ni, []).append(gi)
+                    cursor += k
+            for pod in pods[cursor:cursor + unsched[gi]]:
+                res.unschedulable[pod.meta.name] = self._unsched_reason(enc, gi)
+
+        # claim metadata (requirements + ranked type list) depends only on
+        # (pool, resident groups, used vector) — hundreds of nodes from the
+        # same fill collapse to a handful of distinct computations
+        claim_cache: Dict[tuple, tuple] = {}
+        for ni in range(num_active):
+            pods = node_pods.get(ni, [])
+            if not pods:
+                continue
+            pidx = int(node_pool[ni])
+            pool = enc.pools[pidx]
+            gis = tuple(node_groups.get(ni, []))
+            ckey = (pidx, gis, used[ni].tobytes())
+            cached = claim_cache.get(ckey)
+            if cached is None:
+                nmask = (col_pool == pidx) & np.all(
+                    col_alloc - used[ni][None, :R] >= -1e-3, axis=-1)
+                for gi in gis:
+                    nmask &= enc.group_mask[gi]
+                idxs = np.nonzero(nmask)[0]
+                if len(idxs) == 0:
+                    cached = ("no surviving instance type", None, None, None)
+                else:
+                    reqs = pool.template_requirements()
+                    for gi in gis:
+                        merged = enc.merged_reqs[gi][pidx]
+                        if merged is not None:
+                            reqs = reqs.intersection(merged)
+                    best_price: Dict[str, float] = {}
+                    type_of: Dict[str, object] = {}
+                    for ci in idxs:
+                        c = enc.columns[ci]
+                        if c.price < best_price.get(c.type_name, float("inf")):
+                            best_price[c.type_name] = c.price
+                            type_of[c.type_name] = c.instance_type
+                    ranked = sorted(best_price, key=lambda t: (best_price[t], t))
+                    violation = _min_values_violation(
+                        reqs, [type_of[t] for t in ranked])
+                    cached = (violation, reqs, ranked, best_price)
+                claim_cache[ckey] = cached
+            violation, reqs, ranked, best_price = cached
+            if violation is not None:
+                for pod in pods:
+                    res.unschedulable[pod.meta.name] = violation
+                continue
+            res.new_claims.append(NewNodeClaim(
+                nodepool=pool.name,
+                node_class_ref=pool.node_class_ref,
+                requirements=reqs,
+                pods=pods,
+                requests=Resources(list(used[ni][:R].astype(float))),
+                instance_type_names=ranked,
+                price=best_price[ranked[0]],
+                taints=list(pool.taints),
+                startup_taints=list(pool.startup_taints),
+                hostname=f"tpu-solver-node-{ni}",
+            ))
+        return res
+
+    @staticmethod
+    def _unsched_reason(enc: EncodedProblem, gi: int) -> str:
+        if not enc.group_mask[gi].any() and not enc.exist_mask[gi].any():
+            details = []
+            for pidx, pool in enumerate(enc.pools):
+                if enc.merged_reqs[gi][pidx] is None:
+                    details.append(f"nodepool {pool.name}: incompatible or taints")
+                else:
+                    details.append(f"nodepool {pool.name}: no instance type fits/compatible")
+            return "no nodepool can schedule pod: " + "; ".join(details)
+        return ("no capacity: every compatible node/instance-type " +
+                "combination is exhausted or over limits")
