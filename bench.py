@@ -1,0 +1,68 @@
+"""Headline benchmark — BASELINE.json config #5 class:
+
+50k-pod burst (8 heterogeneous size classes incl. GPU extended resources)
+against the full ~700-type catalog (~4.2k zonal spot/on-demand offerings),
+one NodePool, price-optimal packing on one TPU chip.
+
+North star (BASELINE.md): <200 ms on v5e-1, node count ≤ the FFD oracle.
+vs_baseline = 200ms-target / measured — >1.0 means beating the target.
+
+Prints exactly ONE JSON line on stdout.
+"""
+
+import json
+import statistics
+import sys
+import time
+
+
+def main() -> None:
+    from karpenter_tpu.models import NodePool, ObjectMeta, Pod, Resources
+    from karpenter_tpu.providers import generate_catalog
+    from karpenter_tpu.scheduling import ScheduleInput
+    from karpenter_tpu.solver import TPUSolver
+
+    catalog = generate_catalog()
+    sizes = [
+        {"cpu": "250m", "memory": "512Mi"},
+        {"cpu": "500m", "memory": "1Gi"},
+        {"cpu": "1", "memory": "2Gi"},
+        {"cpu": "2", "memory": "8Gi"},
+        {"cpu": "4", "memory": "8Gi"},
+        {"cpu": "500m", "memory": "2Gi"},
+        {"cpu": "1", "memory": "4Gi"},
+        {"cpu": "8", "memory": "16Gi", "nvidia.com/gpu": 1},
+    ]
+    pods = [
+        Pod(meta=ObjectMeta(name=f"p{i}"),
+            requests=Resources.parse(sizes[i % len(sizes)]))
+        for i in range(50_000)
+    ]
+    pool = NodePool(meta=ObjectMeta(name="default"))
+    inp = ScheduleInput(pods=pods, nodepools=[pool],
+                        instance_types={"default": catalog})
+
+    solver = TPUSolver(max_nodes=2048)
+    res = solver.solve(inp)  # compile + warm caches
+    assert not res.unschedulable, "benchmark workload must fully schedule"
+
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        res = solver.solve(inp)
+        t1 = time.perf_counter()
+        times.append((t1 - t0) * 1000.0)
+    ms = statistics.median(times)
+
+    print(json.dumps({
+        "metric": "schedule 50k pods x 700 instance types (end-to-end, 1 chip)",
+        "value": round(ms, 1),
+        "unit": "ms",
+        "vs_baseline": round(200.0 / ms, 3),
+    }))
+    print(f"nodes={res.node_count()} total_price=${res.total_price():.2f}/h "
+          f"runs={[round(t) for t in times]}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,15 @@
+"""Multi-chip sharding of the solver.
+
+The assignment problem's parallel axes are the catalog (columns) and the
+cluster (existing nodes / node slots) — there is no batch/sequence dimension
+(SURVEY §5 explicitly descopes DP/TP/SP; the scale axis is problem size).
+We shard the offering-column axis over a `jax.sharding.Mesh`: each chip owns
+a slice of the catalog, the per-step maxima (`cap_n`, `k_full`) become
+cross-chip reductions XLA lowers onto ICI, and the scan carry's column mask
+stays fully distributed — one chip's HBM never holds the whole
+nodes×offerings state.
+"""
+
+from karpenter_tpu.parallel.mesh import make_mesh, sharded_solve_ffd
+
+__all__ = ["make_mesh", "sharded_solve_ffd"]

@@ -1,0 +1,63 @@
+"""Mesh construction + GSPMD-sharded solve.
+
+Follows the standard recipe (pick a mesh, annotate shardings, let XLA insert
+collectives): the kernel in `solver/ffd.py` is pure masked arithmetic, so
+partitioning is entirely expressible as in_shardings over the column axis —
+`jnp.max(..., axis=1)` over a sharded axis lowers to an `all-reduce-max`
+over ICI, prefix fills stay local (node axis replicated), and no manual
+collective appears in the kernel.
+
+Axis names:
+  cat   — the offering-column axis O (catalog parallelism; the big axis:
+          pools × types × zones × capacity-types)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from karpenter_tpu.solver import ffd
+
+
+def make_mesh(n_devices: "int | None" = None, axis: str = "cat") -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def sharded_solve_ffd(
+    mesh: Mesh,
+    group_req, group_count, group_mask, exist_mask, exist_remaining,
+    col_alloc, col_daemon, col_pool, pool_daemon, pool_limit,
+    max_nodes: int = 1024,
+    axis: str = "cat",
+):
+    """solve_ffd with the column axis sharded over `mesh`.
+
+    The caller must pad O to a multiple of mesh size (the usual bucket
+    alignment of 512 covers meshes up to 512 chips).
+    """
+    col = NamedSharding(mesh, P(axis))        # [O]
+    col2 = NamedSharding(mesh, P(axis, None)) # [O, R]
+    gcol = NamedSharding(mesh, P(None, axis)) # [G, O]
+    rep = NamedSharding(mesh, P())
+
+    args = (
+        jax.device_put(group_req, rep),
+        jax.device_put(group_count, rep),
+        jax.device_put(group_mask, gcol),
+        jax.device_put(exist_mask, rep),
+        jax.device_put(exist_remaining, rep),
+        jax.device_put(col_alloc, col2),
+        jax.device_put(col_daemon, col2),
+        jax.device_put(col_pool, col),
+        jax.device_put(pool_daemon, rep),
+        jax.device_put(pool_limit, rep),
+    )
+    with mesh:
+        return ffd.solve_ffd(*args, max_nodes=max_nodes)

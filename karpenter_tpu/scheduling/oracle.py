@@ -37,6 +37,7 @@ from karpenter_tpu.scheduling.types import (
     ScheduleInput,
     ScheduleResult,
     effective_request,
+    min_values_violation,
 )
 
 _sim_counter = itertools.count(1)
@@ -223,6 +224,22 @@ class Scheduler:
         return True
 
     # -- in-flight new nodes ---------------------------------------------
+    @staticmethod
+    def _unknown_required_key(pod: Pod, template: Requirements) -> Optional[str]:
+        """A pod requirement on a label that is neither well-known (derivable
+        from instance types/offerings) nor provided by the NodePool template
+        can never be satisfied by a new node (reference: scheduling
+        Requirements allowUndefined discipline — pods may only require labels
+        with known values)."""
+        for r in pod.requirements:
+            if r.key in wellknown.WELL_KNOWN_LABELS:
+                continue
+            if template.get(r.key) is not None:
+                continue
+            if not r.matches_absent():
+                return r.key
+        return None
+
     def _try_add_to_new(self, pod: Pod, req: Resources, sim: _NewSim,
                         commit: bool) -> bool:
         key = pod.scheduling_key()
@@ -243,6 +260,9 @@ class Scheduler:
                 return False
         else:
             if not tolerates_all(sim.pool.taints, pod.tolerations):
+                return False
+            if self._unknown_required_key(
+                    pod, sim.pool.template_requirements()) is not None:
                 return False
             if not sim.requirements.compatible(pod.requirements):
                 return False
@@ -380,6 +400,11 @@ class Scheduler:
                 reasons.append(f"nodepool {pool.name}: taints not tolerated")
                 continue
             template = pool.template_requirements()
+            unknown = self._unknown_required_key(pod, template)
+            if unknown is not None:
+                reasons.append(
+                    f"nodepool {pool.name}: label {unknown} has no known values")
+                continue
             if not template.compatible(pod.requirements):
                 key = template.conflict_key(pod.requirements)
                 reasons.append(f"nodepool {pool.name}: incompatible on {key}")
@@ -440,7 +465,7 @@ class Scheduler:
                 sim.candidates,
                 key=lambda it: (it.cheapest_offering(reqs).price, it.name),
             )
-            violation = self._min_values_violation(reqs, ranked)
+            violation = min_values_violation(reqs, ranked)
             if violation is not None:
                 for pod in sim.pods:
                     self.result.unschedulable[pod.meta.name] = violation
@@ -459,20 +484,3 @@ class Scheduler:
                 hostname=sim.hostname,
             ))
 
-    @staticmethod
-    def _min_values_violation(reqs: Requirements,
-                              types: List[InstanceType]) -> Optional[str]:
-        """NodePool minValues: the surviving type set must expose ≥ N
-        distinct values for the keyed label (nodepools.md:240-304)."""
-        for r in reqs:
-            if r.min_values is None:
-                continue
-            seen: Set[str] = set()
-            for it in types:
-                tr = it.requirements.get(r.key)
-                if tr is not None and tr.is_finite():
-                    seen |= tr.values()
-            if len(seen) < r.min_values:
-                return (f"minValues violated for {r.key}: "
-                        f"{len(seen)} < {r.min_values}")
-        return None

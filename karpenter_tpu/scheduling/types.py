@@ -13,6 +13,23 @@ from karpenter_tpu.models.requirements import Requirements
 from karpenter_tpu.models.resources import Resources
 
 
+def min_values_violation(reqs: Requirements, types) -> "str | None":
+    """NodePool minValues: the surviving instance-type set must expose ≥ N
+    distinct values for the keyed label (nodepools.md:240-304). Shared by
+    the oracle and the solver — parity depends on them agreeing."""
+    for r in reqs:
+        if r.min_values is None:
+            continue
+        seen = set()
+        for it in types:
+            tr = it.requirements.get(r.key)
+            if tr is not None and tr.is_finite():
+                seen |= tr.values()
+        if len(seen) < r.min_values:
+            return f"minValues violated for {r.key}: {len(seen)} < {r.min_values}"
+    return None
+
+
 def effective_request(pod: Pod) -> Resources:
     """A pod's packing footprint: declared requests plus the one pod slot it
     occupies. Shared by the oracle and the solver encoder — parity depends

@@ -189,6 +189,11 @@ class CatalogEncoding:
     col_pool: np.ndarray
     pool_daemon: np.ndarray
     templates: List[Requirements]
+    # per pool: column index array, per-key sliced label matrices, and the
+    # set of keys its columns actually provide (non-absent somewhere)
+    pool_cols: List[np.ndarray] = field(default_factory=list)
+    pool_matrices: List[Dict[str, np.ndarray]] = field(default_factory=list)
+    pool_provides: List[set] = field(default_factory=list)
     device_args: Optional[dict] = None  # device-resident padded arrays
 
 
@@ -231,11 +236,20 @@ def encode_catalog(inp: ScheduleInput) -> CatalogEncoding:
     pool_daemon = np.stack([
         np.array(inp.daemon_overhead.get(p.name, Resources()).v, dtype=np.float32)
         for p in pools]) if pools else np.zeros((1, R), np.float32)
+    pool_cols, pool_matrices, pool_provides = [], [], []
+    for pidx in range(len(pools)):
+        sel = np.nonzero(col_pool == pidx)[0]
+        sliced = {k: v[sel] for k, v in col_matrices.items()}
+        pool_cols.append(sel)
+        pool_matrices.append(sliced)
+        pool_provides.append({k for k, v in sliced.items() if (v != _ABSENT).any()})
     return CatalogEncoding(
         pools=pools, columns=columns, vocab=vocab, col_matrices=col_matrices,
         col_alloc=col_alloc, col_daemon=col_daemon, col_price=col_price,
         col_pool=col_pool, pool_daemon=pool_daemon,
         templates=[p.template_requirements() for p in pools],
+        pool_cols=pool_cols, pool_matrices=pool_matrices,
+        pool_provides=pool_provides,
     )
 
 
@@ -280,10 +294,31 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None) -> Encoded
                 continue
             merged = template.intersection(rep.requirements)
             merged_per_pool.append(merged)
-            sel = pool_col == pidx
-            if sel.any():
-                ok = _eval_requirements(merged, vocab, col_matrices, O)
-                gmask |= ok & sel
+            sel = cat.pool_cols[pidx]
+            if len(sel) == 0:
+                continue
+            # Split merged requirements three ways (oracle's open-world type
+            # check, tensorized):
+            #   column-provided key   → vectorized closed-world check
+            #   template-provided key → already validated by the template ∩
+            #                           pod intersection; the node itself
+            #                           will carry the label
+            #   neither               → satisfiable only by absence
+            col_checked = Requirements()
+            feasible = True
+            for req_ in merged:
+                if req_.key in cat.pool_provides[pidx]:
+                    col_checked.add(req_)
+                elif template.get(req_.key) is not None:
+                    continue
+                elif not req_.matches_absent():
+                    feasible = False
+                    break
+            if not feasible:
+                continue
+            ok = _eval_requirements(col_checked, vocab,
+                                    cat.pool_matrices[pidx], len(sel))
+            gmask[sel[ok]] = True
         group_mask[gi] = gmask
         merged_reqs.append(merged_per_pool)
 

@@ -28,7 +28,6 @@ import jax
 import jax.numpy as jnp
 
 EPS = 1e-3
-BIG = jnp.int32(2**30)
 
 
 def _fit_count(avail: jnp.ndarray, req: jnp.ndarray) -> jnp.ndarray:

@@ -18,6 +18,7 @@ from karpenter_tpu.scheduling.types import (
     NewNodeClaim,
     ScheduleInput,
     ScheduleResult,
+    min_values_violation,
 )
 from karpenter_tpu.solver import ffd
 from karpenter_tpu.solver.encode import EncodedProblem, bucket, encode
@@ -42,20 +43,6 @@ def _supported(pod: Pod) -> bool:
     return True
 
 
-def _min_values_violation(reqs: Requirements, types) -> Optional[str]:
-    for r in reqs:
-        if r.min_values is None:
-            continue
-        seen = set()
-        for it in types:
-            tr = it.requirements.get(r.key)
-            if tr is not None and tr.is_finite():
-                seen |= tr.values()
-        if len(seen) < r.min_values:
-            return f"minValues violated for {r.key}: {len(seen)} < {r.min_values}"
-    return None
-
-
 class TPUSolver:
     def __init__(self, max_nodes: int = 1024):
         self.max_nodes = max_nodes
@@ -75,7 +62,9 @@ class TPUSolver:
         lists = tuple(inp.instance_types.get(p.name) for p in pools)
         key = (
             lists,
-            tuple(p.static_hash() for p in pools),
+            # static_hash covers the template; name+weight cover identity and
+            # priority order, which the hash deliberately excludes
+            tuple((p.meta.name, p.weight, p.static_hash()) for p in pools),
             tuple(sorted((k, tuple(v.v)) for k, v in inp.daemon_overhead.items())),
         )
         def _same(a, b):
@@ -242,7 +231,7 @@ class TPUSolver:
                             best_price[c.type_name] = c.price
                             type_of[c.type_name] = c.instance_type
                     ranked = sorted(best_price, key=lambda t: (best_price[t], t))
-                    violation = _min_values_violation(
+                    violation = min_values_violation(
                         reqs, [type_of[t] for t in ranked])
                     cached = (violation, reqs, ranked, best_price)
                 claim_cache[ckey] = cached

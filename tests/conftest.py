@@ -4,14 +4,19 @@ Tests run on CPU with 8 virtual devices so multi-chip sharding paths are
 exercised without TPU hardware (the driver separately dry-runs the multichip
 path; real-chip numbers come from bench.py).
 
-Must run before the first `import jax` anywhere in the test process.
+Note: env vars alone are not enough here — the axon site bootstrap calls
+`jax.config.update("jax_platforms", "axon,cpu")`, and jax config beats the
+environment. We update the config back before any backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -268,3 +268,33 @@ class TestReviewRegressions:
         inp2 = mkinput([mkpod("b")], types=small)
         r2 = solver.solve(inp2)
         assert len(r2.new_claims[0].instance_type_names) <= 5 * 1
+
+    def test_template_custom_requirement_parity(self):
+        """A pool template requirement on a custom (non-catalog) key is
+        provided by the node itself — columns must not be rejected for
+        lacking it."""
+        pool = NodePool(meta=ObjectMeta(name="teamed"), requirements=Requirements(
+            Requirement.single("example.com/team", "ml")))
+        inp = mkinput([mkpod("p0")], pools=[pool])
+        oracle, solver = both(inp)
+        assert not oracle.unschedulable and not solver.unschedulable
+        assert solver.node_count() == oracle.node_count() == 1
+        # and a pod requiring a key nobody provides stays unschedulable
+        ghost = mkpod("ghost")
+        ghost.requirements = Requirements(Requirement.single("example.com/rack", "r1"))
+        o2, s2 = both(mkinput([ghost], pools=[pool]))
+        assert set(s2.unschedulable) == set(o2.unschedulable) == {"ghost"}
+
+    def test_pool_weight_flip_invalidates_cache(self):
+        a = NodePool(meta=ObjectMeta(name="a"), weight=10)
+        b = NodePool(meta=ObjectMeta(name="b"))
+        solver = TPUSolver()
+        shared = list(CATALOG)
+        inp1 = ScheduleInput(pods=[mkpod("x")], nodepools=[a, b],
+                             instance_types={"a": shared, "b": shared})
+        assert solver.solve(inp1).new_claims[0].nodepool == "a"
+        a2 = NodePool(meta=ObjectMeta(name="a"))
+        b2 = NodePool(meta=ObjectMeta(name="b"), weight=10)
+        inp2 = ScheduleInput(pods=[mkpod("y")], nodepools=[a2, b2],
+                             instance_types={"a": shared, "b": shared})
+        assert solver.solve(inp2).new_claims[0].nodepool == "b"
