@@ -1,0 +1,137 @@
+"""In-memory cluster state — the kube-apiserver + informer-cache analogue.
+
+The reference's controllers watch CRs through controller-runtime's informer
+cache and reconcile; all durable state is CRDs in etcd (SURVEY §5
+checkpoint/resume: "recovery = relist"). Our control plane is in-process, so
+the store IS the cluster: typed collections with resource versions,
+finalizer-aware deletion, and a global mutation counter the controller
+manager uses to run reconcilers to a fixed point deterministically.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Optional, TypeVar
+
+from karpenter_tpu.models.objects import (
+    Node,
+    NodeClaim,
+    NodeClass,
+    NodePool,
+    Pod,
+)
+from karpenter_tpu.utils.clock import Clock, RealClock
+
+T = TypeVar("T")
+
+
+class Store:
+    """One typed collection with k8s-ish semantics."""
+
+    def __init__(self, cluster: "Cluster"):
+        self._items: Dict[str, object] = {}
+        self._cluster = cluster
+
+    def create(self, obj) -> object:
+        name = obj.meta.name
+        if name in self._items:
+            raise ValueError(f"already exists: {name}")
+        obj.meta.creation_time = self._cluster.clock.now()
+        self._items[name] = obj
+        self._cluster.mutated()
+        return obj
+
+    def get(self, name: str):
+        return self._items.get(name)
+
+    def update(self, obj) -> None:
+        obj.meta.resource_version += 1
+        self._cluster.mutated()
+
+    def delete(self, name: str) -> None:
+        """Finalizer-aware: objects with finalizers are only marked deleting;
+        removal happens when the last finalizer is stripped
+        (reference termination flow — disruption.md:29-36)."""
+        obj = self._items.get(name)
+        if obj is None:
+            return
+        if obj.meta.finalizers:
+            if obj.meta.deletion_time is None:
+                obj.meta.deletion_time = self._cluster.clock.now()
+                self._cluster.mutated()
+            return
+        del self._items[name]
+        self._cluster.mutated()
+
+    def remove_finalizer(self, name: str, finalizer: str) -> None:
+        obj = self._items.get(name)
+        if obj is None:
+            return
+        if finalizer in obj.meta.finalizers:
+            obj.meta.finalizers.remove(finalizer)
+            self._cluster.mutated()
+        if obj.meta.deleting and not obj.meta.finalizers:
+            del self._items[name]
+            self._cluster.mutated()
+
+    def list(self, filter_: Optional[Callable[[T], bool]] = None) -> List:
+        out = list(self._items.values())
+        if filter_ is not None:
+            out = [o for o in out if filter_(o)]
+        return out
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._items
+
+
+class Cluster:
+    def __init__(self, clock: Optional[Clock] = None):
+        self.clock = clock or RealClock()
+        self.generation = 0  # bumps on every mutation anywhere
+        self.pods = Store(self)
+        self.nodes = Store(self)
+        self.nodeclaims = Store(self)
+        self.nodepools = Store(self)
+        self.nodeclasses = Store(self)
+        self.events: List[tuple] = []  # (time, kind, object, reason, message)
+
+    def mutated(self) -> None:
+        self.generation += 1
+
+    def record_event(self, kind: str, obj_name: str, reason: str,
+                     message: str = "") -> None:
+        """Deduplicated event recorder (reference: sigs.k8s.io/karpenter
+        pkg/events)."""
+        recent = [(k, o, r) for _, k, o, r, _ in self.events[-50:]]
+        if (kind, obj_name, reason) in recent:
+            return
+        self.events.append(
+            (self.clock.now(), kind, obj_name, reason, message))
+
+    # -- convenience views ------------------------------------------------
+    def pending_pods(self) -> List[Pod]:
+        return self.pods.list(
+            lambda p: not p.scheduled and not p.is_daemonset
+            and not p.meta.deleting)
+
+    def daemonset_pods(self) -> List[Pod]:
+        return self.pods.list(lambda p: p.is_daemonset)
+
+    def pods_on_node(self, node_name: str) -> List[Pod]:
+        return self.pods.list(lambda p: p.node_name == node_name)
+
+    def node_for_claim(self, claim: NodeClaim) -> Optional[Node]:
+        if claim.node_name is not None:
+            return self.nodes.get(claim.node_name)
+        for node in self.nodes.list():
+            if node.provider_id and node.provider_id == claim.provider_id:
+                return node
+        return None
+
+    def claim_for_node(self, node: Node) -> Optional[NodeClaim]:
+        for claim in self.nodeclaims.list():
+            if claim.provider_id and claim.provider_id == node.provider_id:
+                return claim
+        return None

@@ -1,0 +1,16 @@
+"""Reconcilers (reference: sigs.k8s.io/karpenter/pkg/controllers — the core
+set — plus the provider controllers of pkg/controllers)."""
+
+from karpenter_tpu.controllers.manager import ControllerManager
+from karpenter_tpu.controllers.provisioning import Provisioner
+from karpenter_tpu.controllers.lifecycle import NodeClaimLifecycle
+from karpenter_tpu.controllers.kubelet import FakeKubelet
+from karpenter_tpu.controllers.binder import PodBinder
+
+__all__ = [
+    "ControllerManager",
+    "Provisioner",
+    "NodeClaimLifecycle",
+    "FakeKubelet",
+    "PodBinder",
+]

@@ -1,0 +1,74 @@
+"""Environment — the DI container wiring clock, fake cloud, providers,
+cloud provider, cluster, and controllers (reference:
+pkg/test/environment.go — "wires all real providers against fake AWS APIs";
+also the shape of pkg/operator.NewOperator's provider construction).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from karpenter_tpu.cloudprovider import TPUCloudProvider
+from karpenter_tpu.cluster import Cluster
+from karpenter_tpu.controllers import (
+    ControllerManager,
+    FakeKubelet,
+    NodeClaimLifecycle,
+    PodBinder,
+    Provisioner,
+)
+from karpenter_tpu.models.objects import InstanceType, NodeClass, ObjectMeta
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.providers.catalog import CatalogSpec
+from karpenter_tpu.providers.fake_cloud import FakeCloud
+from karpenter_tpu.providers.instancetype import InstanceTypeProvider
+from karpenter_tpu.providers.pricing import PricingProvider
+from karpenter_tpu.utils.cache import UnavailableOfferings
+from karpenter_tpu.utils.clock import Clock, FakeClock
+
+
+class Environment:
+    def __init__(
+        self,
+        clock: Optional[Clock] = None,
+        catalog: Optional[List[InstanceType]] = None,
+        options: Optional[Options] = None,
+        catalog_spec: Optional[CatalogSpec] = None,
+    ):
+        self.clock = clock or FakeClock()
+        self.options = options or Options()
+        self.cloud = FakeCloud(catalog=catalog, clock=self.clock,
+                               spec=catalog_spec)
+        self.pricing = PricingProvider(self.cloud)
+        self.unavailable = UnavailableOfferings(clock=self.clock)
+        self.instance_types = InstanceTypeProvider(
+            self.cloud, self.pricing, self.unavailable, clock=self.clock)
+        self.cluster = Cluster(clock=self.clock)
+        self.cloud_provider = TPUCloudProvider(
+            cloud=self.cloud,
+            instance_types=self.instance_types,
+            unavailable=self.unavailable,
+            node_classes=self.cluster.nodeclasses,
+            cluster_name=self.options.cluster_name,
+        )
+        self.provisioner = Provisioner(
+            self.cluster, self.cloud_provider, self.options, self.clock)
+        self.lifecycle = NodeClaimLifecycle(
+            self.cluster, self.cloud_provider, self.options, self.clock)
+        self.kubelet = FakeKubelet(self.cluster, self.cloud_provider)
+        self.binder = PodBinder(self.cluster)
+        self.manager = ControllerManager(self.cluster, [
+            self.provisioner,
+            self.lifecycle,
+            self.kubelet,
+            self.binder,
+        ])
+
+    # -- conveniences -----------------------------------------------------
+    def add_default_nodeclass(self, **kw) -> NodeClass:
+        nc = NodeClass(meta=ObjectMeta(name=kw.pop("name", "default")), **kw)
+        self.cluster.nodeclasses.create(nc)
+        return nc
+
+    def settle(self, max_rounds: int = 50) -> int:
+        return self.manager.run_until_idle(max_rounds)

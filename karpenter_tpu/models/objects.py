@@ -137,7 +137,10 @@ class Pod:
                  tuple(sorted(t.label_selector.items())))
                 for t in self.pod_affinities
             ),
-            tuple((w, reqs) for w, reqs in self.preferences),
+            # NOTE: preferences intentionally excluded — preferred affinity is
+            # not yet consumed by either scheduler, so preference-differing
+            # pods are genuinely interchangeable; fold them in when
+            # preference relaxation lands
             tuple(sorted(self.meta.labels.items())),
             self.priority,
             self.is_daemonset,

@@ -1,0 +1,6 @@
+"""Operator: options, feature gates, and the wiring of providers +
+controllers (reference: pkg/operator + pkg/operator/options)."""
+
+from karpenter_tpu.operator.options import Options, FeatureGates
+
+__all__ = ["Options", "FeatureGates"]

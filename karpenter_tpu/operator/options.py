@@ -1,0 +1,64 @@
+"""Options + feature gates.
+
+Mirrors the reference's layered flag/env config (pkg/operator/options;
+settings documented at website/.../settings.md). The TPU solver toggle is a
+feature gate exactly like the reference's FEATURE_GATES string
+(`SpotToSpotConsolidation=true` style — SURVEY §5 config/flag system).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict
+
+
+@dataclass
+class FeatureGates:
+    tpu_solver: bool = True            # TPUSolver=true: device hot path on
+    spot_to_spot_consolidation: bool = True
+    drift: bool = True
+
+    @classmethod
+    def parse(cls, s: str) -> "FeatureGates":
+        """FEATURE_GATES=TPUSolver=true,Drift=false"""
+        gates = cls()
+        mapping = {
+            "TPUSolver": "tpu_solver",
+            "SpotToSpotConsolidation": "spot_to_spot_consolidation",
+            "Drift": "drift",
+        }
+        for part in s.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            key, _, val = part.partition("=")
+            attr = mapping.get(key)
+            if attr is not None:
+                setattr(gates, attr, val.strip().lower() == "true")
+        return gates
+
+
+@dataclass
+class Options:
+    cluster_name: str = "default-cluster"
+    # pod batching window (settings.md BATCH_IDLE_DURATION / BATCH_MAX_DURATION)
+    batch_idle_duration: float = 1.0
+    batch_max_duration: float = 10.0
+    # lifecycle
+    registration_ttl: float = 15 * 60.0   # never-registered GC (designs/limits.md:23-25)
+    # solver
+    solver_max_nodes: int = 1024
+    feature_gates: FeatureGates = field(default_factory=FeatureGates)
+
+    @classmethod
+    def from_env(cls) -> "Options":
+        opts = cls()
+        opts.cluster_name = os.environ.get("CLUSTER_NAME", opts.cluster_name)
+        if "BATCH_IDLE_DURATION" in os.environ:
+            opts.batch_idle_duration = float(os.environ["BATCH_IDLE_DURATION"])
+        if "BATCH_MAX_DURATION" in os.environ:
+            opts.batch_max_duration = float(os.environ["BATCH_MAX_DURATION"])
+        if "FEATURE_GATES" in os.environ:
+            opts.feature_gates = FeatureGates.parse(os.environ["FEATURE_GATES"])
+        return opts

@@ -80,6 +80,17 @@ class TopologyTracker:
         return self._match_cache[key]
 
     # -- queries ---------------------------------------------------------
+    def eligible_domains(self, pod: Pod, topology_key: str) -> Set[str]:
+        """Domains the pod could ever use for a key: all the cluster knows,
+        filtered by the pod's own hard requirement on that key (k8s
+        nodeAffinityPolicy: Honor — domains the pod's affinity excludes do
+        not participate in skew)."""
+        known = self.known_domains.get(topology_key, set())
+        req = pod.requirements.get(topology_key)
+        if req is None:
+            return set(known)
+        return {d for d in known if req.matches(d)}
+
     def spread_allowed_domains(
         self,
         pod: Pod,
@@ -89,17 +100,16 @@ class TopologyTracker:
         """Domains where adding this pod keeps skew ≤ maxSkew (DoNotSchedule).
 
         Skew is measured over the *eligible* domain set — every domain the
-        cluster knows for the key restricted to candidates the pod could use
-        (k8s counts empty eligible domains as 0). With minDomains set, while
-        fewer than minDomains domains hold matching pods, the global minimum
-        is treated as 0, forcing spreading to empty domains.
+        pod could use given its own node constraints, with empty eligible
+        domains counting as 0. With minDomains set, while fewer than
+        minDomains domains hold matching pods, the global minimum is treated
+        as 0, forcing spreading to empty domains.
         """
         if constraint.when_unsatisfiable != "DoNotSchedule":
             return set(candidate_domains)
         counts = self.ensure_spread_counter(constraint)
-        eligible = set(candidate_domains) | {
-            d for d in self.known_domains.get(constraint.topology_key, set())
-        }
+        eligible = set(candidate_domains) | self.eligible_domains(
+            pod, constraint.topology_key)
         if not eligible:
             return set(candidate_domains)
         global_min = min(counts.get(d, 0) for d in eligible)

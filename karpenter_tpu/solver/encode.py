@@ -265,9 +265,12 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None) -> Encoded
     E = len(inp.existing_nodes)
     G = len(groups)
 
+    # existing-node labels (hostnames are per-node-unique) go into a
+    # per-call vocab so node churn can't grow the cached catalog vocab
+    exist_vocab = _Vocab()
     exist_keys = sorted({k for en in inp.existing_nodes for k in en.node.labels})
     exist_matrices = _label_matrix(
-        vocab, exist_keys, [en.node.labels for en in inp.existing_nodes])
+        exist_vocab, exist_keys, [en.node.labels for en in inp.existing_nodes])
 
     group_req = np.zeros((G, R), dtype=np.float32)
     group_count = np.zeros(G, dtype=np.int32)
@@ -323,7 +326,8 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None) -> Encoded
         merged_reqs.append(merged_per_pool)
 
         if E:
-            ok = _eval_requirements(rep.requirements, vocab, exist_matrices, E)
+            ok = _eval_requirements(rep.requirements, exist_vocab,
+                                    exist_matrices, E)
             for ei, en in enumerate(inp.existing_nodes):
                 if not ok[ei]:
                     continue

@@ -350,3 +350,18 @@ class TestReviewRegressions:
         assert not res.unschedulable
         for c in res.new_claims:
             assert "tpu-west-1a" not in c.requirements.get(wellknown.ZONE_LABEL).values()
+
+    def test_spread_skew_ignores_unusable_domains(self):
+        """k8s nodeAffinityPolicy Honor: zones the pod's own selector
+        excludes don't drive skew (review regression)."""
+        spread = TopologySpreadConstraint(
+            topology_key=wellknown.ZONE_LABEL, max_skew=1,
+            label_selector={"app": "w"})
+        pods = []
+        for i in range(3):
+            p = mkpod(f"w{i}", labels={"app": "w"}, topology_spread=[spread])
+            p.requirements = Requirements(
+                Requirement.make(wellknown.ZONE_LABEL, "In", "tpu-west-1a"))
+            pods.append(p)
+        res = solve(pods)
+        assert not res.unschedulable  # all three land in the only usable zone
