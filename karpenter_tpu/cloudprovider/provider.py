@@ -26,7 +26,6 @@ from karpenter_tpu.models.objects import (
     InstanceType,
     NodeClaim,
     NodeClass,
-    ObjectMeta,
 )
 from karpenter_tpu.models.requirements import Requirement
 from karpenter_tpu.providers.fake_cloud import (

@@ -95,6 +95,7 @@ class Cluster:
         self.nodeclaims = Store(self)
         self.nodepools = Store(self)
         self.nodeclasses = Store(self)
+        self.pdbs = Store(self)
         self.events: List[tuple] = []  # (time, kind, object, reason, message)
 
     def mutated(self) -> None:
@@ -135,3 +136,25 @@ class Cluster:
             if claim.provider_id and claim.provider_id == node.provider_id:
                 return claim
         return None
+
+    # -- eviction budget (PDB) --------------------------------------------
+    def pdb_disruptions_allowed(self, pod: Pod) -> Optional[int]:
+        """The tightest remaining voluntary-disruption budget covering the
+        pod, or None if no PDB selects it. 'unavailable' = selected pods
+        currently not Running."""
+        tightest: Optional[int] = None
+        for pdb in self.pdbs.list():
+            if not pdb.matches(pod):
+                continue
+            selected = self.pods.list(lambda p: pdb.matches(p))
+            unavailable = sum(
+                1 for p in selected
+                if p.phase != "Running" or p.meta.deleting)
+            allowed = pdb.max_unavailable - unavailable
+            if tightest is None or allowed < tightest:
+                tightest = allowed
+        return tightest
+
+    def can_evict(self, pod: Pod) -> bool:
+        allowed = self.pdb_disruptions_allowed(pod)
+        return allowed is None or allowed > 0

@@ -6,6 +6,11 @@ from karpenter_tpu.controllers.provisioning import Provisioner
 from karpenter_tpu.controllers.lifecycle import NodeClaimLifecycle
 from karpenter_tpu.controllers.kubelet import FakeKubelet
 from karpenter_tpu.controllers.binder import PodBinder
+from karpenter_tpu.controllers.termination import Termination
+from karpenter_tpu.controllers.interruption import Interruption
+from karpenter_tpu.controllers.gc import GarbageCollection
+from karpenter_tpu.controllers.expiration import Expiration
+from karpenter_tpu.controllers.disruption import Disruption
 
 __all__ = [
     "ControllerManager",
@@ -13,4 +18,9 @@ __all__ = [
     "NodeClaimLifecycle",
     "FakeKubelet",
     "PodBinder",
+    "Termination",
+    "Interruption",
+    "GarbageCollection",
+    "Expiration",
+    "Disruption",
 ]

@@ -1,0 +1,405 @@
+"""Disruption controller — drift, emptiness, and consolidation.
+
+The second hot path (SURVEY §3.3). Flow per disruption.md:14-27: build
+candidates from cluster state → budget check → scheduling SIMULATION →
+taint → pre-spin replacement → wait Ready → delete. Methods run in order
+Drift → Emptiness → Multi-node consolidation → Single-node consolidation
+(disruption.md:90-101), one command at a time.
+
+Candidate ranking follows designs/consolidation.md:25-42: disruption cost =
+Σ over evictable pods of (1 + deletion-cost & priority weights), scaled by
+the node's remaining lifetime fraction (1.0 at creation → 0.0 at expiry).
+
+Consolidation decisions:
+  delete   — candidate's pods fit on the remaining nodes, no new capacity
+  replace  — pods fit with exactly ONE new node strictly cheaper than the
+             candidates it replaces; spot→spot additionally requires ≥15
+             instance-type flexibility in the replacement
+             (disruption.md:123-132) and its feature gate
+Multi-node tries the cheapest-to-disrupt prefix of candidates first and
+shrinks until feasible (the reference's heuristic subset search).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from karpenter_tpu.cloudprovider import TPUCloudProvider
+from karpenter_tpu.cluster import Cluster
+from karpenter_tpu.controllers.provisioning import create_claim_from_spec
+from karpenter_tpu.controllers.state import GatedSolver, build_schedule_input
+from karpenter_tpu.models import wellknown
+from karpenter_tpu.models.objects import (
+    CONSOLIDATE_WHEN_EMPTY,
+    CONSOLIDATE_WHEN_EMPTY_OR_UNDERUTILIZED,
+    COND_INITIALIZED,
+    Node,
+    NodeClaim,
+    NodePool,
+)
+from karpenter_tpu.models.taints import NO_SCHEDULE, Taint
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.scheduling import ScheduleResult
+from karpenter_tpu.scheduling.types import ScheduleInput
+from karpenter_tpu.utils.clock import Clock
+
+SPOT_TO_SPOT_MIN_TYPES = 15  # disruption.md:123-132
+
+REASON_DRIFT = "Drifted"
+REASON_EMPTY = "Empty"
+REASON_UNDERUTILIZED = "Underutilized"
+
+DISRUPTING_TAINT = Taint(wellknown.DISRUPTION_TAINT_KEY, "disrupting",
+                         NO_SCHEDULE)
+
+
+@dataclass
+class Candidate:
+    claim: NodeClaim
+    node: Node
+    pool: NodePool
+    reschedulable: List = field(default_factory=list)  # non-daemon pods
+    price: float = 0.0
+    cost: float = 0.0  # disruption cost for ranking
+
+
+@dataclass
+class Command:
+    """An in-flight disruption: replacements must initialize before the
+    candidates are deleted (pre-spin — disruption.md:14-27)."""
+    reason: str
+    candidate_names: List[str]
+    replacement_names: List[str]
+    started: float
+
+
+class Disruption:
+    name = "disruption"
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        cloud_provider: TPUCloudProvider,
+        options: Optional[Options] = None,
+        clock: Optional[Clock] = None,
+        solver: Optional[GatedSolver] = None,
+    ):
+        self.cluster = cluster
+        self.cp = cloud_provider
+        self.options = options or Options()
+        self.clock = clock or cluster.clock
+        self.solver = solver or GatedSolver(self.options, cluster)
+        self.commands: List[Command] = []
+        self._replacement_seq = 0
+        self.command_timeout = 10 * 60.0
+        # replacements stay off the candidate list until pods land on them
+        # (or the grace period lapses) — otherwise the emptiness method can
+        # delete a just-initialized replacement before evicted pods rebind
+        self._protected: Dict[str, float] = {}
+        self.protection_grace = 5 * 60.0
+
+    # ------------------------------------------------------------------
+    def reconcile(self) -> None:
+        if self._process_commands():
+            return  # one in-flight command at a time (minimal-change bias)
+        candidates = self._build_candidates()
+        if not candidates:
+            return
+        for method in (self._drift, self._emptiness,
+                       self._multi_node, self._single_node):
+            if method(candidates):
+                return
+
+    # -- in-flight commands ----------------------------------------------
+    def _process_commands(self) -> bool:
+        still: List[Command] = []
+        for cmd in self.commands:
+            done, abort = self._command_state(cmd)
+            if done:
+                for name in cmd.candidate_names:
+                    self.cluster.nodeclaims.delete(name)
+                self.cluster.record_event(
+                    "Disruption", ",".join(cmd.candidate_names),
+                    f"Disrupted{cmd.reason}",
+                    f"replacements: {cmd.replacement_names or 'none'}")
+            elif abort:
+                self._abort(cmd)
+            else:
+                still.append(cmd)
+        self.commands = still
+        return bool(still)
+
+    def _command_state(self, cmd: Command) -> tuple:
+        if self.clock.now() - cmd.started > self.command_timeout:
+            return False, True
+        for name in cmd.replacement_names:
+            rep = self.cluster.nodeclaims.get(name)
+            if rep is None:
+                return False, True  # replacement failed terminally
+            if not rep.is_(COND_INITIALIZED):
+                return False, False
+        return True, False
+
+    def _abort(self, cmd: Command) -> None:
+        for name in cmd.replacement_names:
+            self.cluster.nodeclaims.delete(name)
+        for name in cmd.candidate_names:
+            claim = self.cluster.nodeclaims.get(name)
+            node = self.cluster.node_for_claim(claim) if claim else None
+            if node is not None:
+                node.taints = [t for t in node.taints
+                               if t.key != wellknown.DISRUPTION_TAINT_KEY]
+                self.cluster.nodes.update(node)
+        self.cluster.record_event(
+            "Disruption", ",".join(cmd.candidate_names),
+            "DisruptionAborted", cmd.reason)
+
+    # -- candidates -------------------------------------------------------
+    def _build_candidates(self) -> List[Candidate]:
+        out: List[Candidate] = []
+        in_flight = {n for cmd in self.commands for n in cmd.candidate_names}
+        now = self.clock.now()
+        # drop stale protections (claim gone, grace lapsed, or pods landed)
+        for name, t in list(self._protected.items()):
+            claim = self.cluster.nodeclaims.get(name)
+            if claim is None or now - t > self.protection_grace:
+                del self._protected[name]
+            elif claim.node_name and self.cluster.pods_on_node(claim.node_name):
+                del self._protected[name]
+        for claim in self.cluster.nodeclaims.list():
+            if claim.meta.deleting or claim.name in in_flight:
+                continue
+            if claim.name in self._protected:
+                continue  # fresh replacement: evicted pods haven't landed yet
+            if not claim.is_(COND_INITIALIZED):
+                continue
+            node = self.cluster.node_for_claim(claim)
+            if node is None or node.meta.deleting or not node.ready:
+                continue
+            pool = self.cluster.nodepools.get(claim.nodepool)
+            if pool is None:
+                continue
+            # minimum settle time before consolidation (consolidate_after)
+            settle = pool.disruption.consolidate_after
+            if claim.launch_time is not None and now - claim.launch_time < settle:
+                continue
+            pods = self.cluster.pods_on_node(node.name)
+            resched = [p for p in pods if not p.is_daemonset]
+            # blocking pods (designs/consolidation.md:46-52)
+            if any(p.do_not_disrupt() or p.owner_kind is None
+                   or not self.cluster.can_evict(p) for p in resched):
+                continue
+            out.append(Candidate(
+                claim=claim, node=node, pool=pool, reschedulable=resched,
+                price=self._node_price(claim, node),
+                cost=self._disruption_cost(claim, pool, resched, now),
+            ))
+        out.sort(key=lambda c: c.cost)
+        return out
+
+    def _node_price(self, claim: NodeClaim, node: Node) -> float:
+        itype = node.instance_type
+        zone = node.zone
+        ct = node.capacity_type
+        if itype and zone and ct:
+            p = self.cp.instance_types.pricing.price(itype, zone, ct)
+            if p is not None:
+                return p
+        return 0.0
+
+    def _disruption_cost(self, claim: NodeClaim, pool: NodePool,
+                         pods: List, now: float) -> float:
+        base = sum(
+            1.0 + max(p.priority, 0) / 1e6 + p.deletion_cost() / 1e3
+            for p in pods)
+        lifetime = 1.0
+        if pool.expire_after and claim.launch_time is not None:
+            remaining = pool.expire_after - (now - claim.launch_time)
+            lifetime = max(0.0, min(1.0, remaining / pool.expire_after))
+        return base * lifetime
+
+    # -- budgets ----------------------------------------------------------
+    def _budget_allows(self, pool: NodePool, reason: str, want: int) -> int:
+        total = len([
+            c for c in self.cluster.nodeclaims.list(
+                lambda c: c.nodepool == pool.name)
+        ])
+        disrupting = len([
+            c for c in self.cluster.nodeclaims.list(
+                lambda c: c.nodepool == pool.name and c.meta.deleting)
+        ]) + sum(
+            1 for cmd in self.commands for n in cmd.candidate_names
+            if (cl := self.cluster.nodeclaims.get(n)) is not None
+            and cl.nodepool == pool.name)
+        allowed = None
+        for budget in pool.disruption.budgets:
+            if budget.reasons is not None and reason not in budget.reasons:
+                continue
+            a = budget.allowed_disruptions(total)
+            allowed = a if allowed is None else min(allowed, a)
+        if allowed is None:
+            allowed = total
+        return max(0, min(want, allowed - disrupting))
+
+    # -- methods ----------------------------------------------------------
+    def _drift(self, candidates: List[Candidate]) -> bool:
+        if not self.options.feature_gates.drift:
+            return False
+        for cand in candidates:
+            reason = self._drift_reason(cand)
+            if reason is None:
+                continue
+            if self._budget_allows(cand.pool, REASON_DRIFT, 1) < 1:
+                continue
+            # drifted capacity is replaced in kind: feasibility simulation
+            # without the cheaper-price requirement
+            sim = self._simulate([cand], price_cap=None)
+            if sim is None:
+                self.cluster.record_event(
+                    "NodeClaim", cand.claim.name, "Undisruptable",
+                    "drifted but pods cannot reschedule")
+                continue
+            self._execute(REASON_DRIFT, [cand], sim)
+            return True
+        return False
+
+    def _drift_reason(self, cand: Candidate) -> Optional[str]:
+        pool_hash = cand.pool.static_hash()
+        stamped = cand.claim.meta.annotations.get(
+            wellknown.NODEPOOL_HASH_ANNOTATION)
+        if stamped is not None and stamped != pool_hash:
+            return "NodePoolDrift"
+        return self.cp.is_drifted(cand.claim)
+
+    def _emptiness(self, candidates: List[Candidate]) -> bool:
+        empty = [c for c in candidates if not c.reschedulable
+                 and c.pool.disruption.consolidation_policy in (
+                     CONSOLIDATE_WHEN_EMPTY,
+                     CONSOLIDATE_WHEN_EMPTY_OR_UNDERUTILIZED)]
+        if not empty:
+            return False
+        by_pool: Dict[str, List[Candidate]] = {}
+        for c in empty:
+            by_pool.setdefault(c.pool.name, []).append(c)
+        acted = False
+        for pool_name, cands in by_pool.items():
+            n = self._budget_allows(cands[0].pool, REASON_EMPTY, len(cands))
+            for cand in cands[:n]:
+                self.cluster.record_event(
+                    "NodeClaim", cand.claim.name, "DisruptedEmpty", "")
+                self.cluster.nodeclaims.delete(cand.claim.name)
+                acted = True
+        return acted
+
+    def _consolidatable(self, candidates: List[Candidate]) -> List[Candidate]:
+        return [
+            c for c in candidates
+            if c.pool.disruption.consolidation_policy
+            == CONSOLIDATE_WHEN_EMPTY_OR_UNDERUTILIZED
+            and c.reschedulable  # empties are handled by emptiness
+        ]
+
+    def _multi_node(self, candidates: List[Candidate]) -> bool:
+        cands = self._consolidatable(candidates)
+        if len(cands) < 2:
+            return False
+        # shrink the cheapest-to-disrupt prefix until feasible
+        k = len(cands)
+        while k >= 2:
+            subset = cands[:k]
+            # budgets are per pool over the WHOLE subset — each pool must
+            # allow as many concurrent disruptions as the subset takes
+            per_pool: Dict[str, int] = {}
+            for c in subset:
+                per_pool[c.pool.name] = per_pool.get(c.pool.name, 0) + 1
+            pools = {c.pool.name: c.pool for c in subset}
+            if any(self._budget_allows(pools[name], REASON_UNDERUTILIZED, n) < n
+                   for name, n in per_pool.items()):
+                k -= 1
+                continue
+            total_price = sum(c.price for c in subset)
+            sim = self._simulate(subset, price_cap=total_price)
+            if sim is not None and self._acceptable(subset, sim):
+                self._execute(REASON_UNDERUTILIZED, subset, sim)
+                return True
+            k -= 1
+        return False
+
+    def _single_node(self, candidates: List[Candidate]) -> bool:
+        for cand in self._consolidatable(candidates):
+            if self._budget_allows(cand.pool, REASON_UNDERUTILIZED, 1) < 1:
+                continue
+            sim = self._simulate([cand], price_cap=cand.price)
+            if sim is not None and self._acceptable([cand], sim):
+                self._execute(REASON_UNDERUTILIZED, [cand], sim)
+                return True
+        return False
+
+    # -- simulation -------------------------------------------------------
+    def _simulate(self, cands: List[Candidate],
+                  price_cap: Optional[float]) -> Optional[ScheduleResult]:
+        """Can the candidates' pods run on the remaining nodes, plus at most
+        one new (price-capped) node? None = infeasible."""
+        pods = [p for c in cands for p in c.reschedulable]
+        exclude = {c.node.name for c in cands}
+        exclude_claims = {c.claim.name for c in cands}
+        inp = build_schedule_input(
+            self.cluster, self.cp, pods,
+            exclude_nodes=exclude, exclude_claims=exclude_claims,
+            price_cap=price_cap)
+        result = self._solve(inp)
+        if result.unschedulable:
+            return None
+        if len(result.new_claims) > 1:
+            return None  # minimal change: at most one replacement node
+        return result
+
+    def _solve(self, inp: ScheduleInput) -> ScheduleResult:
+        return self.solver.solve(inp, source="disruption")
+
+    def _acceptable(self, cands: List[Candidate],
+                    sim: ScheduleResult) -> bool:
+        if not sim.new_claims:
+            return True  # pure delete: always saves money
+        total_price = sum(c.price for c in cands)
+        rep = sim.new_claims[0]
+        if rep.price >= total_price:
+            return False
+        # spot→spot: replacement must keep ≥15 types of flexibility so it
+        # lands on reliable spot capacity (disruption.md:123-132)
+        all_spot = all(
+            c.node.capacity_type == wellknown.CAPACITY_TYPE_SPOT for c in cands)
+        rep_ct = rep.requirements.get(wellknown.CAPACITY_TYPE_LABEL)
+        rep_spot = rep_ct is not None and rep_ct.is_finite() \
+            and rep_ct.values() == {wellknown.CAPACITY_TYPE_SPOT}
+        rep_spot = rep_spot or (rep_ct is None)
+        if all_spot and rep_spot:
+            if not self.options.feature_gates.spot_to_spot_consolidation:
+                return False
+            if len(rep.instance_type_names) < SPOT_TO_SPOT_MIN_TYPES:
+                return False
+        return True
+
+    # -- execution --------------------------------------------------------
+    def _execute(self, reason: str, cands: List[Candidate],
+                 sim: ScheduleResult) -> None:
+        for cand in cands:
+            if not any(t.key == wellknown.DISRUPTION_TAINT_KEY
+                       for t in cand.node.taints):
+                cand.node.taints.append(DISRUPTING_TAINT)
+                self.cluster.nodes.update(cand.node)
+        replacements = []
+        for spec in sim.new_claims:
+            self._replacement_seq += 1
+            claim = create_claim_from_spec(
+                self.cluster, self.cp, spec,
+                f"{spec.nodepool}-replace-{self._replacement_seq}")
+            replacements.append(claim.name)
+            self._protected[claim.name] = self.clock.now()
+        self.commands.append(Command(
+            reason=reason,
+            candidate_names=[c.claim.name for c in cands],
+            replacement_names=replacements,
+            started=self.clock.now(),
+        ))

@@ -1,0 +1,31 @@
+"""Expiration — nodes past their NodePool `expireAfter` are replaced.
+
+Reference: NodePool.spec.template.spec.expireAfter
+(karpenter.sh_nodepools.yaml) — expiration deletes the claim; the
+termination flow drains it and the provisioner replaces the capacity.
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.cluster import Cluster
+
+
+class Expiration:
+    name = "expiration"
+
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+
+    def reconcile(self) -> None:
+        now = self.cluster.clock.now()
+        for claim in self.cluster.nodeclaims.list(lambda c: not c.meta.deleting):
+            pool = self.cluster.nodepools.get(claim.nodepool)
+            if pool is None or pool.expire_after is None:
+                continue
+            if claim.launch_time is None:
+                continue
+            if now - claim.launch_time >= pool.expire_after:
+                self.cluster.record_event(
+                    "NodeClaim", claim.name, "Expired",
+                    f"older than expireAfter={pool.expire_after}s")
+                self.cluster.nodeclaims.delete(claim.name)

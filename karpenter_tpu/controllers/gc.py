@@ -1,0 +1,61 @@
+"""Garbage collection.
+
+Two directions, mirroring pkg/controllers/nodeclaim/garbagecollection
+(:54-109) and the core's cloud-side reconciliation:
+  * leaked instances — cloud instances tagged to this cluster with no
+    matching NodeClaim are terminated (cloud-side orphans)
+  * vanished instances — claims whose instance is gone (out-of-band
+    termination, spot reclaim executed) are deleted so their pods
+    reschedule; orphan Node objects without claims are removed
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.cloudprovider import TPUCloudProvider
+from karpenter_tpu.cluster import Cluster
+from karpenter_tpu.providers.fake_cloud import INSTANCE_RUNNING
+
+
+class GarbageCollection:
+    name = "garbagecollection"
+
+    def __init__(self, cluster: Cluster, cloud_provider: TPUCloudProvider):
+        self.cluster = cluster
+        self.cp = cloud_provider
+
+    def reconcile(self) -> None:
+        claims = self.cluster.nodeclaims.list()
+        by_provider = {c.provider_id for c in claims if c.provider_id}
+
+        # leaked: instance exists, claim doesn't
+        for inst in self.cp.list_instances():
+            if inst.state != INSTANCE_RUNNING:
+                continue
+            if inst.instance_id not in by_provider:
+                self.cp.cloud.terminate_instances([inst.instance_id])
+                self.cluster.record_event(
+                    "Instance", inst.instance_id, "LeakedInstanceReclaimed",
+                    "no NodeClaim references this instance")
+
+        # vanished: claim exists, instance doesn't (or is terminated)
+        for claim in claims:
+            if not claim.provider_id or claim.meta.deleting:
+                continue
+            inst = self.cp.get(claim.provider_id)
+            if inst is None or inst.state != INSTANCE_RUNNING:
+                self.cluster.record_event(
+                    "NodeClaim", claim.name, "InstanceTerminated",
+                    "backing instance is gone; removing claim")
+                self.cluster.nodeclaims.delete(claim.name)
+
+        # orphan nodes: node object with no claim — unbind residents (their
+        # machine is gone) so they re-enter the provisioning queue
+        for node in self.cluster.nodes.list(lambda n: not n.meta.deleting):
+            if self.cluster.claim_for_node(node) is None:
+                for pod in self.cluster.pods_on_node(node.name):
+                    if pod.is_daemonset:
+                        continue
+                    pod.node_name = None
+                    pod.phase = "Pending"
+                    self.cluster.pods.update(pod)
+                self.cluster.nodes.delete(node.name)

@@ -1,0 +1,56 @@
+"""Interruption controller — spot reclaim / health events → proactive drain.
+
+Mirrors pkg/controllers/interruption/controller.go:86-126: drain the
+interruption queue, match messages to NodeClaims by instance id, mark the
+spot offering unavailable (feeding the scheduler's ICE cache, :202-208),
+and delete the claim so the termination flow drains it ahead of the 2-minute
+reclaim (designs/interruption-handling.md:11-17).
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.cluster import Cluster
+from karpenter_tpu.providers.fake_cloud import FakeCloud
+from karpenter_tpu.utils.cache import UnavailableOfferings
+
+
+class Interruption:
+    name = "interruption"
+
+    def __init__(self, cluster: Cluster, cloud: FakeCloud,
+                 unavailable: UnavailableOfferings):
+        self.cluster = cluster
+        self.cloud = cloud
+        self.unavailable = unavailable
+
+    def reconcile(self) -> None:
+        for msg in list(self.cloud.receive_messages()):
+            self._handle(msg)
+            self.cloud.delete_message(msg)
+
+    def _handle(self, msg: dict) -> None:
+        instance_id = msg.get("instance_id")
+        claim = next(
+            (c for c in self.cluster.nodeclaims.list()
+             if c.provider_id == instance_id), None)
+        kind = msg.get("kind")
+        if kind == "spot_interruption":
+            inst = self.cloud.instances.get(instance_id)
+            if inst is not None:
+                # the reclaimed pool is unavailable for the next 3 minutes —
+                # the scheduler must not immediately relaunch into it
+                self.unavailable.mark_unavailable(
+                    inst.capacity_type, inst.instance_type, inst.zone,
+                    reason="SpotInterruption")
+            if claim is not None:
+                self.cluster.record_event(
+                    "NodeClaim", claim.name, "SpotInterrupted",
+                    f"instance {instance_id} reclaim imminent")
+                self.cluster.nodeclaims.delete(claim.name)
+        elif kind == "state_change":
+            if msg.get("state") in ("stopping", "stopped", "terminated") \
+                    and claim is not None:
+                self.cluster.record_event(
+                    "NodeClaim", claim.name, "InstanceStateChange",
+                    msg.get("state", ""))
+                self.cluster.nodeclaims.delete(claim.name)

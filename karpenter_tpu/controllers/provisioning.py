@@ -10,22 +10,16 @@ solver failure must never fail provisioning (SURVEY §5).
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 from karpenter_tpu.cloudprovider import TPUCloudProvider
 from karpenter_tpu.cluster import Cluster
+from karpenter_tpu.controllers.state import GatedSolver, build_schedule_input
 from karpenter_tpu.models import wellknown
-from karpenter_tpu.models.objects import NodeClaim, NodePool, ObjectMeta, Pod
-from karpenter_tpu.models.resources import Resources
-from karpenter_tpu.models.taints import tolerates_all
+from karpenter_tpu.models.objects import NodeClaim, ObjectMeta, Pod
 from karpenter_tpu.operator.options import Options
-from karpenter_tpu.scheduling import ExistingNode, ScheduleInput, Scheduler
-from karpenter_tpu.scheduling.types import (
-    NewNodeClaim,
-    ScheduleResult,
-    effective_request,
-)
-from karpenter_tpu.solver import TPUSolver, UnsupportedPods
+from karpenter_tpu.scheduling import ScheduleInput
+from karpenter_tpu.scheduling.types import NewNodeClaim, ScheduleResult
 from karpenter_tpu.utils.clock import Clock
 
 NOMINATED_ANNOTATION = "karpenter.sh/nominated-claim"
@@ -40,12 +34,13 @@ class Provisioner:
         cloud_provider: TPUCloudProvider,
         options: Optional[Options] = None,
         clock: Optional[Clock] = None,
+        solver: Optional[GatedSolver] = None,
     ):
         self.cluster = cluster
         self.cp = cloud_provider
         self.options = options or Options()
         self.clock = clock or cluster.clock
-        self.tpu_solver = TPUSolver(max_nodes=self.options.solver_max_nodes)
+        self.solver = solver or GatedSolver(self.options, cluster)
         self._claim_seq = 0
         self._batch_first: Optional[float] = None
         self._batch_sig: Optional[frozenset] = None
@@ -86,73 +81,10 @@ class Provisioner:
 
     # -- input assembly ---------------------------------------------------
     def _build_input(self, pending: List[Pod]) -> ScheduleInput:
-        pools: List[NodePool] = self.cluster.nodepools.list(
-            lambda np_: not np_.meta.deleting)
-        instance_types = {
-            p.name: self.cp.get_instance_types(p.node_class_ref) for p in pools
-        }
+        return build_schedule_input(self.cluster, self.cp, pending)
 
-        existing: List[ExistingNode] = []
-        for node in self.cluster.nodes.list(lambda n: not n.meta.deleting):
-            resident = self.cluster.pods_on_node(node.name)
-            used = Resources()
-            for pod in resident:
-                used += effective_request(pod)
-            existing.append(ExistingNode(
-                node=node, available=node.allocatable - used, pods=resident))
-
-        daemon_overhead = {
-            p.name: self._daemon_overhead(p) for p in pools
-        }
-        remaining_limits = {
-            p.name: self._remaining_limit(p) for p in pools
-        }
-        return ScheduleInput(
-            pods=pending,
-            nodepools=pools,
-            instance_types=instance_types,
-            existing_nodes=existing,
-            daemon_overhead=daemon_overhead,
-            remaining_limits=remaining_limits,
-        )
-
-    def _daemon_overhead(self, pool: NodePool) -> Resources:
-        """Aggregate requests of daemonset pods a new node in this pool
-        would run (daemonset overhead accounting — SURVEY §2.2 scheduler)."""
-        template = pool.template_requirements()
-        total = Resources()
-        for pod in self.cluster.daemonset_pods():
-            if not tolerates_all(pool.taints, pod.tolerations):
-                continue
-            if not template.compatible(pod.requirements):
-                continue
-            total += effective_request(pod)
-        return total
-
-    def _remaining_limit(self, pool: NodePool) -> Optional[Resources]:
-        if pool.limits is None:
-            return None
-        used = Resources()
-        for claim in self.cluster.nodeclaims.list(
-                lambda c: c.nodepool == pool.name):
-            # unlaunched claims have no capacity yet — charge their planned
-            # requests so stalled launches still hold their limit reservation
-            used += (claim.capacity if not claim.capacity.is_zero()
-                     else claim.resource_requests)
-        remaining = pool.limits - used
-        return remaining
-
-    # -- solve (gated, with fallback) -------------------------------------
     def _solve(self, inp: ScheduleInput) -> ScheduleResult:
-        if self.options.feature_gates.tpu_solver:
-            try:
-                return self.tpu_solver.solve(inp)
-            except UnsupportedPods:
-                pass  # constraints the encoder can't express yet → oracle
-            except Exception as e:  # noqa: BLE001 — solver down ⇒ fall back
-                self.cluster.record_event(
-                    "Provisioner", "solver", "SolverFallback", str(e))
-        return Scheduler(inp).solve()
+        return self.solver.solve(inp, source="provisioning")
 
     # -- apply -------------------------------------------------------------
     def _apply(self, result: ScheduleResult) -> None:
@@ -179,28 +111,35 @@ class Provisioner:
 
     def _create_claim(self, spec: NewNodeClaim) -> NodeClaim:
         self._claim_seq += 1
-        pool = self.cluster.nodepools.get(spec.nodepool)
-        nc = self.cp.node_classes.get(spec.node_class_ref)
-        name = f"{spec.nodepool}-{self._claim_seq}"
-        claim = NodeClaim(
-            meta=ObjectMeta(
-                name=name,
-                labels={wellknown.NODEPOOL_LABEL: spec.nodepool},
-                annotations={
-                    wellknown.NODEPOOL_HASH_ANNOTATION:
-                        pool.static_hash() if pool else "",
-                    wellknown.NODECLASS_HASH_ANNOTATION:
-                        nc.static_hash() if nc else "",
-                },
-                finalizers=[wellknown.TERMINATION_FINALIZER],
-            ),
-            nodepool=spec.nodepool,
-            node_class_ref=spec.node_class_ref,
-            requirements=spec.requirements.copy(),
-            resource_requests=spec.requests.copy(),
-            taints=list(spec.taints),
-            startup_taints=list(spec.startup_taints),
-            instance_type_options=list(spec.instance_type_names),
-        )
-        self.cluster.nodeclaims.create(claim)
-        return claim
+        return create_claim_from_spec(
+            self.cluster, self.cp, spec, f"{spec.nodepool}-{self._claim_seq}")
+
+
+def create_claim_from_spec(cluster: Cluster, cp: TPUCloudProvider,
+                           spec: NewNodeClaim, name: str) -> NodeClaim:
+    """NewNodeClaim (scheduler output) → NodeClaim CR, shared by the
+    provisioner and the disruption controller's replacement pre-spin."""
+    pool = cluster.nodepools.get(spec.nodepool)
+    nc = cp.node_classes.get(spec.node_class_ref)
+    claim = NodeClaim(
+        meta=ObjectMeta(
+            name=name,
+            labels={wellknown.NODEPOOL_LABEL: spec.nodepool},
+            annotations={
+                wellknown.NODEPOOL_HASH_ANNOTATION:
+                    pool.static_hash() if pool else "",
+                wellknown.NODECLASS_HASH_ANNOTATION:
+                    nc.static_hash() if nc else "",
+            },
+            finalizers=[wellknown.TERMINATION_FINALIZER],
+        ),
+        nodepool=spec.nodepool,
+        node_class_ref=spec.node_class_ref,
+        requirements=spec.requirements.copy(),
+        resource_requests=spec.requests.copy(),
+        taints=list(spec.taints),
+        startup_taints=list(spec.startup_taints),
+        instance_type_options=list(spec.instance_type_names),
+    )
+    cluster.nodeclaims.create(claim)
+    return claim

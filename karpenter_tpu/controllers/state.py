@@ -1,0 +1,127 @@
+"""Cluster-state → ScheduleInput assembly, shared by the provisioner and
+the disruption simulator (SURVEY §2.2 Cluster state: one in-memory model
+feeds both hot paths).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from karpenter_tpu.cloudprovider import TPUCloudProvider
+from karpenter_tpu.cluster import Cluster
+from karpenter_tpu.models.objects import InstanceType, NodePool, Offering, Pod
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.models.taints import tolerates_all
+from karpenter_tpu.scheduling import ExistingNode, ScheduleInput
+from karpenter_tpu.scheduling.types import effective_request
+
+
+class GatedSolver:
+    """The TPU solver behind its feature gate with the CPU oracle as
+    fallback — shared by the provisioner and the disruption simulator so
+    they share one device catalog cache (solver down ⇒ fall back, never
+    fail — SURVEY §5)."""
+
+    def __init__(self, options, cluster: Cluster):
+        from karpenter_tpu.solver import TPUSolver
+        self.options = options
+        self.cluster = cluster
+        self.tpu = TPUSolver(max_nodes=options.solver_max_nodes)
+
+    def solve(self, inp: ScheduleInput, source: str = "solver"):
+        from karpenter_tpu.scheduling import Scheduler
+        from karpenter_tpu.solver import UnsupportedPods
+        if self.options.feature_gates.tpu_solver:
+            try:
+                return self.tpu.solve(inp)
+            except UnsupportedPods:
+                pass  # constraints the encoder can't express yet → oracle
+            except Exception as e:  # noqa: BLE001
+                self.cluster.record_event(
+                    "Provisioner", source, "SolverFallback", str(e))
+        return Scheduler(inp).solve()
+
+
+def daemon_overhead(cluster: Cluster, pool: NodePool) -> Resources:
+    """Aggregate requests of daemonset pods a new node in this pool would
+    run (daemonset overhead accounting — SURVEY §2.2 scheduler)."""
+    template = pool.template_requirements()
+    total = Resources()
+    for pod in cluster.daemonset_pods():
+        if not tolerates_all(pool.taints, pod.tolerations):
+            continue
+        if not template.compatible(pod.requirements):
+            continue
+        total += effective_request(pod)
+    return total
+
+
+def remaining_limit(cluster: Cluster, pool: NodePool,
+                    exclude_claims: Set[str] = frozenset()) -> Optional[Resources]:
+    if pool.limits is None:
+        return None
+    used = Resources()
+    for claim in cluster.nodeclaims.list(lambda c: c.nodepool == pool.name):
+        if claim.name in exclude_claims:
+            continue
+        # unlaunched claims have no capacity yet — charge their planned
+        # requests so stalled launches still hold their limit reservation
+        used += (claim.capacity if not claim.capacity.is_zero()
+                 else claim.resource_requests)
+    return pool.limits - used
+
+
+def price_capped_types(types: List[InstanceType],
+                       price_cap: float) -> List[InstanceType]:
+    """Restrict offerings to those strictly cheaper than the cap — the
+    consolidation simulator only considers cheaper replacements
+    (designs/consolidation.md node-replacement cost rule)."""
+    out: List[InstanceType] = []
+    for it in types:
+        offs = [o for o in it.offerings if o.available and o.price < price_cap]
+        if not offs:
+            continue
+        out.append(InstanceType(
+            name=it.name, capacity=it.capacity,
+            requirements=it.requirements, offerings=offs,
+            overhead=it.overhead))
+    return out
+
+
+def build_schedule_input(
+    cluster: Cluster,
+    cp: TPUCloudProvider,
+    pods: List[Pod],
+    exclude_nodes: Set[str] = frozenset(),
+    exclude_claims: Set[str] = frozenset(),
+    price_cap: Optional[float] = None,
+) -> ScheduleInput:
+    pools: List[NodePool] = cluster.nodepools.list(
+        lambda np_: not np_.meta.deleting)
+    instance_types: Dict[str, List[InstanceType]] = {}
+    for p in pools:
+        types = cp.get_instance_types(p.node_class_ref)
+        if price_cap is not None:
+            types = price_capped_types(types, price_cap)
+        instance_types[p.name] = types
+
+    existing: List[ExistingNode] = []
+    for node in cluster.nodes.list(lambda n: not n.meta.deleting):
+        if node.name in exclude_nodes:
+            continue
+        resident = cluster.pods_on_node(node.name)
+        used = Resources()
+        for pod in resident:
+            used += effective_request(pod)
+        existing.append(ExistingNode(
+            node=node, available=node.allocatable - used, pods=resident))
+
+    return ScheduleInput(
+        pods=pods,
+        nodepools=pools,
+        instance_types=instance_types,
+        existing_nodes=existing,
+        daemon_overhead={p.name: daemon_overhead(cluster, p) for p in pools},
+        remaining_limits={
+            p.name: remaining_limit(cluster, p, exclude_claims) for p in pools},
+    )

@@ -1,0 +1,70 @@
+"""Termination controller — the graceful drain state machine.
+
+Mirrors website/.../disruption.md:29-36 + designs/termination.md: when a
+NodeClaim is deleted its finalizer holds it while we (1) taint the node
+`karpenter.sh/disrupted:NoSchedule`, (2) evict evictable pods through the
+PDB-aware eviction budget (daemonsets stay), (3) once drained, call
+CloudProvider.Delete, strip the finalizer, and remove the node object.
+Evicted pods return to Pending and re-enter the provisioning queue.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from karpenter_tpu.cloudprovider import TPUCloudProvider
+from karpenter_tpu.cluster import Cluster
+from karpenter_tpu.controllers.provisioning import NOMINATED_ANNOTATION
+from karpenter_tpu.models import wellknown
+from karpenter_tpu.models.objects import NodeClaim
+from karpenter_tpu.models.taints import NO_SCHEDULE, Taint
+
+DISRUPTED_TAINT = Taint(wellknown.DISRUPTED_TAINT_KEY, "", NO_SCHEDULE)
+
+
+class Termination:
+    name = "termination"
+
+    def __init__(self, cluster: Cluster, cloud_provider: TPUCloudProvider):
+        self.cluster = cluster
+        self.cp = cloud_provider
+
+    def reconcile(self) -> None:
+        for claim in list(self.cluster.nodeclaims.list(
+                lambda c: c.meta.deleting)):
+            self._terminate(claim)
+
+    def _terminate(self, claim: NodeClaim) -> None:
+        node = self.cluster.node_for_claim(claim)
+        if node is not None:
+            if not any(t.key == wellknown.DISRUPTED_TAINT_KEY
+                       for t in node.taints):
+                node.taints.append(DISRUPTED_TAINT)
+                self.cluster.nodes.update(node)
+            remaining = self._drain(node.name)
+            if remaining > 0:
+                return  # PDBs throttle the drain; retry next round
+        # drained (or node never joined): release the instance + objects
+        self.cp.delete(claim)
+        if node is not None and not node.meta.deleting:
+            self.cluster.nodes.delete(node.name)
+        self.cluster.nodeclaims.remove_finalizer(
+            claim.name, wellknown.TERMINATION_FINALIZER)
+        self.cluster.record_event(
+            "NodeClaim", claim.name, "Terminated", "instance released")
+
+    def _drain(self, node_name: str) -> int:
+        """Evict what the budgets allow; returns count of pods still to
+        evict (excluding daemonsets)."""
+        remaining = 0
+        for pod in self.cluster.pods_on_node(node_name):
+            if pod.is_daemonset:
+                continue
+            if not self.cluster.can_evict(pod):
+                remaining += 1
+                continue
+            pod.node_name = None
+            pod.phase = "Pending"
+            pod.meta.annotations.pop(NOMINATED_ANNOTATION, None)
+            self.cluster.pods.update(pod)
+        return remaining

@@ -12,10 +12,15 @@ from karpenter_tpu.cloudprovider import TPUCloudProvider
 from karpenter_tpu.cluster import Cluster
 from karpenter_tpu.controllers import (
     ControllerManager,
+    Disruption,
+    Expiration,
     FakeKubelet,
+    GarbageCollection,
+    Interruption,
     NodeClaimLifecycle,
     PodBinder,
     Provisioner,
+    Termination,
 )
 from karpenter_tpu.models.objects import InstanceType, NodeClass, ObjectMeta
 from karpenter_tpu.operator.options import Options
@@ -57,11 +62,23 @@ class Environment:
             self.cluster, self.cloud_provider, self.options, self.clock)
         self.kubelet = FakeKubelet(self.cluster, self.cloud_provider)
         self.binder = PodBinder(self.cluster)
+        self.termination = Termination(self.cluster, self.cloud_provider)
+        self.interruption = Interruption(
+            self.cluster, self.cloud, self.unavailable)
+        self.gc = GarbageCollection(self.cluster, self.cloud_provider)
+        self.expiration = Expiration(self.cluster)
+        self.disruption = Disruption(
+            self.cluster, self.cloud_provider, self.options, self.clock)
         self.manager = ControllerManager(self.cluster, [
             self.provisioner,
             self.lifecycle,
             self.kubelet,
             self.binder,
+            self.interruption,
+            self.expiration,
+            self.disruption,
+            self.termination,
+            self.gc,
         ])
 
     # -- conveniences -----------------------------------------------------

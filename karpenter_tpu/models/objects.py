@@ -168,6 +168,20 @@ class Pod:
         return self._sched_group_id
 
 
+@dataclass
+class PodDisruptionBudget:
+    """Minimal PDB: how many pods matching the selector may be voluntarily
+    disrupted (reference consumes these through the Eviction API —
+    website/.../disruption.md:29-36; pods at/over budget block consolidation,
+    designs/consolidation.md:46-52)."""
+    meta: ObjectMeta
+    selector: Dict[str, str] = field(default_factory=dict)
+    max_unavailable: int = 1
+
+    def matches(self, pod: "Pod") -> bool:
+        return all(pod.meta.labels.get(k) == v for k, v in self.selector.items())
+
+
 # ---------------------------------------------------------------------------
 # Instance types
 # ---------------------------------------------------------------------------
@@ -327,9 +341,11 @@ class Budget:
 
     def allowed_disruptions(self, total_nodes: int) -> int:
         if self.nodes.endswith("%"):
+            import math
             pct = float(self.nodes[:-1]) / 100.0
-            # floor, but immune to binary-float error (29% of 100 is 29, not 28)
-            return int(pct * total_nodes + 1e-9)
+            # ceil (with float-error guard): "10%" of a 3-node cluster allows
+            # 1 disruption — flooring would freeze small clusters entirely
+            return math.ceil(pct * total_nodes - 1e-9)
         return int(self.nodes)
 
 

@@ -1,0 +1,200 @@
+"""Disruption controller: emptiness, consolidation (delete/replace), drift,
+budgets, and blocking pods — BASELINE config #4 territory."""
+
+import pytest
+
+from karpenter_tpu.env import Environment
+from karpenter_tpu.models import (
+    NodePool,
+    ObjectMeta,
+    Pod,
+    Requirement,
+    Requirements,
+    Resources,
+    wellknown,
+)
+from karpenter_tpu.models.objects import Budget, Disruption as DisruptionSpec
+from karpenter_tpu.operator.options import Options
+
+
+@pytest.fixture
+def env():
+    e = Environment(options=Options(batch_idle_duration=0))
+    e.add_default_nodeclass()
+    e.cluster.nodepools.create(NodePool(meta=ObjectMeta(name="default")))
+    return e
+
+
+def mkpod(name, cpu="500m", mem="1Gi", **kw):
+    return Pod(meta=ObjectMeta(name=name, labels=kw.pop("labels", {})),
+               requests=Resources.parse({"cpu": cpu, "memory": mem}), **kw)
+
+
+class TestEmptiness:
+    def test_empty_node_deleted(self, env):
+        env.cluster.pods.create(mkpod("p"))
+        env.settle()
+        assert len(env.cluster.nodeclaims.list()) == 1
+        # workload scales to zero
+        pod = env.cluster.pods.get("p")
+        pod.node_name = None
+        env.cluster.pods.delete("p")
+        env.settle()
+        assert len(env.cluster.nodeclaims.list()) == 0
+        assert all(i.state == "terminated"
+                   for i in env.cloud.instances.values())
+
+    def test_when_empty_policy_never_consolidates_nonempty(self, env):
+        pool = env.cluster.nodepools.get("default")
+        pool.disruption = DisruptionSpec(consolidation_policy="WhenEmpty")
+        # two half-empty nodes that COULD consolidate onto one
+        for i in range(2):
+            env.cluster.pods.create(mkpod(f"a{i}", cpu="6", mem="8Gi"))
+            env.settle()
+            # force separate nodes by filling sequentially
+        claims = env.cluster.nodeclaims.list()
+        env.settle()
+        # nothing deleted: policy forbids underutilized consolidation
+        assert {c.name for c in env.cluster.nodeclaims.list()} == {
+            c.name for c in claims}
+
+
+class TestConsolidation:
+    def _two_underutilized_nodes(self, env):
+        """Build two nodes whose remaining pods jointly fit on one cheaper
+        machine. Anchors are sized to fill their node so nothing else fits
+        (16-vCPU shapes keep ~15.9 cores after kube-reserved); deleting them
+        leaves two nearly-empty nodes each holding one small pod."""
+        env.cluster.pods.create(mkpod("anchor-1", cpu="15", mem="20Gi"))
+        env.cluster.pods.create(mkpod("small-1", cpu="700m", mem="512Mi"))
+        env.settle()
+        env.cluster.pods.create(mkpod("anchor-2", cpu="15", mem="20Gi"))
+        env.cluster.pods.create(mkpod("small-2", cpu="700m", mem="512Mi"))
+        env.settle()
+        assert len(env.cluster.nodeclaims.list()) == 2
+        smalls = {env.cluster.pods.get("small-1").node_name,
+                  env.cluster.pods.get("small-2").node_name}
+        assert len(smalls) == 2  # one small per node
+        # anchors scale away: both nodes now nearly empty
+        for name in ("anchor-1", "anchor-2"):
+            p = env.cluster.pods.get(name)
+            p.node_name = None
+            env.cluster.pods.delete(name)
+
+    def test_multi_or_single_node_consolidation(self, env):
+        self._two_underutilized_nodes(env)
+        env.settle()
+        # the two smalls end up on ONE (cheaper) node
+        claims = env.cluster.nodeclaims.list()
+        assert len(claims) == 1
+        pods = env.cluster.pods.list()
+        assert all(p.scheduled for p in pods)
+        names = {p.node_name for p in pods}
+        assert len(names) == 1
+
+    def test_do_not_disrupt_blocks(self, env):
+        self._two_underutilized_nodes(env)
+        for p in env.cluster.pods.list():
+            p.meta.annotations[wellknown.DO_NOT_DISRUPT_ANNOTATION] = "true"
+        env.settle()
+        assert len(env.cluster.nodeclaims.list()) == 2  # untouched
+
+    def test_zero_budget_blocks(self, env):
+        pool = env.cluster.nodepools.get("default")
+        pool.disruption.budgets = [Budget(nodes="0")]
+        self._two_underutilized_nodes(env)
+        env.settle()
+        assert len(env.cluster.nodeclaims.list()) == 2
+
+    def test_consolidate_after_delays(self, env):
+        pool = env.cluster.nodepools.get("default")
+        pool.disruption.consolidate_after = 300.0
+        self._two_underutilized_nodes(env)
+        env.settle()
+        assert len(env.cluster.nodeclaims.list()) == 2  # too young
+        env.clock.step(301)
+        env.settle()
+        assert len(env.cluster.nodeclaims.list()) == 1
+
+
+class TestDrift:
+    def test_nodeclass_drift_replaces_node(self, env):
+        env.cluster.pods.create(mkpod("p"))
+        env.settle()
+        old = env.cluster.nodeclaims.list()[0]
+        nc = env.cluster.nodeclasses.get("default")
+        nc.boot_config["image"] = "v2"  # spec change → hash change
+        env.cluster.mutated()
+        env.settle()
+        claims = env.cluster.nodeclaims.list()
+        assert len(claims) == 1
+        assert claims[0].name != old.name  # replaced
+        assert env.cluster.pods.get("p").scheduled
+
+    def test_drift_gate_off(self, env):
+        env.options.feature_gates.drift = False
+        env.cluster.pods.create(mkpod("p"))
+        env.settle()
+        old = env.cluster.nodeclaims.list()[0]
+        env.cluster.nodeclasses.get("default").boot_config["image"] = "v2"
+        env.cluster.mutated()
+        env.settle()
+        assert env.cluster.nodeclaims.list()[0].name == old.name
+
+
+class TestSpotToSpot:
+    def test_acceptable_requires_flexibility(self, env):
+        from karpenter_tpu.controllers.disruption import (
+            Candidate, SPOT_TO_SPOT_MIN_TYPES)
+        from karpenter_tpu.models.objects import Node
+        from karpenter_tpu.scheduling.types import NewNodeClaim, ScheduleResult
+        d = env.disruption
+        node = Node(meta=ObjectMeta(name="n", labels={
+            wellknown.CAPACITY_TYPE_LABEL: "spot"}))
+        cand = Candidate(claim=None, node=node, pool=None, price=1.0)
+        inflexible = ScheduleResult(new_claims=[NewNodeClaim(
+            nodepool="default", node_class_ref="default",
+            requirements=Requirements(Requirement.make(
+                wellknown.CAPACITY_TYPE_LABEL, "In", "spot")),
+            instance_type_names=["a"] * 5, price=0.5)])
+        assert not d._acceptable([cand], inflexible)
+        flexible = ScheduleResult(new_claims=[NewNodeClaim(
+            nodepool="default", node_class_ref="default",
+            requirements=Requirements(Requirement.make(
+                wellknown.CAPACITY_TYPE_LABEL, "In", "spot")),
+            instance_type_names=[f"t{i}" for i in range(SPOT_TO_SPOT_MIN_TYPES)],
+            price=0.5)])
+        assert d._acceptable([cand], flexible)
+        # gate off → even flexible spot→spot is rejected
+        env.options.feature_gates.spot_to_spot_consolidation = False
+        assert not d._acceptable([cand], flexible)
+
+
+class TestReviewRegressions:
+    def test_multi_node_respects_subset_budget(self, env):
+        """A budget of 1 must not let one multi-node command take 2 nodes."""
+        pool = env.cluster.nodepools.get("default")
+        pool.disruption.budgets = [Budget(nodes="1")]
+        TestConsolidation()._two_underutilized_nodes(env)
+        env.manager.run_once()
+        cmds = env.disruption.commands
+        for cmd in cmds:
+            assert len(cmd.candidate_names) <= 1
+        env.settle()
+        # convergence still reaches 1 node via sequential single disruptions
+        assert len(env.cluster.nodeclaims.list()) == 1
+
+    def test_replacement_protected_from_emptiness(self, env):
+        """A 100% budget must not let emptiness eat a fresh replacement."""
+        pool = env.cluster.nodepools.get("default")
+        pool.disruption.budgets = [Budget(nodes="100%")]
+        TestConsolidation()._two_underutilized_nodes(env)
+        env.settle()
+        claims = env.cluster.nodeclaims.list()
+        assert len(claims) == 1
+        # pods landed on the replacement (not a brand-new 4th node)
+        pods = env.cluster.pods.list()
+        assert all(p.scheduled for p in pods)
+        assert {p.node_name for p in pods} == {claims[0].node_name}
+        # only 3 instances were ever launched (2 originals + 1 replacement)
+        assert len(env.cloud.instances) == 3

@@ -1,0 +1,126 @@
+"""Termination, interruption, GC, and expiration controllers — the
+documented state machines of SURVEY §3.4/§3.5 + nodeclaim GC."""
+
+import pytest
+
+from karpenter_tpu.env import Environment
+from karpenter_tpu.models import NodePool, ObjectMeta, Pod, Resources, wellknown
+from karpenter_tpu.models.objects import PodDisruptionBudget
+from karpenter_tpu.operator.options import Options
+
+
+@pytest.fixture
+def env():
+    e = Environment(options=Options(batch_idle_duration=0))
+    e.add_default_nodeclass()
+    e.cluster.nodepools.create(NodePool(meta=ObjectMeta(name="default")))
+    return e
+
+
+def mkpod(name, cpu="500m", mem="1Gi", **kw):
+    return Pod(meta=ObjectMeta(name=name, labels=kw.pop("labels", {})),
+               requests=Resources.parse({"cpu": cpu, "memory": mem}), **kw)
+
+
+def provision(env, n=3):
+    for i in range(n):
+        env.cluster.pods.create(mkpod(f"p{i}"))
+    env.settle()
+    claims = env.cluster.nodeclaims.list()
+    assert claims and all(c.is_("Initialized") for c in claims)
+    return claims
+
+
+class TestTermination:
+    def test_graceful_drain_and_release(self, env):
+        claims = provision(env)
+        claim = claims[0]
+        inst_id = claim.provider_id
+        env.cluster.nodeclaims.delete(claim.name)  # finalizer holds it
+        env.settle()
+        # claim + node gone, instance terminated, pods rescheduled
+        assert env.cluster.nodeclaims.get(claim.name) is None
+        assert env.cluster.nodes.get(claim.name) is None
+        assert env.cloud.instances[inst_id].state == "terminated"
+        assert all(p.scheduled for p in env.cluster.pods.list())
+
+    def test_pdb_throttles_drain(self, env):
+        for i in range(3):
+            env.cluster.pods.create(mkpod(f"w{i}", labels={"app": "guarded"}))
+        env.settle()
+        # PDB allows zero voluntary disruptions
+        env.cluster.pdbs.create(PodDisruptionBudget(
+            meta=ObjectMeta(name="pdb"), selector={"app": "guarded"},
+            max_unavailable=0))
+        claim = env.cluster.nodeclaims.list()[0]
+        env.cluster.nodeclaims.delete(claim.name)
+        env.settle()
+        # drain blocked: claim still exists (deleting), node tainted, pods on it
+        held = env.cluster.nodeclaims.get(claim.name)
+        assert held is not None and held.meta.deleting
+        node = env.cluster.nodes.get(claim.name)
+        assert any(t.key == wellknown.DISRUPTED_TAINT_KEY for t in node.taints)
+        assert env.cluster.pods_on_node(node.name)
+        # budget relaxed → drain completes
+        env.cluster.pdbs.get("pdb").max_unavailable = 3
+        env.cluster.mutated()
+        env.settle()
+        assert env.cluster.nodeclaims.get(claim.name) is None
+
+
+class TestInterruption:
+    def test_spot_interruption_drains_and_marks_unavailable(self, env):
+        claims = provision(env)
+        claim = claims[0]
+        inst = env.cloud.get_instance(claim.provider_id)
+        assert inst.capacity_type == "spot"
+        env.cloud.interrupt_spot(inst.instance_id)
+        env.settle()
+        # pool marked unavailable so the replacement avoids it
+        assert env.unavailable.is_unavailable(
+            "spot", inst.instance_type, inst.zone)
+        # claim replaced: old gone, new claim launched elsewhere
+        assert env.cluster.nodeclaims.get(claim.name) is None
+        pods = env.cluster.pods.list()
+        assert all(p.scheduled for p in pods)
+        new_claims = env.cluster.nodeclaims.list()
+        assert new_claims
+        for c in new_claims:
+            ninst = env.cloud.get_instance(c.provider_id)
+            assert (ninst.capacity_type, ninst.instance_type, ninst.zone) != \
+                (inst.capacity_type, inst.instance_type, inst.zone)
+
+
+class TestGC:
+    def test_leaked_instance_reclaimed(self, env):
+        from karpenter_tpu.providers.fake_cloud import FleetCandidate
+        leaked, _ = env.cloud.create_fleet(
+            [FleetCandidate("m6.large", "tpu-west-1a", "on-demand", 0.1)],
+            tags={"karpenter.sh/discovery": env.options.cluster_name})
+        env.settle()
+        assert env.cloud.instances[leaked.instance_id].state == "terminated"
+
+    def test_vanished_instance_reschedules_pods(self, env):
+        claims = provision(env)
+        claim = claims[0]
+        # cloud kills the instance out-of-band (no interruption message)
+        env.cloud.terminate_instances([claim.provider_id])
+        env.settle()
+        assert env.cluster.nodeclaims.get(claim.name) is None
+        # pods rescheduled onto a replacement
+        pods = env.cluster.pods.list()
+        assert all(p.scheduled for p in pods)
+        assert all(env.cluster.nodes.get(p.node_name) is not None for p in pods)
+
+
+class TestExpiration:
+    def test_expired_claims_replaced(self, env):
+        pool = env.cluster.nodepools.get("default")
+        pool.expire_after = 3600.0
+        claims = provision(env)
+        old = {c.name for c in claims}
+        env.clock.step(3601)
+        env.settle()
+        current = {c.name for c in env.cluster.nodeclaims.list()}
+        assert not (current & old)  # all replaced
+        assert all(p.scheduled for p in env.cluster.pods.list())

@@ -137,8 +137,10 @@ class TestReviewRegressions:
 def test_budget_percentage_float_exact():
     from karpenter_tpu.models import Budget
     assert Budget(nodes="29%").allowed_disruptions(100) == 29
-    assert Budget(nodes="10%").allowed_disruptions(25) == 2   # floor
+    assert Budget(nodes="10%").allowed_disruptions(25) == 3   # ceil
+    assert Budget(nodes="10%").allowed_disruptions(1) == 1    # small clusters can disrupt
     assert Budget(nodes="5").allowed_disruptions(100) == 5
+    assert Budget(nodes="0").allowed_disruptions(100) == 0
 
 
 def test_offerings_open_world_on_non_offering_keys():
