@@ -97,6 +97,8 @@ class Cluster:
         self.nodeclasses = Store(self)
         self.pdbs = Store(self)
         self.events: List[tuple] = []  # (time, kind, object, reason, message)
+        self._pdb_budget_cache: Dict[str, int] = {}
+        self._pdb_budget_gen = -1
 
     def mutated(self) -> None:
         self.generation += 1
@@ -141,16 +143,24 @@ class Cluster:
     def pdb_disruptions_allowed(self, pod: Pod) -> Optional[int]:
         """The tightest remaining voluntary-disruption budget covering the
         pod, or None if no PDB selects it. 'unavailable' = selected pods
-        currently not Running."""
+        currently not Running. Per-PDB budgets are memoized against the
+        cluster generation: callers check every pod on every candidate each
+        reconcile, and rescanning all pods per check is O(pods²)."""
+        if self._pdb_budget_gen != self.generation:
+            self._pdb_budget_cache.clear()
+            self._pdb_budget_gen = self.generation
         tightest: Optional[int] = None
         for pdb in self.pdbs.list():
             if not pdb.matches(pod):
                 continue
-            selected = self.pods.list(lambda p: pdb.matches(p))
-            unavailable = sum(
-                1 for p in selected
-                if p.phase != "Running" or p.meta.deleting)
-            allowed = pdb.max_unavailable - unavailable
+            allowed = self._pdb_budget_cache.get(pdb.meta.name)
+            if allowed is None:
+                unavailable = sum(
+                    1 for p in self.pods.list()
+                    if pdb.matches(p)
+                    and (p.phase != "Running" or p.meta.deleting))
+                allowed = pdb.max_unavailable - unavailable
+                self._pdb_budget_cache[pdb.meta.name] = allowed
             if tightest is None or allowed < tightest:
                 tightest = allowed
         return tightest

@@ -56,8 +56,13 @@ class Environment:
             node_classes=self.cluster.nodeclasses,
             cluster_name=self.options.cluster_name,
         )
+        # one GatedSolver shared by both hot paths so they share the device
+        # catalog cache and compiled-program cache
+        from karpenter_tpu.controllers.state import GatedSolver
+        self.solver = GatedSolver(self.options, self.cluster)
         self.provisioner = Provisioner(
-            self.cluster, self.cloud_provider, self.options, self.clock)
+            self.cluster, self.cloud_provider, self.options, self.clock,
+            solver=self.solver)
         self.lifecycle = NodeClaimLifecycle(
             self.cluster, self.cloud_provider, self.options, self.clock)
         self.kubelet = FakeKubelet(self.cluster, self.cloud_provider)
@@ -68,7 +73,8 @@ class Environment:
         self.gc = GarbageCollection(self.cluster, self.cloud_provider)
         self.expiration = Expiration(self.cluster)
         self.disruption = Disruption(
-            self.cluster, self.cloud_provider, self.options, self.clock)
+            self.cluster, self.cloud_provider, self.options, self.clock,
+            solver=self.solver)
         self.manager = ControllerManager(self.cluster, [
             self.provisioner,
             self.lifecycle,

@@ -9,6 +9,7 @@ from karpenter_tpu.providers.catalog import generate_catalog, CatalogSpec
 from karpenter_tpu.providers.pricing import PricingProvider
 from karpenter_tpu.providers.instancetype import InstanceTypeProvider
 from karpenter_tpu.providers.fake_cloud import FakeCloud, CloudInstance
+from karpenter_tpu.providers.batched_cloud import BatchedCloud
 
 __all__ = [
     "generate_catalog",
@@ -17,4 +18,5 @@ __all__ = [
     "InstanceTypeProvider",
     "FakeCloud",
     "CloudInstance",
+    "BatchedCloud",
 ]

@@ -1,6 +1,9 @@
-"""Shared runtime utilities: clocks, TTL caches, the ICE feedback cache."""
+"""Shared runtime utilities: clocks, TTL caches, the ICE feedback cache,
+and the request-coalescing batcher."""
 
 from karpenter_tpu.utils.clock import Clock, FakeClock, RealClock
 from karpenter_tpu.utils.cache import TTLCache, UnavailableOfferings
+from karpenter_tpu.utils.batcher import Batcher
 
-__all__ = ["Clock", "FakeClock", "RealClock", "TTLCache", "UnavailableOfferings"]
+__all__ = ["Batcher", "Clock", "FakeClock", "RealClock", "TTLCache",
+           "UnavailableOfferings"]
