@@ -32,6 +32,7 @@ from karpenter_tpu.providers.fake_cloud import (
     CloudInstance,
     FakeCloud,
     FleetCandidate,
+    LaunchTemplateNotFound,
     TAG_CLUSTER,
     TAG_NODECLAIM,
     TAG_NODECLASS,
@@ -64,12 +65,20 @@ class TPUCloudProvider:
         unavailable: UnavailableOfferings,
         node_classes,  # Store of NodeClass
         cluster_name: str = "default-cluster",
+        subnets=None,  # SubnetProvider (optional plumbing)
+        launch_templates=None,  # LaunchTemplateProvider
+        security_groups=None,  # SecurityGroupProvider (drift inputs)
+        images=None,  # ImageProvider (drift inputs)
     ):
         self.cloud = cloud
         self.instance_types = instance_types
         self.unavailable = unavailable
         self.node_classes = node_classes
         self.cluster_name = cluster_name
+        self.subnets = subnets
+        self.launch_templates = launch_templates
+        self.security_groups = security_groups
+        self.images = images
 
     # -- instance types ---------------------------------------------------
     def get_instance_types(self, node_class_ref: str) -> List[InstanceType]:
@@ -93,13 +102,31 @@ class TPUCloudProvider:
             raise CloudProviderError(
                 "all requested instance types were unavailable during launch")
 
-        candidates = self._fleet_candidates(claim, types)
-        inst, ice = self.cloud.create_fleet(candidates, tags=self._tags(claim))
+        candidates = self._fleet_candidates(claim, types, nc)
+        try:
+            inst, ice = self.cloud.create_fleet(
+                candidates, tags=self._tags(claim))
+        except LaunchTemplateNotFound as err:
+            # a template the cache thought existed is gone: invalidate and
+            # retry once (instance.go:107-111)
+            if self.launch_templates is not None:
+                self.launch_templates.invalidate(str(err))
+            candidates = self._fleet_candidates(claim, types, nc)
+            inst, ice = self.cloud.create_fleet(
+                candidates, tags=self._tags(claim))
         for cap_type, itype, zone in ice:
             self.unavailable.mark_unavailable(cap_type, itype, zone)
         if inst is None:
             raise InsufficientCapacity(
                 f"no capacity in {len(ice)} candidate pools")
+        if self.subnets is not None:
+            chosen_cand = next(
+                (c for c in candidates
+                 if c.instance_type == inst.instance_type
+                 and c.zone == inst.zone
+                 and c.capacity_type == inst.capacity_type), None)
+            if chosen_cand is not None and chosen_cand.subnet_id:
+                self.subnets.update_inflight_ips(chosen_cand.subnet_id)
 
         by_name = {it.name: it for it in types}
         chosen = by_name[inst.instance_type]
@@ -171,26 +198,51 @@ class TPUCloudProvider:
                 out.append(it)
         return out or types
 
-    def _fleet_candidates(self, claim: NodeClaim,
-                          types: List[InstanceType]) -> List[FleetCandidate]:
-        """(type × zone × capacity-type) overrides ranked by price — the
+    def _fleet_candidates(self, claim: NodeClaim, types: List[InstanceType],
+                          nc: Optional[NodeClass] = None,
+                          ) -> List[FleetCandidate]:
+        """(type × zone × capacity-type) overrides ranked by price, crossed
+        with the zonal subnet choice and the per-type launch template — the
         price-capacity-optimized allocation input (instance.go:323-359)."""
         ct_req = claim.requirements.get(wellknown.CAPACITY_TYPE_LABEL)
         allows_spot = ct_req is None or ct_req.matches(wellknown.CAPACITY_TYPE_SPOT)
+        zonal = None
+        if self.subnets is not None and nc is not None:
+            zonal = self.subnets.zonal_subnets_for_launch(nc)
+        lt_by_type: Dict[str, str] = {}
+        if self.launch_templates is not None and nc is not None:
+            for lt_name, cfg in self.launch_templates.ensure_all(
+                    nc, types).items():
+                for tname in cfg.instance_type_names:
+                    lt_by_type[tname] = lt_name
+
+        def mk(it, o) -> Optional[FleetCandidate]:
+            subnet_id = None
+            if zonal is not None:
+                subnet = zonal.get(o.zone)
+                if subnet is None:
+                    return None  # no launchable subnet in this zone
+                subnet_id = subnet.subnet_id
+            return FleetCandidate(
+                instance_type=it.name, zone=o.zone,
+                capacity_type=o.capacity_type, price=o.price,
+                subnet_id=subnet_id,
+                launch_template=lt_by_type.get(it.name))
+
         cands = []
         for it in types:
             for o in it.available_offerings(claim.requirements):
                 if allows_spot and o.capacity_type != wellknown.CAPACITY_TYPE_SPOT:
                     continue  # spot-capable claims launch spot
-                cands.append(FleetCandidate(
-                    instance_type=it.name, zone=o.zone,
-                    capacity_type=o.capacity_type, price=o.price))
+                c = mk(it, o)
+                if c is not None:
+                    cands.append(c)
         if not cands:  # no spot offerings at all — fall back to whatever exists
             for it in types:
                 for o in it.available_offerings(claim.requirements):
-                    cands.append(FleetCandidate(
-                        instance_type=it.name, zone=o.zone,
-                        capacity_type=o.capacity_type, price=o.price))
+                    c = mk(it, o)
+                    if c is not None:
+                        cands.append(c)
         cands.sort(key=lambda c: (c.price, c.instance_type, c.zone))
         return cands
 
@@ -231,12 +283,31 @@ class TPUCloudProvider:
 
     # -- drift ------------------------------------------------------------
     def is_drifted(self, claim: NodeClaim) -> Optional[str]:
+        """Drift reasons mirror pkg/cloudprovider/drift.go:35-38
+        (AMIDrift→ImageDrift, SubnetDrift, SecurityGroupDrift,
+        NodeClassDrift): compare the live instance's launch provenance
+        against what the nodeclass would resolve today."""
         nc = self.node_classes.get(claim.node_class_ref)
         if nc is None:
             return None
         stamped = claim.meta.annotations.get(wellknown.NODECLASS_HASH_ANNOTATION)
         if stamped is not None and stamped != nc.static_hash():
             return "NodeClassDrift"
+        inst = self.get(claim.provider_id) if claim.provider_id else None
+        if inst is None:
+            return None
+        if self.images is not None and inst.image_id is not None:
+            wanted = {img.image_id for img in self.images.list(nc)}
+            if wanted and inst.image_id not in wanted:
+                return "ImageDrift"
+        if self.subnets is not None and inst.subnet_id is not None:
+            wanted = {s.subnet_id for s in self.subnets.list(nc)}
+            if wanted and inst.subnet_id not in wanted:
+                return "SubnetDrift"
+        if self.security_groups is not None and inst.security_group_ids:
+            wanted = {g.group_id for g in self.security_groups.list(nc)}
+            if wanted and not set(inst.security_group_ids) <= wanted:
+                return "SecurityGroupDrift"
         return None
 
     # -- liveness ---------------------------------------------------------

@@ -1,32 +1,40 @@
 """Interruption controller — spot reclaim / health events → proactive drain.
 
 Mirrors pkg/controllers/interruption/controller.go:86-126: drain the
-interruption queue, match messages to NodeClaims by instance id, mark the
-spot offering unavailable (feeding the scheduler's ICE cache, :202-208),
-and delete the claim so the termination flow drains it ahead of the 2-minute
-reclaim (designs/interruption-handling.md:11-17).
+interruption queue via the queue provider, match messages to NodeClaims by
+instance id (:148-173), act per message kind
+(pkg/controllers/interruption/messages/*):
+
+  spot_interruption        mark the offering unavailable (feeding the
+                           scheduler's ICE cache, :202-208) and delete the
+                           claim so termination drains it ahead of the
+                           2-minute reclaim (designs/interruption-handling.md)
+  rebalance_recommendation advisory only — event, no action (the reference
+                           only acts on these behind explicit opt-in)
+  scheduled_change         cloud maintenance: delete the claim
+  state_change             stopping/terminated out from under us: delete
 """
 
 from __future__ import annotations
 
 from karpenter_tpu.cluster import Cluster
-from karpenter_tpu.providers.fake_cloud import FakeCloud
+from karpenter_tpu.providers.queue import QueueProvider
 from karpenter_tpu.utils.cache import UnavailableOfferings
 
 
 class Interruption:
     name = "interruption"
 
-    def __init__(self, cluster: Cluster, cloud: FakeCloud,
+    def __init__(self, cluster: Cluster, queue: QueueProvider,
                  unavailable: UnavailableOfferings):
         self.cluster = cluster
-        self.cloud = cloud
+        self.queue = queue
         self.unavailable = unavailable
 
     def reconcile(self) -> None:
-        for msg in list(self.cloud.receive_messages()):
+        for msg in list(self.queue.receive()):
             self._handle(msg)
-            self.cloud.delete_message(msg)
+            self.queue.delete(msg)
 
     def _handle(self, msg: dict) -> None:
         instance_id = msg.get("instance_id")
@@ -35,7 +43,7 @@ class Interruption:
              if c.provider_id == instance_id), None)
         kind = msg.get("kind")
         if kind == "spot_interruption":
-            inst = self.cloud.instances.get(instance_id)
+            inst = self.queue.cloud.instances.get(instance_id)
             if inst is not None:
                 # the reclaimed pool is unavailable for the next 3 minutes —
                 # the scheduler must not immediately relaunch into it
@@ -46,6 +54,17 @@ class Interruption:
                 self.cluster.record_event(
                     "NodeClaim", claim.name, "SpotInterrupted",
                     f"instance {instance_id} reclaim imminent")
+                self.cluster.nodeclaims.delete(claim.name)
+        elif kind == "rebalance_recommendation":
+            if claim is not None:
+                self.cluster.record_event(
+                    "NodeClaim", claim.name, "RebalanceRecommendation",
+                    f"instance {instance_id} at elevated reclaim risk")
+        elif kind == "scheduled_change":
+            if claim is not None:
+                self.cluster.record_event(
+                    "NodeClaim", claim.name, "ScheduledChange",
+                    "cloud maintenance event")
                 self.cluster.nodeclaims.delete(claim.name)
         elif kind == "state_change":
             if msg.get("state") in ("stopping", "stopped", "terminated") \

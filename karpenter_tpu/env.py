@@ -26,8 +26,15 @@ from karpenter_tpu.models.objects import InstanceType, NodeClass, ObjectMeta
 from karpenter_tpu.operator.options import Options
 from karpenter_tpu.providers.catalog import CatalogSpec
 from karpenter_tpu.providers.fake_cloud import FakeCloud
+from karpenter_tpu.providers.imagefamily import ImageProvider
 from karpenter_tpu.providers.instancetype import InstanceTypeProvider
+from karpenter_tpu.providers.instanceprofile import InstanceProfileProvider
+from karpenter_tpu.providers.launchtemplate import LaunchTemplateProvider
 from karpenter_tpu.providers.pricing import PricingProvider
+from karpenter_tpu.providers.queue import QueueProvider
+from karpenter_tpu.providers.securitygroup import SecurityGroupProvider
+from karpenter_tpu.providers.subnet import SubnetProvider
+from karpenter_tpu.providers.version import VersionProvider
 from karpenter_tpu.utils.cache import UnavailableOfferings
 from karpenter_tpu.utils.clock import Clock, FakeClock
 
@@ -49,12 +56,33 @@ class Environment:
         self.instance_types = InstanceTypeProvider(
             self.cloud, self.pricing, self.unavailable, clock=self.clock)
         self.cluster = Cluster(clock=self.clock)
+        # cloud plumbing providers (operator.go:140-182 construction order)
+        cluster_name = self.options.cluster_name
+        # the fake cloud seeds its defaults under "default-cluster"
+        self.versions = VersionProvider(self.cloud, clock=self.clock)
+        self.subnets = SubnetProvider(
+            self.cloud, cluster_name="default-cluster", clock=self.clock)
+        self.security_groups = SecurityGroupProvider(
+            self.cloud, cluster_name="default-cluster", clock=self.clock)
+        self.images = ImageProvider(
+            self.cloud, self.versions, cluster_name=cluster_name,
+            clock=self.clock)
+        self.launch_templates = LaunchTemplateProvider(
+            self.cloud, self.images, self.security_groups,
+            cluster_name=cluster_name, clock=self.clock)
+        self.instance_profiles = InstanceProfileProvider(
+            self.cloud, cluster_name=cluster_name)
+        self.queue = QueueProvider(self.cloud)
         self.cloud_provider = TPUCloudProvider(
             cloud=self.cloud,
             instance_types=self.instance_types,
             unavailable=self.unavailable,
             node_classes=self.cluster.nodeclasses,
-            cluster_name=self.options.cluster_name,
+            cluster_name=cluster_name,
+            subnets=self.subnets,
+            launch_templates=self.launch_templates,
+            security_groups=self.security_groups,
+            images=self.images,
         )
         # one GatedSolver shared by both hot paths so they share the device
         # catalog cache and compiled-program cache
@@ -69,7 +97,7 @@ class Environment:
         self.binder = PodBinder(self.cluster)
         self.termination = Termination(self.cluster, self.cloud_provider)
         self.interruption = Interruption(
-            self.cluster, self.cloud, self.unavailable)
+            self.cluster, self.queue, self.unavailable)
         self.gc = GarbageCollection(self.cluster, self.cloud_provider)
         self.expiration = Expiration(self.cluster)
         self.disruption = Disruption(

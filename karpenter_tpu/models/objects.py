@@ -408,12 +408,50 @@ class NodePool:
 
 
 @dataclass
+class SelectorTerm:
+    """One discovery selector term (pkg/apis/v1/ec2nodeclass.go selector
+    terms): terms in a list are OR'd; within a term, id/name/tags are AND'd
+    and the tag map entries are AND'd."""
+    id: Optional[str] = None
+    name: Optional[str] = None
+    tags: Dict[str, str] = field(default_factory=dict)
+
+    def matches(self, obj_id: str, name: str = "",
+                tags: Optional[Dict[str, str]] = None) -> bool:
+        if self.id is not None and self.id != obj_id:
+            return False
+        if self.name is not None and self.name != name:
+            return False
+        tags = tags or {}
+        for k, v in self.tags.items():
+            if v == "*":
+                if k not in tags:
+                    return False
+            elif tags.get(k) != v:
+                return False
+        return True
+
+    def key(self) -> tuple:
+        return (self.id, self.name, tuple(sorted(self.tags.items())))
+
+
+def match_selector_terms(terms: List[SelectorTerm], obj_id: str,
+                         name: str = "",
+                         tags: Optional[Dict[str, str]] = None) -> bool:
+    """Empty terms = select nothing is the reference's rule; our fake cloud
+    seeds cluster-tagged defaults, so None/empty means 'cluster defaults'
+    and is handled by the providers, not here."""
+    return any(t.matches(obj_id, name, tags) for t in terms)
+
+
+@dataclass
 class NodeClass:
     """Provider node configuration — the EC2NodeClass analogue
-    (pkg/apis/v1/ec2nodeclass.go). For the TPU/GCE-shaped provider this
-    carries zone/network/boot configuration rather than AMI/subnet/SG
-    selectors; `ready` gates Create() exactly as the reference's readiness
-    condition does (pkg/cloudprovider/cloudprovider.go:99-102).
+    (pkg/apis/v1/ec2nodeclass.go:29-128). Carries zone/network/boot
+    configuration: subnet/security-group/image selector terms, the image
+    family, and the node identity role; `ready` gates Create() exactly as
+    the reference's readiness condition does
+    (pkg/cloudprovider/cloudprovider.go:99-102).
     """
     meta: ObjectMeta
     zones: List[str] = field(default_factory=list)
@@ -422,19 +460,47 @@ class NodeClass:
                                  wellknown.CAPACITY_TYPE_SPOT])
     boot_config: Dict[str, str] = field(default_factory=dict)  # userdata analogue
     instance_families: Optional[List[str]] = None  # None = all
+    # discovery selectors (None = the cloud's cluster-tagged defaults)
+    subnet_selector_terms: Optional[List[SelectorTerm]] = None
+    security_group_selector_terms: Optional[List[SelectorTerm]] = None
+    image_selector_terms: Optional[List[SelectorTerm]] = None
+    image_family: str = "cos"  # AMIFamily analogue (resolver.go:163-180)
+    role: str = "default-node-role"
+    user_data: str = ""  # appended to the family bootstrap script
+    block_device_gib: int = 100
+    tags: Dict[str, str] = field(default_factory=dict)
     ready: bool = True
-    # status (mirrors EC2NodeClass.status discovered resources)
+    # status (mirrors EC2NodeClass.status discovered resources,
+    # pkg/apis/v1/ec2nodeclass_status.go)
     discovered_zones: List[str] = field(default_factory=list)
+    discovered_subnets: List[str] = field(default_factory=list)
+    discovered_security_groups: List[str] = field(default_factory=list)
+    discovered_images: List[str] = field(default_factory=list)
+    instance_profile: str = ""
+    status_conditions: Dict[str, bool] = field(default_factory=dict)
 
     @property
     def name(self) -> str:
         return self.meta.name
 
     def static_hash(self) -> str:
+        """Drift input — spec-only, status excluded
+        (pkg/apis/v1/ec2nodeclass.go:421-427)."""
         payload = json.dumps({
             "zones": sorted(self.zones),
             "capacity_types": sorted(self.capacity_types),
             "boot_config": sorted(self.boot_config.items()),
             "instance_families": sorted(self.instance_families or []),
+            "image_family": self.image_family,
+            "role": self.role,
+            "user_data": self.user_data,
+            "block_device_gib": self.block_device_gib,
+            "tags": sorted(self.tags.items()),
+            "subnet_terms": sorted(
+                t.key() for t in self.subnet_selector_terms or []),
+            "sg_terms": sorted(
+                t.key() for t in self.security_group_selector_terms or []),
+            "image_terms": sorted(
+                t.key() for t in self.image_selector_terms or []),
         }, sort_keys=True)
         return hashlib.sha256(payload.encode()).hexdigest()[:16]

@@ -10,6 +10,13 @@ from karpenter_tpu.providers.pricing import PricingProvider
 from karpenter_tpu.providers.instancetype import InstanceTypeProvider
 from karpenter_tpu.providers.fake_cloud import FakeCloud, CloudInstance
 from karpenter_tpu.providers.batched_cloud import BatchedCloud
+from karpenter_tpu.providers.imagefamily import ImageProvider
+from karpenter_tpu.providers.instanceprofile import InstanceProfileProvider
+from karpenter_tpu.providers.launchtemplate import LaunchTemplateProvider
+from karpenter_tpu.providers.queue import QueueProvider
+from karpenter_tpu.providers.securitygroup import SecurityGroupProvider
+from karpenter_tpu.providers.subnet import SubnetProvider
+from karpenter_tpu.providers.version import VersionProvider
 
 __all__ = [
     "generate_catalog",
@@ -19,4 +26,11 @@ __all__ = [
     "FakeCloud",
     "CloudInstance",
     "BatchedCloud",
+    "ImageProvider",
+    "InstanceProfileProvider",
+    "LaunchTemplateProvider",
+    "QueueProvider",
+    "SecurityGroupProvider",
+    "SubnetProvider",
+    "VersionProvider",
 ]

@@ -41,12 +41,64 @@ class CloudAPIError(Exception):
     pass
 
 
+class LaunchTemplateNotFound(CloudAPIError):
+    """Launch template referenced by a fleet request no longer exists —
+    the launch path retries once after cache invalidation
+    (pkg/providers/instance/instance.go:107-111)."""
+
+
 @dataclass
 class FleetCandidate:
     instance_type: str
     zone: str
     capacity_type: str
     price: float
+    # launch plumbing (filled when the subnet/launch-template providers are
+    # wired — the reference's getOverrides crosses offerings × zonal subnets
+    # and attaches the per-AMI launch template, instance.go:323-359)
+    subnet_id: Optional[str] = None
+    launch_template: Optional[str] = None
+
+
+@dataclass
+class Subnet:
+    """VPC subnet analogue (pkg/providers/subnet/subnet.go)."""
+    subnet_id: str
+    zone: str
+    available_ips: int
+    tags: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class SecurityGroup:
+    """Firewall/network-tag analogue (pkg/providers/securitygroup)."""
+    group_id: str
+    group_name: str
+    tags: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class MachineImage:
+    """Boot image analogue (pkg/providers/amifamily/ami.go). ``requirements``
+    restricts which instance types can boot it (e.g. accelerator variants)."""
+    image_id: str
+    name: str
+    family: str
+    creation_time: float = 0.0
+    deprecated: bool = False
+    requirements: Dict[str, List[str]] = field(default_factory=dict)
+    tags: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class LaunchTemplate:
+    """Stored launch config (pkg/providers/launchtemplate)."""
+    name: str
+    image_id: str
+    user_data: str
+    security_group_ids: List[str]
+    block_device_gib: int
+    tags: Dict[str, str] = field(default_factory=dict)
 
 
 @dataclass
@@ -59,6 +111,10 @@ class CloudInstance:
     state: str = INSTANCE_RUNNING
     launch_time: float = 0.0
     interrupted: bool = False
+    # launch-config provenance (drift inputs — pkg/cloudprovider/drift.go)
+    subnet_id: Optional[str] = None
+    image_id: Optional[str] = None
+    security_group_ids: List[str] = field(default_factory=list)
 
 
 class FakeCloud:
@@ -82,6 +138,42 @@ class FakeCloud:
         self._alive = True
         # interruption queue (EventBridge→SQS analogue)
         self.interruption_queue: List[dict] = []
+        # networking / boot resources (seeded per zone; tests can replace)
+        self.subnets: Dict[str, Subnet] = {}
+        self.security_groups: Dict[str, SecurityGroup] = {}
+        self.images: Dict[str, MachineImage] = {}
+        self.launch_templates: Dict[str, LaunchTemplate] = {}
+        self.instance_profiles: Dict[str, Dict[str, str]] = {}
+        self.cluster_version = "1.30"
+        self._seed_network_resources()
+
+    def _seed_network_resources(self) -> None:
+        """Default geography: one subnet per zone, one cluster SG, and two
+        image generations per family (newest must win —
+        pkg/providers/amifamily/ami.go newest-wins discovery)."""
+        cluster_tag = {TAG_CLUSTER: "default-cluster"}
+        for i, zone in enumerate(self.zones):
+            sid = f"subnet-{zone}"
+            self.subnets[sid] = Subnet(
+                subnet_id=sid, zone=zone, available_ips=4096,
+                tags=dict(cluster_tag))
+        self.security_groups["sg-cluster"] = SecurityGroup(
+            group_id="sg-cluster", group_name="cluster-default",
+            tags=dict(cluster_tag))
+        t = self.clock.now()
+        for family, variants in (("cos", ("", "-accelerator")),
+                                 ("ubuntu", ("",))):
+            for gen, age in (("v118", 2_000_000.0), ("v121", 1_000.0)):
+                for variant in variants:
+                    iid = f"img-{family}-{gen}{variant}"
+                    # accelerator variants only boot GPU shapes ("*" = the
+                    # label must exist, any value)
+                    reqs = ({"karpenter.tpu/instance-gpu-name": ["*"]}
+                            if variant else {})
+                    self.images[iid] = MachineImage(
+                        image_id=iid, name=f"{family}-{gen}{variant}",
+                        family=family, creation_time=t - age,
+                        requirements=reqs)
 
     def _catalog_zones(self) -> List[str]:
         """Zones are derived from the catalog's offerings (not the spec) so an
@@ -117,6 +209,65 @@ class FakeCloud:
     def live(self) -> bool:
         return self._alive
 
+    # -- network / boot resource APIs ------------------------------------
+    def describe_subnets(self) -> List[Subnet]:
+        self._check_fault("DescribeSubnets")
+        return list(self.subnets.values())
+
+    def describe_security_groups(self) -> List[SecurityGroup]:
+        self._check_fault("DescribeSecurityGroups")
+        return list(self.security_groups.values())
+
+    def describe_images(self) -> List[MachineImage]:
+        self._check_fault("DescribeImages")
+        return list(self.images.values())
+
+    def resolve_image_alias(self, family: str, k8s_version: str) -> Optional[str]:
+        """Release-channel alias → image id (SSM parameter analogue,
+        pkg/providers/amifamily/ami.go SSM alias resolution): latest
+        non-deprecated image of the family's base variant."""
+        self._check_fault("ResolveImageAlias", (family, k8s_version))
+        best = None
+        for img in self.images.values():
+            if img.family != family or img.deprecated or img.requirements:
+                continue
+            if best is None or img.creation_time > best.creation_time:
+                best = img
+        return best.image_id if best else None
+
+    def get_cluster_version(self) -> str:
+        self._check_fault("GetClusterVersion")
+        return self.cluster_version
+
+    def create_launch_template(self, lt: LaunchTemplate) -> None:
+        self._check_fault("CreateLaunchTemplate", lt.name)
+        self.launch_templates[lt.name] = lt
+
+    def delete_launch_template(self, name: str) -> bool:
+        self._check_fault("DeleteLaunchTemplate", name)
+        return self.launch_templates.pop(name, None) is not None
+
+    def list_launch_templates(
+            self, tag_filter: Optional[Dict[str, str]] = None
+    ) -> List[LaunchTemplate]:
+        self._check_fault("ListLaunchTemplates", tag_filter)
+        out = []
+        for lt in self.launch_templates.values():
+            if tag_filter and any(lt.tags.get(k) != v
+                                  for k, v in tag_filter.items()):
+                continue
+            out.append(lt)
+        return out
+
+    def create_instance_profile(self, name: str, role: str,
+                                tags: Dict[str, str]) -> None:
+        self._check_fault("CreateInstanceProfile", name)
+        self.instance_profiles[name] = {"role": role, **tags}
+
+    def delete_instance_profile(self, name: str) -> bool:
+        self._check_fault("DeleteInstanceProfile", name)
+        return self.instance_profiles.pop(name, None) is not None
+
     # -- fleet APIs ------------------------------------------------------
     def create_fleet(
         self,
@@ -131,12 +282,25 @@ class FakeCloud:
         (pkg/providers/instance/instance.go:203-259, pkg/fake/ec2api.go:112-199).
         """
         self._check_fault("CreateFleet", (candidates, tags))
+        for cand in candidates:
+            if (cand.launch_template is not None
+                    and cand.launch_template not in self.launch_templates):
+                raise LaunchTemplateNotFound(cand.launch_template)
         ice: List[Tuple[str, str, str]] = []
         for cand in candidates:
             pool = (cand.capacity_type, cand.instance_type, cand.zone)
             if pool in self.insufficient_capacity_pools:
                 ice.append(pool)
                 continue
+            subnet = (self.subnets.get(cand.subnet_id)
+                      if cand.subnet_id else None)
+            if subnet is not None:
+                if subnet.zone != cand.zone or subnet.available_ips <= 0:
+                    ice.append(pool)
+                    continue
+                subnet.available_ips -= 1
+            lt = (self.launch_templates.get(cand.launch_template)
+                  if cand.launch_template else None)
             inst = CloudInstance(
                 instance_id=f"i-{next(self._id_counter):08d}",
                 instance_type=cand.instance_type,
@@ -145,6 +309,9 @@ class FakeCloud:
                 tags=dict(tags),
                 state=INSTANCE_RUNNING,
                 launch_time=self.clock.now(),
+                subnet_id=cand.subnet_id,
+                image_id=lt.image_id if lt else None,
+                security_group_ids=list(lt.security_group_ids) if lt else [],
             )
             self.instances[inst.instance_id] = inst
             return inst, ice
@@ -209,6 +376,20 @@ class FakeCloud:
             "kind": "state_change",
             "instance_id": instance_id,
             "state": state,
+            "time": self.clock.now(),
+        })
+
+    def send_rebalance_recommendation(self, instance_id: str) -> None:
+        self.interruption_queue.append({
+            "kind": "rebalance_recommendation",
+            "instance_id": instance_id,
+            "time": self.clock.now(),
+        })
+
+    def send_scheduled_change(self, instance_id: str) -> None:
+        self.interruption_queue.append({
+            "kind": "scheduled_change",
+            "instance_id": instance_id,
             "time": self.clock.now(),
         })
 

@@ -1,0 +1,175 @@
+"""Image-family resolver — the AMI-family analogue.
+
+Mirrors pkg/providers/amifamily: a family interface (resolver.go:79-86)
+with per-family bootstrap user-data, dispatched by name
+(resolver.go:163-180); image discovery combines release-channel alias
+resolution (the SSM path in ami.go) with explicit selector terms, and
+newest-creation-time wins among candidates. Resolve() groups instance
+types by which discovered image can boot them (per-(image ×
+instance-type-group) launch parameters, resolver.go:122-161).
+
+Families here are TPU/GCE-flavored: "cos" (Container-Optimized OS — the
+AL2023 role), "ubuntu", and "custom" (selector terms only, no alias, no
+generated user-data — amifamily/custom.go).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from karpenter_tpu.models.objects import (
+    InstanceType,
+    NodeClass,
+    match_selector_terms,
+)
+from karpenter_tpu.providers.fake_cloud import MachineImage
+from karpenter_tpu.utils.cache import TTLCache
+from karpenter_tpu.utils.clock import Clock, RealClock
+
+IMAGE_CACHE_TTL = 60.0
+
+
+@dataclass
+class ResolvedLaunchConfig:
+    """One (image × compatible-instance-type-group) launch parameter set —
+    the reference's amifamily.LaunchTemplate (resolver.go:122-161)."""
+    image: MachineImage
+    instance_type_names: List[str]
+    user_data: str
+    block_device_gib: int = 100
+    security_group_ids: List[str] = field(default_factory=list)
+
+
+class ImageFamily:
+    """Family interface (resolver.go:79-86): alias for discovery plus the
+    bootstrap script the node runs to join the cluster."""
+
+    name = "base"
+
+    def user_data(self, cluster_name: str, k8s_version: str,
+                  nc: NodeClass) -> str:
+        raise NotImplementedError
+
+
+class COSFamily(ImageFamily):
+    name = "cos"
+
+    def user_data(self, cluster_name, k8s_version, nc):
+        base = (f"#cloud-config\n# join {cluster_name} (k8s {k8s_version})\n"
+                f"runcmd:\n- kubelet --bootstrap --cluster {cluster_name}\n")
+        return base + nc.user_data
+
+
+class UbuntuFamily(ImageFamily):
+    name = "ubuntu"
+
+    def user_data(self, cluster_name, k8s_version, nc):
+        base = (f"#!/bin/bash\n/etc/kubernetes/bootstrap.sh "
+                f"--cluster {cluster_name} --kube-version {k8s_version}\n")
+        return base + nc.user_data
+
+
+class CustomFamily(ImageFamily):
+    """Selector-terms-only: the user supplies the full user-data
+    (amifamily/custom.go)."""
+    name = "custom"
+
+    def user_data(self, cluster_name, k8s_version, nc):
+        return nc.user_data
+
+
+FAMILIES: Dict[str, ImageFamily] = {
+    f.name: f for f in (COSFamily(), UbuntuFamily(), CustomFamily())
+}
+
+
+def get_family(name: str) -> ImageFamily:
+    """Dispatch by family name, defaulting like GetAMIFamily
+    (resolver.go:163-180)."""
+    return FAMILIES.get(name, FAMILIES["cos"])
+
+
+class ImageProvider:
+    def __init__(self, cloud, version_provider,
+                 cluster_name: str = "default-cluster",
+                 clock: Optional[Clock] = None):
+        self.cloud = cloud
+        self.versions = version_provider
+        self.cluster_name = cluster_name
+        self._cache = TTLCache(ttl=IMAGE_CACHE_TTL,
+                               clock=clock or RealClock())
+
+    def list(self, nc: NodeClass) -> List[MachineImage]:
+        """Discovered images, newest first (ami.go newest-wins)."""
+        key = ("images", nc.name, nc.static_hash())
+        cached = self._cache.get(key)
+        if cached is not None:
+            return cached
+        out: List[MachineImage] = []
+        terms = nc.image_selector_terms
+        if terms:
+            for img in self.cloud.describe_images():
+                if img.deprecated:
+                    continue
+                if match_selector_terms(terms, img.image_id, img.name,
+                                        img.tags):
+                    out.append(img)
+        elif nc.image_family != "custom":
+            # release-channel alias → latest image of the family; variants
+            # (e.g. accelerator builds) of the same generation come along
+            alias = self.cloud.resolve_image_alias(
+                nc.image_family, self.versions.get())
+            if alias is not None:
+                base = self.cloud.images[alias]
+                for img in self.cloud.describe_images():
+                    if (img.family == nc.image_family and not img.deprecated
+                            and img.creation_time == base.creation_time):
+                        out.append(img)
+        out.sort(key=lambda i: (-i.creation_time, i.image_id))
+        self._cache.set(key, out)
+        return out
+
+    def resolve(self, nc: NodeClass, instance_types: List[InstanceType],
+                security_group_ids: Optional[List[str]] = None,
+                ) -> List[ResolvedLaunchConfig]:
+        """Group instance types under the newest image whose requirements
+        admit them (resolver.go:122-161)."""
+        images = self.list(nc)
+        if not images:
+            return []
+        family = get_family(nc.image_family)
+        ud = family.user_data(self.cluster_name, self.versions.get(), nc)
+        # specific variants (accelerator builds) outrank plain images of the
+        # same generation; then newest wins
+        images = sorted(images, key=lambda i: (-len(i.requirements),
+                                               -i.creation_time, i.image_id))
+        assigned: Dict[str, List[str]] = {}
+        for it in instance_types:
+            for img in images:  # first admitting image wins
+                if self._image_admits(img, it):
+                    assigned.setdefault(img.image_id, []).append(it.name)
+                    break
+        by_id = {img.image_id: img for img in images}
+        return [
+            ResolvedLaunchConfig(
+                image=by_id[iid], instance_type_names=names, user_data=ud,
+                block_device_gib=nc.block_device_gib,
+                security_group_ids=list(security_group_ids or []))
+            for iid, names in assigned.items()
+        ]
+
+    @staticmethod
+    def _image_admits(img: MachineImage, it: InstanceType) -> bool:
+        """An image with requirements only boots matching types (accelerator
+        variants). "*" means the label must exist with any value. Plain
+        images admit every type."""
+        for key, values in img.requirements.items():
+            req = it.requirements.get(key)
+            if req is None:
+                return False
+            if "*" in values:
+                continue
+            if not any(req.matches(v) for v in values):
+                return False
+        return True

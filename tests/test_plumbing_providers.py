@@ -1,0 +1,309 @@
+"""Cloud plumbing providers — subnet, security group, image family, launch
+template, instance profile, version, queue (reference:
+pkg/providers/{subnet,securitygroup,amifamily,launchtemplate,
+instanceprofile,version,sqs})."""
+
+import pytest
+
+from karpenter_tpu.env import Environment
+from karpenter_tpu.models import (
+    NodePool,
+    ObjectMeta,
+    Pod,
+    Requirement,
+    Requirements,
+    Resources,
+    wellknown,
+)
+from karpenter_tpu.models.objects import NodeClass, SelectorTerm
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.providers.fake_cloud import MachineImage, Subnet
+from karpenter_tpu.providers.imagefamily import get_family
+
+
+@pytest.fixture
+def env():
+    e = Environment(options=Options(batch_idle_duration=0))
+    e.add_default_nodeclass()
+    e.cluster.nodepools.create(NodePool(meta=ObjectMeta(name="default")))
+    return e
+
+
+def mkpod(name, cpu="500m", mem="1Gi", **kw):
+    return Pod(meta=ObjectMeta(name=name),
+               requests=Resources.parse({"cpu": cpu, "memory": mem}), **kw)
+
+
+class TestSubnetProvider:
+    def test_default_discovery_is_cluster_tagged(self, env):
+        nc = env.cluster.nodeclasses.get("default")
+        subnets = env.subnets.list(nc)
+        assert len(subnets) == len(env.cloud.zones)
+        assert {s.zone for s in subnets} == set(env.cloud.zones)
+
+    def test_selector_terms_by_id(self, env):
+        zone = env.cloud.zones[0]
+        nc = NodeClass(meta=ObjectMeta(name="picky"),
+                       subnet_selector_terms=[
+                           SelectorTerm(id=f"subnet-{zone}")])
+        env.cluster.nodeclasses.create(nc)
+        subnets = env.subnets.list(nc)
+        assert [s.subnet_id for s in subnets] == [f"subnet-{zone}"]
+
+    def test_zonal_choice_prefers_most_free_ips(self, env):
+        zone = env.cloud.zones[0]
+        env.cloud.subnets["subnet-extra"] = Subnet(
+            subnet_id="subnet-extra", zone=zone, available_ips=9999,
+            tags={"karpenter.sh/discovery": "default-cluster"})
+        nc = env.cluster.nodeclasses.get("default")
+        zonal = env.subnets.zonal_subnets_for_launch(nc)
+        assert zonal[zone].subnet_id == "subnet-extra"
+
+    def test_exhausted_subnet_is_skipped(self, env):
+        nc = env.cluster.nodeclasses.get("default")
+        for s in env.subnets.list(nc):
+            env.cloud.subnets[s.subnet_id].available_ips = 0
+        assert env.subnets.zonal_subnets_for_launch(nc) == {}
+
+    def test_inflight_ips_decrement_prediction(self, env):
+        zone = env.cloud.zones[0]
+        sid = f"subnet-{zone}"
+        env.cloud.subnets["subnet-extra"] = Subnet(
+            subnet_id="subnet-extra", zone=zone,
+            available_ips=env.cloud.subnets[sid].available_ips + 1,
+            tags={"karpenter.sh/discovery": "default-cluster"})
+        nc = env.cluster.nodeclasses.get("default")
+        assert env.subnets.zonal_subnets_for_launch(nc)[zone].subnet_id \
+            == "subnet-extra"
+        env.subnets.update_inflight_ips("subnet-extra", 2)
+        assert env.subnets.zonal_subnets_for_launch(nc)[zone].subnet_id == sid
+
+
+class TestSecurityGroupProvider:
+    def test_default_discovery(self, env):
+        nc = env.cluster.nodeclasses.get("default")
+        groups = env.security_groups.list(nc)
+        assert [g.group_id for g in groups] == ["sg-cluster"]
+
+    def test_selector_by_name(self, env):
+        nc = NodeClass(meta=ObjectMeta(name="named"),
+                       security_group_selector_terms=[
+                           SelectorTerm(name="cluster-default")])
+        groups = env.security_groups.list(nc)
+        assert [g.group_id for g in groups] == ["sg-cluster"]
+
+    def test_selector_no_match(self, env):
+        nc = NodeClass(meta=ObjectMeta(name="none"),
+                       security_group_selector_terms=[
+                           SelectorTerm(id="sg-nope")])
+        assert env.security_groups.list(nc) == []
+
+
+class TestImageProvider:
+    def test_alias_resolves_newest_of_family(self, env):
+        nc = env.cluster.nodeclasses.get("default")
+        images = env.images.list(nc)
+        ids = {i.image_id for i in images}
+        # newest cos generation incl. accelerator variant; old gen excluded
+        assert ids == {"img-cos-v121", "img-cos-v121-accelerator"}
+
+    def test_deprecated_images_excluded_from_alias(self, env):
+        for img in env.cloud.images.values():
+            if "v121" in img.image_id and img.family == "cos":
+                img.deprecated = True
+        nc = NodeClass(meta=ObjectMeta(name="dep"))
+        images = env.images.list(nc)
+        assert {i.image_id for i in images} == {"img-cos-v118",
+                                                "img-cos-v118-accelerator"}
+
+    def test_selector_terms_override_alias(self, env):
+        nc = NodeClass(meta=ObjectMeta(name="pinned"),
+                       image_selector_terms=[SelectorTerm(id="img-cos-v118")])
+        images = env.images.list(nc)
+        assert [i.image_id for i in images] == ["img-cos-v118"]
+
+    def test_custom_family_without_terms_resolves_nothing(self, env):
+        nc = NodeClass(meta=ObjectMeta(name="cust"), image_family="custom")
+        assert env.images.list(nc) == []
+
+    def test_resolve_groups_gpu_types_under_accelerator_image(self, env):
+        nc = env.cluster.nodeclasses.get("default")
+        types = env.instance_types.list(nc)
+        gpu_types = [t for t in types if t.capacity.get("gpu") > 0][:3]
+        cpu_types = [t for t in types if t.capacity.get("gpu") == 0][:3]
+        configs = env.images.resolve(nc, gpu_types + cpu_types)
+        by_image = {c.image.image_id: set(c.instance_type_names)
+                    for c in configs}
+        assert by_image["img-cos-v121-accelerator"] == {
+            t.name for t in gpu_types}
+        assert by_image["img-cos-v121"] == {t.name for t in cpu_types}
+
+    def test_family_user_data_shapes(self, env):
+        nc = NodeClass(meta=ObjectMeta(name="ud"), user_data="echo extra\n")
+        cos = get_family("cos").user_data("c", "1.30", nc)
+        assert cos.startswith("#cloud-config") and "echo extra" in cos
+        ubuntu = get_family("ubuntu").user_data("c", "1.30", nc)
+        assert ubuntu.startswith("#!/bin/bash") and "echo extra" in ubuntu
+        custom = get_family("custom").user_data("c", "1.30", nc)
+        assert custom == "echo extra\n"
+        # unknown family dispatches to the default (resolver.go:163-180)
+        assert get_family("nope").name == "cos"
+
+
+class TestLaunchTemplateProvider:
+    def test_ensure_all_creates_and_dedupes(self, env):
+        nc = env.cluster.nodeclasses.get("default")
+        types = env.instance_types.list(nc)[:5]
+        first = env.launch_templates.ensure_all(nc, types)
+        assert len(first) >= 1
+        calls_before = len(env.cloud.api_calls)
+        second = env.launch_templates.ensure_all(nc, types)
+        assert set(second) == set(first)
+        create_calls = [c for c in env.cloud.api_calls[calls_before:]
+                        if c[0] == "CreateLaunchTemplate"]
+        assert create_calls == []  # cached — no second create
+
+    def test_templates_carry_bootstrap_userdata_and_sgs(self, env):
+        nc = env.cluster.nodeclasses.get("default")
+        env.launch_templates.ensure_all(nc, env.instance_types.list(nc)[:3])
+        lts = env.cloud.list_launch_templates()
+        assert lts and all("kubelet --bootstrap" in lt.user_data for lt in lts)
+        assert all(lt.security_group_ids == ["sg-cluster"] for lt in lts)
+
+    def test_delete_all_removes_nodeclass_templates(self, env):
+        nc = env.cluster.nodeclasses.get("default")
+        env.launch_templates.ensure_all(nc, env.instance_types.list(nc)[:3])
+        n = env.launch_templates.delete_all(nc)
+        assert n >= 1
+        assert env.cloud.list_launch_templates(
+            tag_filter={"karpenter.tpu/nodeclass": nc.name}) == []
+
+    def test_cache_eviction_deletes_cloud_side(self, env):
+        nc = env.cluster.nodeclasses.get("default")
+        env.launch_templates.ensure_all(nc, env.instance_types.list(nc)[:3])
+        assert env.cloud.launch_templates
+        env.clock.step(700)  # past the 10-min cache TTL
+        env.launch_templates.sweep()
+        assert env.cloud.launch_templates == {}
+
+
+class TestInstanceProfileProvider:
+    def test_create_is_idempotent_and_hash_named(self, env):
+        nc = env.cluster.nodeclasses.get("default")
+        name = env.instance_profiles.create(nc)
+        assert name == env.instance_profiles.create(nc)
+        assert env.cloud.instance_profiles[name]["role"] == nc.role
+        # same role ⇒ same profile, different role ⇒ different profile
+        other = NodeClass(meta=ObjectMeta(name="other"), role="other-role")
+        assert env.instance_profiles.profile_name(other) != name
+
+    def test_delete(self, env):
+        nc = env.cluster.nodeclasses.get("default")
+        env.instance_profiles.create(nc)
+        assert env.instance_profiles.delete(nc) is True
+        assert env.instance_profiles.get(nc) is None
+
+
+class TestVersionProvider:
+    def test_cached_version(self, env):
+        assert env.versions.get() == "1.30"
+        env.cloud.cluster_version = "1.31"
+        assert env.versions.get() == "1.30"  # cached for 15 min
+        env.clock.step(1000)
+        assert env.versions.get() == "1.31"
+
+
+class TestLaunchPathIntegration:
+    def test_instances_carry_launch_provenance(self, env):
+        env.cluster.pods.create(mkpod("p0"))
+        env.settle()
+        claims = env.cluster.nodeclaims.list()
+        assert len(claims) == 1
+        inst = env.cloud.get_instance(claims[0].provider_id)
+        assert inst.subnet_id == f"subnet-{inst.zone}"
+        assert inst.image_id == "img-cos-v121"
+        assert inst.security_group_ids == ["sg-cluster"]
+        # the chosen subnet's predicted free IPs were decremented
+        assert env.subnets._inflight.get(inst.subnet_id) == 1
+
+    def test_launch_template_not_found_retries_once(self, env):
+        env.cluster.pods.create(mkpod("p0"))
+        nc = env.cluster.nodeclasses.get("default")
+        # warm template cache, then delete the templates cloud-side
+        env.launch_templates.ensure_all(nc, env.instance_types.list(nc))
+        env.cloud.launch_templates.clear()
+        env.settle()
+        pods = env.cluster.pods.list()
+        assert all(p.phase == "Running" for p in pods)
+
+    def test_gpu_pod_lands_on_accelerator_image(self, env):
+        env.cluster.pods.create(Pod(
+            meta=ObjectMeta(name="gpu-pod"),
+            requests=Resources.parse(
+                {"cpu": "2", "memory": "4Gi", "nvidia.com/gpu": 1})))
+        env.settle()
+        claims = env.cluster.nodeclaims.list()
+        assert len(claims) == 1
+        inst = env.cloud.get_instance(claims[0].provider_id)
+        assert inst.image_id == "img-cos-v121-accelerator"
+
+
+class TestDrift:
+    def _launch_one(self, env):
+        env.cluster.pods.create(mkpod("p0"))
+        env.settle()
+        return env.cluster.nodeclaims.list()[0]
+
+    def test_image_drift_when_new_generation_released(self, env):
+        claim = self._launch_one(env)
+        assert env.cloud_provider.is_drifted(claim) is None
+        t = env.clock.now()
+        for variant, reqs in (("", {}),
+                              ("-accelerator",
+                               {"karpenter.tpu/instance-gpu-name": ["*"]})):
+            iid = f"img-cos-v125{variant}"
+            env.cloud.images[iid] = MachineImage(
+                image_id=iid, name=f"cos-v125{variant}", family="cos",
+                creation_time=t + 10, requirements=reqs)
+        env.clock.step(120)  # expire the image cache
+        assert env.cloud_provider.is_drifted(claim) == "ImageDrift"
+
+    def test_subnet_drift_when_discovery_changes(self, env):
+        # spec unchanged; the cloud-side subnet loses its cluster tag, so
+        # discovery no longer returns the subnet the instance runs in
+        claim = self._launch_one(env)
+        inst = env.cloud.get_instance(claim.provider_id)
+        env.cloud.subnets[inst.subnet_id].tags.clear()
+        env.clock.step(120)
+        assert env.cloud_provider.is_drifted(claim) == "SubnetDrift"
+
+    def test_security_group_drift_when_discovery_changes(self, env):
+        claim = self._launch_one(env)
+        sg = env.cloud.security_groups.pop("sg-cluster")
+        env.cloud.security_groups["sg-new"] = type(sg)(
+            group_id="sg-new", group_name="cluster-default",
+            tags=dict(sg.tags))
+        env.clock.step(120)
+        assert env.cloud_provider.is_drifted(claim) == "SecurityGroupDrift"
+
+
+class TestInterruptionKinds:
+    def _launch_one(self, env):
+        env.cluster.pods.create(mkpod("p0"))
+        env.settle()
+        return env.cluster.nodeclaims.list()[0]
+
+    def test_rebalance_recommendation_is_advisory(self, env):
+        claim = self._launch_one(env)
+        env.cloud.send_rebalance_recommendation(claim.provider_id)
+        env.interruption.reconcile()
+        assert env.cluster.nodeclaims.get(claim.name) is not None
+        assert any(r == "RebalanceRecommendation"
+                   for _, _, _, r, _ in env.cluster.events)
+
+    def test_scheduled_change_deletes_claim(self, env):
+        claim = self._launch_one(env)
+        env.cloud.send_scheduled_change(claim.provider_id)
+        env.interruption.reconcile()
+        c = env.cluster.nodeclaims.get(claim.name)
+        assert c is None or c.meta.deleting
