@@ -11,6 +11,13 @@ from karpenter_tpu.controllers.interruption import Interruption
 from karpenter_tpu.controllers.gc import GarbageCollection
 from karpenter_tpu.controllers.expiration import Expiration
 from karpenter_tpu.controllers.disruption import Disruption
+from karpenter_tpu.controllers.nodeclass import (
+    NodeClassHash,
+    NodeClassStatus,
+    NodeClassTermination,
+)
+from karpenter_tpu.controllers.tagging import NodeClaimTagging
+from karpenter_tpu.controllers.refresh import InstanceTypeRefresh, PricingRefresh
 
 __all__ = [
     "ControllerManager",
@@ -23,4 +30,10 @@ __all__ = [
     "GarbageCollection",
     "Expiration",
     "Disruption",
+    "NodeClassHash",
+    "NodeClassStatus",
+    "NodeClassTermination",
+    "NodeClaimTagging",
+    "InstanceTypeRefresh",
+    "PricingRefresh",
 ]

@@ -16,9 +16,15 @@ from karpenter_tpu.controllers import (
     Expiration,
     FakeKubelet,
     GarbageCollection,
+    InstanceTypeRefresh,
     Interruption,
     NodeClaimLifecycle,
+    NodeClaimTagging,
+    NodeClassHash,
+    NodeClassStatus,
+    NodeClassTermination,
     PodBinder,
+    PricingRefresh,
     Provisioner,
     Termination,
 )
@@ -100,19 +106,36 @@ class Environment:
             self.cluster, self.queue, self.unavailable)
         self.gc = GarbageCollection(self.cluster, self.cloud_provider)
         self.expiration = Expiration(self.cluster)
+        self.nodeclass_hash = NodeClassHash(self.cluster)
+        self.nodeclass_status = NodeClassStatus(
+            self.cluster, self.subnets, self.security_groups, self.images,
+            self.instance_profiles)
+        self.nodeclass_termination = NodeClassTermination(
+            self.cluster, self.launch_templates, self.instance_profiles)
+        self.tagging = NodeClaimTagging(
+            self.cluster, self.cloud, cluster_name=cluster_name)
+        self.pricing_refresh = PricingRefresh(self.pricing, clock=self.clock)
+        self.instancetype_refresh = InstanceTypeRefresh(
+            self.instance_types, clock=self.clock)
         self.disruption = Disruption(
             self.cluster, self.cloud_provider, self.options, self.clock,
             solver=self.solver)
         self.manager = ControllerManager(self.cluster, [
+            self.nodeclass_hash,
+            self.nodeclass_status,
+            self.pricing_refresh,
+            self.instancetype_refresh,
             self.provisioner,
             self.lifecycle,
             self.kubelet,
             self.binder,
+            self.tagging,
             self.interruption,
             self.expiration,
             self.disruption,
             self.termination,
             self.gc,
+            self.nodeclass_termination,
         ])
 
     # -- conveniences -----------------------------------------------------

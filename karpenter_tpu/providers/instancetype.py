@@ -95,6 +95,12 @@ class InstanceTypeProvider:
         self._cache.set(node_class.name, (key, out))
         return out
 
+    def invalidate(self) -> None:
+        """Drop cached lists so the next call re-pulls the catalog (the
+        refresh controller's UpdateInstanceTypes/Offerings analogue,
+        instancetype.go:184-253)."""
+        self._cache.flush()
+
     def live(self) -> bool:
         """Liveness aggregation (reference: instancetype.go:177-182 folds
         subnet+pricing liveness into the cloudprovider probe)."""

@@ -100,15 +100,22 @@ class TestProvisioningE2E:
             for it in claim.instance_type_options for z in env.cloud.zones)
 
     def test_nodeclass_not_ready_blocks_launch(self, env):
-        env.cluster.nodeclasses.get("default").ready = False
+        # custom image family with no selector terms discovers no images —
+        # the status controller marks the nodeclass NotReady, which gates
+        # Create() (cloudprovider.go:99-102)
+        nc = env.cluster.nodeclasses.get("default")
+        nc.image_family = "custom"
         env.cluster.pods.create(mkpod("p"))
         env.manager.run_once()
         env.manager.run_once()
+        assert nc.ready is False
         claim = env.cluster.nodeclaims.list()[0]
         assert not claim.is_(COND_LAUNCHED)
         # readiness restored → launch proceeds
-        env.cluster.nodeclasses.get("default").ready = True
+        nc.image_family = "cos"
+        env.clock.step(120)  # let the image-discovery cache expire
         env.settle()
+        assert nc.ready is True
         assert env.cluster.nodeclaims.list()[0].is_(COND_LAUNCHED)
 
     def test_tainted_pool_requires_toleration(self, env):

@@ -1,0 +1,142 @@
+"""NodeClass hash/status/termination, NodeClaim tagging, provider refresh
+controllers (reference: pkg/controllers/nodeclass, nodeclaim/tagging,
+providers/{instancetype,pricing})."""
+
+import pytest
+
+from karpenter_tpu.controllers.nodeclass import (
+    COND_IMAGES_READY,
+    COND_READY,
+    NODECLASS_FINALIZER,
+)
+from karpenter_tpu.env import Environment
+from karpenter_tpu.models import NodePool, ObjectMeta, Pod, Resources, wellknown
+from karpenter_tpu.models.objects import NodeClass
+from karpenter_tpu.operator.options import Options
+
+
+@pytest.fixture
+def env():
+    e = Environment(options=Options(batch_idle_duration=0))
+    e.add_default_nodeclass()
+    e.cluster.nodepools.create(NodePool(meta=ObjectMeta(name="default")))
+    return e
+
+
+def mkpod(name):
+    return Pod(meta=ObjectMeta(name=name),
+               requests=Resources.parse({"cpu": "500m", "memory": "1Gi"}))
+
+
+class TestNodeClassHash:
+    def test_stamps_hash_and_version(self, env):
+        nc = env.cluster.nodeclasses.get("default")
+        env.manager.run_once()
+        assert nc.meta.annotations[wellknown.NODECLASS_HASH_ANNOTATION] \
+            == nc.static_hash()
+        assert nc.meta.annotations[
+            wellknown.NODECLASS_HASH_VERSION_ANNOTATION] == "v1"
+
+    def test_restamps_on_spec_change(self, env):
+        nc = env.cluster.nodeclasses.get("default")
+        env.manager.run_once()
+        before = nc.meta.annotations[wellknown.NODECLASS_HASH_ANNOTATION]
+        nc.role = "new-role"
+        env.manager.run_once()
+        after = nc.meta.annotations[wellknown.NODECLASS_HASH_ANNOTATION]
+        assert after == nc.static_hash() != before
+
+
+class TestNodeClassStatus:
+    def test_populates_discovered_resources(self, env):
+        env.settle()
+        nc = env.cluster.nodeclasses.get("default")
+        assert nc.discovered_subnets == sorted(
+            f"subnet-{z}" for z in env.cloud.zones)
+        assert nc.discovered_security_groups == ["sg-cluster"]
+        assert "img-cos-v121" in nc.discovered_images
+        assert set(nc.discovered_zones) == set(env.cloud.zones)
+        assert nc.instance_profile in env.cloud.instance_profiles
+        assert nc.status_conditions[COND_READY] is True
+        assert NODECLASS_FINALIZER in nc.meta.finalizers
+
+    def test_not_ready_when_no_images(self, env):
+        nc = NodeClass(meta=ObjectMeta(name="broken"), image_family="custom")
+        env.cluster.nodeclasses.create(nc)
+        env.settle()
+        assert nc.ready is False
+        assert nc.status_conditions[COND_IMAGES_READY] is False
+        assert any(r == "NotReady" and o == "broken"
+                   for _, _, o, r, _ in env.cluster.events)
+
+    def test_ready_transition_recovers(self, env):
+        nc = NodeClass(meta=ObjectMeta(name="late"), image_family="custom")
+        env.cluster.nodeclasses.create(nc)
+        env.settle()
+        assert nc.ready is False
+        nc.image_family = "cos"
+        env.clock.step(120)
+        env.settle()
+        assert nc.ready is True
+
+
+class TestNodeClassTermination:
+    def test_blocked_while_claims_reference_it(self, env):
+        env.cluster.pods.create(mkpod("p"))
+        env.settle()
+        env.cluster.nodeclasses.delete("default")
+        env.manager.run_once()
+        nc = env.cluster.nodeclasses.get("default")
+        assert nc is not None and nc.meta.deleting
+        assert any(r == "TerminationBlocked"
+                   for _, _, _, r, _ in env.cluster.events)
+
+    def test_cleans_up_templates_and_profile(self, env):
+        env.cluster.pods.create(mkpod("p"))
+        env.settle()
+        nc = env.cluster.nodeclasses.get("default")
+        profile = nc.instance_profile
+        assert env.cloud.launch_templates and profile
+        # remove the workload then the nodeclass
+        for p in env.cluster.pods.list():
+            p.meta.finalizers.clear()
+            env.cluster.pods.delete(p.meta.name)
+        for c in env.cluster.nodeclaims.list():
+            env.cluster.nodeclaims.delete(c.name)
+        env.settle()
+        env.cluster.nodeclasses.delete("default")
+        env.settle()
+        assert env.cluster.nodeclasses.get("default") is None
+        assert env.cloud.list_launch_templates(
+            tag_filter={"karpenter.tpu/nodeclass": "default"}) == []
+        assert profile not in env.cloud.instance_profiles
+
+
+class TestNodeClaimTagging:
+    def test_registered_instance_gets_name_tag(self, env):
+        env.cluster.pods.create(mkpod("p"))
+        env.settle()
+        claim = env.cluster.nodeclaims.list()[0]
+        inst = env.cloud.get_instance(claim.provider_id)
+        assert inst.tags["Name"] == claim.node_name
+        assert inst.tags["karpenter.tpu/managed-by"] == "default-cluster"
+
+
+class TestProviderRefresh:
+    def test_pricing_refresh_picks_up_new_prices(self, env):
+        env.settle()
+        old_seq = env.pricing.seqnum
+        for it in env.cloud._catalog:
+            for o in it.offerings:
+                o.price *= 2
+        env.clock.step(400)  # past the refresh interval
+        env.manager.run_once()
+        assert env.pricing.seqnum > old_seq
+
+    def test_instancetype_refresh_invalidates_cache(self, env):
+        nc = env.cluster.nodeclasses.get("default")
+        first = env.instance_types.list(nc)
+        assert env.instance_types.list(nc) is first  # cached
+        env.clock.step(400)
+        env.manager.run_once()
+        assert env.instance_types.list(nc) is not first
