@@ -42,6 +42,7 @@ from karpenter_tpu.models.taints import NO_SCHEDULE, Taint
 from karpenter_tpu.operator.options import Options
 from karpenter_tpu.scheduling import ScheduleResult
 from karpenter_tpu.scheduling.types import ScheduleInput
+from karpenter_tpu.utils import errors, metrics
 from karpenter_tpu.utils.clock import Clock
 
 SPOT_TO_SPOT_MIN_TYPES = 15  # disruption.md:123-132
@@ -101,15 +102,39 @@ class Disruption:
 
     # ------------------------------------------------------------------
     def reconcile(self) -> None:
+        try:
+            self._reconcile()
+        except Exception as e:  # noqa: BLE001 — cloud outage: skip the pass
+            if not errors.is_retryable(e):
+                raise
+
+    def _reconcile(self) -> None:
         if self._process_commands():
             return  # one in-flight command at a time (minimal-change bias)
         candidates = self._build_candidates()
+        self._publish_eligibility(candidates)
         if not candidates:
             return
         for method in (self._drift, self._emptiness,
                        self._multi_node, self._single_node):
-            if method(candidates):
+            mname = method.__name__.lstrip("_")
+            with metrics.DISRUPTION_EVALUATION_DURATION.time(method=mname):
+                acted = method(candidates)
+            if acted:
+                metrics.DISRUPTION_ACTIONS.inc(method=mname)
                 return
+
+    def _publish_eligibility(self, candidates: List[Candidate]) -> None:
+        """Refresh every method's eligible-nodes gauge each pass (including
+        to zero) so the exported values never go stale."""
+        consolidatable = self._consolidatable(candidates)
+        empty = [c for c in candidates if not c.reschedulable]
+        metrics.DISRUPTION_ELIGIBLE_NODES.set(len(candidates), method="drift")
+        metrics.DISRUPTION_ELIGIBLE_NODES.set(len(empty), method="emptiness")
+        metrics.DISRUPTION_ELIGIBLE_NODES.set(
+            len(consolidatable), method="multi_node")
+        metrics.DISRUPTION_ELIGIBLE_NODES.set(
+            len(consolidatable), method="single_node")
 
     # -- in-flight commands ----------------------------------------------
     def _process_commands(self) -> bool:
@@ -356,7 +381,8 @@ class Disruption:
         return result
 
     def _solve(self, inp: ScheduleInput) -> ScheduleResult:
-        return self.solver.solve(inp, source="disruption")
+        with metrics.SCHEDULING_SIMULATION_DURATION.time():
+            return self.solver.solve(inp, source="disruption")
 
     def _acceptable(self, cands: List[Candidate],
                     sim: ScheduleResult) -> bool:

@@ -14,6 +14,7 @@ from __future__ import annotations
 from karpenter_tpu.cloudprovider import TPUCloudProvider
 from karpenter_tpu.cluster import Cluster
 from karpenter_tpu.providers.fake_cloud import INSTANCE_RUNNING
+from karpenter_tpu.utils import errors
 
 
 class GarbageCollection:
@@ -24,6 +25,15 @@ class GarbageCollection:
         self.cp = cloud_provider
 
     def reconcile(self) -> None:
+        try:
+            self._reconcile()
+        except Exception as e:  # noqa: BLE001
+            # GC is cloud-read-heavy; a transient outage just means this
+            # sweep is skipped (pkg/errors taxonomy — retry next round)
+            if not errors.is_retryable(e):
+                raise
+
+    def _reconcile(self) -> None:
         claims = self.cluster.nodeclaims.list()
         by_provider = {c.provider_id for c in claims if c.provider_id}
 

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 from karpenter_tpu.cluster import Cluster
 from karpenter_tpu.providers.queue import QueueProvider
+from karpenter_tpu.utils import errors, metrics
 from karpenter_tpu.utils.cache import UnavailableOfferings
 
 
@@ -32,11 +33,19 @@ class Interruption:
         self.unavailable = unavailable
 
     def reconcile(self) -> None:
-        for msg in list(self.queue.receive()):
+        try:
+            msgs = list(self.queue.receive())
+        except Exception as e:  # noqa: BLE001 — queue outage: poll next round
+            if not errors.is_retryable(e):
+                raise
+            return
+        for msg in msgs:
             self._handle(msg)
             self.queue.delete(msg)
 
     def _handle(self, msg: dict) -> None:
+        metrics.INTERRUPTION_MESSAGES.inc(
+            message_type=msg.get("kind", "unknown"))
         instance_id = msg.get("instance_id")
         claim = next(
             (c for c in self.cluster.nodeclaims.list()

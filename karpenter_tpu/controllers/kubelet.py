@@ -17,6 +17,7 @@ from karpenter_tpu.models import wellknown
 from karpenter_tpu.models.objects import Node, ObjectMeta
 from karpenter_tpu.models.taints import Taint
 from karpenter_tpu.providers.fake_cloud import INSTANCE_RUNNING, TAG_NODECLAIM
+from karpenter_tpu.utils import errors
 
 
 class FakeKubelet:
@@ -27,6 +28,13 @@ class FakeKubelet:
         self.cp = cloud_provider
 
     def reconcile(self) -> None:
+        try:
+            self._reconcile()
+        except Exception as e:  # noqa: BLE001 — skip the round on outage
+            if not errors.is_retryable(e):
+                raise
+
+    def _reconcile(self) -> None:
         for inst in self.cp.list_instances():
             if inst.state != INSTANCE_RUNNING:
                 continue

@@ -25,6 +25,7 @@ from karpenter_tpu.models.objects import (
     NodeClaim,
 )
 from karpenter_tpu.operator.options import Options
+from karpenter_tpu.utils import errors, metrics
 from karpenter_tpu.utils.clock import Clock
 
 
@@ -59,6 +60,7 @@ class NodeClaimLifecycle:
         try:
             self.cp.create(claim)
             self.cluster.nodeclaims.update(claim)
+            metrics.NODECLAIMS_LAUNCHED.inc(nodepool=claim.nodepool)
             self.cluster.record_event(
                 "NodeClaim", claim.name, "Launched",
                 f"instance {claim.provider_id}")
@@ -83,6 +85,14 @@ class NodeClaimLifecycle:
             self.cluster.nodeclaims.remove_finalizer(
                 claim.name, wellknown.TERMINATION_FINALIZER)
             self.cluster.nodeclaims.delete(claim.name)
+        except Exception as e:  # noqa: BLE001 — raw cloud API errors
+            if not errors.is_retryable(e):
+                raise
+            # cloud unreachable: keep the claim, retry next reconcile
+            # (SURVEY §5 failure detection — launch failure must never
+            # crash the control loop)
+            self.cluster.record_event(
+                "NodeClaim", claim.name, "LaunchRetryable", str(e))
 
     # -- register ---------------------------------------------------------
     def _register(self, claim: NodeClaim) -> None:
@@ -92,6 +102,7 @@ class NodeClaimLifecycle:
             return
         claim.node_name = node.name
         claim.set_condition(COND_REGISTERED)
+        metrics.NODECLAIMS_REGISTERED.inc(nodepool=claim.nodepool)
         node.meta.labels[wellknown.REGISTERED_LABEL] = "true"
         # strip the unregistered taint the node joined with
         node.taints = [
@@ -128,6 +139,7 @@ class NodeClaimLifecycle:
         if node.allocatable.is_zero():
             return
         claim.set_condition(COND_INITIALIZED)
+        metrics.NODECLAIMS_INITIALIZED.inc(nodepool=claim.nodepool)
         node.meta.labels[wellknown.INITIALIZED_LABEL] = "true"
         self.cluster.nodes.update(node)
         self.cluster.nodeclaims.update(claim)

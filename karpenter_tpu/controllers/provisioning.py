@@ -20,6 +20,7 @@ from karpenter_tpu.models.objects import NodeClaim, ObjectMeta, Pod
 from karpenter_tpu.operator.options import Options
 from karpenter_tpu.scheduling import ScheduleInput
 from karpenter_tpu.scheduling.types import NewNodeClaim, ScheduleResult
+from karpenter_tpu.utils import errors, metrics
 from karpenter_tpu.utils.clock import Clock
 
 NOMINATED_ANNOTATION = "karpenter.sh/nominated-claim"
@@ -71,12 +72,24 @@ class Provisioner:
             p for p in self.cluster.pending_pods()
             if NOMINATED_ANNOTATION not in p.meta.annotations
         ]
+        metrics.SCHEDULING_QUEUE_DEPTH.set(len(pending))
         if not self._batch_ready(pending):
             return
         self._batch_first = self._batch_sig = self._batch_last_change = None
 
-        inp = self._build_input(pending)
-        result = self._solve(inp)
+        try:
+            inp = self._build_input(pending)
+        except Exception as e:  # noqa: BLE001
+            # catalog discovery hit a cloud outage with a cold cache — keep
+            # the pods pending and retry next round (provisioning must never
+            # crash the loop, SURVEY §5)
+            if not errors.is_retryable(e):
+                raise
+            self.cluster.record_event(
+                "Provisioner", "provisioning", "SchedulingRetryable", str(e))
+            return
+        with metrics.SCHEDULING_DURATION.time():
+            result = self._solve(inp)
         self._apply(result)
 
     # -- input assembly ---------------------------------------------------

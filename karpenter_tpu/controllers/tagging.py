@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from karpenter_tpu.cluster import Cluster
 from karpenter_tpu.models.objects import COND_REGISTERED
+from karpenter_tpu.utils import errors
 
 TAG_NAME = "Name"
 TAG_MANAGED_BY = "karpenter.tpu/managed-by"
@@ -25,6 +26,13 @@ class NodeClaimTagging:
         self.cluster_name = cluster_name
 
     def reconcile(self) -> None:
+        try:
+            self._reconcile()
+        except Exception as e:  # noqa: BLE001 — tagging is cosmetic; retry
+            if not errors.is_retryable(e):
+                raise
+
+    def _reconcile(self) -> None:
         for claim in self.cluster.nodeclaims.list():
             if not claim.is_(COND_REGISTERED) or not claim.provider_id:
                 continue

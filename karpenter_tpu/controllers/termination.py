@@ -18,6 +18,7 @@ from karpenter_tpu.controllers.provisioning import NOMINATED_ANNOTATION
 from karpenter_tpu.models import wellknown
 from karpenter_tpu.models.objects import NodeClaim
 from karpenter_tpu.models.taints import NO_SCHEDULE, Taint
+from karpenter_tpu.utils import errors, metrics
 
 DISRUPTED_TAINT = Taint(wellknown.DISRUPTED_TAINT_KEY, "", NO_SCHEDULE)
 
@@ -44,12 +45,24 @@ class Termination:
             remaining = self._drain(node.name)
             if remaining > 0:
                 return  # PDBs throttle the drain; retry next round
-        # drained (or node never joined): release the instance + objects
-        self.cp.delete(claim)
+        # drained (or node never joined): release the instance + objects.
+        # NotFound is success (the instance is already gone); transient cloud
+        # errors keep the finalizer for a retry next round
+        # (pkg/errors/errors.go taxonomy)
+        try:
+            self.cp.delete(claim)
+        except Exception as e:  # noqa: BLE001
+            if errors.is_retryable(e):
+                self.cluster.record_event(
+                    "NodeClaim", claim.name, "TerminationRetryable", str(e))
+                return
+            if not errors.is_not_found(e):
+                raise
         if node is not None and not node.meta.deleting:
             self.cluster.nodes.delete(node.name)
         self.cluster.nodeclaims.remove_finalizer(
             claim.name, wellknown.TERMINATION_FINALIZER)
+        metrics.NODECLAIMS_TERMINATED.inc(nodepool=claim.nodepool)
         self.cluster.record_event(
             "NodeClaim", claim.name, "Terminated", "instance released")
 

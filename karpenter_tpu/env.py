@@ -41,6 +41,7 @@ from karpenter_tpu.providers.queue import QueueProvider
 from karpenter_tpu.providers.securitygroup import SecurityGroupProvider
 from karpenter_tpu.providers.subnet import SubnetProvider
 from karpenter_tpu.providers.version import VersionProvider
+from karpenter_tpu.utils import metrics
 from karpenter_tpu.utils.cache import UnavailableOfferings
 from karpenter_tpu.utils.clock import Clock, FakeClock
 
@@ -79,7 +80,7 @@ class Environment:
         self.instance_profiles = InstanceProfileProvider(
             self.cloud, cluster_name=cluster_name)
         self.queue = QueueProvider(self.cloud)
-        self.cloud_provider = TPUCloudProvider(
+        self.cloud_provider = metrics.DecoratedCloudProvider(TPUCloudProvider(
             cloud=self.cloud,
             instance_types=self.instance_types,
             unavailable=self.unavailable,
@@ -89,7 +90,7 @@ class Environment:
             launch_templates=self.launch_templates,
             security_groups=self.security_groups,
             images=self.images,
-        )
+        ))
         # one GatedSolver shared by both hot paths so they share the device
         # catalog cache and compiled-program cache
         from karpenter_tpu.controllers.state import GatedSolver

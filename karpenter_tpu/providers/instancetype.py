@@ -59,12 +59,22 @@ class InstanceTypeProvider:
         if cached is not None and cached[0] == key:
             return cached[1]
 
+        try:
+            shapes = self._cloud.describe_instance_types()
+        except Exception:  # noqa: BLE001
+            if cached is not None:
+                # stale-on-error: the last-known catalog beats failing the
+                # scheduling pass (the static-fallback discipline,
+                # pricing.go:54-59)
+                return cached[1]
+            raise
+
         zones = set(node_class.zones or self._cloud.zones)
         families = set(node_class.instance_families or [])
         cap_types = set(node_class.capacity_types)
 
         out: List[InstanceType] = []
-        for shape in self._cloud.describe_instance_types():
+        for shape in shapes:
             if families:
                 fam = shape.requirements.get(wellknown.INSTANCE_FAMILY_LABEL)
                 # unlabeled shapes are excluded: a family restriction is a

@@ -26,6 +26,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Generic, Hashable, List, Optional, TypeVar
 
+from karpenter_tpu.utils import metrics
+
 T = TypeVar("T")  # request item
 U = TypeVar("U")  # per-item result
 
@@ -169,6 +171,7 @@ class Batcher(Generic[T, U]):
         self.batches_executed += 1
         self.items_batched += len(items)
         self.batch_sizes.append(len(items))
+        metrics.BATCHER_BATCH_SIZE.observe(len(items), batcher=self.name)
         for p, r in zip(items, results):
             p.result = r
             p.done.set()

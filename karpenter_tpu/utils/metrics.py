@@ -1,0 +1,288 @@
+"""In-process Prometheus-style metrics registry.
+
+The reference exports its contract on :8000 via prometheus client_golang;
+the metric *names* are the compatibility surface (SURVEY §5: "these metric
+names are the contract for the baseline comparison") — catalogued in
+website/content/en/preview/reference/metrics.md. This module provides the
+same families over a dependency-free registry with Prometheus text
+exposition, so dashboards written for the reference keep working.
+
+Key families (metrics.md):
+  karpenter_provisioner_scheduling_duration_seconds           :102
+  karpenter_provisioner_scheduling_simulation_duration_seconds
+  karpenter_provisioner_scheduling_queue_depth
+  karpenter_disruption_evaluation_duration_seconds            :137
+  karpenter_disruption_eligible_nodes
+  karpenter_nodeclaims_{launched,registered,initialized,terminated}_total
+                                                              :27-48
+  karpenter_interruption_received_messages_total              :107-116
+  karpenter_cloudprovider_duration_seconds   (metrics.Decorate wrapper,
+                                              cmd/controller/main.go:43)
+  karpenter_cloudprovider_errors_total
+  karpenter_cloudprovider_batcher_batch_size (pkg/batcher/metrics.go)
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                    1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, label_names: Tuple[str, ...]):
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
+        if set(labels) != set(self.label_names):
+            raise ValueError(
+                f"{self.name}: labels {sorted(labels)} != declared "
+                f"{sorted(self.label_names)}")
+        return tuple(labels[k] for k in self.label_names)
+
+    @staticmethod
+    def _fmt_labels(names, values) -> str:
+        if not names:
+            return ""
+        inner = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+        return "{" + inner + "}"
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help_, label_names=()):
+        super().__init__(name, help_, tuple(label_names))
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(self._key(labels), 0.0)
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} {self.kind}"]
+        for key, v in sorted(self._values.items()):
+            out.append(
+                f"{self.name}{self._fmt_labels(self.label_names, key)} {v}")
+        return out
+
+
+class Gauge(Counter):
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = value
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_, label_names=(), buckets=_DEFAULT_BUCKETS):
+        super().__init__(name, help_, tuple(label_names))
+        self.buckets = tuple(buckets)
+        self._counts: Dict[Tuple[str, ...], List[int]] = {}
+        self._sums: Dict[Tuple[str, ...], float] = {}
+        self._totals: Dict[Tuple[str, ...], int] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def time(self, **labels):
+        """Context manager: observe the elapsed wall time."""
+        metric = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                metric.observe(time.perf_counter() - self.t0, **labels)
+                return False
+
+        return _Timer()
+
+    def count(self, **labels) -> int:
+        return self._totals.get(self._key(labels), 0)
+
+    def sum(self, **labels) -> float:
+        return self._sums.get(self._key(labels), 0.0)
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} {self.kind}"]
+        for key in sorted(self._totals):
+            cum = 0
+            for i, b in enumerate(self.buckets):
+                cum = self._counts[key][i]
+                names = self.label_names + ("le",)
+                values = key + (repr(b),)
+                out.append(f"{self.name}_bucket"
+                           f"{self._fmt_labels(names, values)} {cum}")
+            names = self.label_names + ("le",)
+            out.append(f"{self.name}_bucket"
+                       f"{self._fmt_labels(names, key + ('+Inf',))} "
+                       f"{self._totals[key]}")
+            lbl = self._fmt_labels(self.label_names, key)
+            out.append(f"{self.name}_sum{lbl} {self._sums[key]}")
+            out.append(f"{self.name}_count{lbl} {self._totals[key]}")
+        return out
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None:
+                return existing
+            self._metrics[metric.name] = metric
+            return metric
+
+    def counter(self, name, help_="", labels=()) -> Counter:
+        return self.register(Counter(name, help_, labels))  # type: ignore
+
+    def gauge(self, name, help_="", labels=()) -> Gauge:
+        return self.register(Gauge(name, help_, labels))  # type: ignore
+
+    def histogram(self, name, help_="", labels=(),
+                  buckets=_DEFAULT_BUCKETS) -> Histogram:
+        return self.register(
+            Histogram(name, help_, labels, buckets))  # type: ignore
+
+    def get(self, name: str) -> Optional[_Metric]:
+        return self._metrics.get(name)
+
+    def render(self) -> str:
+        """Prometheus text exposition of every registered family."""
+        lines: List[str] = []
+        for name in sorted(self._metrics):
+            lines.extend(self._metrics[name].render())
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        """Zero every metric's samples. Registrations are kept — module-level
+        metric objects stay live and exported; only their values clear."""
+        with self._lock:
+            for m in self._metrics.values():
+                for attr in ("_values", "_counts", "_sums", "_totals"):
+                    d = getattr(m, attr, None)
+                    if d is not None:
+                        d.clear()
+
+
+# the process-global registry (the role of prometheus.DefaultRegisterer)
+REGISTRY = Registry()
+
+
+def _h(name, help_, labels=()):
+    return REGISTRY.histogram(name, help_, labels)
+
+
+def _c(name, help_, labels=()):
+    return REGISTRY.counter(name, help_, labels)
+
+
+def _g(name, help_, labels=()):
+    return REGISTRY.gauge(name, help_, labels)
+
+
+# -- the contract families (metrics.md) ---------------------------------
+SCHEDULING_DURATION = _h(
+    "karpenter_provisioner_scheduling_duration_seconds",
+    "Duration of one scheduling solve.")
+SCHEDULING_SIMULATION_DURATION = _h(
+    "karpenter_provisioner_scheduling_simulation_duration_seconds",
+    "Duration of one disruption scheduling simulation.")
+SCHEDULING_QUEUE_DEPTH = _g(
+    "karpenter_provisioner_scheduling_queue_depth",
+    "Pending pods awaiting a scheduling pass.")
+DISRUPTION_EVALUATION_DURATION = _h(
+    "karpenter_disruption_evaluation_duration_seconds",
+    "Duration of one disruption evaluation pass.", ("method",))
+DISRUPTION_ELIGIBLE_NODES = _g(
+    "karpenter_disruption_eligible_nodes",
+    "Candidates eligible for disruption in the last pass.", ("method",))
+DISRUPTION_ACTIONS = _c(
+    "karpenter_disruption_actions_performed_total",
+    "Disruption commands executed.", ("method",))
+NODECLAIMS_LAUNCHED = _c(
+    "karpenter_nodeclaims_launched_total",
+    "NodeClaims launched.", ("nodepool",))
+NODECLAIMS_REGISTERED = _c(
+    "karpenter_nodeclaims_registered_total",
+    "NodeClaims whose node registered.", ("nodepool",))
+NODECLAIMS_INITIALIZED = _c(
+    "karpenter_nodeclaims_initialized_total",
+    "NodeClaims fully initialized.", ("nodepool",))
+NODECLAIMS_TERMINATED = _c(
+    "karpenter_nodeclaims_terminated_total",
+    "NodeClaims terminated.", ("nodepool",))
+INTERRUPTION_MESSAGES = _c(
+    "karpenter_interruption_received_messages_total",
+    "Interruption-queue messages received.", ("message_type",))
+CLOUDPROVIDER_DURATION = _h(
+    "karpenter_cloudprovider_duration_seconds",
+    "CloudProvider method latency.", ("method",))
+CLOUDPROVIDER_ERRORS = _c(
+    "karpenter_cloudprovider_errors_total",
+    "CloudProvider method errors.", ("method",))
+BATCHER_BATCH_SIZE = REGISTRY.histogram(
+    "karpenter_cloudprovider_batcher_batch_size",
+    "Items per executed batch.", ("batcher",),
+    buckets=(1, 2, 5, 10, 25, 50, 100, 250, 500, 1000))
+
+
+class DecoratedCloudProvider:
+    """metrics.Decorate analogue (cmd/controller/main.go:43): wraps every
+    public CloudProvider method with duration + error counters. Methods are
+    wrapped once at construction so repeated attribute reads return the same
+    callable with no per-call allocation."""
+
+    _METHODS = ("create", "delete", "get", "list_instances",
+                "get_instance_types", "is_drifted", "live")
+
+    def __init__(self, inner):
+        self._inner = inner
+        for name in self._METHODS:
+            setattr(self, name, self._wrap(name, getattr(inner, name)))
+
+    @staticmethod
+    def _wrap(name, fn):
+        def wrapped(*args, **kwargs):
+            t0 = time.perf_counter()
+            try:
+                return fn(*args, **kwargs)
+            except Exception:
+                CLOUDPROVIDER_ERRORS.inc(method=name)
+                raise
+            finally:
+                CLOUDPROVIDER_DURATION.observe(
+                    time.perf_counter() - t0, method=name)
+
+        return wrapped
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)

@@ -1,0 +1,250 @@
+"""Metrics contract + errors taxonomy (reference:
+website/content/en/preview/reference/metrics.md — "these metric names are
+the contract"; pkg/errors/errors.go)."""
+
+import pytest
+
+from karpenter_tpu.env import Environment
+from karpenter_tpu.models import NodePool, ObjectMeta, Pod, Resources
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.utils import errors, metrics
+from karpenter_tpu.utils.metrics import Counter, Gauge, Histogram, Registry
+
+
+@pytest.fixture
+def env():
+    e = Environment(options=Options(batch_idle_duration=0))
+    e.add_default_nodeclass()
+    e.cluster.nodepools.create(NodePool(meta=ObjectMeta(name="default")))
+    return e
+
+
+def mkpod(name):
+    return Pod(meta=ObjectMeta(name=name),
+               requests=Resources.parse({"cpu": "500m", "memory": "1Gi"}))
+
+
+class TestInstruments:
+    def test_counter(self):
+        c = Counter("c_total", "help", ("k",))
+        c.inc(k="a")
+        c.inc(2, k="a")
+        assert c.value(k="a") == 3
+        assert 'c_total{k="a"} 3' in "\n".join(c.render())
+
+    def test_counter_rejects_wrong_labels(self):
+        c = Counter("c2_total", "help", ("k",))
+        with pytest.raises(ValueError):
+            c.inc(wrong="x")
+
+    def test_gauge_set(self):
+        g = Gauge("g", "help")
+        g.set(7)
+        g.set(3)
+        assert g.value() == 3
+
+    def test_histogram_observe_and_time(self):
+        h = Histogram("h_seconds", "help", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(5.0)
+        assert h.count() == 3
+        assert h.sum() == pytest.approx(5.55)
+        text = "\n".join(h.render())
+        assert 'h_seconds_bucket{le="0.1"} 1' in text
+        assert 'h_seconds_bucket{le="1.0"} 2' in text
+        assert 'h_seconds_bucket{le="+Inf"} 3' in text
+        with h.time():
+            pass
+        assert h.count() == 4
+
+    def test_registry_dedupes_by_name(self):
+        r = Registry()
+        a = r.counter("x_total")
+        b = r.counter("x_total")
+        assert a is b
+
+    def test_reset_clears_values_but_keeps_registrations(self):
+        r = Registry()
+        c = r.counter("keep_total", "", ("k",))
+        c.inc(k="a")
+        r.reset()
+        assert r.get("keep_total") is c  # still registered and live
+        assert c.value(k="a") == 0
+        c.inc(k="a")
+        assert c.value(k="a") == 1
+        assert "keep_total" in r.render()
+
+    def test_render_exposition(self):
+        r = Registry()
+        c = r.counter("demo_total", "demo help")
+        c.inc()
+        text = r.render()
+        assert "# HELP demo_total demo help" in text
+        assert "# TYPE demo_total counter" in text
+
+
+class TestContractNames:
+    """The reference metric families exist under their contract names."""
+
+    CONTRACT = [
+        "karpenter_provisioner_scheduling_duration_seconds",
+        "karpenter_provisioner_scheduling_simulation_duration_seconds",
+        "karpenter_provisioner_scheduling_queue_depth",
+        "karpenter_disruption_evaluation_duration_seconds",
+        "karpenter_disruption_eligible_nodes",
+        "karpenter_disruption_actions_performed_total",
+        "karpenter_nodeclaims_launched_total",
+        "karpenter_nodeclaims_registered_total",
+        "karpenter_nodeclaims_initialized_total",
+        "karpenter_nodeclaims_terminated_total",
+        "karpenter_interruption_received_messages_total",
+        "karpenter_cloudprovider_duration_seconds",
+        "karpenter_cloudprovider_errors_total",
+        "karpenter_cloudprovider_batcher_batch_size",
+    ]
+
+    def test_all_contract_families_registered(self):
+        for name in self.CONTRACT:
+            assert metrics.REGISTRY.get(name) is not None, name
+
+
+class TestEndToEndEmission:
+    def test_provision_lifecycle_interrupt_emits(self, env):
+        launched0 = metrics.NODECLAIMS_LAUNCHED.value(nodepool="default")
+        registered0 = metrics.NODECLAIMS_REGISTERED.value(nodepool="default")
+        initialized0 = metrics.NODECLAIMS_INITIALIZED.value(
+            nodepool="default")
+        sched0 = metrics.SCHEDULING_DURATION.count()
+        env.cluster.pods.create(mkpod("p"))
+        env.settle()
+        assert metrics.SCHEDULING_DURATION.count() > sched0
+        assert metrics.NODECLAIMS_LAUNCHED.value(
+            nodepool="default") == launched0 + 1
+        assert metrics.NODECLAIMS_REGISTERED.value(
+            nodepool="default") == registered0 + 1
+        assert metrics.NODECLAIMS_INITIALIZED.value(
+            nodepool="default") == initialized0 + 1
+        assert metrics.CLOUDPROVIDER_DURATION.count(method="create") >= 1
+
+        term0 = metrics.NODECLAIMS_TERMINATED.value(nodepool="default")
+        msg0 = metrics.INTERRUPTION_MESSAGES.value(
+            message_type="spot_interruption")
+        claim = env.cluster.nodeclaims.list()[0]
+        env.cloud.interrupt_spot(claim.provider_id)
+        env.settle()
+        assert metrics.INTERRUPTION_MESSAGES.value(
+            message_type="spot_interruption") == msg0 + 1
+        assert metrics.NODECLAIMS_TERMINATED.value(
+            nodepool="default") == term0 + 1
+
+    def test_cloudprovider_errors_counted(self, env):
+        from karpenter_tpu.models.objects import NodeClaim
+        errs0 = metrics.CLOUDPROVIDER_ERRORS.value(method="create")
+        claim = NodeClaim(meta=ObjectMeta(name="orphan"),
+                          nodepool="default", node_class_ref="missing")
+        with pytest.raises(Exception):
+            env.cloud_provider.create(claim)
+        assert metrics.CLOUDPROVIDER_ERRORS.value(
+            method="create") == errs0 + 1
+
+    def test_exposition_renders(self, env):
+        env.cluster.pods.create(mkpod("p"))
+        env.settle()
+        text = metrics.REGISTRY.render()
+        assert "karpenter_nodeclaims_launched_total" in text
+        assert 'nodepool="default"' in text
+
+
+class TestRetryableCloudFailures:
+    """The taxonomy wired into the control loop: transient cloud outages
+    never crash reconciliation or lose claims (SURVEY §5)."""
+
+    def test_provisioning_survives_cloud_outage(self, env):
+        env.cluster.pods.create(mkpod("p"))
+        env.cloud.set_alive(False)
+        # no controller crashes; the pod just stays pending
+        env.manager.run_once()
+        env.manager.run_once()
+        assert all(p.phase == "Pending" for p in env.cluster.pods.list())
+        env.cloud.set_alive(True)
+        env.clock.step(400)  # let provider caches retry discovery
+        env.settle()
+        assert all(p.phase == "Running" for p in env.cluster.pods.list())
+
+    def test_launch_outage_keeps_claim(self, env):
+        # warm the catalog cache first, then fail the cloud: the solve
+        # succeeds from cache, the claim is created, and the CreateFleet
+        # failure is retryable — the claim survives and launches on recovery
+        env.cluster.pods.create(mkpod("warm"))
+        env.settle()
+        env.cloud.set_alive(False)
+        env.cluster.pods.create(mkpod("p"))
+        env.manager.run_once()
+        env.manager.run_once()
+        claims = [c for c in env.cluster.nodeclaims.list()
+                  if not c.provider_id]
+        assert len(claims) == 1  # created but unlaunched, not reaped
+        env.cloud.set_alive(True)
+        env.settle()
+        assert all(p.phase == "Running" for p in env.cluster.pods.list())
+
+    def test_termination_keeps_finalizer_through_outage(self, env):
+        env.cluster.pods.create(mkpod("p"))
+        env.settle()
+        claim = env.cluster.nodeclaims.list()[0]
+        env.cloud.set_alive(False)
+        env.cluster.nodeclaims.delete(claim.name)
+        env.manager.run_once()
+        assert env.cluster.nodeclaims.get(claim.name) is not None
+        assert env.cloud.instances[claim.provider_id].state == "running"
+        env.cloud.set_alive(True)
+        env.settle()
+        assert env.cluster.nodeclaims.get(claim.name) is None
+        assert env.cloud.instances[claim.provider_id].state == "terminated"
+
+    def test_eligible_nodes_gauge_resets_to_zero(self, env):
+        from karpenter_tpu.models.objects import (
+            CONSOLIDATE_WHEN_EMPTY_OR_UNDERUTILIZED,
+        )
+        pool = env.cluster.nodepools.get("default")
+        pool.disruption.consolidation_policy = \
+            CONSOLIDATE_WHEN_EMPTY_OR_UNDERUTILIZED
+        pool.disruption.consolidate_after = 0
+        env.cluster.pods.create(mkpod("p"))
+        env.settle()
+        env.disruption.reconcile()
+        assert metrics.DISRUPTION_ELIGIBLE_NODES.value(method="drift") >= 1
+        # tear the workload + node down; the next pass must publish zero
+        for p in env.cluster.pods.list():
+            p.meta.finalizers.clear()
+            env.cluster.pods.delete(p.meta.name)
+        for c in env.cluster.nodeclaims.list():
+            env.cluster.nodeclaims.delete(c.name)
+        env.settle()
+        env.disruption.reconcile()
+        assert metrics.DISRUPTION_ELIGIBLE_NODES.value(method="drift") == 0
+
+
+class TestErrorsTaxonomy:
+    def test_unfulfillable_capacity(self):
+        from karpenter_tpu.cloudprovider.provider import InsufficientCapacity
+        assert errors.is_unfulfillable_capacity(InsufficientCapacity("ice"))
+        assert not errors.is_unfulfillable_capacity(RuntimeError("x"))
+
+    def test_launch_template_not_found(self):
+        from karpenter_tpu.providers.fake_cloud import LaunchTemplateNotFound
+        assert errors.is_launch_template_not_found(
+            LaunchTemplateNotFound("lt"))
+        assert not errors.is_launch_template_not_found(RuntimeError("x"))
+
+    def test_not_found_and_retryable(self):
+        from karpenter_tpu.providers.fake_cloud import (
+            CloudAPIError,
+            LaunchTemplateNotFound,
+        )
+        assert errors.is_not_found(CloudAPIError("instance not found"))
+        assert not errors.is_not_found(CloudAPIError("throttled"))
+        assert errors.is_retryable(CloudAPIError("cloud unreachable"))
+        assert not errors.is_retryable(LaunchTemplateNotFound("lt"))
+        assert not errors.is_retryable(RuntimeError("bug"))
