@@ -32,8 +32,10 @@ def make_mesh(n_devices: "int | None" = None, axis: str = "cat") -> Mesh:
 
 def sharded_solve_ffd(
     mesh: Mesh,
-    group_req, group_count, group_mask, exist_mask, exist_remaining,
+    group_req, group_count, group_mask, exist_cap, exist_remaining,
     col_alloc, col_daemon, col_pool, pool_daemon, pool_limit,
+    group_ncap, group_dsel, group_dbase, group_dcap, group_skew,
+    group_mindom, group_delig, col_zone, col_ct, exist_zone, exist_ct,
     max_nodes: int = 1024,
     axis: str = "cat",
 ):
@@ -51,13 +53,24 @@ def sharded_solve_ffd(
         jax.device_put(group_req, rep),
         jax.device_put(group_count, rep),
         jax.device_put(group_mask, gcol),
-        jax.device_put(exist_mask, rep),
+        jax.device_put(exist_cap, rep),
         jax.device_put(exist_remaining, rep),
         jax.device_put(col_alloc, col2),
         jax.device_put(col_daemon, col2),
         jax.device_put(col_pool, col),
         jax.device_put(pool_daemon, rep),
         jax.device_put(pool_limit, rep),
+        jax.device_put(group_ncap, rep),
+        jax.device_put(group_dsel, rep),
+        jax.device_put(group_dbase, rep),
+        jax.device_put(group_dcap, rep),
+        jax.device_put(group_skew, rep),
+        jax.device_put(group_mindom, rep),
+        jax.device_put(group_delig, rep),
+        jax.device_put(col_zone, col),
+        jax.device_put(col_ct, col),
+        jax.device_put(exist_zone, rep),
+        jax.device_put(exist_ct, rep),
     )
     with mesh:
         return ffd.solve_ffd(*args, max_nodes=max_nodes)

@@ -113,8 +113,12 @@ class Scheduler:
                       if inp.remaining_limits.get(np.name) is not None else None)
             for np in inp.nodepools
         }
-        # seed topology state from resident pods and cluster geography
+        # seed topology state from resident pods and cluster geography —
+        # every live node contributes its domains even when empty (an empty
+        # zone pins the spread minimum at 0, forcing spreading toward it)
         for sim in self.existing:
+            for key, dom in sim.domains.items():
+                self.tracker.observe_domains(key, {dom})
             for pod in sim.en.pods:
                 self.tracker.register(pod, sim.domains)
         zones: Set[str] = set()

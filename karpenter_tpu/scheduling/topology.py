@@ -69,6 +69,11 @@ class TopologyTracker:
         return self._matching_counts(constraint.topology_key,
                                      _sel(constraint.label_selector))
 
+    def counts_for(self, topology_key: str, selector: Dict[str, str]) -> Counter:
+        """Matching-pod counts per domain for an arbitrary (key, selector) —
+        the solver encoder's view of the same aggregation the oracle uses."""
+        return self._matching_counts(topology_key, _sel(selector))
+
     def _matching_counts(self, topology_key: str, selector: Selector) -> Counter:
         key = (topology_key, selector)
         if key not in self._match_cache:
@@ -112,7 +117,14 @@ class TopologyTracker:
             pod, constraint.topology_key)
         if not eligible:
             return set(candidate_domains)
-        global_min = min(counts.get(d, 0) for d in eligible)
+        if constraint.topology_key == wellknown.HOSTNAME_LABEL:
+            # the provisioner can always mint a fresh, empty hostname
+            # domain (a new node), so the global minimum is 0 — maxSkew
+            # becomes a per-node ceiling, which is what hostname spread
+            # means to users ("at most N pods of this set per node")
+            global_min = 0
+        else:
+            global_min = min(counts.get(d, 0) for d in eligible)
         if constraint.min_domains is not None:
             populated = sum(1 for d in eligible if counts.get(d, 0) > 0)
             if populated < constraint.min_domains:

@@ -19,9 +19,13 @@ entire nodes×offerings fill on the MXU-friendly dense arrays built by
     sequential scan axis is short while every inner operation is a wide
     vectorized fill.
 
-Pods with topology spread / pod-affinity constraints are not yet encoded;
-`TPUSolver.solve` raises `UnsupportedPods` and the provisioner falls back to
-the CPU oracle (solver-unavailable ⇒ fall back, never fail — SURVEY §5).
+Topology spread constraints (hostname / zone / capacity-type, maxSkew,
+minDomains) and required pod anti-affinity are encoded as per-group domain
+tensors solved in-kernel (see `ffd.py`); constraint shapes the encoding
+can't express — required pod affinity, custom topology keys, selectors
+coupling pending groups — raise `UnsupportedPods` and the provisioner falls
+back to the CPU oracle (solver-unavailable ⇒ fall back, never fail —
+SURVEY §5).
 """
 
 from karpenter_tpu.solver.solve import TPUSolver, UnsupportedPods

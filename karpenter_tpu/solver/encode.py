@@ -10,7 +10,8 @@ Turns a `ScheduleInput` into dense numpy arrays for the device kernel:
                   column (vectorized over the interned label vocabulary —
                   the Python set algebra runs once per (group × key), not
                   per (group × column))
-  exist_mask [G,E]  same against existing nodes
+  exist_cap [G,E]   per-existing-node pod allowance (0 = blocked; also
+                  carries hostname-spread / anti-affinity per-node caps)
   + capacity/price/limit arrays
 
 The encoding is cached against the instance-type list identity and catalog
@@ -29,6 +30,7 @@ from karpenter_tpu.models.objects import InstanceType, NodePool, Pod
 from karpenter_tpu.models.requirements import Requirements
 from karpenter_tpu.models.resources import RESOURCE_AXIS, Resources
 from karpenter_tpu.models.taints import tolerates_all
+from karpenter_tpu.scheduling.topology import TopologyTracker, node_domains_for
 from karpenter_tpu.scheduling.types import (
     ExistingNode,
     ScheduleInput,
@@ -37,6 +39,16 @@ from karpenter_tpu.scheduling.types import (
 
 R = len(RESOURCE_AXIS)
 _ABSENT = -1
+BIG = 2 ** 29  # "unbounded" cap that still fits i32 arithmetic on device
+D_BUCKETS = (8, 16, 32, 64, 128)
+_DOM_KEYS = (wellknown.ZONE_LABEL, wellknown.CAPACITY_TYPE_LABEL)
+_TOPO_KEYS = (wellknown.HOSTNAME_LABEL,) + _DOM_KEYS
+
+
+class Unsupported(Exception):
+    """A group's topology constraints can't be expressed in the tensor
+    encoding (cross-group coupling, required pod affinity, custom topology
+    keys) — the caller falls back to the CPU oracle."""
 
 
 @dataclass
@@ -58,13 +70,32 @@ class EncodedProblem:
     group_req: np.ndarray       # [G, R] f32 — effective per-pod request
     group_count: np.ndarray     # [G] i32
     group_mask: np.ndarray      # [G, O] bool
-    exist_mask: np.ndarray      # [G, E] bool
+    exist_cap: np.ndarray       # [G, E] i32 — per-node allowance (0 = blocked)
     exist_remaining: np.ndarray # [E, R] f32
     col_alloc: np.ndarray       # [O, R] f32
     col_daemon: np.ndarray      # [O, R] f32 — pool daemonset overhead per column
     col_price: np.ndarray       # [O] f32
     col_pool: np.ndarray        # [O] i32
     pool_limit: np.ndarray      # [P, R] f32 (inf = unlimited)
+    # topology tensors (see solver/ffd.py docstring)
+    group_ncap: np.ndarray = None    # [G] i32 per-new-node cap
+    group_dsel: np.ndarray = None    # [G] i32 0 none / 1 zone / 2 capacity-type
+    group_dbase: np.ndarray = None   # [G, D] i32
+    group_dcap: np.ndarray = None    # [G, D] i32
+    group_skew: np.ndarray = None    # [G] i32
+    group_mindom: np.ndarray = None  # [G] i32
+    group_delig: np.ndarray = None   # [G, D] bool
+    col_zone: np.ndarray = None      # [O] i32
+    col_ct: np.ndarray = None        # [O] i32
+    exist_zone: np.ndarray = None    # [E] i32
+    exist_ct: np.ndarray = None      # [E] i32
+    zone_values: List[str] = field(default_factory=list)  # id → zone
+    ct_values: List[str] = field(default_factory=list)    # id → capacity type
+    n_domains: int = 1
+    # per group: static allowed-domain id sets (None = unrestricted) — folded
+    # into the column masks for the solve AND into claim requirements at
+    # decode, so launch can't drift into a statically-forbidden domain
+    static_allowed: List[Dict[str, Optional[set]]] = field(default_factory=list)
     # host metadata for decode
     groups: List[List[Pod]] = field(default_factory=list)
     columns: List[Column] = field(default_factory=list)
@@ -194,6 +225,11 @@ class CatalogEncoding:
     pool_cols: List[np.ndarray] = field(default_factory=list)
     pool_matrices: List[Dict[str, np.ndarray]] = field(default_factory=list)
     pool_provides: List[set] = field(default_factory=list)
+    # topology domain interning (zone / capacity-type → dense id)
+    zone_ids: Dict[str, int] = field(default_factory=dict)
+    ct_ids: Dict[str, int] = field(default_factory=dict)
+    col_zone: np.ndarray = None  # [O] i32
+    col_ct: np.ndarray = None    # [O] i32
     device_args: Optional[dict] = None  # device-resident padded arrays
 
 
@@ -243,6 +279,13 @@ def encode_catalog(inp: ScheduleInput) -> CatalogEncoding:
         pool_cols.append(sel)
         pool_matrices.append(sliced)
         pool_provides.append({k for k, v in sliced.items() if (v != _ABSENT).any()})
+    zone_ids: Dict[str, int] = {}
+    ct_ids: Dict[str, int] = {}
+    for c in columns:
+        zone_ids.setdefault(c.zone, len(zone_ids))
+        ct_ids.setdefault(c.capacity_type, len(ct_ids))
+    col_zone = np.array([zone_ids[c.zone] for c in columns], dtype=np.int32)
+    col_ct = np.array([ct_ids[c.capacity_type] for c in columns], dtype=np.int32)
     return CatalogEncoding(
         pools=pools, columns=columns, vocab=vocab, col_matrices=col_matrices,
         col_alloc=col_alloc, col_daemon=col_daemon, col_price=col_price,
@@ -250,7 +293,226 @@ def encode_catalog(inp: ScheduleInput) -> CatalogEncoding:
         templates=[p.template_requirements() for p in pools],
         pool_cols=pool_cols, pool_matrices=pool_matrices,
         pool_provides=pool_provides,
+        zone_ids=zone_ids, ct_ids=ct_ids, col_zone=col_zone, col_ct=col_ct,
     )
+
+
+def _matches(sel: Dict[str, str], labels: Dict[str, str]) -> bool:
+    return all(labels.get(k) == v for k, v in sel.items())
+
+
+class _TopologyEncoder:
+    """Classifies each group's spread / (anti-)affinity constraints and
+    produces the kernel's topology tensors; raises `Unsupported` for shapes
+    the tensor encoding can't express — required pod affinity, custom
+    topology keys, and selectors that couple pending groups (their counts
+    would change with other groups' placements mid-solve) — so the caller
+    falls back to the CPU oracle. Mirrors scheduling/topology.py; reference
+    surface: website/content/en/preview/concepts/scheduling.md:209-417.
+    """
+
+    def __init__(self, inp: ScheduleInput, cat: "CatalogEncoding",
+                 groups: List[List[Pod]]):
+        self.tracker = TopologyTracker()
+        for en in inp.existing_nodes:
+            domains = node_domains_for(en.node.labels, en.node.name)
+            for key, dom in domains.items():
+                self.tracker.observe_domains(key, {dom})
+            for pod in en.pods:
+                self.tracker.register(pod, domains)
+        self.tracker.observe_domains(
+            wellknown.ZONE_LABEL, {c.zone for c in cat.columns})
+        self.tracker.observe_domains(
+            wellknown.CAPACITY_TYPE_LABEL,
+            {c.capacity_type for c in cat.columns})
+        # domain vocab: catalog ids first (stable across calls), existing-node
+        # domains appended per call
+        self.zone_ids = dict(cat.zone_ids)
+        self.ct_ids = dict(cat.ct_ids)
+        for en in inp.existing_nodes:
+            z = en.node.labels.get(wellknown.ZONE_LABEL)
+            if z is not None:
+                self.zone_ids.setdefault(z, len(self.zone_ids))
+            t = en.node.labels.get(wellknown.CAPACITY_TYPE_LABEL)
+            if t is not None:
+                self.ct_ids.setdefault(t, len(self.ct_ids))
+        self.existing = inp.existing_nodes
+        self.exist_zone = np.array(
+            [self.zone_ids.get(en.node.labels.get(wellknown.ZONE_LABEL), -1)
+             for en in self.existing], dtype=np.int32).reshape(len(self.existing))
+        self.exist_ct = np.array(
+            [self.ct_ids.get(en.node.labels.get(wellknown.CAPACITY_TYPE_LABEL), -1)
+             for en in self.existing], dtype=np.int32).reshape(len(self.existing))
+        self.group_labels = [g[0].meta.labels for g in groups]
+        self.D = max(len(self.zone_ids), len(self.ct_ids), 1)
+        self._sel_cache: Dict[tuple, set] = {}
+        # pending groups' required anti terms (for the symmetry coupling check)
+        self.pending_anti: List[tuple] = [
+            (i, dict(t.label_selector))
+            for i, g in enumerate(groups)
+            for t in g[0].pod_affinities if t.required and t.anti
+        ]
+
+    def _matching_groups(self, selector: Dict[str, str]) -> set:
+        key = tuple(sorted(selector.items()))
+        out = self._sel_cache.get(key)
+        if out is None:
+            out = {i for i, lbls in enumerate(self.group_labels)
+                   if _matches(selector, lbls)}
+            self._sel_cache[key] = out
+        return out
+
+    def _dom_ids(self, key: str) -> Dict[str, int]:
+        return self.zone_ids if key == wellknown.ZONE_LABEL else self.ct_ids
+
+    def _static_gmin(self, rep: Pod, key: str, counts, mindom) -> int:
+        eligible = self.tracker.eligible_domains(rep, key)
+        if not eligible:
+            return 0
+        gmin = min(counts.get(d, 0) for d in eligible)
+        if mindom is not None:
+            populated = sum(1 for d in eligible if counts.get(d, 0) > 0)
+            if populated < mindom:
+                gmin = 0
+        return gmin
+
+    def encode_group(self, gi: int, rep: Pod) -> dict:
+        E = len(self.existing)
+        ncap = BIG
+        ecap = np.full(E, BIG, dtype=np.int32)
+        allowed: Dict[str, Optional[set]] = {k: None for k in _DOM_KEYS}
+        requires: Dict[str, bool] = {k: False for k in _DOM_KEYS}
+        dyn_key: Optional[str] = None
+        dbase = np.zeros(self.D, dtype=np.int32)
+        dcap = np.full(self.D, BIG, dtype=np.int32)
+        skew = BIG
+        mindom = 0
+        my = rep.meta.labels
+
+        def clamp_hosts(cap_of_host):
+            for ei, en in enumerate(self.existing):
+                c = cap_of_host(en.node.name)
+                if c < ecap[ei]:
+                    ecap[ei] = max(int(c), 0)
+
+        def restrict(key, dom_names: set):
+            ids = self._dom_ids(key)
+            sid = {ids[d] for d in dom_names if d in ids}
+            allowed[key] = sid if allowed[key] is None else (allowed[key] & sid)
+
+        for c in rep.topology_spread:
+            if c.when_unsatisfiable != "DoNotSchedule":
+                continue  # ScheduleAnyway is best-effort, never blocks
+            key = c.topology_key
+            if key not in _TOPO_KEYS:
+                raise Unsupported(f"spread topology key {key}")
+            if self._matching_groups(c.label_selector) - {gi}:
+                raise Unsupported("spread selector couples pending groups")
+            self_match = _matches(c.label_selector, my)
+            counts = self.tracker.counts_for(key, c.label_selector)
+            if key == wellknown.HOSTNAME_LABEL:
+                # a fresh hostname domain is always available, so the global
+                # minimum is 0 and maxSkew is a per-node ceiling (slightly
+                # conservative when every candidate node holds matching pods)
+                if self_match:
+                    ncap = min(ncap, c.max_skew)
+                    clamp_hosts(lambda h: c.max_skew - counts.get(h, 0))
+                else:
+                    clamp_hosts(
+                        lambda h: BIG if counts.get(h, 0) + 1 <= c.max_skew else 0)
+            elif self_match:
+                if dyn_key is not None and dyn_key != key:
+                    raise Unsupported("two dynamic topology keys on one pod")
+                if skew != BIG:
+                    raise Unsupported("multiple dynamic spread constraints")
+                dyn_key = key
+                skew = c.max_skew
+                mindom = c.min_domains or 0
+                ids = self._dom_ids(key)
+                for d, n in counts.items():
+                    if d in ids:
+                        dbase[ids[d]] = n
+            else:
+                # counts never change with this group's placements → the
+                # allowed-domain set is static; fold it into the masks
+                gmin = self._static_gmin(rep, key, counts, c.min_domains)
+                ok = {d for d in self._dom_ids(key)
+                      if counts.get(d, 0) + 1 - gmin <= c.max_skew}
+                restrict(key, ok)
+                requires[key] = True
+
+        for t in rep.pod_affinities:
+            if not t.required:
+                continue  # preferred terms are not consumed (oracle parity)
+            key = t.topology_key
+            if not t.anti:
+                raise Unsupported("required pod affinity")
+            if key not in _TOPO_KEYS:
+                raise Unsupported(f"anti-affinity topology key {key}")
+            if self._matching_groups(t.label_selector) - {gi}:
+                raise Unsupported("anti-affinity selector couples pending groups")
+            self_match = _matches(t.label_selector, my)
+            counts = self.tracker.counts_for(key, t.label_selector)
+            if key == wellknown.HOSTNAME_LABEL:
+                if self_match:
+                    ncap = min(ncap, 1)
+                    clamp_hosts(lambda h: 1 - counts.get(h, 0))
+                else:
+                    clamp_hosts(lambda h: 0 if counts.get(h, 0) else BIG)
+            elif self_match:
+                if dyn_key is not None and dyn_key != key:
+                    raise Unsupported("two dynamic topology keys on one pod")
+                dyn_key = key
+                ids = self._dom_ids(key)
+                for d, i in ids.items():
+                    dcap[i] = min(int(dcap[i]), max(0, 1 - counts.get(d, 0)))
+            else:
+                blocked = {d for d, n in counts.items() if n > 0}
+                restrict(key, set(self._dom_ids(key)) - blocked)
+                requires[key] = True
+
+        # symmetry: already-placed pods' required anti-affinity blocks this
+        # group (oracle `_affinity_ok` tail); label-absent nodes pass
+        for key in self.tracker.anti_topology_keys():
+            blocked = self.tracker.symmetric_anti_blocked_domains(rep, key)
+            if not blocked:
+                continue
+            if key == wellknown.HOSTNAME_LABEL:
+                clamp_hosts(lambda h: 0 if h in blocked else BIG)
+            elif key in _DOM_KEYS:
+                if dyn_key == key:
+                    ids = self._dom_ids(key)
+                    for d in blocked:
+                        if d in ids:
+                            dcap[ids[d]] = 0
+                else:
+                    restrict(key, set(self._dom_ids(key)) - blocked)
+            else:
+                raise Unsupported(f"symmetric anti-affinity on {key}")
+        # pending groups' anti terms matching this group couple dynamically
+        for gj, sel in self.pending_anti:
+            if gj != gi and _matches(sel, my):
+                raise Unsupported("another pending group's anti-affinity "
+                                  "matches this group")
+
+        dsel = 0
+        delig = np.zeros(self.D, dtype=bool)
+        if dyn_key is not None:
+            dsel = 1 if dyn_key == wellknown.ZONE_LABEL else 2
+            ids = self._dom_ids(dyn_key)
+            for d in self.tracker.eligible_domains(rep, dyn_key):
+                if d in ids:
+                    delig[ids[d]] = True
+            if allowed[dyn_key] is not None:
+                # statically-blocked domains stay in the skew minimum but
+                # can't take placements
+                for d, i in ids.items():
+                    if i not in allowed[dyn_key]:
+                        dcap[i] = 0
+                allowed[dyn_key] = None
+        return dict(ncap=ncap, ecap=ecap, dsel=dsel, dbase=dbase, dcap=dcap,
+                    skew=skew, mindom=mindom, delig=delig,
+                    allowed=allowed, requires=requires)
 
 
 def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None) -> EncodedProblem:
@@ -265,6 +527,9 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None) -> Encoded
     E = len(inp.existing_nodes)
     G = len(groups)
 
+    topo = _TopologyEncoder(inp, cat, groups)
+    D = topo.D
+
     # existing-node labels (hostnames are per-node-unique) go into a
     # per-call vocab so node churn can't grow the cached catalog vocab
     exist_vocab = _Vocab()
@@ -275,15 +540,33 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None) -> Encoded
     group_req = np.zeros((G, R), dtype=np.float32)
     group_count = np.zeros(G, dtype=np.int32)
     group_mask = np.zeros((G, O), dtype=bool)
-    exist_mask = np.zeros((G, E), dtype=bool)
+    exist_cap = np.zeros((G, E), dtype=np.int32)
+    group_ncap = np.zeros(G, dtype=np.int32)
+    group_dsel = np.zeros(G, dtype=np.int32)
+    group_dbase = np.zeros((G, D), dtype=np.int32)
+    group_dcap = np.zeros((G, D), dtype=np.int32)
+    group_skew = np.zeros(G, dtype=np.int32)
+    group_mindom = np.zeros(G, dtype=np.int32)
+    group_delig = np.zeros((G, D), dtype=bool)
+    static_allowed: List[Dict[str, Optional[set]]] = []
     merged_reqs: List[List[Optional[Requirements]]] = []
 
     pool_col = cat.col_pool
+    dom_arrays = {wellknown.ZONE_LABEL: (cat.col_zone, topo.exist_zone),
+                  wellknown.CAPACITY_TYPE_LABEL: (cat.col_ct, topo.exist_ct)}
 
     for gi, g in enumerate(groups):
         rep = g[0]
         group_req[gi] = np.array(effective_request(rep).v, dtype=np.float32)
         group_count[gi] = len(g)
+        t = topo.encode_group(gi, rep)  # raises Unsupported → oracle fallback
+        group_ncap[gi] = t["ncap"]
+        group_dsel[gi] = t["dsel"]
+        group_dbase[gi] = t["dbase"]
+        group_dcap[gi] = t["dcap"]
+        group_skew[gi] = t["skew"]
+        group_mindom[gi] = t["mindom"]
+        group_delig[gi] = t["delig"]
 
         merged_per_pool: List[Optional[Requirements]] = []
         gmask = np.zeros(O, dtype=bool)
@@ -322,6 +605,12 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None) -> Encoded
             ok = _eval_requirements(col_checked, vocab,
                                     cat.pool_matrices[pidx], len(sel))
             gmask[sel[ok]] = True
+        # static topology domain restrictions → column mask
+        for key, (col_ids, _) in dom_arrays.items():
+            al = t["allowed"][key]
+            if al is not None:
+                gmask &= np.isin(col_ids, list(al))
+        static_allowed.append(t["allowed"])
         group_mask[gi] = gmask
         merged_reqs.append(merged_per_pool)
 
@@ -336,7 +625,16 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None) -> Encoded
                     ok[ei] = False
                 elif not tolerates_all(node.taints, rep.tolerations):
                     ok[ei] = False
-            exist_mask[gi] = ok
+            cap_row = np.where(ok, t["ecap"], 0).astype(np.int32)
+            # static topology domain restrictions → per-node allowance
+            for key, (_, ex_ids) in dom_arrays.items():
+                al = t["allowed"][key]
+                if al is not None:
+                    ok_dom = np.isin(ex_ids, list(al))
+                    if not t["requires"][key]:
+                        ok_dom |= ex_ids < 0  # label-absent passes (symmetry)
+                    cap_row = np.where(ok_dom, cap_row, 0)
+            exist_cap[gi] = cap_row
 
     exist_remaining = np.array(
         [en.available.v for en in inp.existing_nodes], dtype=np.float32
@@ -348,17 +646,39 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None) -> Encoded
         if lim is not None:
             pool_limit[pidx] = np.array(lim.v, dtype=np.float32)
 
+    zone_values = [None] * len(topo.zone_ids)
+    for z, i in topo.zone_ids.items():
+        zone_values[i] = z
+    ct_values = [None] * len(topo.ct_ids)
+    for ct, i in topo.ct_ids.items():
+        ct_values[i] = ct
+
     return EncodedProblem(
         group_req=group_req,
         group_count=group_count,
         group_mask=group_mask,
-        exist_mask=exist_mask,
+        exist_cap=exist_cap,
         exist_remaining=exist_remaining,
         col_alloc=cat.col_alloc,
         col_daemon=cat.col_daemon,
         col_price=cat.col_price,
         col_pool=pool_col,
         pool_limit=pool_limit,
+        group_ncap=group_ncap,
+        group_dsel=group_dsel,
+        group_dbase=group_dbase,
+        group_dcap=group_dcap,
+        group_skew=group_skew,
+        group_mindom=group_mindom,
+        group_delig=group_delig,
+        col_zone=cat.col_zone,
+        col_ct=cat.col_ct,
+        exist_zone=topo.exist_zone,
+        exist_ct=topo.exist_ct,
+        zone_values=zone_values,
+        ct_values=ct_values,
+        n_domains=D,
+        static_allowed=static_allowed,
         groups=groups,
         columns=columns,
         existing=list(inp.existing_nodes),

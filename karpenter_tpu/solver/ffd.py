@@ -14,7 +14,30 @@ existing → in-flight → open-new cascade exactly but over whole groups:
      highest-priority compatible pool, ceil-divide to get node count,
      activate slots
 
-Everything is static-shaped (`G × E × O × N` padded to buckets by the
+Topology constraints (reference surface:
+website/content/en/preview/concepts/scheduling.md:209-417) are encoded as
+per-group tensors (SURVEY §7 step 5 — "zonal/hostname spread as per-domain
+count tensors + penalty/feasibility masks"):
+
+  - hostname spread / hostname anti-affinity → per-node caps (`group_ncap`,
+    `exist_cap`): a fresh hostname domain always exists, so the global
+    minimum is 0 and the per-node allowance is just maxSkew (resp. 1).
+  - zone / capacity-type spread + anti-affinity → a domain axis D: the
+    group's pod count is split into per-domain quotas by a closed-form
+    water-fill against per-domain capacity, base counts, maxSkew and
+    minDomains, then each fill above runs per-domain. Each touched node is
+    pinned to its domain by narrowing its column mask (and recorded in
+    `node_zone`/`node_ct` for the host-side claim narrowing, mirroring the
+    oracle's `_resolve_topology` requirement pinning).
+
+Only self-selecting constraints reach this kernel (the encoder falls back
+to the CPU oracle for cross-group coupling), so all spread state is local
+to one scan step — base counts are static and only the group's own
+placements move them. Groups without a domain constraint take a `lax.cond`
+branch identical to the original cascade, so the unconstrained hot path
+pays nothing.
+
+Everything is static-shaped (`G × E × O × N × D` padded to buckets by the
 caller); control flow is masked arithmetic, no data-dependent branching —
 the whole solve is one XLA program (SURVEY §7: compiler-friendly control
 flow, no recompiles inside the latency budget).
@@ -28,6 +51,7 @@ import jax
 import jax.numpy as jnp
 
 EPS = 1e-3
+BIG = jnp.int32(2 ** 29)
 
 
 def _fit_count(avail: jnp.ndarray, req: jnp.ndarray) -> jnp.ndarray:
@@ -48,25 +72,91 @@ def _prefix_fill(cap: jnp.ndarray, want: jnp.ndarray) -> jnp.ndarray:
     return jnp.clip(jnp.minimum(cap, want - before), 0, None)
 
 
+def _water_fill(cnt, base, xmax, elig, skew, mindom):
+    """Split `cnt` pods into per-domain quotas [D].
+
+    Maximises total placement subject to DoNotSchedule spread semantics:
+    final counts f_d = base_d + x_d with x_d ≤ xmax_d must satisfy
+    max_eligible(f) - min_eligible(f) ≤ skew, where the minimum is treated
+    as 0 while fewer than `mindom` domains are populated (the oracle's
+    `spread_allowed_domains`, in closed form). Piecewise-linear in the
+    water level L, so L* is found exactly by evaluating feasibility at the
+    O(D) breakpoints — no data-dependent iteration.
+    """
+    D = base.shape[0]
+    cnt_f = cnt.astype(jnp.float32)
+    skew_f = skew.astype(jnp.float32)
+    c = base.astype(jnp.float32)
+    ub = jnp.where(elig, (base + xmax).astype(jnp.float32), c)
+
+    def f_at(L):  # [K] → [K, D] final counts
+        return jnp.clip(L[:, None], c[None, :], ub[None, :])
+
+    def placed(L):  # [K]
+        return (f_at(L) - c[None, :]).sum(-1)
+
+    def minf(L):  # [K] skew floor (0 while under minDomains)
+        f = f_at(L)
+        m = jnp.where(elig[None, :], f, jnp.inf).min(-1)
+        pop = (jnp.where(elig[None, :], f, 0.0) > 0.5).sum(-1)
+        return jnp.where((mindom > 0) & (pop < mindom), 0.0, m)
+
+    bps = jnp.sort(jnp.concatenate([c, ub]))                      # [2D]
+    pl = placed(bps)
+    # segment slope after each breakpoint = #domains actively filling
+    slope = ((c[None, :] <= bps[:, None]) & (bps[:, None] < ub[None, :])
+             & elig[None, :]).sum(-1)
+    cands = jnp.concatenate([
+        bps,
+        minf(bps) + skew_f,                                       # skew crossings
+        bps + (cnt_f - pl) / jnp.maximum(slope, 1),               # count crossing
+    ])
+    ok = ((cands <= minf(cands) + skew_f + EPS)
+          & (placed(cands) <= cnt_f + EPS))
+    L = jnp.floor(jnp.max(jnp.where(ok, cands, c.min() if D else 0.0)))
+    x = (jnp.clip(L, c, ub) - c).astype(jnp.int32)
+    # integral repair: flooring L strands < D pods; hand them to domains
+    # whose bumped count still respects the skew floor
+    leftover = jnp.maximum(cnt - x.sum(), 0)
+    m = minf(L[None])[0]
+    bumpable = elig & (c + x < ub) & (jnp.clip(L, c, ub) + 1.0 - m <= skew_f + EPS)
+    x = x + _prefix_fill(bumpable.astype(jnp.int32), leftover)
+    return jnp.minimum(x, cnt)
+
+
 @partial(jax.jit, static_argnames=("max_nodes",))
 def solve_ffd(
     group_req: jnp.ndarray,       # [G, R]
     group_count: jnp.ndarray,     # [G]
     group_mask: jnp.ndarray,      # [G, O] bool
-    exist_mask: jnp.ndarray,      # [G, E] bool
+    exist_cap: jnp.ndarray,       # [G, E] i32 (0 = blocked)
     exist_remaining: jnp.ndarray, # [E, R]
     col_alloc: jnp.ndarray,       # [O, R]
     col_daemon: jnp.ndarray,      # [O, R]
     col_pool: jnp.ndarray,        # [O] i32
     pool_daemon: jnp.ndarray,     # [P, R]
     pool_limit: jnp.ndarray,      # [P, R]
+    group_ncap: jnp.ndarray,      # [G] i32 per-new-node cap
+    group_dsel: jnp.ndarray,      # [G] i32 0 none / 1 zone / 2 capacity-type
+    group_dbase: jnp.ndarray,     # [G, D] i32 spread base counts
+    group_dcap: jnp.ndarray,      # [G, D] i32 max additional per domain
+    group_skew: jnp.ndarray,      # [G] i32
+    group_mindom: jnp.ndarray,    # [G] i32 (0 = unset)
+    group_delig: jnp.ndarray,     # [G, D] bool eligible domains for skew min
+    col_zone: jnp.ndarray,        # [O] i32
+    col_ct: jnp.ndarray,          # [O] i32
+    exist_zone: jnp.ndarray,      # [E] i32
+    exist_ct: jnp.ndarray,        # [E] i32
     max_nodes: int = 1024,
 ):
     G, RDIM = group_req.shape
     E = exist_remaining.shape[0]
     O = col_alloc.shape[0]
     P = pool_limit.shape[0]
+    D = group_dbase.shape[1]
     N = max_nodes
+    dom_ids = jnp.arange(D, dtype=jnp.int32)
+    idx = jnp.arange(N, dtype=jnp.int32)
 
     init = dict(
         exist_rem=exist_remaining,
@@ -74,129 +164,313 @@ def solve_ffd(
         colmask=jnp.zeros((N, O), bool),
         active=jnp.zeros((N,), bool),
         node_pool=jnp.zeros((N,), jnp.int32),
+        node_zone=jnp.full((N,), -1, jnp.int32),
+        node_ct=jnp.full((N,), -1, jnp.int32),
         num_active=jnp.int32(0),
         limits=pool_limit,
     )
 
-    def step(carry, xs):
-        req, cnt, gmask, emask = xs
-        exist_rem = carry["exist_rem"]
-        used = carry["used"]
-        colmask = carry["colmask"]
-        active = carry["active"]
-        node_pool = carry["node_pool"]
-        num_active = carry["num_active"]
-        limits = carry["limits"]
-
-        # -- 1. existing nodes ------------------------------------------
-        cap_e = jnp.where(emask, _fit_count(exist_rem, req), 0) if E else jnp.zeros((0,), jnp.int32)
-        take_e = _prefix_fill(cap_e, cnt) if E else cap_e
-        exist_rem = exist_rem - take_e[:, None] * req if E else exist_rem
-        c1 = cnt - (take_e.sum() if E else 0)
-
-        # -- 2. in-flight nodes -----------------------------------------
-        avail = col_alloc[None, :, :] - used[:, None, :]           # [N,O,R]
-        cap_no = _fit_count(avail, req)                            # [N,O]
-        cap_no = jnp.where(colmask & gmask[None, :], cap_no, 0)
-        cap_n = jnp.where(active, cap_no.max(axis=1), 0)
+    def _clamp_pool_limits(cap_n, node_pool, limits, req):
         # pool limits are COLLECTIVE: clamp each node's cap by what the
         # pool's budget leaves after earlier (lower-index) nodes of the same
         # pool take theirs — per-node clamping alone would let several nodes
         # of one pool jointly overrun the limit (P is static → unrolled)
-        limit_cap = _fit_count(limits, req)                        # [P]
+        limit_cap = _fit_count(limits, req)                    # [P]
         for p in range(P):
             mask_p = node_pool == p
             cap_p = jnp.where(mask_p, cap_n, 0)
             before_p = jnp.cumsum(cap_p) - cap_p
             allowed = jnp.clip(limit_cap[p] - before_p, 0, None)
             cap_n = jnp.where(mask_p, jnp.minimum(cap_p, allowed), cap_n)
-        take_n = _prefix_fill(cap_n, c1)
-        used = used + take_n[:, None] * req
-        touched = take_n > 0
-        colmask = jnp.where(touched[:, None], colmask & gmask[None, :], colmask)
-        col_ok = jnp.all(col_alloc[None, :, :] - used[:, None, :] >= -EPS, axis=-1)
-        colmask = colmask & col_ok
-        pool_take = jax.ops.segment_sum(take_n.astype(jnp.float32), node_pool,
-                                        num_segments=P)
-        limits = limits - pool_take[:, None] * req
-        c2 = c1 - take_n.sum()
+        return cap_n
 
-        # -- 3. open new nodes ------------------------------------------
-        # Unrolled over pools in priority order (P is static): a pool whose
-        # limit or catalog can't absorb the remaining pods falls through to
-        # the next pool, exactly like the oracle's per-pod pool cascade.
-        per_col = _fit_count(col_alloc - col_daemon, req)          # [O]
-        col_feas = gmask & (per_col >= 1)
-        idx = jnp.arange(N, dtype=jnp.int32)
-        c_rem = c2
-        k_new_total = jnp.zeros((N,), jnp.int32)
-        for p in range(P):
-            cols_p = col_feas & (col_pool == p)
-            k_full = jnp.max(jnp.where(cols_p, per_col, 0))
-            pool_room = jnp.all(limits[p] - pool_daemon[p] - req >= -EPS)
-            can = cols_p.any() & pool_room & (c_rem > 0) & (k_full > 0)
-            m_need = jnp.where(can, -(-c_rem // jnp.maximum(k_full, 1)), 0)
-            # per-node charge against the pool limit (full-node approximation)
-            charge = pool_daemon[p] + k_full.astype(jnp.float32) * req
-            m_limit = _fit_count(limits[p][None, :], charge)[0]
-            m = jnp.minimum(jnp.minimum(m_need, m_limit), N - num_active)
-            newmask = (idx >= num_active) & (idx < num_active + m)
-            pos = idx - num_active
-            taken_new = jnp.minimum(c_rem, m * k_full)
-            k_node = jnp.where(
-                newmask,
-                jnp.where(pos == m - 1, taken_new - (m - 1) * k_full, k_full),
-                0)
-            new_used = pool_daemon[p][None, :] + k_node[:, None].astype(jnp.float32) * req
-            used = jnp.where(newmask[:, None], new_used, used)
-            new_colmask = cols_p[None, :] & jnp.all(
-                col_alloc[None, :, :] - new_used[:, None, :] >= -EPS, axis=-1)
-            colmask = jnp.where(newmask[:, None], new_colmask, colmask)
-            active = active | newmask
-            node_pool = jnp.where(newmask, jnp.int32(p), node_pool)
-            num_active = num_active + m
-            limits = limits.at[p].add(
-                -(m.astype(jnp.float32) * pool_daemon[p]
-                  + taken_new.astype(jnp.float32) * req))
-            k_new_total = k_new_total + k_node
-            c_rem = c_rem - taken_new
-        unsched = c_rem
+    def step(carry, xs):
+        (req, cnt, gmask, ecap, ncap, dsel,
+         dbase, dcap, skew, mindom, delig) = xs
 
-        carry = dict(exist_rem=exist_rem, used=used, colmask=colmask,
-                     active=active, node_pool=node_pool,
-                     num_active=num_active, limits=limits)
-        out = dict(take_exist=take_e, take_new=take_n + k_new_total,
-                   unsched=unsched)
-        return carry, out
+        def light(carry):
+            exist_rem = carry["exist_rem"]
+            used = carry["used"]
+            colmask = carry["colmask"]
+            active = carry["active"]
+            node_pool = carry["node_pool"]
+            num_active = carry["num_active"]
+            limits = carry["limits"]
 
-    xs = (group_req, group_count, group_mask, exist_mask)
+            # -- 1. existing nodes --------------------------------------
+            cap_e = (jnp.minimum(_fit_count(exist_rem, req), ecap)
+                     if E else jnp.zeros((0,), jnp.int32))
+            take_e = _prefix_fill(cap_e, cnt) if E else cap_e
+            exist_rem = exist_rem - take_e[:, None] * req if E else exist_rem
+            c1 = cnt - (take_e.sum() if E else 0)
+
+            # -- 2. in-flight nodes -------------------------------------
+            avail = col_alloc[None, :, :] - used[:, None, :]       # [N,O,R]
+            cap_no = _fit_count(avail, req)                        # [N,O]
+            cap_no = jnp.where(colmask & gmask[None, :], cap_no, 0)
+            cap_n = jnp.where(active, jnp.minimum(cap_no.max(axis=1), ncap), 0)
+            cap_n = _clamp_pool_limits(cap_n, node_pool, limits, req)
+            take_n = _prefix_fill(cap_n, c1)
+            used = used + take_n[:, None] * req
+            touched = take_n > 0
+            colmask = jnp.where(touched[:, None], colmask & gmask[None, :], colmask)
+            col_ok = jnp.all(col_alloc[None, :, :] - used[:, None, :] >= -EPS, axis=-1)
+            colmask = colmask & col_ok
+            pool_take = jax.ops.segment_sum(take_n.astype(jnp.float32), node_pool,
+                                            num_segments=P)
+            limits = limits - pool_take[:, None] * req
+            c2 = c1 - take_n.sum()
+
+            # -- 3. open new nodes --------------------------------------
+            # Unrolled over pools in priority order (P is static): a pool
+            # whose limit or catalog can't absorb the remaining pods falls
+            # through to the next pool, like the oracle's per-pod cascade.
+            per_col = jnp.minimum(_fit_count(col_alloc - col_daemon, req), ncap)
+            col_feas = gmask & (per_col >= 1)
+            c_rem = c2
+            k_new_total = jnp.zeros((N,), jnp.int32)
+            active_, node_pool_, num_active_ = active, node_pool, num_active
+            for p in range(P):
+                cols_p = col_feas & (col_pool == p)
+                k_full = jnp.max(jnp.where(cols_p, per_col, 0))
+                pool_room = jnp.all(limits[p] - pool_daemon[p] - req >= -EPS)
+                can = cols_p.any() & pool_room & (c_rem > 0) & (k_full > 0)
+                m_need = jnp.where(can, -(-c_rem // jnp.maximum(k_full, 1)), 0)
+                # per-node charge against the pool limit (full-node approx)
+                charge = pool_daemon[p] + k_full.astype(jnp.float32) * req
+                m_limit = _fit_count(limits[p][None, :], charge)[0]
+                m = jnp.minimum(jnp.minimum(m_need, m_limit), N - num_active_)
+                newmask = (idx >= num_active_) & (idx < num_active_ + m)
+                pos = idx - num_active_
+                taken_new = jnp.minimum(c_rem, m * k_full)
+                k_node = jnp.where(
+                    newmask,
+                    jnp.where(pos == m - 1, taken_new - (m - 1) * k_full, k_full),
+                    0)
+                new_used = pool_daemon[p][None, :] + k_node[:, None].astype(jnp.float32) * req
+                used = jnp.where(newmask[:, None], new_used, used)
+                new_colmask = cols_p[None, :] & jnp.all(
+                    col_alloc[None, :, :] - new_used[:, None, :] >= -EPS, axis=-1)
+                colmask = jnp.where(newmask[:, None], new_colmask, colmask)
+                active_ = active_ | newmask
+                node_pool_ = jnp.where(newmask, jnp.int32(p), node_pool_)
+                num_active_ = num_active_ + m
+                limits = limits.at[p].add(
+                    -(m.astype(jnp.float32) * pool_daemon[p]
+                      + taken_new.astype(jnp.float32) * req))
+                k_new_total = k_new_total + k_node
+                c_rem = c_rem - taken_new
+
+            out_carry = dict(exist_rem=exist_rem, used=used, colmask=colmask,
+                             active=active_, node_pool=node_pool_,
+                             node_zone=carry["node_zone"],
+                             node_ct=carry["node_ct"],
+                             num_active=num_active_, limits=limits)
+            out = dict(take_exist=take_e, take_new=take_n + k_new_total,
+                       unsched=c_rem,
+                       dom_placed=jnp.zeros((D,), jnp.int32))
+            return out_carry, out
+
+        def heavy(carry):
+            exist_rem = carry["exist_rem"]
+            used = carry["used"]
+            colmask = carry["colmask"]
+            active = carry["active"]
+            node_pool = carry["node_pool"]
+            node_zone = carry["node_zone"]
+            node_ct = carry["node_ct"]
+            num_active = carry["num_active"]
+            limits = carry["limits"]
+
+            col_dom = jnp.where(dsel == 1, col_zone, col_ct)       # [O]
+            ex_dom = (jnp.where(dsel == 1, exist_zone, exist_ct)
+                      if E else jnp.zeros((0,), jnp.int32))
+            dom_cols = col_dom[None, :] == dom_ids[:, None]        # [D, O]
+            dom_ex = (ex_dom[None, :] == dom_ids[:, None]
+                      if E else jnp.zeros((D, 0), bool))           # [D, E]
+
+            # -- capacity estimates per domain (for the water-fill) -----
+            cap_e = (jnp.minimum(_fit_count(exist_rem, req), ecap)
+                     if E else jnp.zeros((0,), jnp.int32))
+            cap_ed = (jnp.where(dom_ex, cap_e[None, :], 0)
+                      if E else jnp.zeros((D, 0), jnp.int32))      # [D, E]
+
+            avail = col_alloc[None, :, :] - used[:, None, :]
+            cap_no = _fit_count(avail, req)
+            cap_no = jnp.where(colmask & gmask[None, :], cap_no, 0)  # [N,O]
+            # segment-max over the column axis: no [D,N,O] intermediate
+            cap_nd = jax.ops.segment_max(cap_no.T, col_dom, num_segments=D,
+                                         indices_are_sorted=False)   # [D, N]
+            cap_nd = jnp.maximum(cap_nd, 0)
+            cap_nd = jnp.minimum(cap_nd, ncap)
+            cap_nd = jnp.where(active[None, :], cap_nd, 0)
+            # each in-flight node serves exactly ONE domain (placing a
+            # zone-spread pod pins the node, as the oracle's requirement
+            # narrowing does); break capacity ties by rotating over nodes
+            # so equal nodes spread across domains
+            score = cap_nd * jnp.int32(D + 1) + (idx[None, :] + dom_ids[:, None]) % D
+            bd = jnp.argmax(score, axis=0).astype(jnp.int32)        # [N]
+            sel_nd = dom_ids[:, None] == bd[None, :]
+            cap_nd = jnp.where(sel_nd, cap_nd, 0)
+
+            per_col = jnp.minimum(_fit_count(col_alloc - col_daemon, req), ncap)
+            col_feas = gmask & (per_col >= 1)
+            kfull_pd = []
+            for p in range(P):
+                cols_p = col_feas & (col_pool == p)
+                kfull_pd.append(jnp.where(dom_cols & cols_p[None, :],
+                                          per_col[None, :], 0).max(-1))  # [D]
+            kfull_pd = jnp.stack(kfull_pd)                          # [P, D]
+            rooms = jnp.stack([
+                jnp.all(limits[p] - pool_daemon[p] - req >= -EPS)
+                for p in range(P)])                                 # [P]
+            new_est = (N - num_active) * jnp.where(rooms[:, None], kfull_pd, 0
+                                                   ).max(0)         # [D]
+            capacity = cap_ed.sum(-1) + cap_nd.sum(-1) + new_est    # [D]
+            want = _water_fill(cnt, dbase, jnp.minimum(capacity, dcap),
+                               delig, skew, mindom)                  # [D]
+            unplaceable = cnt - want.sum()
+
+            # -- 1. existing nodes, per domain --------------------------
+            if E:
+                take_ed = jax.vmap(_prefix_fill)(cap_ed, want)       # [D, E]
+                take_e = take_ed.sum(0)
+                exist_rem = exist_rem - take_e[:, None] * req
+                want = want - take_ed.sum(-1)
+            else:
+                take_e = jnp.zeros((0,), jnp.int32)
+
+            # -- 2. in-flight nodes, per domain -------------------------
+            cap_n_flat = _clamp_pool_limits(cap_nd.sum(0), node_pool, limits, req)
+            cap_nd = jnp.minimum(cap_nd, cap_n_flat[None, :])
+            take_nd = jax.vmap(_prefix_fill)(cap_nd, want)           # [D, N]
+            take_n = take_nd.sum(0)
+            used = used + take_n[:, None] * req
+            touched = take_n > 0
+            node_dcols = dom_cols[bd]                                # [N, O] bool
+            colmask = jnp.where(touched[:, None],
+                                colmask & gmask[None, :] & node_dcols, colmask)
+            col_ok = jnp.all(col_alloc[None, :, :] - used[:, None, :] >= -EPS, axis=-1)
+            colmask = colmask & col_ok
+            node_zone = jnp.where(touched & (dsel == 1), bd, node_zone)
+            node_ct = jnp.where(touched & (dsel == 2), bd, node_ct)
+            pool_take = jax.ops.segment_sum(take_n.astype(jnp.float32), node_pool,
+                                            num_segments=P)
+            limits = limits - pool_take[:, None] * req
+            want = want - take_nd.sum(-1)
+
+            # -- 3. open new nodes, per pool × domain -------------------
+            k_new_total = jnp.zeros((N,), jnp.int32)
+            new_dom_placed = jnp.zeros((D,), jnp.int32)
+            active_, node_pool_, num_active_ = active, node_pool, num_active
+            for p in range(P):
+                cols_p = col_feas & (col_pool == p)
+                kfull_d = kfull_pd[p]                                # [D]
+                # budget allocation over domains shares the pool limit
+                # sequentially (D is static → unrolled, cheap [R] math)
+                rem_budget = limits[p]
+                slots_left = N - num_active_
+                m_list, taken_list = [], []
+                for d in range(D):
+                    can = (kfull_d[d] > 0) & (want[d] > 0)
+                    m_need = jnp.where(
+                        can, -(-want[d] // jnp.maximum(kfull_d[d], 1)), 0)
+                    charge = pool_daemon[p] + kfull_d[d].astype(jnp.float32) * req
+                    m_lim = _fit_count(rem_budget[None, :], charge)[0]
+                    m_d = jnp.minimum(jnp.minimum(m_need, m_lim), slots_left)
+                    taken_d = jnp.minimum(want[d], m_d * kfull_d[d])
+                    rem_budget = rem_budget - (
+                        m_d.astype(jnp.float32) * pool_daemon[p]
+                        + taken_d.astype(jnp.float32) * req)
+                    slots_left = slots_left - m_d
+                    m_list.append(m_d)
+                    taken_list.append(taken_d)
+                m_d = jnp.stack(m_list)                              # [D]
+                taken_d = jnp.stack(taken_list)                      # [D]
+                starts = num_active_ + jnp.cumsum(m_d) - m_d         # [D]
+                in_dom = ((idx[None, :] >= starts[:, None])
+                          & (idx[None, :] < (starts + m_d)[:, None]))  # [D, N]
+                is_last = idx[None, :] == (starts + m_d - 1)[:, None]
+                k_dn = jnp.where(
+                    in_dom,
+                    jnp.where(is_last,
+                              (taken_d - (m_d - 1) * kfull_d)[:, None],
+                              kfull_d[:, None]),
+                    0)                                               # [D, N]
+                k_node = k_dn.sum(0)                                 # [N]
+                newmask = in_dom.any(0)
+                new_used = (pool_daemon[p][None, :]
+                            + k_node[:, None].astype(jnp.float32) * req)
+                used = jnp.where(newmask[:, None], new_used, used)
+                new_bd = (in_dom * dom_ids[:, None]).sum(0).astype(jnp.int32)
+                nd_cols = dom_cols[new_bd]                           # [N, O]
+                new_colmask = nd_cols & cols_p[None, :] & jnp.all(
+                    col_alloc[None, :, :] - new_used[:, None, :] >= -EPS, axis=-1)
+                colmask = jnp.where(newmask[:, None], new_colmask, colmask)
+                node_zone = jnp.where(newmask & (dsel == 1), new_bd, node_zone)
+                node_ct = jnp.where(newmask & (dsel == 2), new_bd, node_ct)
+                active_ = active_ | newmask
+                node_pool_ = jnp.where(newmask, jnp.int32(p), node_pool_)
+                num_active_ = num_active_ + m_d.sum()
+                limits = limits.at[p].add(
+                    -(m_d.sum().astype(jnp.float32) * pool_daemon[p]
+                      + taken_d.sum().astype(jnp.float32) * req))
+                k_new_total = k_new_total + k_node
+                new_dom_placed = new_dom_placed + taken_d
+                want = want - taken_d
+
+            dom_placed = ((take_ed.sum(-1) if E else 0)
+                          + take_nd.sum(-1) + new_dom_placed)
+            out_carry = dict(exist_rem=exist_rem, used=used, colmask=colmask,
+                             active=active_, node_pool=node_pool_,
+                             node_zone=node_zone, node_ct=node_ct,
+                             num_active=num_active_, limits=limits)
+            out = dict(take_exist=take_e, take_new=take_n + k_new_total,
+                       unsched=unplaceable + want.sum(),
+                       dom_placed=dom_placed)
+            return out_carry, out
+
+        return jax.lax.cond(dsel > 0, heavy, light, carry)
+
+    xs = (group_req, group_count, group_mask, exist_cap, group_ncap,
+          group_dsel, group_dbase, group_dcap, group_skew, group_mindom,
+          group_delig)
     final, outs = jax.lax.scan(step, init, xs)
     # Results are packed into ONE flat f32 buffer: each host pull pays a
-    # full round trip on the device link, so six small arrays cost six RTTs
+    # full round trip on the device link, so small arrays cost one RTT each
     # — one concatenated buffer costs one. colmask [N,O] stays on device
-    # entirely; the host reconstructs it from (take_new, used, group_mask).
+    # entirely; the host reconstructs it from (take_new, used, group_mask,
+    # node_zone/node_ct).
     packed = jnp.concatenate([
         outs["take_exist"].astype(jnp.float32).reshape(-1),  # G*E
         outs["take_new"].astype(jnp.float32).reshape(-1),    # G*N
         outs["unsched"].astype(jnp.float32).reshape(-1),     # G
+        outs["dom_placed"].astype(jnp.float32).reshape(-1),  # G*D
         final["used"].reshape(-1),                            # N*R
         final["node_pool"].astype(jnp.float32),               # N
+        final["node_zone"].astype(jnp.float32),               # N
+        final["node_ct"].astype(jnp.float32),                 # N
         final["num_active"][None].astype(jnp.float32),        # 1
     ])
     return packed
 
 
-def unpack(packed, G: int, E: int, N: int, RDIM: int):
+def unpack(packed, G: int, E: int, N: int, RDIM: int, D: int):
     """Split the flat result buffer back into named host arrays."""
     import numpy as np
-    flat = np.asarray(packed)
-    sizes = [G * E, G * N, G, N * RDIM, N, 1]
+    # copy: device buffers surface as read-only views, and the topology
+    # repair pass (solve.py) mutates these arrays in place
+    flat = np.array(packed)
+    sizes = [G * E, G * N, G, G * D, N * RDIM, N, N, N, 1]
     offs = np.cumsum([0] + sizes)
     return dict(
         take_exist=flat[offs[0]:offs[1]].reshape(G, E),
         take_new=flat[offs[1]:offs[2]].reshape(G, N),
         unsched=flat[offs[2]:offs[3]],
-        used=flat[offs[3]:offs[4]].reshape(N, RDIM),
-        node_pool=flat[offs[4]:offs[5]].astype(np.int32),
-        num_active=flat[offs[5]],
+        dom_placed=flat[offs[3]:offs[4]].reshape(G, D),
+        used=flat[offs[4]:offs[5]].reshape(N, RDIM),
+        node_pool=flat[offs[5]:offs[6]].astype(np.int32),
+        node_zone=flat[offs[6]:offs[7]].astype(np.int32),
+        node_ct=flat[offs[7]:offs[8]].astype(np.int32),
+        num_active=flat[offs[8]],
     )

@@ -21,7 +21,14 @@ from karpenter_tpu.scheduling.types import (
     min_values_violation,
 )
 from karpenter_tpu.solver import ffd
-from karpenter_tpu.solver.encode import EncodedProblem, bucket, encode
+from karpenter_tpu.solver.encode import (
+    BIG,
+    D_BUCKETS,
+    EncodedProblem,
+    Unsupported,
+    bucket,
+    encode,
+)
 
 R = len(RESOURCE_AXIS)
 
@@ -31,16 +38,8 @@ O_ALIGN = 512
 
 
 class UnsupportedPods(Exception):
-    """Raised when the encoding can't express some pods' constraints yet;
+    """Raised when the encoding can't express some pods' constraints;
     the provisioner falls back to the CPU oracle for this batch."""
-
-
-def _supported(pod: Pod) -> bool:
-    if pod.topology_spread:
-        return False
-    if any(t.required for t in pod.pod_affinities):
-        return False
-    return True
 
 
 class TPUSolver:
@@ -82,6 +81,8 @@ class TPUSolver:
                 col_alloc=jax.device_put(self._pad(cat.col_alloc, 0, O)),
                 col_daemon=jax.device_put(self._pad(cat.col_daemon, 0, O)),
                 col_pool=jax.device_put(self._pad(cat.col_pool, 0, O)),
+                col_zone=jax.device_put(self._pad(cat.col_zone, 0, O)),
+                col_ct=jax.device_put(self._pad(cat.col_ct, 0, O)),
                 pool_daemon=jax.device_put(cat.pool_daemon),
                 O=O,
             )
@@ -98,23 +99,28 @@ class TPUSolver:
         return np.pad(arr, widths, constant_values=value)
 
     def solve(self, inp: ScheduleInput) -> ScheduleResult:
-        unsupported = [p for p in inp.pods if not _supported(p)]
-        if unsupported:
-            raise UnsupportedPods(
-                f"{len(unsupported)} pods carry topology/affinity constraints "
-                "not yet encoded for the device solver")
-
         cat = self._catalog_encoding(inp)
-        enc = encode(inp, cat)
+        try:
+            enc = encode(inp, cat)
+        except Unsupported as e:
+            raise UnsupportedPods(str(e)) from e
         if enc.n_groups == 0:
             return ScheduleResult()
         if enc.n_columns == 0:
             # no purchasable capacity — but existing nodes can still absorb
-            # pods, exactly as the oracle fills them first
+            # pods, exactly as the oracle fills them first. The host-side
+            # fill enforces per-node caps (exist_cap) but not the dynamic
+            # per-domain quotas, so dynamically-constrained groups go to
+            # the oracle instead of risking a skew/anti violation.
+            if (enc.group_dsel > 0).any():
+                raise UnsupportedPods(
+                    "zone/capacity-type-constrained pods with no purchasable "
+                    "capacity: domain quotas need the device solve")
             return self._existing_only(enc)
 
         G = bucket(enc.n_groups, G_BUCKETS)
         E = bucket(len(enc.existing), E_BUCKETS)
+        Db = bucket(enc.n_domains, D_BUCKETS)
         dev = cat.device_args
         O = dev["O"]
 
@@ -122,16 +128,29 @@ class TPUSolver:
             self._pad(enc.group_req, 0, G),
             self._pad(enc.group_count, 0, G),
             self._pad(self._pad(enc.group_mask, 1, O), 0, G),
-            self._pad(self._pad(enc.exist_mask, 1, E), 0, G),
+            self._pad(self._pad(enc.exist_cap, 1, E), 0, G),
             self._pad(enc.exist_remaining, 0, E),
             dev["col_alloc"],
             dev["col_daemon"],
             dev["col_pool"],
             dev["pool_daemon"],
             enc.pool_limit,
+            self._pad(enc.group_ncap, 0, G),
+            self._pad(enc.group_dsel, 0, G),
+            self._pad(self._pad(enc.group_dbase, 1, Db), 0, G),
+            # pad domains take no quota (cap 0) and stay out of the skew min
+            self._pad(self._pad(enc.group_dcap, 1, Db), 0, G),
+            self._pad(enc.group_skew, 0, G),
+            self._pad(enc.group_mindom, 0, G),
+            self._pad(self._pad(enc.group_delig, 1, Db), 0, G),
+            dev["col_zone"],
+            dev["col_ct"],
+            self._pad(enc.exist_zone, 0, E, value=-1),
+            self._pad(enc.exist_ct, 0, E, value=-1),
             max_nodes=self.max_nodes,
         )
-        out = ffd.unpack(packed, G, E, self.max_nodes, R)
+        out = ffd.unpack(packed, G, E, self.max_nodes, R, Db)
+        self._repair_topology(enc, out)
         return self._decode(enc, out)
 
     def _existing_only(self, enc: EncodedProblem) -> ScheduleResult:
@@ -142,11 +161,12 @@ class TPUSolver:
             req = enc.group_req[gi]
             cursor = 0
             for ei in range(len(enc.existing)):
-                if cursor >= len(pods) or not enc.exist_mask[gi, ei]:
+                if cursor >= len(pods) or enc.exist_cap[gi, ei] <= 0:
                     continue
                 with np.errstate(divide="ignore", invalid="ignore"):
                     per = np.where(req > 0, np.floor((remaining[ei] + 1e-3) / np.where(req > 0, req, 1)), np.inf)
-                k = int(min(np.min(per), len(pods) - cursor))
+                k = int(min(np.min(per), enc.exist_cap[gi, ei],
+                            len(pods) - cursor))
                 if k <= 0:
                     continue
                 for pod in pods[cursor:cursor + k]:
@@ -156,6 +176,64 @@ class TPUSolver:
             for pod in pods[cursor:]:
                 res.unschedulable[pod.meta.name] = "no instance types available"
         return res
+
+    # -- topology repair --------------------------------------------------
+    def _repair_topology(self, enc: EncodedProblem, out: Dict[str, np.ndarray]) -> None:
+        """The kernel's per-domain quotas are planned against a capacity
+        *estimate* (new-node slots and pool budgets are shared across
+        domains); when a domain achieves less than planned, another may end
+        above the final skew ceiling. Strip the excess placements here so
+        every emitted placement is skew-valid (DoNotSchedule is a hard
+        constraint) — the stripped pods report unschedulable, exactly what
+        the oracle does when capacity starves a domain."""
+        Er = len(enc.existing)
+        num_active = int(out["num_active"])
+        for gi in range(enc.n_groups):
+            dsel = int(enc.group_dsel[gi])
+            skew = int(enc.group_skew[gi])
+            if dsel == 0 or skew >= BIG:
+                continue
+            D = enc.n_domains
+            elig = enc.group_delig[gi]
+            if not elig.any():
+                continue
+            placed = out["dom_placed"][gi][:D].astype(np.int64)
+            f = enc.group_dbase[gi].astype(np.int64) + placed
+            m = int(f[elig].min())
+            if enc.group_mindom[gi] > 0 and int((f[elig] > 0).sum()) < int(enc.group_mindom[gi]):
+                m = 0
+            limit = m + skew
+            node_dom = out["node_zone"] if dsel == 1 else out["node_ct"]
+            ex_dom = enc.exist_zone if dsel == 1 else enc.exist_ct
+            req = enc.group_req[gi]
+            for d in np.nonzero(elig & (f > limit))[0]:
+                excess = int(f[d] - limit)
+                removed = 0
+                # strip new nodes last-first (the partial node empties first)
+                for ni in range(num_active - 1, -1, -1):
+                    if removed >= excess:
+                        break
+                    if node_dom[ni] != d:
+                        continue
+                    k = int(out["take_new"][gi, ni])
+                    if k <= 0:
+                        continue
+                    r = min(k, excess - removed)
+                    out["take_new"][gi, ni] -= r
+                    out["used"][ni] -= r * req
+                    removed += r
+                for ei in range(Er - 1, -1, -1):
+                    if removed >= excess:
+                        break
+                    if ex_dom[ei] != d:
+                        continue
+                    k = int(out["take_exist"][gi, ei])
+                    if k <= 0:
+                        continue
+                    r = min(k, excess - removed)
+                    out["take_exist"][gi, ei] -= r
+                    removed += r
+                out["unsched"][gi] += removed
 
     # -- decode ----------------------------------------------------------
     def _decode(self, enc: EncodedProblem, out: Dict[str, np.ndarray]) -> ScheduleResult:
@@ -168,11 +246,13 @@ class TPUSolver:
         take_new = out["take_new"][:Gr, : self.max_nodes].astype(int)
         unsched = out["unsched"][:Gr].astype(int)
         node_pool = out["node_pool"]
+        node_zone = out["node_zone"]
+        node_ct = out["node_ct"]
         used = out["used"]
         # reconstruct each active node's surviving-column mask host-side
         # (cheap numpy; saves shipping the [N,O] device array back):
         #   columns of the node's pool ∩ every resident group's label mask
-        #   ∩ capacity ≥ final used
+        #   ∩ the node's pinned topology domain ∩ capacity ≥ final used
         col_pool = enc.col_pool
         col_alloc = enc.col_alloc
 
@@ -197,8 +277,8 @@ class TPUSolver:
                 res.unschedulable[pod.meta.name] = self._unsched_reason(enc, gi)
 
         # claim metadata (requirements + ranked type list) depends only on
-        # (pool, resident groups, used vector) — hundreds of nodes from the
-        # same fill collapse to a handful of distinct computations
+        # (pool, resident groups, used vector, pinned domains) — hundreds of
+        # nodes from the same fill collapse to a handful of computations
         claim_cache: Dict[tuple, tuple] = {}
         for ni in range(num_active):
             pods = node_pods.get(ni, [])
@@ -207,11 +287,16 @@ class TPUSolver:
             pidx = int(node_pool[ni])
             pool = enc.pools[pidx]
             gis = tuple(node_groups.get(ni, []))
-            ckey = (pidx, gis, used[ni].tobytes())
+            zi, ci = int(node_zone[ni]), int(node_ct[ni])
+            ckey = (pidx, gis, zi, ci, used[ni].tobytes())
             cached = claim_cache.get(ckey)
             if cached is None:
                 nmask = (col_pool == pidx) & np.all(
                     col_alloc - used[ni][None, :R] >= -1e-3, axis=-1)
+                if zi >= 0:
+                    nmask &= enc.col_zone == zi
+                if ci >= 0:
+                    nmask &= enc.col_ct == ci
                 for gi in gis:
                     nmask &= enc.group_mask[gi]
                 idxs = np.nonzero(nmask)[0]
@@ -223,10 +308,31 @@ class TPUSolver:
                         merged = enc.merged_reqs[gi][pidx]
                         if merged is not None:
                             reqs = reqs.intersection(merged)
+                    # pin the claim to the domain the kernel chose, as the
+                    # oracle's _resolve_topology narrows the claim — launch
+                    # must not drift to another domain
+                    if zi >= 0:
+                        reqs = reqs.intersection(Requirements(Requirement.make(
+                            wellknown.ZONE_LABEL, "In", enc.zone_values[zi])))
+                    if ci >= 0:
+                        reqs = reqs.intersection(Requirements(Requirement.make(
+                            wellknown.CAPACITY_TYPE_LABEL, "In", enc.ct_values[ci])))
+                    # static allowed-domain sets restrict launch the same way
+                    for gi in gis:
+                        for key, al in enc.static_allowed[gi].items():
+                            if al is None:
+                                continue
+                            values = (enc.zone_values
+                                      if key == wellknown.ZONE_LABEL
+                                      else enc.ct_values)
+                            names = [values[i] for i in sorted(al)]
+                            if names:
+                                reqs = reqs.intersection(Requirements(
+                                    Requirement.make(key, "In", *names)))
                     best_price: Dict[str, float] = {}
                     type_of: Dict[str, object] = {}
-                    for ci in idxs:
-                        c = enc.columns[ci]
+                    for cidx in idxs:
+                        c = enc.columns[cidx]
                         if c.price < best_price.get(c.type_name, float("inf")):
                             best_price[c.type_name] = c.price
                             type_of[c.type_name] = c.instance_type
@@ -256,7 +362,7 @@ class TPUSolver:
 
     @staticmethod
     def _unsched_reason(enc: EncodedProblem, gi: int) -> str:
-        if not enc.group_mask[gi].any() and not enc.exist_mask[gi].any():
+        if not enc.group_mask[gi].any() and not (enc.exist_cap[gi] > 0).any():
             details = []
             for pidx, pool in enumerate(enc.pools):
                 if enc.merged_reqs[gi][pidx] is None:
@@ -264,5 +370,12 @@ class TPUSolver:
                 else:
                     details.append(f"nodepool {pool.name}: no instance type fits/compatible")
             return "no nodepool can schedule pod: " + "; ".join(details)
+        # attribute to topology only when the encoder actually enforced a
+        # constraint for this group (ScheduleAnyway spread and preferred
+        # affinity are ignored and must not be blamed)
+        if (enc.group_dsel[gi] > 0 or enc.group_ncap[gi] < BIG
+                or any(v is not None for v in enc.static_allowed[gi].values())):
+            return ("topology constraints unsatisfiable: every allowed "
+                    "domain is at its skew ceiling or out of capacity")
         return ("no capacity: every compatible node/instance-type " +
                 "combination is exhausted or over limits")

@@ -196,11 +196,24 @@ class TestParity:
         assert fams == {"m6", "c6"}
 
     def test_unsupported_raises(self):
-        from karpenter_tpu.models import TopologySpreadConstraint
-        p = mkpod("t", topology_spread=[TopologySpreadConstraint(
-            topology_key=wellknown.ZONE_LABEL, label_selector={})])
+        # required pod *affinity* (non-anti) has no tensor encoding yet
+        from karpenter_tpu.models import PodAffinityTerm
+        p = mkpod("t", labels={"app": "web"}, pod_affinities=[PodAffinityTerm(
+            label_selector={"app": "web"},
+            topology_key=wellknown.ZONE_LABEL)])
         with pytest.raises(UnsupportedPods):
             TPUSolver().solve(mkinput([p]))
+
+    def test_unsupported_cross_group_coupling(self):
+        # a spread selector matching another pending group couples their
+        # placements mid-solve — oracle fallback
+        from karpenter_tpu.models import TopologySpreadConstraint
+        a = mkpod("a", labels={"team": "x"}, topology_spread=[
+            TopologySpreadConstraint(topology_key=wellknown.ZONE_LABEL,
+                                     label_selector={"team": "x"})])
+        b = mkpod("b", cpu="1", labels={"team": "x"})
+        with pytest.raises(UnsupportedPods):
+            TPUSolver().solve(mkinput([a, b]))
 
     def test_large_scale_smoke(self):
         # 2000 pods across 4 equivalence classes

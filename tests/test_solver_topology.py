@@ -1,0 +1,376 @@
+"""Topology constraints in the TPU solver — parity + validity vs the oracle.
+
+Covers the constraint surface of
+website/content/en/preview/concepts/scheduling.md:209-417 (reference):
+topologySpreadConstraints over zone/hostname/capacity-type honoring
+maxSkew/minDomains, and required pod anti-affinity, now solved in-kernel
+(SURVEY §7 step 5). Validity is the hard assertion (DoNotSchedule skew must
+hold on every emitted placement); node counts are compared to the oracle.
+"""
+
+import collections
+
+import pytest
+
+from karpenter_tpu.models import (
+    Node,
+    NodePool,
+    ObjectMeta,
+    Pod,
+    PodAffinityTerm,
+    Requirement,
+    Requirements,
+    Resources,
+    TopologySpreadConstraint,
+    wellknown,
+)
+from karpenter_tpu.providers import generate_catalog
+from karpenter_tpu.providers.catalog import CatalogSpec
+from karpenter_tpu.scheduling import ExistingNode, ScheduleInput, Scheduler
+from karpenter_tpu.solver import TPUSolver, UnsupportedPods
+
+ZONE = wellknown.ZONE_LABEL
+CT = wellknown.CAPACITY_TYPE_LABEL
+HOST = wellknown.HOSTNAME_LABEL
+ZONES = ["tpu-west-1a", "tpu-west-1b", "tpu-west-1c"]
+
+CATALOG = generate_catalog(CatalogSpec(max_types=40, include_gpu=False))
+
+
+def spread(key=ZONE, skew=1, sel=None, mindom=None, when="DoNotSchedule"):
+    return TopologySpreadConstraint(
+        topology_key=key, max_skew=skew, when_unsatisfiable=when,
+        label_selector={"app": "web"} if sel is None else sel,
+        min_domains=mindom)
+
+
+def anti(key=HOST, sel=None):
+    return PodAffinityTerm(
+        label_selector={"app": "web"} if sel is None else sel,
+        topology_key=key, anti=True, required=True)
+
+
+def mkpod(name, cpu="500m", mem="1Gi", labels=None, **kw):
+    return Pod(meta=ObjectMeta(name=name,
+                               labels={"app": "web"} if labels is None else labels),
+               requests=Resources.parse({"cpu": cpu, "memory": mem}), **kw)
+
+
+def mknode(name, zone="tpu-west-1a", ct="on-demand", cpu=16000, mem=32768,
+           pods_cap=58, resident=None, extra_labels=None):
+    labels = {
+        ZONE: zone, CT: ct,
+        wellknown.NODEPOOL_LABEL: "default",
+        wellknown.ARCH_LABEL: "amd64",
+        wellknown.OS_LABEL: "linux",
+        HOST: name,
+    }
+    labels.update(extra_labels or {})
+    node = Node(meta=ObjectMeta(name=name, labels=labels),
+                allocatable=Resources.of(cpu=cpu, memory=mem, pods=pods_cap),
+                ready=True)
+    resident = resident or []
+    avail = node.allocatable.copy()
+    for p in resident:
+        avail = avail - p.requests
+    return ExistingNode(node=node, available=avail, pods=resident)
+
+
+def mkinput(pods, pools=None, types=None, **kw):
+    pools = pools or [NodePool(meta=ObjectMeta(name="default"))]
+    types = types if types is not None else CATALOG
+    return ScheduleInput(pods=pods, nodepools=pools,
+                         instance_types={p.name: types for p in pools}, **kw)
+
+
+def both(inp):
+    return Scheduler(inp).solve(), TPUSolver().solve(inp)
+
+
+def zone_counts(inp, result, selector=None):
+    """Count matching placed pods per zone: existing assignments via node
+    labels, new claims via the claim's pinned zone requirement."""
+    sel = {"app": "web"} if selector is None else selector
+    by_name = {p.meta.name: p for p in inp.pods}
+    node_zone = {en.name: en.node.labels.get(ZONE) for en in inp.existing_nodes}
+    counts = collections.Counter()
+
+    def matches(pod):
+        return all(pod.meta.labels.get(k) == v for k, v in sel.items())
+
+    for pod_name, node in result.existing_assignments.items():
+        if matches(by_name[pod_name]):
+            counts[node_zone[node]] += 1
+    for claim in result.new_claims:
+        zreq = claim.requirements.get(ZONE)
+        assert zreq is not None and zreq.is_finite(), (
+            "claims serving spread pods must be zone-pinned")
+        (z,) = zreq.values() if len(zreq.values()) == 1 else (None,)
+        assert z is not None, "claim spans zones despite spread constraint"
+        for pod in claim.pods:
+            if matches(pod):
+                counts[z] += 1
+    return counts
+
+
+def assert_skew_valid(counts, base, skew, domains=ZONES):
+    """Incremental DoNotSchedule validity: domains that RECEIVED pods must
+    end within maxSkew of the global minimum (domains whose base counts
+    already violated skew are legal as long as nothing lands on them —
+    the k8s check is per-placement, not a final-state property)."""
+    f = {d: counts.get(d, 0) + base.get(d, 0) for d in domains}
+    m = min(f.values())
+    for d in domains:
+        if counts.get(d, 0) > 0:
+            assert f[d] <= m + skew, (f, d)
+
+
+class TestZoneSpread:
+    def test_even_spread_fresh_cluster(self):
+        pods = [mkpod(f"p{i}", topology_spread=[spread()]) for i in range(30)]
+        inp = mkinput(pods)
+        oracle, solver = both(inp)
+        assert not solver.unschedulable
+        counts = zone_counts(inp, solver)
+        assert_skew_valid(counts, {}, 1)
+        assert sum(counts.values()) == 30
+        assert solver.node_count() <= oracle.node_count() + len(ZONES) - 1
+
+    def test_spread_uneven_base_counts(self):
+        # zone a already holds 5 matching pods → new pods go b/c first
+        resident = [mkpod(f"r{i}") for i in range(5)]
+        node = mknode("n1", zone="tpu-west-1a", resident=resident)
+        pods = [mkpod(f"p{i}", topology_spread=[spread()]) for i in range(7)]
+        inp = mkinput(pods, existing_nodes=[node])
+        oracle, solver = both(inp)
+        assert not solver.unschedulable
+        counts = zone_counts(inp, solver)
+        assert_skew_valid(counts, {"tpu-west-1a": 5}, 1)
+        # balancing to [5,6,6] needs all 7 in b/c (6+6-5-... 12-base) — at
+        # most skew allows f<=min+1; min stays 5+x_a
+        assert counts["tpu-west-1b"] + counts["tpu-west-1c"] >= 6
+
+    def test_max_skew_2(self):
+        pods = [mkpod(f"p{i}", topology_spread=[spread(skew=2)])
+                for i in range(10)]
+        inp = mkinput(pods)
+        oracle, solver = both(inp)
+        assert not solver.unschedulable
+        assert_skew_valid(zone_counts(inp, solver), {}, 2)
+
+    def test_skew_limits_placement_when_zone_unbuyable(self):
+        # catalog restricted to one zone, but all three zones are known
+        # domains → the empty zones pin the min at 0; only maxSkew pods place
+        one_zone = generate_catalog(CatalogSpec(
+            max_types=20, include_gpu=False, zones=["tpu-west-1a"]))
+        # zones b/c exist in the cluster (visible via existing nodes)
+        tiny_b = mknode("nb", zone="tpu-west-1b", cpu=100, mem=128, pods_cap=1)
+        tiny_c = mknode("nc", zone="tpu-west-1c", cpu=100, mem=128, pods_cap=1)
+        pods = [mkpod(f"p{i}", topology_spread=[spread()]) for i in range(9)]
+        inp = mkinput(pods, types=one_zone, existing_nodes=[tiny_b, tiny_c])
+        oracle, solver = both(inp)
+        # both must refuse to pile everything into zone a: the empty zones
+        # pin the global minimum at 0, so only maxSkew pods may land in a
+        assert len(oracle.unschedulable) == 8
+        assert len(solver.unschedulable) == 8
+        assert_skew_valid(zone_counts(inp, solver), {}, 1)
+
+    def test_min_domains(self):
+        # minDomains=3: while fewer than 3 zones are populated the global
+        # min is treated as 0, so no zone may exceed maxSkew
+        pods = [mkpod(f"p{i}", topology_spread=[spread(mindom=3)])
+                for i in range(6)]
+        inp = mkinput(pods)
+        oracle, solver = both(inp)
+        assert not solver.unschedulable
+        counts = zone_counts(inp, solver)
+        assert len([z for z in ZONES if counts.get(z, 0) > 0]) == 3
+
+    def test_schedule_anyway_is_soft(self):
+        pods = [mkpod(f"p{i}", topology_spread=[spread(when="ScheduleAnyway")])
+                for i in range(9)]
+        inp = mkinput(pods)
+        oracle, solver = both(inp)
+        assert not solver.unschedulable
+        assert solver.node_count() == oracle.node_count()
+
+    def test_zone_requirement_filters_eligible_domains(self):
+        # pod restricted to zones a/b: zone c is not an eligible domain and
+        # must not pin the minimum at 0 (nodeAffinityPolicy: Honor)
+        reqs = Requirements(Requirement.make(ZONE, "In",
+                                             "tpu-west-1a", "tpu-west-1b"))
+        pods = [mkpod(f"p{i}", requirements=reqs, topology_spread=[spread()])
+                for i in range(10)]
+        inp = mkinput(pods)
+        oracle, solver = both(inp)
+        assert not solver.unschedulable
+        counts = zone_counts(inp, solver)
+        assert counts.get("tpu-west-1c", 0) == 0
+        assert_skew_valid(counts, {}, 1, domains=["tpu-west-1a", "tpu-west-1b"])
+
+    def test_capacity_type_spread(self):
+        pods = [mkpod(f"p{i}", topology_spread=[spread(key=CT)])
+                for i in range(10)]
+        inp = mkinput(pods)
+        oracle, solver = both(inp)
+        assert not solver.unschedulable
+        # count per capacity type via claims' pinned requirement
+        counts = collections.Counter()
+        for claim in solver.new_claims:
+            ctreq = claim.requirements.get(CT)
+            assert ctreq is not None and len(ctreq.values()) == 1
+            (c,) = ctreq.values()
+            counts[c] += len(claim.pods)
+        assert abs(counts["spot"] - counts["on-demand"]) <= 1
+
+    def test_static_selector_not_matching_self(self):
+        # selector targets a different app: counts are static (from existing
+        # pods), incoming pods just avoid over-skewed zones
+        resident = [mkpod(f"r{i}", labels={"app": "db"}) for i in range(2)]
+        node = mknode("n1", zone="tpu-west-1a", resident=resident)
+        pods = [mkpod(f"p{i}", labels={"app": "web"},
+                      topology_spread=[spread(sel={"app": "db"})])
+                for i in range(6)]
+        inp = mkinput(pods, existing_nodes=[node])
+        oracle, solver = both(inp)
+        assert not solver.unschedulable
+        # db counts: a=2, b=0, c=0, min 0 → zone a blocked (2+1-0 > 1);
+        # the claim's requirements must exclude zone a so launch can't
+        # drift there (counts are static → a multi-zone b/c claim is fine)
+        for claim in solver.new_claims:
+            zreq = claim.requirements.get(ZONE)
+            assert zreq is not None and zreq.is_finite()
+            assert "tpu-west-1a" not in zreq.values()
+
+
+class TestHostnameConstraints:
+    def test_hostname_spread_caps_pods_per_node(self):
+        pods = [mkpod(f"p{i}", topology_spread=[spread(key=HOST, skew=2)])
+                for i in range(10)]
+        inp = mkinput(pods)
+        oracle, solver = both(inp)
+        assert not solver.unschedulable
+        for claim in solver.new_claims:
+            assert len(claim.pods) <= 2
+        assert solver.node_count() == oracle.node_count() == 5
+
+    def test_hostname_anti_affinity_one_per_node(self):
+        pods = [mkpod(f"p{i}", pod_affinities=[anti()]) for i in range(6)]
+        inp = mkinput(pods)
+        oracle, solver = both(inp)
+        assert not solver.unschedulable
+        assert solver.node_count() == oracle.node_count() == 6
+        for claim in solver.new_claims:
+            assert len(claim.pods) == 1
+
+    def test_hostname_anti_blocks_existing_holders(self):
+        resident = [mkpod("r0")]
+        n1 = mknode("n1", resident=resident)   # already holds a matching pod
+        n2 = mknode("n2")
+        pods = [mkpod(f"p{i}", pod_affinities=[anti()]) for i in range(2)]
+        inp = mkinput(pods, existing_nodes=[n1, n2])
+        oracle, solver = both(inp)
+        assert not solver.unschedulable
+        # n1 blocked; exactly one pod lands on n2, the other gets a new node
+        assert "n1" not in set(solver.existing_assignments.values())
+        assert list(solver.existing_assignments.values()).count("n2") == 1
+        assert solver.node_count() == oracle.node_count() == 1
+
+    def test_symmetric_anti_from_existing_pods(self):
+        # an existing pod with anti-affinity against app=web blocks web pods
+        # from its node even though the incoming pods carry no constraints
+        guard = mkpod("guard", labels={"app": "db"},
+                      pod_affinities=[anti(sel={"app": "web"})])
+        n1 = mknode("n1", resident=[guard])
+        n2 = mknode("n2")
+        pods = [mkpod(f"p{i}") for i in range(4)]
+        inp = mkinput(pods, existing_nodes=[n1, n2])
+        oracle, solver = both(inp)
+        assert not solver.unschedulable
+        assert "n1" not in set(solver.existing_assignments.values())
+        assert set(oracle.existing_assignments.values()) == {"n2"}
+        assert set(solver.existing_assignments.values()) == {"n2"}
+
+    def test_zone_anti_affinity_one_per_zone(self):
+        pods = [mkpod(f"p{i}", pod_affinities=[anti(key=ZONE)])
+                for i in range(5)]
+        inp = mkinput(pods)
+        oracle, solver = both(inp)
+        # 3 zones → 3 placed, 2 unschedulable (both engines)
+        assert len(solver.unschedulable) == len(oracle.unschedulable) == 2
+        counts = zone_counts(inp, solver)
+        assert all(v == 1 for v in counts.values())
+
+
+class TestCombined:
+    def test_config3_shape(self):
+        # BASELINE config #3 in miniature: anti-affinity + zonal spread
+        pods = [mkpod(f"p{i}",
+                      topology_spread=[spread()],
+                      pod_affinities=[anti()])   # 1 per node + zone balance
+                for i in range(12)]
+        inp = mkinput(pods)
+        oracle, solver = both(inp)
+        assert not solver.unschedulable
+        counts = zone_counts(inp, solver)
+        assert_skew_valid(counts, {}, 1)
+        for claim in solver.new_claims:
+            assert len(claim.pods) == 1
+        assert solver.node_count() == oracle.node_count() == 12
+
+    def test_mixed_constrained_and_plain_groups(self):
+        pods = ([mkpod(f"s{i}", topology_spread=[spread()]) for i in range(9)]
+                + [mkpod(f"plain{i}", cpu="1", mem="2Gi",
+                         labels={"app": "other"}) for i in range(20)])
+        inp = mkinput(pods)
+        oracle, solver = both(inp)
+        assert not solver.unschedulable
+        assert_skew_valid(zone_counts(inp, solver), {}, 1)
+        assert solver.node_count() <= oracle.node_count() + 2
+
+    def test_spread_pods_reuse_existing_nodes(self):
+        nodes = [mknode(f"n{z}", zone=z) for z in ZONES]
+        pods = [mkpod(f"p{i}", topology_spread=[spread()]) for i in range(30)]
+        inp = mkinput(pods, existing_nodes=nodes)
+        oracle, solver = both(inp)
+        assert not solver.unschedulable
+        assert solver.node_count() == oracle.node_count() == 0
+        assert_skew_valid(zone_counts(inp, solver), {}, 1)
+
+    def test_unsupported_two_dynamic_keys(self):
+        p = mkpod("p", topology_spread=[spread(key=ZONE), spread(key=CT)])
+        with pytest.raises(UnsupportedPods):
+            TPUSolver().solve(mkinput([p]))
+
+    def test_gated_solver_falls_back_for_unsupported(self):
+        # the full provisioner path: unsupported constraints must still
+        # schedule via the oracle, never fail (SURVEY §5)
+        p = mkpod("p", topology_spread=[spread(key=ZONE), spread(key=CT)])
+        inp = mkinput([p])
+        from karpenter_tpu.solver import TPUSolver as TS
+        try:
+            TS().solve(inp)
+            assert False, "expected UnsupportedPods"
+        except UnsupportedPods:
+            res = Scheduler(inp).solve()
+        assert not res.unschedulable
+
+
+class TestScale:
+    def test_config3_10k(self):
+        # BASELINE config #3: 10k pods with podAntiAffinity (hostname) in
+        # one workload + zonal spread in another — through the device kernel
+        spread_pods = [mkpod(f"sp{i}", cpu="250m", mem="512Mi",
+                             topology_spread=[spread()])
+                       for i in range(9000)]
+        anti_pods = [mkpod(f"an{i}", cpu="1", mem="2Gi",
+                           labels={"app": "singleton"},
+                           pod_affinities=[anti(sel={"app": "singleton"},
+                                                key=ZONE)])
+                     for i in range(3)]
+        inp = mkinput(spread_pods + anti_pods)
+        solver = TPUSolver(max_nodes=2048).solve(inp)
+        assert not solver.unschedulable
+        counts = zone_counts(inp, solver)
+        assert_skew_valid(counts, {}, 1)
+        assert sum(counts.values()) == 9000
