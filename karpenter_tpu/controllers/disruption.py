@@ -42,6 +42,7 @@ from karpenter_tpu.models.taints import NO_SCHEDULE, Taint
 from karpenter_tpu.operator.options import Options
 from karpenter_tpu.scheduling import ScheduleResult
 from karpenter_tpu.scheduling.types import ScheduleInput
+from karpenter_tpu.solver.solve import B_BUCKETS as SOLVER_B_BUCKETS
 from karpenter_tpu.utils import errors, metrics
 from karpenter_tpu.utils.clock import Clock
 
@@ -325,13 +326,20 @@ class Disruption:
             and c.reschedulable  # empties are handled by emptiness
         ]
 
+    # candidate sets per batched simulation call: one device call evaluates
+    # the whole chunk — linked to the solver's largest batch bucket so a
+    # disruption chunk never splits into multiple device calls
+    SIM_CHUNK = SOLVER_B_BUCKETS[-1]
+
     def _multi_node(self, candidates: List[Candidate]) -> bool:
         cands = self._consolidatable(candidates)
         if len(cands) < 2:
             return False
-        # shrink the cheapest-to-disrupt prefix until feasible
-        k = len(cands)
-        while k >= 2:
+        # shrink the cheapest-to-disrupt prefix until feasible, largest
+        # prefix first (the reference's heuristic subset search) — all
+        # prefix simulations batch onto the device in chunks
+        subsets: List[List[Candidate]] = []
+        for k in range(len(cands), 1, -1):
             subset = cands[:k]
             # budgets are per pool over the WHOLE subset — each pool must
             # allow as many concurrent disruptions as the subset takes
@@ -341,48 +349,68 @@ class Disruption:
             pools = {c.pool.name: c.pool for c in subset}
             if any(self._budget_allows(pools[name], REASON_UNDERUTILIZED, n) < n
                    for name, n in per_pool.items()):
-                k -= 1
                 continue
-            total_price = sum(c.price for c in subset)
-            sim = self._simulate(subset, price_cap=total_price)
-            if sim is not None and self._acceptable(subset, sim):
-                self._execute(REASON_UNDERUTILIZED, subset, sim)
-                return True
-            k -= 1
+            subsets.append(subset)
+        for start in range(0, len(subsets), self.SIM_CHUNK):
+            chunk = subsets[start:start + self.SIM_CHUNK]
+            sims = self._simulate_batch(
+                chunk, [sum(c.price for c in s) for s in chunk])
+            for subset, sim in zip(chunk, sims):
+                if sim is not None and self._acceptable(subset, sim):
+                    self._execute(REASON_UNDERUTILIZED, subset, sim)
+                    return True
         return False
 
     def _single_node(self, candidates: List[Candidate]) -> bool:
-        for cand in self._consolidatable(candidates):
-            if self._budget_allows(cand.pool, REASON_UNDERUTILIZED, 1) < 1:
-                continue
-            sim = self._simulate([cand], price_cap=cand.price)
-            if sim is not None and self._acceptable([cand], sim):
-                self._execute(REASON_UNDERUTILIZED, [cand], sim)
-                return True
+        cands = [c for c in self._consolidatable(candidates)
+                 if self._budget_allows(c.pool, REASON_UNDERUTILIZED, 1) >= 1]
+        for start in range(0, len(cands), self.SIM_CHUNK):
+            chunk = cands[start:start + self.SIM_CHUNK]
+            sims = self._simulate_batch(
+                [[c] for c in chunk], [c.price for c in chunk])
+            for cand, sim in zip(chunk, sims):
+                if sim is not None and self._acceptable([cand], sim):
+                    self._execute(REASON_UNDERUTILIZED, [cand], sim)
+                    return True
         return False
 
     # -- simulation -------------------------------------------------------
-    def _simulate(self, cands: List[Candidate],
-                  price_cap: Optional[float]) -> Optional[ScheduleResult]:
-        """Can the candidates' pods run on the remaining nodes, plus at most
-        one new (price-capped) node? None = infeasible."""
+    def _build_sim_input(self, cands: List[Candidate],
+                         price_cap: Optional[float]) -> ScheduleInput:
         pods = [p for c in cands for p in c.reschedulable]
         exclude = {c.node.name for c in cands}
         exclude_claims = {c.claim.name for c in cands}
-        inp = build_schedule_input(
+        return build_schedule_input(
             self.cluster, self.cp, pods,
             exclude_nodes=exclude, exclude_claims=exclude_claims,
             price_cap=price_cap)
-        result = self._solve(inp)
+
+    @staticmethod
+    def _admissible(result: ScheduleResult) -> Optional[ScheduleResult]:
         if result.unschedulable:
             return None
         if len(result.new_claims) > 1:
             return None  # minimal change: at most one replacement node
         return result
 
-    def _solve(self, inp: ScheduleInput) -> ScheduleResult:
+    def _simulate(self, cands: List[Candidate],
+                  price_cap: Optional[float]) -> Optional[ScheduleResult]:
+        """Can the candidates' pods run on the remaining nodes, plus at most
+        one new (price-capped) node? None = infeasible."""
+        inp = self._build_sim_input(cands, price_cap)
         with metrics.SCHEDULING_SIMULATION_DURATION.time():
-            return self.solver.solve(inp, source="disruption")
+            return self._admissible(self.solver.solve(inp, source="disruption"))
+
+    def _simulate_batch(self, cand_sets: List[List[Candidate]],
+                        price_caps: List[Optional[float]]):
+        """Lazy iterator of admissible results: with the oracle fallback the
+        underlying solve runs per-consumed item, so a caller that acts on
+        the first acceptable candidate pays for exactly the simulations it
+        looked at (per-simulation metrics recorded in GatedSolver)."""
+        inps = [self._build_sim_input(cs, cap)
+                for cs, cap in zip(cand_sets, price_caps)]
+        results = self.solver.solve_batch(inps, source="disruption")
+        return (self._admissible(r) for r in results)
 
     def _acceptable(self, cands: List[Candidate],
                     sim: ScheduleResult) -> bool:

@@ -41,6 +41,40 @@ class GatedSolver:
                     "Provisioner", source, "SolverFallback", str(e))
         return Scheduler(inp).solve()
 
+    def solve_batch(self, inps: List[ScheduleInput],
+                    source: str = "disruption"):
+        """Batched simulations sharing one cluster snapshot (consolidation's
+        candidate axis). Returns an iterable: the device path is one eager
+        vmapped call; the oracle fallback is LAZY, so a caller that stops at
+        the first acceptable result (the disruption loop) never pays for the
+        simulations it doesn't consume. Each simulation records one
+        observation on the per-simulation duration histogram."""
+        import time as _time
+
+        from karpenter_tpu.scheduling import Scheduler
+        from karpenter_tpu.solver import UnsupportedPods
+        from karpenter_tpu.utils import metrics
+        if self.options.feature_gates.tpu_solver:
+            try:
+                t0 = _time.perf_counter()
+                results = self.tpu.solve_batch(inps)
+                if results:
+                    per = (_time.perf_counter() - t0) / len(results)
+                    for _ in results:
+                        metrics.SCHEDULING_SIMULATION_DURATION.observe(per)
+                return results
+            except UnsupportedPods:
+                pass
+            except Exception as e:  # noqa: BLE001
+                self.cluster.record_event(
+                    "Provisioner", source, "SolverFallback", str(e))
+
+        def _lazy():
+            for inp in inps:
+                with metrics.SCHEDULING_SIMULATION_DURATION.time():
+                    yield Scheduler(inp).solve()
+        return _lazy()
+
 
 def daemon_overhead(cluster: Cluster, pool: NodePool) -> Resources:
     """Aggregate requests of daemonset pods a new node in this pool would
@@ -71,21 +105,6 @@ def remaining_limit(cluster: Cluster, pool: NodePool,
     return pool.limits - used
 
 
-def price_capped_types(types: List[InstanceType],
-                       price_cap: float) -> List[InstanceType]:
-    """Restrict offerings to those strictly cheaper than the cap — the
-    consolidation simulator only considers cheaper replacements
-    (designs/consolidation.md node-replacement cost rule)."""
-    out: List[InstanceType] = []
-    for it in types:
-        offs = [o for o in it.offerings if o.available and o.price < price_cap]
-        if not offs:
-            continue
-        out.append(InstanceType(
-            name=it.name, capacity=it.capacity,
-            requirements=it.requirements, offerings=offs,
-            overhead=it.overhead))
-    return out
 
 
 def build_schedule_input(
@@ -98,12 +117,11 @@ def build_schedule_input(
 ) -> ScheduleInput:
     pools: List[NodePool] = cluster.nodepools.list(
         lambda np_: not np_.meta.deleting)
-    instance_types: Dict[str, List[InstanceType]] = {}
-    for p in pools:
-        types = cp.get_instance_types(p.node_class_ref)
-        if price_cap is not None:
-            types = price_capped_types(types, price_cap)
-        instance_types[p.name] = types
+    # NOTE: price_cap rides on ScheduleInput instead of pre-filtering the
+    # type lists — filtering would hand the TPU solver a fresh list object
+    # per simulation and thrash its device-resident catalog cache
+    instance_types: Dict[str, List[InstanceType]] = {
+        p.name: cp.get_instance_types(p.node_class_ref) for p in pools}
 
     existing: List[ExistingNode] = []
     for node in cluster.nodes.list(lambda n: not n.meta.deleting):
@@ -124,4 +142,5 @@ def build_schedule_input(
         daemon_overhead={p.name: daemon_overhead(cluster, p) for p in pools},
         remaining_limits={
             p.name: remaining_limit(cluster, p, exclude_claims) for p in pools},
+        price_cap=price_cap,
     )

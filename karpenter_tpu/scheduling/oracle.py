@@ -103,6 +103,12 @@ class _NewSim:
 
 class Scheduler:
     def __init__(self, inp: ScheduleInput):
+        if inp.price_cap is not None:
+            import dataclasses
+            from karpenter_tpu.scheduling.types import price_capped_types
+            inp = dataclasses.replace(inp, instance_types={
+                k: price_capped_types(v, inp.price_cap)
+                for k, v in inp.instance_types.items()})
         self.inp = inp
         self.tracker = TopologyTracker()
         self.existing = [_ExistingSim(en) for en in inp.existing_nodes]

@@ -68,6 +68,27 @@ class ScheduleInput:
     # nodepool name → resources still allowed under NodePool.spec.limits
     # (None = unlimited)
     remaining_limits: Dict[str, Optional[Resources]] = field(default_factory=dict)
+    # consolidation simulations only consider replacements strictly cheaper
+    # than the disrupted candidates (designs/consolidation.md). Carried as a
+    # field (not pre-filtered type lists) so the TPU solver can apply it as
+    # a column mask without invalidating its cached catalog encoding.
+    price_cap: Optional[float] = None
+
+
+def price_capped_types(types: List[InstanceType], price_cap: float) -> List[InstanceType]:
+    """Restrict offerings to those strictly cheaper than the cap — the
+    consolidation simulator only considers cheaper replacements
+    (designs/consolidation.md node-replacement cost rule)."""
+    out: List[InstanceType] = []
+    for it in types:
+        offs = [o for o in it.offerings if o.available and o.price < price_cap]
+        if not offs:
+            continue
+        out.append(InstanceType(
+            name=it.name, capacity=it.capacity,
+            requirements=it.requirements, offerings=offs,
+            overhead=it.overhead))
+    return out
 
 
 @dataclass

@@ -124,8 +124,7 @@ def _water_fill(cnt, base, xmax, elig, skew, mindom):
     return jnp.minimum(x, cnt)
 
 
-@partial(jax.jit, static_argnames=("max_nodes",))
-def solve_ffd(
+def _solve_ffd_impl(
     group_req: jnp.ndarray,       # [G, R]
     group_count: jnp.ndarray,     # [G]
     group_mask: jnp.ndarray,      # [G, O] bool
@@ -453,6 +452,25 @@ def solve_ffd(
         final["num_active"][None].astype(jnp.float32),        # 1
     ])
     return packed
+
+
+solve_ffd = partial(jax.jit, static_argnames=("max_nodes",))(_solve_ffd_impl)
+
+# The consolidation simulator's batch axis (SURVEY §7 step 6): many
+# candidate-removal simulations against one cluster state share the catalog
+# (columns replicated) while per-candidate pods/existing/limits vmap over
+# the leading axis — one device call evaluates the whole candidate set.
+_BATCH_AXES = (0, 0, 0, 0, 0,          # group_req..exist_remaining
+               None, None, None, None,  # col_alloc..pool_daemon (shared)
+               0,                       # pool_limit
+               0, 0, 0, 0, 0, 0, 0,     # topology group arrays
+               None, None,              # col_zone, col_ct (shared)
+               0, 0)                    # exist_zone, exist_ct
+
+@partial(jax.jit, static_argnames=("max_nodes",))
+def solve_ffd_batch(*args, max_nodes: int = 1024):
+    return jax.vmap(partial(_solve_ffd_impl, max_nodes=max_nodes),
+                    in_axes=_BATCH_AXES)(*args)
 
 
 def unpack(packed, G: int, E: int, N: int, RDIM: int, D: int):

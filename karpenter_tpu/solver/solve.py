@@ -34,6 +34,7 @@ R = len(RESOURCE_AXIS)
 
 G_BUCKETS = (8, 32, 128, 512, 2048)
 E_BUCKETS = (0, 64, 512, 4096)
+B_BUCKETS = (4, 16, 64)  # simulate-batch axis (SURVEY §7 step 6)
 O_ALIGN = 512
 
 
@@ -98,12 +99,54 @@ class TPUSolver:
         widths[axis] = (0, pad)
         return np.pad(arr, widths, constant_values=value)
 
-    def solve(self, inp: ScheduleInput) -> ScheduleResult:
-        cat = self._catalog_encoding(inp)
+    def _encode_checked(self, inp: ScheduleInput, cat) -> EncodedProblem:
         try:
             enc = encode(inp, cat)
         except Unsupported as e:
             raise UnsupportedPods(str(e)) from e
+        if inp.price_cap is not None:
+            # consolidation price cap as a column mask — the cached catalog
+            # encoding stays untouched (see ScheduleInput.price_cap)
+            enc.group_mask &= (cat.col_price < inp.price_cap)[None, :]
+        return enc
+
+    def _problem_args(self, enc: EncodedProblem, G: int, E: int, Db: int, O: int):
+        """The per-problem (non-catalog) kernel arguments, padded."""
+        return (
+            self._pad(enc.group_req, 0, G),
+            self._pad(enc.group_count, 0, G),
+            self._pad(self._pad(enc.group_mask, 1, O), 0, G),
+            self._pad(self._pad(enc.exist_cap, 1, E), 0, G),
+            self._pad(enc.exist_remaining, 0, E),
+            enc.pool_limit,
+            self._pad(enc.group_ncap, 0, G),
+            self._pad(enc.group_dsel, 0, G),
+            self._pad(self._pad(enc.group_dbase, 1, Db), 0, G),
+            # pad domains take no quota (cap 0) and stay out of the skew min
+            self._pad(self._pad(enc.group_dcap, 1, Db), 0, G),
+            self._pad(enc.group_skew, 0, G),
+            self._pad(enc.group_mindom, 0, G),
+            self._pad(self._pad(enc.group_delig, 1, Db), 0, G),
+            self._pad(enc.exist_zone, 0, E, value=-1),
+            self._pad(enc.exist_ct, 0, E, value=-1),
+        )
+
+    @staticmethod
+    def _assemble(dev, prob):
+        """Interleave per-problem and shared catalog args in kernel order."""
+        (group_req, group_count, group_mask, exist_cap, exist_remaining,
+         pool_limit, group_ncap, group_dsel, group_dbase, group_dcap,
+         group_skew, group_mindom, group_delig, exist_zone, exist_ct) = prob
+        return (group_req, group_count, group_mask, exist_cap, exist_remaining,
+                dev["col_alloc"], dev["col_daemon"], dev["col_pool"],
+                dev["pool_daemon"], pool_limit,
+                group_ncap, group_dsel, group_dbase, group_dcap,
+                group_skew, group_mindom, group_delig,
+                dev["col_zone"], dev["col_ct"], exist_zone, exist_ct)
+
+    def solve(self, inp: ScheduleInput) -> ScheduleResult:
+        cat = self._catalog_encoding(inp)
+        enc = self._encode_checked(inp, cat)
         if enc.n_groups == 0:
             return ScheduleResult()
         if enc.n_columns == 0:
@@ -122,36 +165,54 @@ class TPUSolver:
         E = bucket(len(enc.existing), E_BUCKETS)
         Db = bucket(enc.n_domains, D_BUCKETS)
         dev = cat.device_args
-        O = dev["O"]
-
-        packed = ffd.solve_ffd(
-            self._pad(enc.group_req, 0, G),
-            self._pad(enc.group_count, 0, G),
-            self._pad(self._pad(enc.group_mask, 1, O), 0, G),
-            self._pad(self._pad(enc.exist_cap, 1, E), 0, G),
-            self._pad(enc.exist_remaining, 0, E),
-            dev["col_alloc"],
-            dev["col_daemon"],
-            dev["col_pool"],
-            dev["pool_daemon"],
-            enc.pool_limit,
-            self._pad(enc.group_ncap, 0, G),
-            self._pad(enc.group_dsel, 0, G),
-            self._pad(self._pad(enc.group_dbase, 1, Db), 0, G),
-            # pad domains take no quota (cap 0) and stay out of the skew min
-            self._pad(self._pad(enc.group_dcap, 1, Db), 0, G),
-            self._pad(enc.group_skew, 0, G),
-            self._pad(enc.group_mindom, 0, G),
-            self._pad(self._pad(enc.group_delig, 1, Db), 0, G),
-            dev["col_zone"],
-            dev["col_ct"],
-            self._pad(enc.exist_zone, 0, E, value=-1),
-            self._pad(enc.exist_ct, 0, E, value=-1),
-            max_nodes=self.max_nodes,
-        )
+        args = self._assemble(dev, self._problem_args(enc, G, E, Db, dev["O"]))
+        packed = ffd.solve_ffd(*args, max_nodes=self.max_nodes)
         out = ffd.unpack(packed, G, E, self.max_nodes, R, Db)
         self._repair_topology(enc, out)
         return self._decode(enc, out)
+
+    def solve_batch(self, inps: List[ScheduleInput]) -> List[ScheduleResult]:
+        """Evaluate many scheduling problems that share one catalog — the
+        consolidation simulator's candidate axis (SURVEY §3.3 HOT LOOP #2:
+        'many candidates against one cluster state, a natural extra batch
+        axis the Go code can't exploit'). One vmapped device call per chunk;
+        per-problem pods/existing/limits batch, catalog columns replicate.
+
+        All inputs must come from the same cluster snapshot (same nodepools
+        and instance-type lists); `price_cap` may differ per input.
+        """
+        if not inps:
+            return []
+        cat = self._catalog_encoding(inps[0])
+        encs = [self._encode_checked(inp, cat) for inp in inps]
+        if len(cat.columns) == 0:
+            return [self.solve(inp) for inp in inps]
+
+        G = bucket(max(e.n_groups for e in encs), G_BUCKETS)
+        E = bucket(max(len(e.existing) for e in encs), E_BUCKETS)
+        Db = bucket(max(e.n_domains for e in encs), D_BUCKETS)
+        dev = cat.device_args
+        O = dev["O"]
+
+        results: List[ScheduleResult] = []
+        chunk_size = B_BUCKETS[-1]
+        for start in range(0, len(encs), chunk_size):
+            chunk = encs[start:start + chunk_size]
+            B = bucket(len(chunk), B_BUCKETS)
+            probs = [self._problem_args(e, G, E, Db, O) for e in chunk]
+            # pad the batch axis with empty problems (zero groups = no work)
+            # so repeat calls hit the jit cache at bucketed shapes
+            while len(probs) < B:
+                probs.append(tuple(np.zeros_like(a) for a in probs[0]))
+            stacked = tuple(np.stack(parts) for parts in zip(*probs))
+            packed = ffd.solve_ffd_batch(
+                *self._assemble(dev, stacked), max_nodes=self.max_nodes)
+            packed = np.array(packed)
+            for bi, enc in enumerate(chunk):
+                out = ffd.unpack(packed[bi], G, E, self.max_nodes, R, Db)
+                self._repair_topology(enc, out)
+                results.append(self._decode(enc, out))
+        return results
 
     def _existing_only(self, enc: EncodedProblem) -> ScheduleResult:
         """Host-side step-1-only fill when there are no columns to buy."""

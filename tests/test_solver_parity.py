@@ -311,3 +311,86 @@ class TestReviewRegressions:
         inp2 = ScheduleInput(pods=[mkpod("y")], nodepools=[a2, b2],
                              instance_types={"a": shared, "b": shared})
         assert solver.solve(inp2).new_claims[0].nodepool == "b"
+
+
+class TestSolveBatch:
+    """The consolidation simulator's candidate batch axis (SURVEY §7 step 6):
+    one vmapped device call must agree with sequential solve() calls."""
+
+    def _inputs(self):
+        from karpenter_tpu.models import Node
+        shared = list(CATALOG)
+        pool = NodePool(meta=ObjectMeta(name="default"))
+        inps = []
+        for b in range(5):
+            node = Node(
+                meta=ObjectMeta(name=f"n{b}", labels={
+                    wellknown.ZONE_LABEL: "tpu-west-1a",
+                    wellknown.NODEPOOL_LABEL: "default",
+                    wellknown.ARCH_LABEL: "amd64",
+                    wellknown.OS_LABEL: "linux",
+                    wellknown.HOSTNAME_LABEL: f"n{b}",
+                }),
+                allocatable=Resources.of(cpu=8000, memory=16384, pods=29),
+                ready=True)
+            en = ExistingNode(node=node, available=node.allocatable.copy())
+            pods = [mkpod(f"b{b}-p{i}", cpu="500m") for i in range(3 + b * 4)]
+            inps.append(ScheduleInput(
+                pods=pods, nodepools=[pool],
+                instance_types={"default": shared},
+                existing_nodes=[en] if b % 2 else []))
+        return inps
+
+    def test_batch_matches_sequential(self):
+        inps = self._inputs()
+        solver = TPUSolver()
+        batched = solver.solve_batch(inps)
+        for inp, res in zip(inps, batched):
+            single = TPUSolver().solve(inp)
+            assert set(res.existing_assignments) == set(single.existing_assignments)
+            assert set(res.unschedulable) == set(single.unschedulable)
+            assert res.node_count() == single.node_count()
+            assert abs(res.total_price() - single.total_price()) < 1e-6
+
+    def test_batch_price_cap(self):
+        import dataclasses
+        pool = NodePool(meta=ObjectMeta(name="default"))
+        base = ScheduleInput(pods=[mkpod("p0", cpu="2", mem="4Gi")],
+                             nodepools=[pool],
+                             instance_types={"default": list(CATALOG)})
+        uncapped = TPUSolver().solve(base)
+        cheap = uncapped.new_claims[0].price
+        # cap below the cheapest feasible price → unschedulable
+        capped = dataclasses.replace(base, price_cap=cheap * 0.5)
+        generous = dataclasses.replace(base, price_cap=cheap * 10)
+        solver = TPUSolver()
+        r_capped, r_generous = solver.solve_batch([capped, generous])
+        assert r_capped.unschedulable
+        assert not r_generous.unschedulable
+        assert r_generous.new_claims[0].price < cheap * 10
+        # oracle agrees on the capped case
+        assert Scheduler(capped).solve().unschedulable
+
+    def test_batch_empty_and_topology(self):
+        from karpenter_tpu.models import TopologySpreadConstraint
+        pool = NodePool(meta=ObjectMeta(name="default"))
+        shared = list(CATALOG)
+        spread_pods = [
+            mkpod(f"s{i}", labels={"app": "web"}, topology_spread=[
+                TopologySpreadConstraint(topology_key=wellknown.ZONE_LABEL,
+                                         label_selector={"app": "web"})])
+            for i in range(6)]
+        inps = [
+            ScheduleInput(pods=[], nodepools=[pool],
+                          instance_types={"default": shared}),
+            ScheduleInput(pods=spread_pods, nodepools=[pool],
+                          instance_types={"default": shared}),
+        ]
+        empty_res, spread_res = TPUSolver().solve_batch(inps)
+        assert empty_res.node_count() == 0
+        assert not spread_res.unschedulable
+        zones = set()
+        for c in spread_res.new_claims:
+            (z,) = c.requirements.get(wellknown.ZONE_LABEL).values()
+            zones.add(z)
+        assert len(zones) == 3
