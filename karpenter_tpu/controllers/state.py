@@ -23,10 +23,20 @@ class GatedSolver:
     fail — SURVEY §5)."""
 
     def __init__(self, options, cluster: Cluster):
-        from karpenter_tpu.solver import TPUSolver
         self.options = options
         self.cluster = cluster
-        self.tpu = TPUSolver(max_nodes=options.solver_max_nodes)
+        if options.solver_endpoint:
+            # remote TPU-owning solver process (native/solverd.cc): same
+            # solve/solve_batch seam, coalesced in the daemon's window
+            from karpenter_tpu.service import SolverServiceClient
+            self.tpu = SolverServiceClient(options.solver_endpoint)
+        else:
+            from karpenter_tpu.solver import TPUSolver
+            self.tpu = TPUSolver(max_nodes=options.solver_max_nodes)
+            # warm the native host-ops build at startup, never inside a
+            # latency-sensitive solve
+            from karpenter_tpu.native import hostops
+            hostops()
 
     def solve(self, inp: ScheduleInput, source: str = "solver"):
         from karpenter_tpu.scheduling import Scheduler

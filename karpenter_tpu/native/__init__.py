@@ -1,0 +1,73 @@
+"""Loader for the native (C++) solver-boundary components.
+
+The extension is optional: if `native/build/` holds a compiled
+`kt_hostops` it is used, otherwise we try ONE `make hostops` (the
+toolchain is in the image; the build takes ~2s) and fall back to the pure
+Python implementations on any failure. `KARPENTER_TPU_NO_NATIVE=1`
+disables both the build attempt and the load — the differential tests use
+it to pin the Python path.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+import sysconfig
+from typing import Optional
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native")
+_BUILD_DIR = os.path.join(_NATIVE_DIR, "build")
+
+import threading
+
+_hostops = None
+_attempted = False
+_build_lock = threading.Lock()
+
+
+def _ext_path() -> str:
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    return os.path.join(_BUILD_DIR, f"kt_hostops{suffix}")
+
+
+def _load(path: str):
+    spec = importlib.util.spec_from_file_location("kt_hostops", path)
+    if spec is None or spec.loader is None:
+        return None
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    sys.modules.setdefault("kt_hostops", mod)
+    return mod
+
+
+def hostops() -> Optional[object]:
+    """The kt_hostops module, building it on first use; None if unavailable.
+
+    The build happens at most once (lock-guarded: two controllers racing
+    here must not spawn two `make`s over the same output file). Call this
+    eagerly at operator startup — GatedSolver does — so the compiler never
+    runs inside a latency-sensitive solve.
+    """
+    global _hostops, _attempted
+    if _hostops is not None:
+        return _hostops
+    if os.environ.get("KARPENTER_TPU_NO_NATIVE"):
+        return None
+    with _build_lock:
+        if _attempted:
+            return _hostops
+        _attempted = True
+        path = _ext_path()
+        try:
+            if not os.path.exists(path):
+                subprocess.run(
+                    ["make", "-s", "hostops"], cwd=_NATIVE_DIR, timeout=120,
+                    check=True, capture_output=True)
+            _hostops = _load(path)
+        except Exception:  # noqa: BLE001 — any failure means Python fallback
+            _hostops = None
+    return _hostops

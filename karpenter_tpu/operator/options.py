@@ -49,6 +49,10 @@ class Options:
     registration_ttl: float = 15 * 60.0   # never-registered GC (designs/limits.md:23-25)
     # solver
     solver_max_nodes: int = 1024
+    # unix-socket path of a kt_solverd solver service (native/solverd.cc);
+    # None = in-process solver. Lets control-plane replicas share one
+    # TPU-owning process (SURVEY §2.3 leader-election note).
+    solver_endpoint: "str | None" = None
     feature_gates: FeatureGates = field(default_factory=FeatureGates)
 
     @classmethod
@@ -61,4 +65,6 @@ class Options:
             opts.batch_max_duration = float(os.environ["BATCH_MAX_DURATION"])
         if "FEATURE_GATES" in os.environ:
             opts.feature_gates = FeatureGates.parse(os.environ["FEATURE_GATES"])
+        opts.solver_endpoint = os.environ.get(
+            "SOLVER_ENDPOINT", opts.solver_endpoint)
         return opts

@@ -1,0 +1,11 @@
+"""The solver service boundary (SURVEY §2/§5): control-plane replicas talk
+to one TPU-owning solver process over a framed unix socket. The daemon
+(`native/solverd.cc`, C++) owns IO, threading, and the request-coalescing
+window — the reference's `pkg/batcher` pattern natively — and hands each
+batch to `backend.handle_batch` in its embedded interpreter, where
+catalog-sharing requests fuse into one vmapped device solve.
+"""
+
+from karpenter_tpu.service.client import SolverServiceClient
+
+__all__ = ["SolverServiceClient"]

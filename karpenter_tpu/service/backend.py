@@ -1,0 +1,135 @@
+"""Server-side solver backend, executed inside kt_solverd's embedded
+CPython interpreter (native/solverd.cc).
+
+Requests are pickled tuples `(kind, body)`:
+
+  ("catalog", {"fingerprint", "nodepools", "instance_types"})
+      Upload + content-address a catalog. The cross-process analogue of
+      the solver's device-resident catalog discipline (SURVEY §7 step 2:
+      uploaded once per change, not per call): schedule requests then
+      reference it by fingerprint, and because the server reuses the SAME
+      list objects per fingerprint, TPUSolver's identity-keyed device
+      cache holds across requests.
+  ("schedule", {"fingerprint", "pods", "existing_nodes", "daemon_overhead",
+                "remaining_limits", "price_cap"})
+      One scheduling problem. All schedule requests in a batch that share
+      a fingerprint fuse into ONE vmapped device call (solve_batch).
+
+Responses: ("result", ScheduleResult) | ("ok", None) |
+           ("need_catalog", None) | ("error", message).
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Dict, List, Optional, Tuple
+
+_catalogs: Dict[str, Tuple[list, dict]] = {}
+_solver = None
+# per-handle_batch sizes of the schedule groups actually fused onto the
+# device — exposed via the ("stats", _) request for tests/observability
+_batch_log: List[int] = []
+
+
+def _get_solver():
+    global _solver
+    if _solver is None:
+        import os
+        if os.environ.get("KARPENTER_TPU_FORCE_CPU"):
+            # env alone is not enough: site bootstraps (axon) set
+            # jax_platforms via jax.config, which beats JAX_PLATFORMS
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        from karpenter_tpu.solver import TPUSolver
+        _solver = TPUSolver(max_nodes=2048)
+    return _solver
+
+
+def _solve_group(inps: List) -> List:
+    """Device batch with per-input oracle fallback (never fail — SURVEY §5)."""
+    from karpenter_tpu.scheduling import Scheduler
+    from karpenter_tpu.solver import UnsupportedPods
+    try:
+        return _get_solver().solve_batch(inps)
+    except UnsupportedPods:
+        return [Scheduler(inp).solve() for inp in inps]
+
+
+def handle_batch(payloads: List[bytes]) -> List[bytes]:
+    from karpenter_tpu.scheduling import ScheduleInput
+
+    n = len(payloads)
+    responses: List[Optional[tuple]] = [None] * n
+    requests: List[Optional[tuple]] = [None] * n
+    for i, raw in enumerate(payloads):
+        # one replica's malformed frame must never poison the coalesced
+        # batch — validate shape per request, answer per request
+        try:
+            req = pickle.loads(raw)
+            if not (isinstance(req, tuple) and len(req) == 2
+                    and isinstance(req[1], dict)):
+                raise ValueError("request must be a (kind, body-dict) tuple")
+            requests[i] = req
+        except Exception as e:  # noqa: BLE001
+            responses[i] = ("error", f"unpicklable request: {e}")
+
+    # catalog uploads first so same-batch schedule requests can use them
+    for i, req in enumerate(requests):
+        if req is None or responses[i] is not None:
+            continue
+        kind, body = req
+        if kind == "catalog":
+            try:
+                _catalogs[body["fingerprint"]] = (
+                    body["nodepools"], body["instance_types"])
+                responses[i] = ("ok", None)
+            except KeyError as e:
+                responses[i] = ("error", f"catalog body missing {e}")
+        elif kind == "stats":
+            responses[i] = ("result", {"batch_sizes": list(_batch_log),
+                                       "catalogs": len(_catalogs)})
+
+    # schedule requests grouped by catalog fingerprint → one device batch
+    # per group (the coalescing the C++ window exists to enable)
+    by_fp: Dict[str, List[int]] = {}
+    for i, req in enumerate(requests):
+        if req is None or responses[i] is not None:
+            continue
+        kind, body = req
+        if kind != "schedule":
+            responses[i] = ("error", f"unknown request kind {kind!r}")
+            continue
+        fp = body.get("fingerprint")
+        if "pods" not in body:
+            responses[i] = ("error", "schedule body missing pods")
+            continue
+        if fp not in _catalogs:
+            responses[i] = ("need_catalog", None)
+            continue
+        by_fp.setdefault(fp, []).append(i)
+
+    for fp, idxs in by_fp.items():
+        _batch_log.append(len(idxs))
+        nodepools, instance_types = _catalogs[fp]
+        inps = []
+        for i in idxs:
+            body = requests[i][1]
+            inps.append(ScheduleInput(
+                pods=body["pods"],
+                nodepools=nodepools,
+                instance_types=instance_types,
+                existing_nodes=body.get("existing_nodes") or [],
+                daemon_overhead=body.get("daemon_overhead") or {},
+                remaining_limits=body.get("remaining_limits") or {},
+                price_cap=body.get("price_cap"),
+            ))
+        try:
+            results = _solve_group(inps)
+            for i, res in zip(idxs, results):
+                responses[i] = ("result", res)
+        except Exception as e:  # noqa: BLE001
+            for i in idxs:
+                responses[i] = ("error", f"solve failed: {e}")
+
+    return [pickle.dumps(r, protocol=pickle.HIGHEST_PROTOCOL)
+            for r in responses]

@@ -1,0 +1,237 @@
+"""Client for the kt_solverd solver service (native/solverd.cc).
+
+Framing: u32 payload_len | u64 request_id | payload (both directions;
+responses may arrive out of order). Payloads are pickled (kind, body)
+tuples — see service/backend.py.
+
+`SolverServiceClient` exposes the solver seam (`solve` / `solve_batch`)
+so the control plane can point `GatedSolver` at a remote TPU-owning
+process instead of the in-process solver. Catalogs are uploaded once per
+content fingerprint (cached against the instance-type lists' identity,
+the same invalidation signal TPUSolver uses) and referenced by hash
+thereafter, keeping the steady-state request small: pods + cluster deltas
+only. Concurrent requests coalesce in the daemon's native batch window
+into one vmapped device call.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import pickle
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from karpenter_tpu.scheduling.types import ScheduleInput, ScheduleResult
+
+
+class SolverServiceError(RuntimeError):
+    pass
+
+
+class SolverServiceClient:
+    def __init__(self, socket_path: str, timeout: float = 60.0):
+        self.socket_path = socket_path
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._wlock = threading.Lock()
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._pending: Dict[int, "threading.Event"] = {}
+        self._responses: Dict[int, tuple] = {}
+        self._reader: Optional[threading.Thread] = None
+        # instance-type list identity → (fingerprint, payload). The strong
+        # refs in _strong keep `id()`-keyed invalidation sound (a freed
+        # list's address could be recycled — same discipline as TPUSolver)
+        self._fingerprints: Dict[tuple, Tuple[str, bytes]] = {}
+        self._strong: Dict[str, tuple] = {}
+        self._uploaded: set = set()
+
+    # -- connection -------------------------------------------------------
+    def _ensure_connected(self) -> socket.socket:
+        with self._lock:
+            if self._sock is not None:
+                return self._sock
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.settimeout(self.timeout)
+            s.connect(self.socket_path)
+            self._sock = s
+            # a fresh connection may face a restarted daemon with an empty
+            # catalog store — re-upload on demand
+            self._uploaded.clear()
+            self._reader = threading.Thread(
+                target=self._read_loop, args=(s,), daemon=True)
+            self._reader.start()
+            return s
+
+    def close(self) -> None:
+        with self._lock:
+            sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _read_loop(self, sock: socket.socket) -> None:
+        try:
+            while True:
+                header = self._read_exact(sock, 12)
+                if header is None:
+                    break
+                plen, rid = struct.unpack("<IQ", header)
+                payload = self._read_exact(sock, plen)
+                if payload is None:
+                    break
+                try:
+                    resp = pickle.loads(payload)
+                except Exception as e:  # noqa: BLE001
+                    resp = ("error", f"undecodable response: {e}")
+                with self._lock:
+                    self._responses[rid] = resp
+                    ev = self._pending.get(rid)
+                if ev is not None:
+                    ev.set()
+        except OSError:
+            pass
+        # connection died: drop the socket so the next call reconnects, and
+        # release every waiter
+        with self._lock:
+            if self._sock is sock:
+                self._sock = None
+            for rid, ev in self._pending.items():
+                self._responses.setdefault(
+                    rid, ("error", "connection to solver service lost"))
+                ev.set()
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    @staticmethod
+    def _read_exact(sock, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    # -- framing ----------------------------------------------------------
+    def _send(self, kind: str, body: dict) -> int:
+        sock = self._ensure_connected()
+        payload = pickle.dumps((kind, body), protocol=pickle.HIGHEST_PROTOCOL)
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+            self._pending[rid] = threading.Event()
+        frame = struct.pack("<IQ", len(payload), rid) + payload
+        try:
+            with self._wlock:
+                sock.sendall(frame)
+        except OSError as e:
+            with self._lock:
+                self._pending.pop(rid, None)
+                if self._sock is sock:
+                    self._sock = None
+            raise SolverServiceError(f"solver service send failed: {e}") from e
+        return rid
+
+    def _wait(self, rid: int) -> tuple:
+        with self._lock:
+            ev = self._pending[rid]
+        if not ev.wait(self.timeout):
+            with self._lock:
+                self._pending.pop(rid, None)
+                self._responses.pop(rid, None)
+            raise SolverServiceError("solver service timed out")
+        with self._lock:
+            self._pending.pop(rid, None)
+            resp = self._responses.pop(rid)
+        if not (isinstance(resp, tuple) and len(resp) == 2):
+            # the daemon's internal-error marker (pickled None) or any
+            # other malformed response
+            raise SolverServiceError("solver service internal error")
+        return resp
+
+    # -- catalog fingerprinting -------------------------------------------
+    def _fingerprint(self, inp: ScheduleInput) -> Tuple[str, bytes]:
+        pools = sorted(inp.nodepools, key=lambda p: (-p.weight, p.meta.name))
+        lists = tuple(id(inp.instance_types.get(p.name)) for p in pools)
+        # key mirrors TPUSolver._catalog_encoding: list identity AND pool
+        # spec content (name/weight/static hash) — a pool edit that leaves
+        # the type lists untouched must still re-upload
+        key = (lists,
+               tuple((p.meta.name, p.weight, p.static_hash()) for p in pools))
+        cached = self._fingerprints.get(key)
+        if cached is None:
+            if len(self._fingerprints) >= 8:
+                # superseded catalogs would otherwise pin multi-MB payloads
+                # and dead instance-type lists forever
+                self._fingerprints.clear()
+                self._strong.clear()
+            payload = pickle.dumps(
+                {"nodepools": pools, "instance_types": inp.instance_types},
+                protocol=pickle.HIGHEST_PROTOCOL)
+            fp = hashlib.sha256(payload).hexdigest()
+            cached = (fp, payload)
+            self._fingerprints[key] = cached
+            self._strong[fp] = tuple(inp.instance_types.values())
+        return cached[0], cached[1]
+
+    def _ensure_catalog(self, fp: str, payload: bytes) -> None:
+        if fp in self._uploaded:
+            return
+        body = pickle.loads(payload)
+        rid = self._send("catalog", {
+            "fingerprint": fp,
+            "nodepools": body["nodepools"],
+            "instance_types": body["instance_types"],
+        })
+        kind, _ = self._wait(rid)
+        if kind != "ok":
+            raise SolverServiceError(f"catalog upload failed: {kind}")
+        self._uploaded.add(fp)
+
+    def stats(self) -> dict:
+        """Server-side batch/coalescing counters (observability + tests)."""
+        rid = self._send("stats", {})
+        kind, body = self._wait(rid)
+        if kind != "result":
+            raise SolverServiceError(f"stats failed: {body}")
+        return body
+
+    # -- the solver seam ---------------------------------------------------
+    def solve(self, inp: ScheduleInput) -> ScheduleResult:
+        return self.solve_batch([inp])[0]
+
+    def solve_batch(self, inps: List[ScheduleInput]) -> List[ScheduleResult]:
+        if not inps:
+            return []
+        fp, payload = self._fingerprint(inps[0])
+        self._ensure_catalog(fp, payload)
+        rids = []
+        for inp in inps:
+            f, p = self._fingerprint(inp)
+            self._ensure_catalog(f, p)
+            rids.append(self._send("schedule", {
+                "fingerprint": f,
+                "pods": inp.pods,
+                "existing_nodes": inp.existing_nodes,
+                "daemon_overhead": inp.daemon_overhead,
+                "remaining_limits": inp.remaining_limits,
+                "price_cap": inp.price_cap,
+            }))
+        out: List[ScheduleResult] = []
+        for rid in rids:
+            kind, body = self._wait(rid)
+            if kind == "result":
+                out.append(body)
+            elif kind == "need_catalog":
+                raise SolverServiceError(
+                    "service lost the catalog (restarted?); reconnect")
+            else:
+                raise SolverServiceError(f"solver service error: {body}")
+        return out

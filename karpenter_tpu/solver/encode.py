@@ -191,7 +191,20 @@ def _eval_requirements(
 
 
 def group_pods(pods: List[Pod]) -> List[List[Pod]]:
-    """Equivalence classes in FFD order (size desc, then name for stability)."""
+    """Equivalence classes in FFD order (size desc, then name for stability).
+
+    The C++ fast path (native/hostops.cc) carries the identical contract;
+    at 50k pods the Python loop costs more than the device solve, so this
+    is part of the native solver boundary (SURVEY §2). `group_pods_py` is
+    the fallback and the differential-test oracle."""
+    from karpenter_tpu.native import hostops
+    native = hostops()
+    if native is not None:
+        return native.group_pods(pods)
+    return group_pods_py(pods)
+
+
+def group_pods_py(pods: List[Pod]) -> List[List[Pod]]:
     byid: Dict[int, List[Pod]] = {}
     for pod in pods:
         byid.setdefault(pod.scheduling_group_id(), []).append(pod)

@@ -1,0 +1,214 @@
+// kt_hostops — C++ fast paths for the solver's host-side hot loops.
+//
+// The reference implements its entire control plane in Go (SURVEY §2: no
+// native code anywhere in tzneal/karpenter); our performance-critical
+// native component is the solver boundary (SURVEY §2 consequence note).
+// This extension owns the host-side encode hot spots that sit in front of
+// the device solve — at 50k pods the Python grouping loop alone costs more
+// than the XLA program.
+//
+// Exposed functions (exact drop-in semantics for the Python originals in
+// karpenter_tpu/solver/encode.py — the Python implementations remain as
+// the fallback and the differential-test oracle):
+//
+//   group_pods(pods) -> list[list[Pod]]
+//       Pod equivalence classes in FFD order: group by
+//       pod.scheduling_group_id() (reading the `_sched_group_id` cache
+//       attribute directly and only falling back to the method call when
+//       unset), sort each class by pod name, order classes by
+//       (requests.sort_key(), first name) descending.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <algorithm>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Entry {
+  const char* name;  // UTF-8 pointer owned by the pod's name object
+  Py_ssize_t name_len;
+  PyObject* pod;  // borrowed (the input list keeps it alive)
+};
+
+struct Group {
+  std::vector<Entry> entries;
+  PyObject* sort_key = nullptr;  // owned: (requests.sort_key(), first_name)
+};
+
+bool name_less(const Entry& a, const Entry& b) {
+  // Python str '<' on UTF-8 text == byte-wise compare (UTF-8 preserves
+  // code-point order)
+  const Py_ssize_t n = a.name_len < b.name_len ? a.name_len : b.name_len;
+  const int c = std::memcmp(a.name, b.name, static_cast<size_t>(n));
+  if (c != 0) return c < 0;
+  return a.name_len < b.name_len;
+}
+
+PyObject* group_pods(PyObject* /*self*/, PyObject* arg) {
+  PyObject* seq = PySequence_Fast(arg, "group_pods expects a sequence of pods");
+  if (seq == nullptr) return nullptr;
+  const Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  PyObject** items = PySequence_Fast_ITEMS(seq);
+
+  // interned attribute names (created once per call; cheap vs. 50k lookups)
+  PyObject* s_gid = PyUnicode_InternFromString("_sched_group_id");
+  PyObject* s_gid_call = PyUnicode_InternFromString("scheduling_group_id");
+  PyObject* s_meta = PyUnicode_InternFromString("meta");
+  PyObject* s_name = PyUnicode_InternFromString("name");
+  PyObject* s_requests = PyUnicode_InternFromString("requests");
+  PyObject* s_sort_key = PyUnicode_InternFromString("sort_key");
+
+  std::unordered_map<long long, size_t> index;  // gid -> groups slot
+  std::vector<Group> groups;
+  groups.reserve(64);
+  bool failed = false;
+
+  for (Py_ssize_t i = 0; i < n && !failed; ++i) {
+    PyObject* pod = items[i];
+    // fast path: the cached interned group id
+    PyObject* gid_obj = PyObject_GetAttr(pod, s_gid);
+    if (gid_obj == nullptr) {
+      failed = true;
+      break;
+    }
+    if (gid_obj == Py_None) {
+      Py_DECREF(gid_obj);
+      gid_obj = PyObject_CallMethodNoArgs(pod, s_gid_call);
+      if (gid_obj == nullptr) {
+        failed = true;
+        break;
+      }
+    }
+    const long long gid = PyLong_AsLongLong(gid_obj);
+    Py_DECREF(gid_obj);
+    if (gid == -1 && PyErr_Occurred()) {
+      failed = true;
+      break;
+    }
+
+    PyObject* meta = PyObject_GetAttr(pod, s_meta);
+    PyObject* name = meta ? PyObject_GetAttr(meta, s_name) : nullptr;
+    Py_XDECREF(meta);
+    if (name == nullptr || !PyUnicode_Check(name)) {
+      Py_XDECREF(name);
+      if (!PyErr_Occurred())
+        PyErr_SetString(PyExc_TypeError, "pod.meta.name must be str");
+      failed = true;
+      break;
+    }
+    Py_ssize_t name_len = 0;
+    const char* name_utf8 = PyUnicode_AsUTF8AndSize(name, &name_len);
+    if (name_utf8 == nullptr) {
+      Py_DECREF(name);
+      failed = true;
+      break;
+    }
+    // the pod object owns `meta.name`; borrowing the UTF-8 buffer is safe
+    // while the input sequence is alive
+    Py_DECREF(name);
+
+    auto it = index.find(gid);
+    if (it == index.end()) {
+      index.emplace(gid, groups.size());
+      groups.emplace_back();
+      groups.back().entries.push_back({name_utf8, name_len, pod});
+    } else {
+      groups[it->second].entries.push_back({name_utf8, name_len, pod});
+    }
+  }
+
+  if (failed) {
+    for (auto& g : groups) Py_XDECREF(g.sort_key);
+    Py_DECREF(s_gid); Py_DECREF(s_gid_call); Py_DECREF(s_meta);
+    Py_DECREF(s_name); Py_DECREF(s_requests); Py_DECREF(s_sort_key);
+    Py_DECREF(seq);
+    return nullptr;
+  }
+
+  // sort members of each class by name, then build each class's FFD key:
+  // (requests.sort_key(), first_member_name)
+  for (auto& g : groups) {
+    std::sort(g.entries.begin(), g.entries.end(), name_less);
+    PyObject* rep = g.entries.front().pod;
+    PyObject* requests = PyObject_GetAttr(rep, s_requests);
+    PyObject* sk = requests ? PyObject_CallMethodNoArgs(requests, s_sort_key)
+                            : nullptr;
+    Py_XDECREF(requests);
+    PyObject* rep_name =
+        sk ? PyUnicode_FromStringAndSize(g.entries.front().name,
+                                         g.entries.front().name_len)
+           : nullptr;
+    if (rep_name != nullptr) {
+      g.sort_key = PyTuple_Pack(2, sk, rep_name);
+      Py_DECREF(rep_name);
+    }
+    Py_XDECREF(sk);
+    if (g.sort_key == nullptr) {
+      failed = true;
+      break;
+    }
+  }
+
+  PyObject* out = nullptr;
+  if (!failed) {
+    // classes in FFD order: key descending, stable (matches
+    // list.sort(key=..., reverse=True))
+    std::vector<size_t> order(groups.size());
+    for (size_t i = 0; i < order.size(); ++i) order[i] = i;
+    std::stable_sort(order.begin(), order.end(),
+                     [&groups, &failed](size_t a, size_t b) {
+                       if (failed) return false;
+                       const int gt = PyObject_RichCompareBool(
+                           groups[a].sort_key, groups[b].sort_key, Py_GT);
+                       if (gt < 0) failed = true;
+                       return gt == 1;
+                     });
+    if (!failed) {
+      out = PyList_New(static_cast<Py_ssize_t>(groups.size()));
+      for (size_t oi = 0; out != nullptr && oi < order.size(); ++oi) {
+        const Group& g = groups[order[oi]];
+        PyObject* lst = PyList_New(static_cast<Py_ssize_t>(g.entries.size()));
+        if (lst == nullptr) {
+          Py_CLEAR(out);
+          break;
+        }
+        for (size_t j = 0; j < g.entries.size(); ++j) {
+          Py_INCREF(g.entries[j].pod);
+          PyList_SET_ITEM(lst, static_cast<Py_ssize_t>(j), g.entries[j].pod);
+        }
+        PyList_SET_ITEM(out, static_cast<Py_ssize_t>(oi), lst);
+      }
+    }
+  }
+
+  for (auto& g : groups) Py_XDECREF(g.sort_key);
+  Py_DECREF(s_gid); Py_DECREF(s_gid_call); Py_DECREF(s_meta);
+  Py_DECREF(s_name); Py_DECREF(s_requests); Py_DECREF(s_sort_key);
+  Py_DECREF(seq);
+  if (failed) {
+    Py_XDECREF(out);
+    return nullptr;
+  }
+  return out;
+}
+
+PyMethodDef kMethods[] = {
+    {"group_pods", group_pods, METH_O,
+     "Pod equivalence classes in FFD order (C++ fast path)."},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef kModule = {
+    PyModuleDef_HEAD_INIT, "kt_hostops",
+    "Native host-side hot paths for the TPU solver boundary.", -1, kMethods,
+    nullptr, nullptr, nullptr, nullptr,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit_kt_hostops() { return PyModule_Create(&kModule); }

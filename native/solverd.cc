@@ -1,0 +1,312 @@
+// kt_solverd — the native solver service boundary (SURVEY §2: "the
+// native-performance component we must write is the solver service
+// boundary"; §5 communication backends: "Go controller ↔ solver over
+// gRPC (process boundary)" — here a dependency-free unix-socket framing).
+//
+// Architecture (two-tier, SURVEY §7): control-plane replicas connect as
+// clients; this daemon owns the TPU process. C++ owns the runtime around
+// the compute — socket IO, threading, and the REQUEST-COALESCING WINDOW
+// (the reference's pkg/batcher/batcher.go:61-183 windowed fan-in,
+// reimplemented natively): the first request opens a window, further
+// requests landing within the idle gap join it (bounded by a max window
+// and a max batch size), and the whole batch is handed to the embedded
+// CPython backend in ONE call, which maps it onto ONE vmapped device
+// solve. Python/JAX stays the compute path; C++ is the executor.
+//
+// Wire protocol (little-endian):
+//   frame := u32 payload_len | u64 request_id | payload bytes
+// identical in both directions; payloads are opaque to C++ (the backend
+// speaks pickle). Responses may arrive out of order; request_id matches
+// them up.
+//
+// Usage:
+//   kt_solverd --socket /tmp/kt.sock [--module karpenter_tpu.service.backend]
+//              [--idle-ms 5] [--max-ms 100] [--max-batch 64]
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <arpa/inet.h>
+#include <csignal>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+constexpr uint32_t kMaxFrame = 256u << 20;  // 256 MiB
+
+struct Conn {
+  int fd;
+  std::mutex write_mu;
+  std::atomic<bool> open{true};
+};
+
+struct Request {
+  std::shared_ptr<Conn> conn;
+  uint64_t id;
+  std::string payload;
+};
+
+struct Batcher {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<Request> queue;
+  bool stopping = false;
+  // window parameters (defaults mirror the reference's per-API batcher
+  // configs, scaled to solver-call latencies)
+  int idle_ms = 5;
+  int max_ms = 100;
+  size_t max_batch = 64;
+};
+
+Batcher g_batcher;
+std::atomic<bool> g_stop{false};
+int g_listen_fd = -1;
+
+bool read_exact(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) {
+      if (r < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+void send_response(const std::shared_ptr<Conn>& conn, uint64_t id,
+                   const char* data, size_t len) {
+  if (!conn->open.load()) return;
+  char header[12];
+  const uint32_t plen = static_cast<uint32_t>(len);
+  std::memcpy(header, &plen, 4);
+  std::memcpy(header + 4, &id, 8);
+  std::lock_guard<std::mutex> lock(conn->write_mu);
+  if (!write_all(conn->fd, header, sizeof header) ||
+      !write_all(conn->fd, data, len)) {
+    conn->open.store(false);
+  }
+}
+
+void reader_loop(std::shared_ptr<Conn> conn) {
+  for (;;) {
+    char header[12];
+    if (!read_exact(conn->fd, header, sizeof header)) break;
+    uint32_t plen;
+    uint64_t id;
+    std::memcpy(&plen, header, 4);
+    std::memcpy(&id, header + 4, 8);
+    if (plen > kMaxFrame) break;
+    Request req;
+    req.conn = conn;
+    req.id = id;
+    req.payload.resize(plen);
+    if (plen > 0 && !read_exact(conn->fd, req.payload.data(), plen)) break;
+    {
+      std::lock_guard<std::mutex> lock(g_batcher.mu);
+      g_batcher.queue.push_back(std::move(req));
+    }
+    g_batcher.cv.notify_one();
+  }
+  conn->open.store(false);
+  ::close(conn->fd);
+}
+
+// Collect one batch under the reference's window semantics: the first
+// request opens the window; we keep draining until the queue stays idle
+// for idle_ms, the window exceeds max_ms, or the batch hits max_batch
+// (pkg/batcher/batcher.go:132-183's trigger → waitForIdle → fan-out).
+std::vector<Request> collect_batch() {
+  std::unique_lock<std::mutex> lock(g_batcher.mu);
+  g_batcher.cv.wait(lock, [] {
+    return g_batcher.stopping || !g_batcher.queue.empty();
+  });
+  std::vector<Request> batch;
+  if (g_batcher.stopping && g_batcher.queue.empty()) return batch;
+  const auto window_start = Clock::now();
+  const auto window_end =
+      window_start + std::chrono::milliseconds(g_batcher.max_ms);
+  for (;;) {
+    while (!g_batcher.queue.empty() && batch.size() < g_batcher.max_batch) {
+      batch.push_back(std::move(g_batcher.queue.front()));
+      g_batcher.queue.pop_front();
+    }
+    if (batch.size() >= g_batcher.max_batch || g_batcher.stopping) break;
+    const auto now = Clock::now();
+    if (now >= window_end) break;
+    const auto idle_deadline =
+        std::min(window_end, now + std::chrono::milliseconds(g_batcher.idle_ms));
+    if (!g_batcher.cv.wait_until(lock, idle_deadline,
+                                 [] { return !g_batcher.queue.empty() ||
+                                              g_batcher.stopping; }))
+      break;  // idle gap elapsed with nothing new: the window closes
+  }
+  return batch;
+}
+
+// One embedded-Python call per batch: handle_batch(list[bytes]) -> list[bytes]
+void dispatch_batch(PyObject* handler, std::vector<Request>& batch) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* payloads = PyList_New(static_cast<Py_ssize_t>(batch.size()));
+  for (size_t i = 0; i < batch.size(); ++i) {
+    PyList_SET_ITEM(
+        payloads, static_cast<Py_ssize_t>(i),
+        PyBytes_FromStringAndSize(batch[i].payload.data(),
+                                  static_cast<Py_ssize_t>(batch[i].payload.size())));
+  }
+  PyObject* out = PyObject_CallOneArg(handler, payloads);
+  Py_DECREF(payloads);
+  if (out == nullptr) {
+    PyErr_Print();
+    PyGILState_Release(gil);
+    const char kErr[] = "\x80\x04N.";  // pickled None = internal error marker
+    for (auto& req : batch)
+      send_response(req.conn, req.id, kErr, sizeof kErr - 1);
+    return;
+  }
+  for (size_t i = 0; i < batch.size(); ++i) {
+    PyObject* item = PySequence_GetItem(out, static_cast<Py_ssize_t>(i));
+    char* data = nullptr;
+    Py_ssize_t len = 0;
+    if (item != nullptr && PyBytes_AsStringAndSize(item, &data, &len) == 0) {
+      // release the GIL for the socket write? writes are short; keep it.
+      send_response(batch[i].conn, batch[i].id, data, static_cast<size_t>(len));
+    }
+    Py_XDECREF(item);
+    if (PyErr_Occurred()) PyErr_Print();
+  }
+  Py_DECREF(out);
+  PyGILState_Release(gil);
+}
+
+void on_signal(int) {
+  g_stop.store(true);
+  {
+    std::lock_guard<std::mutex> lock(g_batcher.mu);
+    g_batcher.stopping = true;
+  }
+  g_batcher.cv.notify_all();
+  if (g_listen_fd >= 0) ::shutdown(g_listen_fd, SHUT_RDWR);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string socket_path;
+  std::string module_name = "karpenter_tpu.service.backend";
+  for (int i = 1; i < argc - 1; ++i) {
+    const std::string a = argv[i];
+    if (a == "--socket") socket_path = argv[++i];
+    else if (a == "--module") module_name = argv[++i];
+    else if (a == "--idle-ms") g_batcher.idle_ms = std::atoi(argv[++i]);
+    else if (a == "--max-ms") g_batcher.max_ms = std::atoi(argv[++i]);
+    else if (a == "--max-batch") g_batcher.max_batch =
+        static_cast<size_t>(std::atoi(argv[++i]));
+  }
+  if (socket_path.empty()) {
+    std::fprintf(stderr, "usage: kt_solverd --socket PATH [--module M]"
+                         " [--idle-ms N] [--max-ms N] [--max-batch N]\n");
+    return 2;
+  }
+
+  ::signal(SIGPIPE, SIG_IGN);
+  ::signal(SIGINT, on_signal);
+  ::signal(SIGTERM, on_signal);
+
+  // --- embedded interpreter + backend handler ---------------------------
+  Py_Initialize();
+  PyObject* module = PyImport_ImportModule(module_name.c_str());
+  if (module == nullptr) {
+    PyErr_Print();
+    return 1;
+  }
+  PyObject* handler = PyObject_GetAttrString(module, "handle_batch");
+  Py_DECREF(module);
+  if (handler == nullptr || !PyCallable_Check(handler)) {
+    std::fprintf(stderr, "kt_solverd: %s.handle_batch not callable\n",
+                 module_name.c_str());
+    return 1;
+  }
+  // drop the GIL: reader threads never touch Python; the batcher thread
+  // re-acquires per batch
+  PyThreadState* main_state = PyEval_SaveThread();
+
+  // --- listener ---------------------------------------------------------
+  ::unlink(socket_path.c_str());
+  g_listen_fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  std::strncpy(addr.sun_path, socket_path.c_str(), sizeof(addr.sun_path) - 1);
+  if (::bind(g_listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0 ||
+      ::listen(g_listen_fd, 64) != 0) {
+    std::perror("kt_solverd: bind/listen");
+    return 1;
+  }
+  std::fprintf(stderr, "kt_solverd: listening on %s (idle %dms, max %dms, "
+               "batch %zu)\n", socket_path.c_str(), g_batcher.idle_ms,
+               g_batcher.max_ms, g_batcher.max_batch);
+
+  std::thread batcher_thread([&handler] {
+    while (!g_stop.load()) {
+      std::vector<Request> batch = collect_batch();
+      if (batch.empty()) continue;
+      dispatch_batch(handler, batch);
+    }
+  });
+
+  while (!g_stop.load()) {
+    int cfd = ::accept(g_listen_fd, nullptr, nullptr);
+    if (cfd < 0) {
+      if (errno == EINTR && !g_stop.load()) continue;
+      break;
+    }
+    auto conn = std::make_shared<Conn>();
+    conn->fd = cfd;
+    // detach immediately: each reader owns its connection and exits on
+    // disconnect; keeping joinable handles would accumulate one zombie
+    // thread per reconnecting replica for the daemon's lifetime
+    std::thread(reader_loop, conn).detach();
+  }
+
+  on_signal(0);
+  batcher_thread.join();
+  ::close(g_listen_fd);
+  ::unlink(socket_path.c_str());
+  PyEval_RestoreThread(main_state);
+  Py_XDECREF(handler);
+  Py_Finalize();
+  return 0;
+}

@@ -1,0 +1,75 @@
+"""Native host-ops (native/hostops.cc) ↔ Python differential tests.
+
+The C++ fast path must be an exact drop-in for the Python implementation
+(`group_pods_py` is the oracle). Skipped if the toolchain can't build the
+extension.
+"""
+
+import pytest
+
+from karpenter_tpu.models import (
+    ObjectMeta,
+    Pod,
+    Resources,
+    Toleration,
+    TopologySpreadConstraint,
+    wellknown,
+)
+from karpenter_tpu.native import hostops
+from karpenter_tpu.solver.encode import group_pods_py
+
+NATIVE = hostops()
+
+
+def same(a, b):
+    assert len(a) == len(b)
+    for ga, gb in zip(a, b):
+        assert [id(p) for p in ga] == [id(p) for p in gb]
+
+
+@pytest.mark.skipif(NATIVE is None, reason="native toolchain unavailable")
+class TestGroupPods:
+    def test_empty(self):
+        same(NATIVE.group_pods([]), group_pods_py([]))
+
+    def test_grouping_and_order(self):
+        pods = []
+        for i in range(200):
+            size = [("250m", "512Mi"), ("2", "4Gi"), ("1", "1Gi")][i % 3]
+            pods.append(Pod(
+                meta=ObjectMeta(name=f"p{i:03d}",
+                                labels={"app": ["a", "b"][i % 2]}),
+                requests=Resources.parse(
+                    {"cpu": size[0], "memory": size[1]})))
+        same(NATIVE.group_pods(pods), group_pods_py(list(pods)))
+
+    def test_distinct_constraints_split_groups(self):
+        tol = Toleration(key="gpu", operator="Exists")
+        spread = TopologySpreadConstraint(
+            topology_key=wellknown.ZONE_LABEL, label_selector={"a": "b"})
+        pods = [
+            Pod(meta=ObjectMeta(name="plain"),
+                requests=Resources.parse({"cpu": "1"})),
+            Pod(meta=ObjectMeta(name="tol"),
+                requests=Resources.parse({"cpu": "1"}), tolerations=[tol]),
+            Pod(meta=ObjectMeta(name="spread"),
+                requests=Resources.parse({"cpu": "1"}),
+                topology_spread=[spread]),
+        ]
+        native = NATIVE.group_pods(pods)
+        assert len(native) == 3
+        same(native, group_pods_py(list(pods)))
+
+    def test_uncached_group_ids(self):
+        # pods that never computed their group id force the method-call path
+        pods = [Pod(meta=ObjectMeta(name=f"f{i}"),
+                    requests=Resources.parse({"cpu": "500m"}))
+                for i in range(50)]
+        assert all(p._sched_group_id is None for p in pods)
+        same(NATIVE.group_pods(pods), group_pods_py(list(pods)))
+
+    def test_name_tiebreak_unicode(self):
+        pods = [Pod(meta=ObjectMeta(name=n),
+                    requests=Resources.parse({"cpu": "1"}))
+                for n in ["b", "a", "ab", "a-1", "z", "ä", "a0"]]
+        same(NATIVE.group_pods(pods), group_pods_py(list(pods)))
