@@ -1,0 +1,42 @@
+"""Shared harness for the 5 BASELINE.json regression benchmarks.
+
+Each config script builds its workload, runs the TPU solver (warm), and
+prints ONE JSON line `{"metric", "value", "unit", "vs_baseline", ...}` —
+the same contract as the repo-root bench.py (which is config #5, the
+headline). `vs_baseline` is target_ms / measured_ms against the north-star
+budget scaled to the config's size.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+
+
+def run(metric: str, target_ms: float, make_input, solve=None, repeats: int = 5,
+        extra=None):
+    from karpenter_tpu.solver import TPUSolver
+
+    inp = make_input()
+    solver = TPUSolver(max_nodes=2048)
+    solve = solve or (lambda s, i: s.solve(i))
+    res = solve(solver, inp)  # compile + warm caches
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        res = solve(solver, inp)
+        times.append((time.perf_counter() - t0) * 1000.0)
+    ms = statistics.median(times)
+    line = {
+        "metric": metric,
+        "value": round(ms, 1),
+        "unit": "ms",
+        "vs_baseline": round(target_ms / ms, 3),
+    }
+    if extra:
+        line.update(extra(res))
+    print(json.dumps(line))
+    print(f"runs={[round(t) for t in times]}", file=sys.stderr)
+    return res

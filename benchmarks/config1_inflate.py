@@ -1,0 +1,32 @@
+"""BASELINE config #1: the `inflate` Deployment — 100 identical cpu/mem-only
+pods, 1 NodePool, ~30 instance types (the reference's examples/workloads
+smoke test)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.common import run
+from karpenter_tpu.models import NodePool, ObjectMeta, Pod, Resources
+from karpenter_tpu.providers import generate_catalog
+from karpenter_tpu.providers.catalog import CatalogSpec
+from karpenter_tpu.scheduling import ScheduleInput
+
+CATALOG = generate_catalog(CatalogSpec(max_types=30, include_gpu=False))
+
+
+def make_input():
+    pods = [Pod(meta=ObjectMeta(name=f"inflate-{i}"),
+                requests=Resources.parse({"cpu": "1", "memory": "1536Mi"}))
+            for i in range(100)]
+    pool = NodePool(meta=ObjectMeta(name="default"))
+    return ScheduleInput(pods=pods, nodepools=[pool],
+                         instance_types={"default": CATALOG})
+
+
+if __name__ == "__main__":
+    res = run("config#1 inflate: 100 identical pods x 30 types", 200.0,
+              make_input,
+              extra=lambda r: {"nodes": r.node_count()})
+    assert not res.unschedulable

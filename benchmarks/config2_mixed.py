@@ -1,0 +1,51 @@
+"""BASELINE config #2: 5k mixed pods with nodeSelectors + taints/tolerations
+across 3 NodePools, full instance-type catalog."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.common import run
+from karpenter_tpu.models import (
+    NodePool, ObjectMeta, Pod, Requirement, Requirements, Resources, Taint,
+    Toleration, wellknown,
+)
+from karpenter_tpu.providers import generate_catalog
+from karpenter_tpu.scheduling import ScheduleInput
+
+CATALOG = generate_catalog()
+ZONES = ["tpu-west-1a", "tpu-west-1b", "tpu-west-1c"]
+SIZES = [("250m", "512Mi"), ("500m", "1Gi"), ("1", "2Gi"), ("2", "4Gi"),
+         ("4", "8Gi"), ("500m", "2Gi")]
+
+
+def make_input():
+    general = NodePool(meta=ObjectMeta(name="general"), weight=10)
+    spot = NodePool(
+        meta=ObjectMeta(name="spot-only"),
+        requirements=Requirements(Requirement.make(
+            wellknown.CAPACITY_TYPE_LABEL, "In", "spot")))
+    dedicated = NodePool(meta=ObjectMeta(name="dedicated"),
+                         taints=[Taint("team", "ml")])
+    pods = []
+    for i in range(5000):
+        cpu, mem = SIZES[i % len(SIZES)]
+        p = Pod(meta=ObjectMeta(name=f"m{i}"),
+                requests=Resources.parse({"cpu": cpu, "memory": mem}))
+        if i % 3 == 0:  # zonal nodeSelector
+            p.requirements = Requirements(Requirement.make(
+                wellknown.ZONE_LABEL, "In", ZONES[i % len(ZONES)]))
+        if i % 7 == 0:  # tolerates the dedicated pool
+            p.tolerations = [Toleration(key="team", operator="Exists")]
+        pods.append(p)
+    pools = [general, spot, dedicated]
+    return ScheduleInput(pods=pods, nodepools=pools,
+                         instance_types={p.meta.name: CATALOG for p in pools})
+
+
+if __name__ == "__main__":
+    res = run("config#2 mixed: 5k pods, selectors+taints, 3 pools", 200.0,
+              make_input,
+              extra=lambda r: {"nodes": r.node_count()})
+    assert not res.unschedulable

@@ -1,0 +1,51 @@
+"""BASELINE config #3: 10k pods with podAntiAffinity + zonal
+topologySpreadConstraints (topology-domain packing) — the in-kernel
+domain machinery (solver/ffd.py heavy branch) under load."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.common import run
+from karpenter_tpu.models import (
+    NodePool, ObjectMeta, Pod, PodAffinityTerm, Resources,
+    TopologySpreadConstraint, wellknown,
+)
+from karpenter_tpu.providers import generate_catalog
+from karpenter_tpu.scheduling import ScheduleInput
+
+CATALOG = generate_catalog()
+
+
+def make_input():
+    pods = []
+    # 4 spread workloads × 2,495 pods, each zone-balanced within itself
+    for w in range(4):
+        sel = {"app": f"web-{w}"}
+        for i in range(2495):
+            pods.append(Pod(
+                meta=ObjectMeta(name=f"w{w}-p{i}", labels=dict(sel)),
+                requests=Resources.parse({"cpu": "250m", "memory": "512Mi"}),
+                topology_spread=[TopologySpreadConstraint(
+                    topology_key=wellknown.ZONE_LABEL, max_skew=1,
+                    label_selector=sel)]))
+    # 20 singleton services, one per zone-domain via required anti-affinity
+    for s in range(20):
+        sel = {"svc": f"s{s}"}
+        pods.append(Pod(
+            meta=ObjectMeta(name=f"svc-{s}", labels=dict(sel)),
+            requests=Resources.parse({"cpu": "1", "memory": "2Gi"}),
+            pod_affinities=[PodAffinityTerm(
+                label_selector=sel, topology_key=wellknown.HOSTNAME_LABEL,
+                anti=True)]))
+    pool = NodePool(meta=ObjectMeta(name="default"))
+    return ScheduleInput(pods=pods, nodepools=[pool],
+                         instance_types={"default": CATALOG})
+
+
+if __name__ == "__main__":
+    res = run("config#3 topology: 10k pods, anti-affinity + zonal spread",
+              200.0, make_input,
+              extra=lambda r: {"nodes": r.node_count()})
+    assert not res.unschedulable

@@ -1,0 +1,68 @@
+"""BASELINE config #4: multi-node consolidation — 2k under-utilized nodes,
+replacement simulation over spot + on-demand offerings. Measures the full
+single-node candidate sweep (2k simulations) through the batched device
+path (solver.solve_batch, vmapped kernel)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.common import run
+from karpenter_tpu.models import Node, NodePool, ObjectMeta, Pod, Resources, wellknown
+from karpenter_tpu.providers import generate_catalog
+from karpenter_tpu.scheduling import ExistingNode, ScheduleInput
+
+CATALOG = generate_catalog()
+ZONES = ["tpu-west-1a", "tpu-west-1b", "tpu-west-1c"]
+N_NODES = 2000
+N_CANDIDATES = 2000
+POOL = NodePool(meta=ObjectMeta(name="default"))
+SHARED = list(CATALOG)
+
+
+def _cluster():
+    nodes = []
+    for i in range(N_NODES):
+        n = Node(meta=ObjectMeta(name=f"n{i}", labels={
+            wellknown.ZONE_LABEL: ZONES[i % 3],
+            wellknown.CAPACITY_TYPE_LABEL: ["spot", "on-demand"][i % 2],
+            wellknown.NODEPOOL_LABEL: "default",
+            wellknown.ARCH_LABEL: "amd64", wellknown.OS_LABEL: "linux",
+            wellknown.HOSTNAME_LABEL: f"n{i}"}),
+            allocatable=Resources.of(cpu=16000, memory=32768, pods=58),
+            ready=True)
+        p = Pod(meta=ObjectMeta(name=f"p{i}"),
+                requests=Resources.parse({"cpu": "500m", "memory": "1Gi"}),
+                node_name=f"n{i}")
+        nodes.append(ExistingNode(node=n, available=n.allocatable - p.requests,
+                                  pods=[p]))
+    return nodes
+
+
+def make_input():
+    """One simulation input per candidate: its pod against the rest of the
+    cluster, price-capped at the candidate's cost."""
+    nodes = _cluster()
+    inps = []
+    for i in range(N_CANDIDATES):
+        inps.append(ScheduleInput(
+            pods=list(nodes[i].pods), nodepools=[POOL],
+            instance_types={"default": SHARED},
+            existing_nodes=nodes[:i] + nodes[i + 1:],
+            price_cap=0.5))
+    return inps
+
+
+def solve(solver, inps):
+    return solver.solve_batch(inps)
+
+
+if __name__ == "__main__":
+    results = run(
+        "config#4 consolidation: 2k candidate simulations (batched)",
+        5000.0, make_input, solve=solve, repeats=3,
+        extra=lambda rs: {
+            "feasible_deletes": sum(
+                1 for r in rs if not r.unschedulable and not r.new_claims)})
+    assert all(not r.unschedulable for r in results)

@@ -1,0 +1,16 @@
+"""BASELINE config #5: the headline — 50k-pod burst, heterogeneous
+requests incl. GPU extended resources, price-optimal packing against the
+full catalog. This is exactly repo-root bench.py (the driver-run metric);
+kept here so the 5-config suite is complete in one place."""
+
+import os
+import runpy
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if __name__ == "__main__":
+    runpy.run_path(
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "bench.py"),
+        run_name="__main__")

@@ -1,0 +1,26 @@
+"""Run all 5 BASELINE config benchmarks; one JSON line each on stdout.
+
+    python benchmarks/run_all.py            # real device if available
+    JAX_PLATFORMS=cpu python benchmarks/run_all.py
+"""
+
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+CONFIGS = ["config1_inflate.py", "config2_mixed.py", "config3_topology.py",
+           "config4_consolidation.py", "config5_burst.py"]
+
+if __name__ == "__main__":
+    failed = []
+    for cfg in CONFIGS:
+        proc = subprocess.run([sys.executable, os.path.join(HERE, cfg)],
+                              stdout=subprocess.PIPE)
+        sys.stdout.buffer.write(proc.stdout)
+        sys.stdout.flush()
+        if proc.returncode != 0:
+            failed.append(cfg)
+    if failed:
+        print(f"FAILED: {failed}", file=sys.stderr)
+        sys.exit(1)

@@ -115,6 +115,28 @@ class Pod:
     def do_not_disrupt(self) -> bool:
         return self.meta.annotations.get(wellknown.DO_NOT_DISRUPT_ANNOTATION) == "true"
 
+    def relaxed(self, level: int) -> "Pod":
+        """The pod with preferred node-affinity terms folded into its hard
+        requirements, the `level` lowest-weight terms dropped.
+
+        Mirrors the reference scheduler's preference handling
+        (website/content/en/preview/concepts/scheduling.md: preferences are
+        treated as required, then relaxed one at a time when the pod cannot
+        schedule). level 0 = all terms enforced; level == len(preferences)
+        = none. Returns a variant Pod with `preferences=[]` so variants at
+        equal effective requirements share a scheduling group.
+        """
+        if not self.preferences:
+            return self
+        import dataclasses
+        order = sorted(enumerate(self.preferences),
+                       key=lambda iw: (-iw[1][0], iw[0]))  # strongest first
+        keep = order[: max(0, len(order) - level)]
+        eff = self.requirements
+        for _, (_, reqs) in keep:
+            eff = eff.intersection(reqs)
+        return dataclasses.replace(self, requirements=eff, preferences=[])
+
     def scheduling_key(self) -> tuple:
         """Equivalence-class key: pods with equal keys are interchangeable to
         the scheduler. The reference exploits the same equivalence when
@@ -137,10 +159,9 @@ class Pod:
                  tuple(sorted(t.label_selector.items())))
                 for t in self.pod_affinities
             ),
-            # NOTE: preferences intentionally excluded — preferred affinity is
-            # not yet consumed by either scheduler, so preference-differing
-            # pods are genuinely interchangeable; fold them in when
-            # preference relaxation lands
+            # preferred node affinity participates in relaxation (pods at
+            # different relax states are not interchangeable)
+            tuple((w, r) for w, r in self.preferences),
             tuple(sorted(self.meta.labels.items())),
             self.priority,
             self.is_daemonset,

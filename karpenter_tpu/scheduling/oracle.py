@@ -154,7 +154,20 @@ class Scheduler:
 
     # ------------------------------------------------------------------
     def _schedule_one(self, pod: Pod) -> None:
+        """Preferred node affinity is treated as required and relaxed one
+        term at a time when the pod cannot place (reference scheduler
+        preference handling, scheduling.md) — a bounded outer loop around
+        the placement attempt (SURVEY §7 hard-parts)."""
         req = effective_request(pod)
+        reason: Optional[str] = None
+        for level in range(len(pod.preferences) + 1):
+            variant = pod.relaxed(level)
+            reason = self._place(variant, req)
+            if reason is None:
+                return
+        self.result.unschedulable[pod.meta.name] = reason
+
+    def _place(self, pod: Pod, req: Resources) -> Optional[str]:
         key = pod.scheduling_key()
         # topology-sensitive pods can't reuse failure memos: the tracker
         # state they were checked against changes with every placement
@@ -170,19 +183,17 @@ class Scheduler:
                 sim.remaining = sim.remaining - req
                 self.result.existing_assignments[pod.meta.name] = sim.name
                 self.tracker.register(pod, sim.domains)
-                return
+                return None
             sim.failed_keys.add(key)
 
         for sim in self.new_sims:
             if not stateful and key in sim.failed_keys:
                 continue
             if self._try_add_to_new(pod, req, sim, commit=True):
-                return
+                return None
             sim.failed_keys.add(key)
 
-        reason = self._open_new(pod, req)
-        if reason is not None:
-            self.result.unschedulable[pod.meta.name] = reason
+        return self._open_new(pod, req)
 
     # -- existing nodes --------------------------------------------------
     def _fits_existing(self, pod: Pod, req: Resources, sim: _ExistingSim) -> bool:

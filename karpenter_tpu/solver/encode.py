@@ -326,18 +326,29 @@ class _TopologyEncoder:
 
     def __init__(self, inp: ScheduleInput, cat: "CatalogEncoding",
                  groups: List[List[Pod]]):
+        # seeding the tracker walks every resident pod — skip it entirely
+        # when no pending pod carries a constraint and no resident pod
+        # carries required anti-affinity (the only way existing state can
+        # constrain unconstrained pods). This keeps consolidation's batched
+        # per-candidate encodes O(pods), not O(cluster).
+        self.active = (
+            any(g[0].topology_spread or g[0].pod_affinities for g in groups)
+            or any(t.required and t.anti
+                   for en in inp.existing_nodes for p in en.pods
+                   for t in p.pod_affinities))
         self.tracker = TopologyTracker()
-        for en in inp.existing_nodes:
-            domains = node_domains_for(en.node.labels, en.node.name)
-            for key, dom in domains.items():
-                self.tracker.observe_domains(key, {dom})
-            for pod in en.pods:
-                self.tracker.register(pod, domains)
-        self.tracker.observe_domains(
-            wellknown.ZONE_LABEL, {c.zone for c in cat.columns})
-        self.tracker.observe_domains(
-            wellknown.CAPACITY_TYPE_LABEL,
-            {c.capacity_type for c in cat.columns})
+        if self.active:
+            for en in inp.existing_nodes:
+                domains = node_domains_for(en.node.labels, en.node.name)
+                for key, dom in domains.items():
+                    self.tracker.observe_domains(key, {dom})
+                for pod in en.pods:
+                    self.tracker.register(pod, domains)
+            self.tracker.observe_domains(
+                wellknown.ZONE_LABEL, {c.zone for c in cat.columns})
+            self.tracker.observe_domains(
+                wellknown.CAPACITY_TYPE_LABEL,
+                {c.capacity_type for c in cat.columns})
         # domain vocab: catalog ids first (stable across calls), existing-node
         # domains appended per call
         self.zone_ids = dict(cat.zone_ids)
@@ -391,6 +402,14 @@ class _TopologyEncoder:
 
     def encode_group(self, gi: int, rep: Pod) -> dict:
         E = len(self.existing)
+        if not self.active:
+            return dict(
+                ncap=BIG, ecap=np.full(E, BIG, dtype=np.int32), dsel=0,
+                dbase=np.zeros(self.D, dtype=np.int32),
+                dcap=np.full(self.D, BIG, dtype=np.int32), skew=BIG, mindom=0,
+                delig=np.zeros(self.D, dtype=bool),
+                allowed={k: None for k in _DOM_KEYS},
+                requires={k: False for k in _DOM_KEYS})
         ncap = BIG
         ecap = np.full(E, BIG, dtype=np.int32)
         allowed: Dict[str, Optional[set]] = {k: None for k in _DOM_KEYS}

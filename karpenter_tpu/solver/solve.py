@@ -145,6 +145,32 @@ class TPUSolver:
                 dev["col_zone"], dev["col_ct"], exist_zone, exist_ct)
 
     def solve(self, inp: ScheduleInput) -> ScheduleResult:
+        """One scheduling problem, with preference relaxation: preferred
+        node affinity is enforced as required, and pods that stay
+        unschedulable get their weakest term dropped and the whole problem
+        re-solved (bounded by the deepest preference list — SURVEY §7
+        hard-parts: 'an outer loop around the solver that must be
+        bounded'). Re-solving whole keeps packing globally consistent."""
+        if not any(p.preferences for p in inp.pods):
+            return self._solve_attempt(inp)
+        import dataclasses
+        by_name = {p.meta.name: p for p in inp.pods}
+        relax: Dict[str, int] = {}
+        rounds = 1 + max(len(p.preferences) for p in inp.pods)
+        res = ScheduleResult()
+        for _ in range(rounds):
+            variants = [p.relaxed(relax.get(p.meta.name, 0)) for p in inp.pods]
+            res = self._solve_attempt(dataclasses.replace(inp, pods=variants))
+            bump = [n for n in res.unschedulable
+                    if n in by_name
+                    and relax.get(n, 0) < len(by_name[n].preferences)]
+            if not bump:
+                return res
+            for n in bump:
+                relax[n] = relax.get(n, 0) + 1
+        return res
+
+    def _solve_attempt(self, inp: ScheduleInput) -> ScheduleResult:
         cat = self._catalog_encoding(inp)
         enc = self._encode_checked(inp, cat)
         if enc.n_groups == 0:
@@ -183,6 +209,18 @@ class TPUSolver:
         """
         if not inps:
             return []
+        # inputs carrying preference pods need the relaxation outer loop —
+        # solve them individually; the rest share the batched device call
+        if any(any(p.preferences for p in inp.pods) for inp in inps):
+            plain = [(i, inp) for i, inp in enumerate(inps)
+                     if not any(p.preferences for p in inp.pods)]
+            out: List[Optional[ScheduleResult]] = [None] * len(inps)
+            for (i, _), res in zip(plain, self.solve_batch([x for _, x in plain])):
+                out[i] = res
+            for i, inp in enumerate(inps):
+                if out[i] is None:
+                    out[i] = self.solve(inp)
+            return out
         cat = self._catalog_encoding(inps[0])
         encs = [self._encode_checked(inp, cat) for inp in inps]
         if len(cat.columns) == 0:

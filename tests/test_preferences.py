@@ -1,0 +1,122 @@
+"""Preference relaxation — preferred node affinity treated as required and
+relaxed term by term when unsatisfiable (reference scheduler preference
+handling, scheduling.md; SURVEY §7 hard-parts 'preference relaxation
+loop'). Oracle and TPU solver must agree."""
+
+from karpenter_tpu.models import (
+    NodePool,
+    ObjectMeta,
+    Pod,
+    Requirement,
+    Requirements,
+    Resources,
+    wellknown,
+)
+from karpenter_tpu.providers import generate_catalog
+from karpenter_tpu.providers.catalog import CatalogSpec
+from karpenter_tpu.scheduling import ScheduleInput, Scheduler
+from karpenter_tpu.solver import TPUSolver
+
+ZONE = wellknown.ZONE_LABEL
+CATALOG = generate_catalog(CatalogSpec(max_types=30, include_gpu=False))
+
+
+def mkpod(name, prefs=None, **kw):
+    return Pod(meta=ObjectMeta(name=name),
+               requests=Resources.parse({"cpu": "500m", "memory": "1Gi"}),
+               preferences=prefs or [], **kw)
+
+
+def mkinput(pods, types=None):
+    pool = NodePool(meta=ObjectMeta(name="default"))
+    return ScheduleInput(pods=pods, nodepools=[pool],
+                         instance_types={"default": types or CATALOG})
+
+
+def both(inp):
+    return Scheduler(inp).solve(), TPUSolver().solve(inp)
+
+
+def claim_zone(claim):
+    zr = claim.requirements.get(ZONE)
+    return zr.values() if zr is not None and zr.is_finite() else None
+
+
+class TestPreferenceRelaxation:
+    def test_satisfiable_preference_honored(self):
+        prefs = [(100, Requirements(Requirement.make(ZONE, "In", "tpu-west-1b")))]
+        inp = mkinput([mkpod(f"p{i}", prefs=list(prefs)) for i in range(10)])
+        oracle, solver = both(inp)
+        for res in (oracle, solver):
+            assert not res.unschedulable
+            for c in res.new_claims:
+                assert claim_zone(c) == {"tpu-west-1b"}
+
+    def test_unsatisfiable_preference_relaxed(self):
+        # preferred zone has no capacity anywhere in the catalog
+        prefs = [(100, Requirements(Requirement.make(ZONE, "In", "mars-east-1a")))]
+        inp = mkinput([mkpod("p0", prefs=prefs)])
+        oracle, solver = both(inp)
+        for res in (oracle, solver):
+            assert not res.unschedulable, res.unschedulable
+            assert res.node_count() == 1
+
+    def test_weakest_term_dropped_first(self):
+        # strong preference satisfiable, weak one impossible → keep strong
+        prefs = [
+            (100, Requirements(Requirement.make(ZONE, "In", "tpu-west-1c"))),
+            (1, Requirements(Requirement.make(
+                wellknown.ARCH_LABEL, "In", "riscv"))),
+        ]
+        inp = mkinput([mkpod("p0", prefs=prefs)])
+        oracle, solver = both(inp)
+        for res in (oracle, solver):
+            assert not res.unschedulable
+            assert claim_zone(res.new_claims[0]) == {"tpu-west-1c"}
+
+    def test_contradictory_preferences_relax_progressively(self):
+        # the two terms conflict; the weaker must be dropped
+        prefs = [
+            (50, Requirements(Requirement.make(ZONE, "In", "tpu-west-1a"))),
+            (10, Requirements(Requirement.make(ZONE, "In", "tpu-west-1b"))),
+        ]
+        inp = mkinput([mkpod("p0", prefs=prefs)])
+        oracle, solver = both(inp)
+        for res in (oracle, solver):
+            assert not res.unschedulable
+            assert claim_zone(res.new_claims[0]) == {"tpu-west-1a"}
+
+    def test_required_constraints_never_relaxed(self):
+        reqs = Requirements(Requirement.make(wellknown.ARCH_LABEL, "In", "riscv"))
+        inp = mkinput([mkpod("impossible", requirements=reqs,
+                             prefs=[(1, Requirements(Requirement.make(
+                                 ZONE, "In", "tpu-west-1a")))])])
+        oracle, solver = both(inp)
+        assert set(oracle.unschedulable) == {"impossible"}
+        assert set(solver.unschedulable) == {"impossible"}
+
+    def test_mixed_preference_and_plain_pods_parity(self):
+        prefs = [(100, Requirements(Requirement.make(ZONE, "In", "tpu-west-1a")))]
+        pods = ([mkpod(f"pref{i}", prefs=list(prefs)) for i in range(20)]
+                + [mkpod(f"plain{i}") for i in range(20)])
+        oracle, solver = both(mkinput(pods))
+        assert not oracle.unschedulable and not solver.unschedulable
+        assert solver.node_count() <= oracle.node_count() + 1
+        # preference pods landed in the preferred zone in both engines
+        for res in (oracle, solver):
+            for c in res.new_claims:
+                if any(p.meta.name.startswith("pref") for p in c.pods):
+                    assert claim_zone(c) == {"tpu-west-1a"}
+
+    def test_grouping_respects_preferences(self):
+        # same size, different preferences → distinct groups, different zones
+        pa = mkpod("a", prefs=[(10, Requirements(
+            Requirement.make(ZONE, "In", "tpu-west-1a")))])
+        pb = mkpod("b", prefs=[(10, Requirements(
+            Requirement.make(ZONE, "In", "tpu-west-1b")))])
+        oracle, solver = both(mkinput([pa, pb]))
+        for res in (oracle, solver):
+            assert not res.unschedulable
+            zones = {frozenset(claim_zone(c)) for c in res.new_claims}
+            assert zones == {frozenset({"tpu-west-1a"}),
+                             frozenset({"tpu-west-1b"})}
