@@ -7,20 +7,26 @@ one NodePool, price-optimal packing on one TPU chip.
 North star (BASELINE.md): <200 ms on v5e-1, node count ≤ the FFD oracle.
 vs_baseline = 200ms-target / measured — >1.0 means beating the target.
 
-Prints exactly ONE JSON line on stdout.
+Prints exactly ONE JSON line on stdout.  Platform handling: the axon site
+bootstrap pins jax_platforms via jax.config (beating JAX_PLATFORMS), so we
+bootstrap through karpenter_tpu.utils.platform — honor an explicit
+JAX_PLATFORMS/KARPENTER_TPU_PLATFORM for CPU smoke runs, otherwise take
+the site default (TPU), retrying UNAVAILABLE backend init with backoff and
+killing leftover kt_solverd daemons that hold the chip (the round-1
+failure mode), falling back to CPU rather than dying with rc=1.
 """
 
 import json
 import statistics
 import sys
+import threading
 import time
 
 
-def main() -> None:
+def build_input(n_pods: int):
     from karpenter_tpu.models import NodePool, ObjectMeta, Pod, Resources
     from karpenter_tpu.providers import generate_catalog
     from karpenter_tpu.scheduling import ScheduleInput
-    from karpenter_tpu.solver import TPUSolver
 
     catalog = generate_catalog()
     sizes = [
@@ -36,12 +42,39 @@ def main() -> None:
     pods = [
         Pod(meta=ObjectMeta(name=f"p{i}"),
             requests=Resources.parse(sizes[i % len(sizes)]))
-        for i in range(50_000)
+        for i in range(n_pods)
     ]
     pool = NodePool(meta=ObjectMeta(name="default"))
-    inp = ScheduleInput(pods=pods, nodepools=[pool],
-                        instance_types={"default": catalog})
+    return ScheduleInput(pods=pods, nodepools=[pool],
+                         instance_types={"default": catalog})
 
+
+def oracle_nodes(inp, budget_s: float):
+    """FFD-oracle node count for the same problem, bounded by a wall-clock
+    budget (the per-pod Python oracle is the reference semantics, not a
+    fast path).  Returns None on timeout."""
+    from karpenter_tpu.scheduling import Scheduler
+    out = {}
+
+    def run():
+        res = Scheduler(inp).solve()
+        out["nodes"] = res.node_count()
+        out["unsched"] = len(res.unschedulable)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(budget_s)
+    return (out.get("nodes"), out.get("unsched")) if out else (None, None)
+
+
+def main() -> None:
+    from karpenter_tpu.utils.platform import initialize
+    platform = initialize(kill_holders=True)
+    print(f"platform={platform}", file=sys.stderr, flush=True)
+
+    from karpenter_tpu.solver import TPUSolver
+
+    inp = build_input(50_000)
     solver = TPUSolver(max_nodes=2048)
     res = solver.solve(inp)  # compile + warm caches
     assert not res.unschedulable, "benchmark workload must fully schedule"
@@ -53,15 +86,35 @@ def main() -> None:
         t1 = time.perf_counter()
         times.append((t1 - t0) * 1000.0)
     ms = statistics.median(times)
+    phases = {k: round(v, 1) for k, v in solver.last_phase_ms.items()}
+
+    # parity line: oracle vs solver on a 5k-pod subproblem of the same mix
+    # (the full 50k through the per-pod Python oracle takes minutes)
+    sub = build_input(5_000)
+    sub_res = solver.solve(sub)
+    onodes, ounsched = oracle_nodes(sub, budget_s=180.0)
+    parity = {
+        "solver_nodes_5k": sub_res.node_count(),
+        "oracle_nodes_5k": onodes,
+        "nodes_le_oracle": (None if onodes is None
+                            else sub_res.node_count() <= onodes),
+    }
 
     print(json.dumps({
         "metric": "schedule 50k pods x 700 instance types (end-to-end, 1 chip)",
         "value": round(ms, 1),
         "unit": "ms",
         "vs_baseline": round(200.0 / ms, 3),
+        "platform": platform,
+        "nodes": res.node_count(),
+        **parity,
     }))
+    host_ms = sum(v for k, v in phases.items() if k != "device")
     print(f"nodes={res.node_count()} total_price=${res.total_price():.2f}/h "
-          f"runs={[round(t) for t in times]}", file=sys.stderr)
+          f"runs={[round(t) for t in times]} phases_ms={phases} "
+          f"host_share={host_ms / ms:.2f} "
+          f"oracle_5k={onodes} (unsched={ounsched}) "
+          f"solver_5k={sub_res.node_count()}", file=sys.stderr)
 
 
 if __name__ == "__main__":

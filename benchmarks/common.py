@@ -17,6 +17,11 @@ import time
 
 def run(metric: str, target_ms: float, make_input, solve=None, repeats: int = 5,
         extra=None):
+    # bootstrap the platform BEFORE any jax dispatch: honor
+    # JAX_PLATFORMS/KARPENTER_TPU_PLATFORM (CPU smoke), else site default
+    # (TPU) with UNAVAILABLE retry + CPU fallback — never die with rc=1
+    from karpenter_tpu.utils.platform import initialize
+    platform = initialize()
     from karpenter_tpu.solver import TPUSolver
 
     inp = make_input()
@@ -34,9 +39,12 @@ def run(metric: str, target_ms: float, make_input, solve=None, repeats: int = 5,
         "value": round(ms, 1),
         "unit": "ms",
         "vs_baseline": round(target_ms / ms, 3),
+        "platform": platform,
     }
     if extra:
         line.update(extra(res))
     print(json.dumps(line))
-    print(f"runs={[round(t) for t in times]}", file=sys.stderr)
+    phases = {k: round(v, 1) for k, v in solver.last_phase_ms.items()}
+    print(f"runs={[round(t) for t in times]} phases_ms={phases}",
+          file=sys.stderr)
     return res

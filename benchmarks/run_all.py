@@ -2,8 +2,14 @@
 
     python benchmarks/run_all.py            # real device if available
     JAX_PLATFORMS=cpu python benchmarks/run_all.py
+
+Each config gets a bounded wall-clock budget (KARPENTER_TPU_BENCH_TIMEOUT,
+default 600 s) so one slow config — e.g. consolidation sims on a CPU smoke
+run — can't eat the whole artifact; a timed-out config reports a JSON line
+with "timeout": true instead of killing the run.
 """
 
+import json
 import os
 import subprocess
 import sys
@@ -11,15 +17,24 @@ import sys
 HERE = os.path.dirname(os.path.abspath(__file__))
 CONFIGS = ["config1_inflate.py", "config2_mixed.py", "config3_topology.py",
            "config4_consolidation.py", "config5_burst.py"]
+TIMEOUT = float(os.environ.get("KARPENTER_TPU_BENCH_TIMEOUT", "600"))
 
 if __name__ == "__main__":
     failed = []
     for cfg in CONFIGS:
-        proc = subprocess.run([sys.executable, os.path.join(HERE, cfg)],
-                              stdout=subprocess.PIPE)
-        sys.stdout.buffer.write(proc.stdout)
-        sys.stdout.flush()
-        if proc.returncode != 0:
+        try:
+            proc = subprocess.run([sys.executable, os.path.join(HERE, cfg)],
+                                  stdout=subprocess.PIPE, timeout=TIMEOUT)
+            sys.stdout.buffer.write(proc.stdout)
+            sys.stdout.flush()
+            if proc.returncode != 0:
+                failed.append(cfg)
+        except subprocess.TimeoutExpired as e:
+            if e.stdout:
+                sys.stdout.buffer.write(e.stdout)
+            print(json.dumps({"metric": cfg, "value": None, "unit": "ms",
+                              "vs_baseline": 0.0, "timeout": True}))
+            sys.stdout.flush()
             failed.append(cfg)
     if failed:
         print(f"FAILED: {failed}", file=sys.stderr)

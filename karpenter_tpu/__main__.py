@@ -1,0 +1,9 @@
+"""`python -m karpenter_tpu` — the operator entry point (the reference's
+cmd/controller/main.go:31-74 single binary)."""
+
+import sys
+
+from karpenter_tpu.operator.operator import main
+
+if __name__ == "__main__":
+    sys.exit(main())

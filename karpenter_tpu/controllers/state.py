@@ -41,6 +41,7 @@ class GatedSolver:
     def solve(self, inp: ScheduleInput, source: str = "solver"):
         from karpenter_tpu.scheduling import Scheduler
         from karpenter_tpu.solver import UnsupportedPods
+        from karpenter_tpu.utils import metrics
         if self.options.feature_gates.tpu_solver:
             try:
                 return self.tpu.solve(inp)
@@ -49,6 +50,7 @@ class GatedSolver:
             except Exception as e:  # noqa: BLE001
                 self.cluster.record_event(
                     "Provisioner", source, "SolverFallback", str(e))
+        metrics.SOLVER_SOLVES.inc(path="oracle")
         return Scheduler(inp).solve()
 
     def solve_batch(self, inps: List[ScheduleInput],
@@ -74,12 +76,20 @@ class GatedSolver:
                         metrics.SCHEDULING_SIMULATION_DURATION.observe(per)
                 return results
             except UnsupportedPods:
-                pass
+                # per-input retry: each simulation gets its own shot at
+                # the device (solve() split-solves inexpressible groups);
+                # only truly unsupported inputs reach the oracle inside
+                def _per_input():
+                    for inp in inps:
+                        with metrics.SCHEDULING_SIMULATION_DURATION.time():
+                            yield self.solve(inp, source=source)
+                return _per_input()
             except Exception as e:  # noqa: BLE001
                 self.cluster.record_event(
                     "Provisioner", source, "SolverFallback", str(e))
 
         def _lazy():
+            metrics.SOLVER_SOLVES.inc(path="oracle")
             for inp in inps:
                 with metrics.SCHEDULING_SIMULATION_DURATION.time():
                     yield Scheduler(inp).solve()

@@ -31,8 +31,13 @@ MEMORY = "memory"
 EPHEMERAL = "ephemeral-storage"
 PODS = "pods"
 GPU = "gpu"  # generic accelerator slot (nvidia.com/gpu et al. map here)
+# attachable persistent-volume slots: the reference enforces per-node
+# volume attach limits during scheduling (scheduling.md:381-417 /
+# instance-store policy ec2nodeclass.go:384-394); modeling them as a
+# resource axis rides the same pods×types capacity tensors as cpu/memory
+VOLUMES = "volumes"
 
-RESOURCE_AXIS: tuple[str, ...] = (CPU, MEMORY, EPHEMERAL, PODS, GPU)
+RESOURCE_AXIS: tuple[str, ...] = (CPU, MEMORY, EPHEMERAL, PODS, GPU, VOLUMES)
 AXIS_INDEX: dict[str, int] = {name: i for i, name in enumerate(RESOURCE_AXIS)}
 
 # Names that alias onto the canonical axis.

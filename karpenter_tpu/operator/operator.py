@@ -1,0 +1,154 @@
+"""The runnable operator process.
+
+Mirrors the reference's boot sequence (cmd/controller/main.go:31-74 →
+pkg/operator/operator.go:92-200): construct the cloud session, probe
+connectivity, build every provider and controller, serve metrics and
+health endpoints, then run the manager until signalled.  The provider
+wiring itself lives in `karpenter_tpu.env.Environment` (the reference
+splits the same construction between operator.go:140-182 and
+pkg/test/environment.go — ours is one container used by both the process
+and the tests, so they can never drift apart).
+
+Endpoints (settings.md: metrics :8000, health probe :8081):
+  :8000 /metrics  — Prometheus text exposition of utils.metrics.REGISTRY
+  :8081 /healthz  — liveness: the reconcile loop is advancing
+  :8081 /readyz   — readiness: CloudProvider.live() (the aggregated
+                    provider probe chain, cloudprovider.go:167-169)
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from karpenter_tpu.env import Environment
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils.clock import RealClock
+
+
+class Operator:
+    """Owns the Environment, the serving threads, and the reconcile loop."""
+
+    def __init__(self, options: Optional[Options] = None,
+                 metrics_port: int = 8000, health_port: int = 8081,
+                 reconcile_interval: float = 1.0):
+        self.options = options or Options.from_env()
+        self.env = Environment(clock=RealClock(), options=self.options)
+        self.metrics_port = metrics_port
+        self.health_port = health_port
+        self.reconcile_interval = reconcile_interval
+        self._stop = threading.Event()
+        self._last_reconcile = 0.0
+        self._servers: list = []
+        # boot-time connectivity probe, the reference's CheckEC2Connectivity
+        # (operator.go:209-218): fail fast if the cloud isn't reachable
+        if not self.env.cloud.live():
+            raise RuntimeError("cloud connectivity probe failed at startup")
+        # build the native host-ops extension now, not inside a solve
+        from karpenter_tpu.native import hostops
+        hostops()
+
+    # -- HTTP endpoints ----------------------------------------------------
+    def _make_handler(operator_self):  # noqa: N805 - closure over operator
+        op = operator_self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet by default
+                pass
+
+            def _respond(self, code: int, body: str,
+                         ctype: str = "text/plain; charset=utf-8"):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802 - stdlib API
+                path = self.path.split("?")[0]
+                if path == "/metrics":
+                    self._respond(200, metrics.REGISTRY.render(),
+                                  "text/plain; version=0.0.4")
+                elif path == "/healthz":
+                    # live while the loop has run recently (3 intervals of
+                    # grace covers a long solve) or hasn't started yet
+                    stale = (op._last_reconcile > 0 and
+                             time.monotonic() - op._last_reconcile >
+                             max(30.0, 3 * op.reconcile_interval +
+                                 op.options.batch_max_duration))
+                    self._respond(503 if stale else 200,
+                                  "unhealthy\n" if stale else "ok\n")
+                elif path == "/readyz":
+                    ready = op.env.cloud_provider.live()
+                    self._respond(200 if ready else 503,
+                                  "ok\n" if ready else "not ready\n")
+                elif path == "/debug/state":
+                    c = op.env.cluster
+                    self._respond(200, json.dumps({
+                        "generation": c.generation,
+                        "nodes": len(c.nodes.list()),
+                        "nodeclaims": len(c.nodeclaims.list()),
+                        "pods": len(c.pods.list()),
+                    }) + "\n", "application/json")
+                else:
+                    self._respond(404, "not found\n")
+
+        return Handler
+
+    def serve(self) -> None:
+        if self._servers:
+            return
+        handler = self._make_handler()
+        ports = []
+        for port in (self.metrics_port, self.health_port):
+            srv = ThreadingHTTPServer(("127.0.0.1", port), handler)
+            ports.append(srv.server_address[1])  # resolves port 0 → actual
+            t = threading.Thread(target=srv.serve_forever, daemon=True,
+                                 name=f"http-{srv.server_address[1]}")
+            t.start()
+            self._servers.append(srv)
+        self.metrics_port, self.health_port = ports
+
+    # -- the reconcile loop ------------------------------------------------
+    def run(self) -> None:
+        """manager.Start: reconcile every controller on a wall-clock cadence
+        until stopped.  Controllers are internally idempotent and
+        clock-driven (batch windows, TTLs), so a fixed outer cadence gives
+        the same observable behavior as the reference's watch-driven
+        workqueues with periodic resync."""
+        self.serve()
+        while not self._stop.is_set():
+            t0 = time.monotonic()
+            self.env.manager.run_once()
+            self._last_reconcile = time.monotonic()
+            elapsed = self._last_reconcile - t0
+            self._stop.wait(max(0.0, self.reconcile_interval - elapsed))
+
+    def stop(self, *_args) -> None:
+        self._stop.set()
+        for srv in self._servers:
+            srv.shutdown()
+
+    def install_signal_handlers(self) -> None:
+        signal.signal(signal.SIGINT, self.stop)
+        signal.signal(signal.SIGTERM, self.stop)
+
+
+def main() -> int:
+    import os
+    op = Operator(
+        metrics_port=int(os.environ.get("KARPENTER_TPU_METRICS_PORT", 8000)),
+        health_port=int(os.environ.get("KARPENTER_TPU_HEALTH_PORT", 8081)))
+    op.install_signal_handlers()
+    op.serve()  # bind before the banner so the printed ports are real
+    print(f"karpenter-tpu operator: cluster={op.options.cluster_name} "
+          f"metrics=:{op.metrics_port} health=:{op.health_port}",
+          flush=True)
+    op.run()
+    return 0

@@ -143,6 +143,9 @@ def _make_type(
         ephemeral_storage=ephemeral_gib * 1024.0,
         pods=float(max_pods),
         gpu=float(gpus),
+        # attachable persistent-volume slots (ENI-style ladder, the role
+        # of the reference's per-type volume limits — scheduling.md:381+)
+        volumes=float(24 if vcpus <= 16 else 40),
     )
     labels = {
         wellknown.INSTANCE_TYPE_LABEL: name,

@@ -30,7 +30,12 @@ from karpenter_tpu.models.objects import InstanceType, NodePool, Pod
 from karpenter_tpu.models.requirements import Requirement, Requirements
 from karpenter_tpu.models.resources import Resources
 from karpenter_tpu.models.taints import tolerates_all, untolerated
-from karpenter_tpu.scheduling.topology import TopologyTracker, node_domains_for
+from karpenter_tpu.scheduling.topology import (
+    TopologyTracker,
+    _matches,
+    _sel,
+    node_domains_for,
+)
 from karpenter_tpu.scheduling.types import (
     ExistingNode,
     NewNodeClaim,
@@ -86,13 +91,20 @@ class _NewSim:
         }
         self._sync_fixed_domains()
 
-    def _sync_fixed_domains(self) -> None:
-        """A requirement narrowed to a single value fixes that domain."""
+    def _sync_fixed_domains(self) -> bool:
+        """A requirement narrowed to a single value fixes that domain.
+        Returns True when a new domain was determined — the caller must
+        then invalidate the tracker's domain caches, because this sim's
+        already-registered pods now count in the new domain."""
+        changed = False
         for key in _NARROWABLE_KEYS:
             req = self.requirements.get(key)
             if req is not None and req.is_finite() and len(req.values()) == 1:
                 (v,) = req.values()
-                self.domains[key] = v
+                if self.domains.get(key) != v:
+                    self.domains[key] = v
+                    changed = True
+        return changed
 
     def finite_values(self, key: str, fallback: Set[str]) -> Set[str]:
         req = self.requirements.get(key)
@@ -304,7 +316,10 @@ class Scheduler:
         sim.requests = total
         sim.pods.append(pod)
         sim.last_key = key
-        sim._sync_fixed_domains()
+        if sim._sync_fixed_domains() and sim.pods[:-1]:
+            # the claim just pinned a domain: resident pods placed while it
+            # was undetermined must count there (affinity co-location)
+            self.tracker.invalidate_counts()
         self.tracker.register(pod, sim.domains)
         if limit is not None:
             self._remaining_limits[sim.pool.name] = limit - req
@@ -366,6 +381,14 @@ class Scheduler:
             else:
                 allowed = self.tracker.affinity_allowed_domains(
                     pod, possible[key], key, term.label_selector)
+                if not allowed and any(
+                        _matches(_sel(term.label_selector), p.meta.labels)
+                        for p in sim.pods):
+                    # no determined domain holds a match, but THIS sim
+                    # does: co-locate here — the narrowing below pins the
+                    # claim's domain, and the pin re-registers its
+                    # residents so later pods see a populated domain
+                    allowed = set(possible[key])
             if not allowed:
                 return None
             possible[key] = allowed

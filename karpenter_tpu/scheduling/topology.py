@@ -36,7 +36,13 @@ class TopologyTracker:
         # (topology_key, selector) → Counter{domain: matching pod count}.
         # One shared cache serves both spread skew counts and affinity
         # queries — they are the same aggregation.
-        self._placed: List[Tuple[Dict[str, str], Dict[str, str]]] = []  # (labels, domains)
+        # _placed entries: (labels, domains, required-anti (key, selector)
+        # pairs). `domains` is stored BY REFERENCE: a new sim's domains
+        # dict may gain entries later when the claim pins (e.g. zone), and
+        # the caller then calls invalidate_counts() to rebuild the caches
+        # so resident pods count in their finally-determined domain.
+        self._placed: List[Tuple[Dict[str, str], Dict[str, str],
+                                 List[Tuple[str, Selector]]]] = []
         self._match_cache: Dict[Tuple[str, Selector], Counter] = {}
         # symmetric anti-affinity: placed pods' anti terms
         # (topology_key, selector) → set of domains holding such a pod
@@ -51,19 +57,34 @@ class TopologyTracker:
 
     def register(self, pod: Pod, node_domains: Dict[str, str]) -> None:
         """Record a placement. node_domains maps topology key → domain value
-        (e.g. zone → us-a, hostname → node-3, capacity-type → spot).
+        (e.g. zone → us-a, hostname → node-3, capacity-type → spot) and is
+        kept by reference — see __init__.
         """
         labels = pod.meta.labels
         for (tkey, sel), counter in self._match_cache.items():
             if tkey in node_domains and _matches(sel, labels):
                 counter[node_domains[tkey]] += 1
-        self._placed.append((dict(labels), dict(node_domains)))
-        for term in pod.pod_affinities:
-            if term.anti and term.required and term.topology_key in node_domains:
-                self._anti_terms[(term.topology_key, _sel(term.label_selector))].add(
-                    node_domains[term.topology_key])
+        anti = [(t.topology_key, _sel(t.label_selector))
+                for t in pod.pod_affinities if t.anti and t.required]
+        self._placed.append((dict(labels), node_domains, anti))
+        for tkey, sel in anti:
+            if tkey in node_domains:
+                self._anti_terms[(tkey, sel)].add(node_domains[tkey])
         for tkey, domain in node_domains.items():
             self.known_domains[tkey].add(domain)
+
+    def invalidate_counts(self) -> None:
+        """Rebuild domain-keyed caches after a registered node's domains
+        dict gained an entry (a claim pinned an undetermined zone/
+        capacity-type): its resident pods must count in the new domain."""
+        self._match_cache.clear()
+        self._anti_terms = defaultdict(set)
+        for _labels, domains, anti in self._placed:
+            for tkey, sel in anti:
+                if tkey in domains:
+                    self._anti_terms[(tkey, sel)].add(domains[tkey])
+            for tkey, domain in domains.items():
+                self.known_domains[tkey].add(domain)
 
     def ensure_spread_counter(self, constraint: TopologySpreadConstraint) -> Counter:
         return self._matching_counts(constraint.topology_key,
@@ -78,7 +99,7 @@ class TopologyTracker:
         key = (topology_key, selector)
         if key not in self._match_cache:
             counter = Counter()
-            for labels, domains in self._placed:
+            for labels, domains, _anti in self._placed:
                 if topology_key in domains and _matches(selector, labels):
                     counter[domains[topology_key]] += 1
             self._match_cache[key] = counter

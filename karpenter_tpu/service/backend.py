@@ -22,37 +22,52 @@ Responses: ("result", ScheduleResult) | ("ok", None) |
 from __future__ import annotations
 
 import pickle
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 _catalogs: Dict[str, Tuple[list, dict]] = {}
 _solver = None
 # per-handle_batch sizes of the schedule groups actually fused onto the
-# device — exposed via the ("stats", _) request for tests/observability
-_batch_log: List[int] = []
+# device — exposed via the ("stats", _) request for tests/observability;
+# bounded so a long-running daemon doesn't grow it forever
+_batch_log: deque = deque(maxlen=1024)
 
 
 def _get_solver():
     global _solver
     if _solver is None:
         import os
-        if os.environ.get("KARPENTER_TPU_FORCE_CPU"):
-            # env alone is not enough: site bootstraps (axon) set
-            # jax_platforms via jax.config, which beats JAX_PLATFORMS
+        # honors KARPENTER_TPU_PLATFORM / JAX_PLATFORMS /
+        # KARPENTER_TPU_FORCE_CPU at the config level (site bootstraps pin
+        # jax_platforms via jax.config, which beats the raw environment)
+        from karpenter_tpu.utils.platform import configure
+        configure()
+        if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
             import jax
-            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              1.0)
         from karpenter_tpu.solver import TPUSolver
-        _solver = TPUSolver(max_nodes=2048)
+        _solver = TPUSolver(
+            max_nodes=int(os.environ.get("KARPENTER_TPU_MAX_NODES", "2048")))
     return _solver
 
 
 def _solve_group(inps: List) -> List:
-    """Device batch with per-input oracle fallback (never fail — SURVEY §5)."""
+    """Device batch with per-input fallback (never fail — SURVEY §5):
+    first the whole fused batch, then per-input device/split solves, and
+    only a truly unsupported input reaches the host oracle."""
     from karpenter_tpu.scheduling import Scheduler
     from karpenter_tpu.solver import UnsupportedPods
     try:
         return _get_solver().solve_batch(inps)
     except UnsupportedPods:
-        return [Scheduler(inp).solve() for inp in inps]
+        out = []
+        for inp in inps:
+            try:
+                out.append(_get_solver().solve(inp))
+            except UnsupportedPods:
+                out.append(Scheduler(inp).solve())
+        return out
 
 
 def handle_batch(payloads: List[bytes]) -> List[bytes]:

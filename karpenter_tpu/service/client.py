@@ -89,8 +89,12 @@ class SolverServiceClient:
                 except Exception as e:  # noqa: BLE001
                     resp = ("error", f"undecodable response: {e}")
                 with self._lock:
-                    self._responses[rid] = resp
                     ev = self._pending.get(rid)
+                    if ev is not None:
+                        # drop responses with no waiter (an abandoned rid
+                        # after a client-side error/timeout) instead of
+                        # accumulating them forever
+                        self._responses[rid] = resp
                 if ev is not None:
                     ev.set()
         except OSError:
@@ -225,13 +229,22 @@ class SolverServiceClient:
                 "price_cap": inp.price_cap,
             }))
         out: List[ScheduleResult] = []
-        for rid in rids:
-            kind, body = self._wait(rid)
-            if kind == "result":
-                out.append(body)
-            elif kind == "need_catalog":
-                raise SolverServiceError(
-                    "service lost the catalog (restarted?); reconnect")
-            else:
-                raise SolverServiceError(f"solver service error: {body}")
+        try:
+            for rid in rids:
+                kind, body = self._wait(rid)
+                if kind == "result":
+                    out.append(body)
+                elif kind == "need_catalog":
+                    raise SolverServiceError(
+                        "service lost the catalog (restarted?); reconnect")
+                else:
+                    raise SolverServiceError(f"solver service error: {body}")
+        finally:
+            # on early exit, abandon the remaining rids so their pending
+            # events and later-arriving responses don't accumulate forever
+            if len(out) < len(rids):
+                with self._lock:
+                    for rid in rids[len(out):]:
+                        self._pending.pop(rid, None)
+                        self._responses.pop(rid, None)
         return out

@@ -96,6 +96,10 @@ class EncodedProblem:
     # into the column masks for the solve AND into claim requirements at
     # decode, so launch can't drift into a statically-forbidden domain
     static_allowed: List[Dict[str, Optional[set]]] = field(default_factory=list)
+    # split mode (encode(split=True)): groups whose constraints the tensor
+    # encoding can't express, with the reason — solved host-side AFTER the
+    # device solve instead of abandoning the whole batch (VERDICT r1 #4)
+    residue: List[Tuple[List[Pod], str]] = field(default_factory=list)
     # host metadata for decode
     groups: List[List[Pod]] = field(default_factory=list)
     columns: List[Column] = field(default_factory=list)
@@ -325,7 +329,15 @@ class _TopologyEncoder:
     """
 
     def __init__(self, inp: ScheduleInput, cat: "CatalogEncoding",
-                 groups: List[List[Pod]]):
+                 groups: List[List[Pod]], split_mode: bool = False):
+        # split mode: groups that raise Unsupported become host-side
+        # residue solved AFTER the device solve, so the victim-side
+        # coupling check (another pending group's anti matching this one)
+        # can be skipped — the anti's OWNER always lands in the residue
+        # (its own selector-couples-pending check fires), and the oracle
+        # registers the device placements before placing it, which
+        # enforces the symmetry.
+        self.split_mode = split_mode
         # seeding the tracker walks every resident pod — skip it entirely
         # when no pending pod carries a constraint and no resident pod
         # carries required anti-affinity (the only way existing state can
@@ -522,10 +534,11 @@ class _TopologyEncoder:
             else:
                 raise Unsupported(f"symmetric anti-affinity on {key}")
         # pending groups' anti terms matching this group couple dynamically
-        for gj, sel in self.pending_anti:
-            if gj != gi and _matches(sel, my):
-                raise Unsupported("another pending group's anti-affinity "
-                                  "matches this group")
+        if not self.split_mode:
+            for gj, sel in self.pending_anti:
+                if gj != gi and _matches(sel, my):
+                    raise Unsupported("another pending group's anti-affinity "
+                                      "matches this group")
 
         dsel = 0
         delig = np.zeros(self.D, dtype=bool)
@@ -547,7 +560,14 @@ class _TopologyEncoder:
                     allowed=allowed, requires=requires)
 
 
-def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None) -> EncodedProblem:
+def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None,
+           split: bool = False) -> EncodedProblem:
+    """split=False: raise Unsupported on the first inexpressible group
+    (caller falls back wholesale).  split=True: collect inexpressible
+    groups into `.residue` and encode the rest — the solver runs the
+    device kernel on the supported majority and hands only the residue to
+    the host oracle (VERDICT r1 #4: a 50k-pod problem with one affinity
+    pod must not abandon the device)."""
     cat = cat or encode_catalog(inp)
     pools = cat.pools
     vocab = cat.vocab
@@ -559,7 +579,7 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None) -> Encoded
     E = len(inp.existing_nodes)
     G = len(groups)
 
-    topo = _TopologyEncoder(inp, cat, groups)
+    topo = _TopologyEncoder(inp, cat, groups, split_mode=split)
     D = topo.D
 
     # existing-node labels (hostnames are per-node-unique) go into a
@@ -587,11 +607,20 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None) -> Encoded
     dom_arrays = {wellknown.ZONE_LABEL: (cat.col_zone, topo.exist_zone),
                   wellknown.CAPACITY_TYPE_LABEL: (cat.col_ct, topo.exist_ct)}
 
+    residue: List[Tuple[List[Pod], str]] = []
+    dropped: List[int] = []
     for gi, g in enumerate(groups):
         rep = g[0]
         group_req[gi] = np.array(effective_request(rep).v, dtype=np.float32)
         group_count[gi] = len(g)
-        t = topo.encode_group(gi, rep)  # raises Unsupported → oracle fallback
+        try:
+            t = topo.encode_group(gi, rep)
+        except Unsupported as e:
+            if not split:
+                raise  # → oracle fallback for the whole batch
+            residue.append((g, str(e)))
+            dropped.append(gi)
+            continue
         group_ncap[gi] = t["ncap"]
         group_dsel[gi] = t["dsel"]
         group_dbase[gi] = t["dbase"]
@@ -668,6 +697,23 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None) -> Encoded
                     cap_row = np.where(ok_dom, cap_row, 0)
             exist_cap[gi] = cap_row
 
+    if dropped:
+        keep = np.ones(G, dtype=bool)
+        keep[dropped] = False
+        group_req = group_req[keep]
+        group_count = group_count[keep]
+        group_mask = group_mask[keep]
+        exist_cap = exist_cap[keep]
+        group_ncap = group_ncap[keep]
+        group_dsel = group_dsel[keep]
+        group_dbase = group_dbase[keep]
+        group_dcap = group_dcap[keep]
+        group_skew = group_skew[keep]
+        group_mindom = group_mindom[keep]
+        group_delig = group_delig[keep]
+        groups = [g for gi, g in enumerate(groups) if keep[gi]]
+        # static_allowed / merged_reqs were only appended for kept groups
+
     exist_remaining = np.array(
         [en.available.v for en in inp.existing_nodes], dtype=np.float32
     ).reshape(E, R)
@@ -711,6 +757,7 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None) -> Encoded
         ct_values=ct_values,
         n_domains=D,
         static_allowed=static_allowed,
+        residue=residue,
         groups=groups,
         columns=columns,
         existing=list(inp.existing_nodes),

@@ -1,0 +1,176 @@
+"""JAX platform bootstrap shared by bench.py, benchmarks/, the solver
+daemon, and tests.
+
+Two environment facts drive this module's design (both observed, both the
+cause of round 1's rc=1 bench artifact):
+
+1. The site bootstrap (axon) exports ``JAX_PLATFORMS=axon`` process-wide
+   and pins ``jax_platforms`` via ``jax.config`` at import time, and jax
+   config beats the raw environment — so a process that wants CPU (tests,
+   smoke benches, the solver daemon under pytest) must update the
+   *config*, and our own CPU knobs (``KARPENTER_TPU_PLATFORM``,
+   ``KARPENTER_TPU_FORCE_CPU``) must take priority over the inherited
+   ``JAX_PLATFORMS``.
+2. TPU backend init can HANG indefinitely (a claim/dial loop against the
+   device relay), not just raise UNAVAILABLE — e.g. when a leftover
+   kt_solverd daemon holds the chip.  An in-process retry never regains
+   control from a hang, so the probe runs in a SUBPROCESS with a hard
+   timeout, and only on probe success does the parent initialize in
+   process.
+
+Mirrors the reference's boot-time EC2 connectivity probe + fail-fast
+diagnostic (/root/reference/pkg/operator/operator.go:209-218).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Optional
+
+
+def _env_platform() -> Optional[str]:
+    """An explicit platform request from the environment, if any.
+
+    Our own knobs outrank the inherited JAX_PLATFORMS: the site bootstrap
+    exports JAX_PLATFORMS=axon globally, so a child process asking for CPU
+    via KARPENTER_TPU_* must not be overridden by it.
+    """
+    val = os.environ.get("KARPENTER_TPU_PLATFORM")
+    if val:
+        return val
+    if os.environ.get("KARPENTER_TPU_FORCE_CPU"):
+        return "cpu"
+    return os.environ.get("JAX_PLATFORMS") or None
+
+
+def _other_device_holders() -> list:
+    """Best-effort list of (pid, cmdline) for processes likely holding the
+    accelerator: kt_solverd daemons that aren't us."""
+    holders = []
+    try:
+        out = subprocess.run(
+            ["ps", "-eo", "pid=,args="], capture_output=True, text=True,
+            timeout=5).stdout
+        me = os.getpid()
+        for line in out.splitlines():
+            parts = line.strip().split(None, 1)
+            if len(parts) != 2:
+                continue
+            pid_s, args = parts
+            if "kt_solverd" in args and int(pid_s) != me:
+                holders.append((int(pid_s), args))
+    except Exception:  # noqa: BLE001 - diagnostics must never raise
+        pass
+    return holders
+
+
+def configure(platform: Optional[str] = None) -> Optional[str]:
+    """Pin jax_platforms explicitly (config-level, beating site bootstraps).
+
+    Resolution order: explicit arg > KARPENTER_TPU_PLATFORM >
+    KARPENTER_TPU_FORCE_CPU > JAX_PLATFORMS > leave the site default.
+    Returns the platform string that was pinned, or None if the site
+    default was left in place.
+    """
+    want = platform or _env_platform()
+    if want:
+        import jax
+        jax.config.update("jax_platforms", want)
+    return want
+
+
+def _probe_subprocess(platform: Optional[str], timeout_s: float,
+                      log) -> bool:
+    """Initialize the backend in a THROWAWAY subprocess with a hard kill
+    timeout — the only way to survive an init that hangs rather than
+    raises.  Returns True if the device came up."""
+    env = dict(os.environ)
+    if platform:
+        env["JAX_PLATFORMS"] = platform
+        env.pop("KARPENTER_TPU_FORCE_CPU", None)
+        env["KARPENTER_TPU_PLATFORM"] = platform
+    code = (
+        "import os\n"
+        "from karpenter_tpu.utils.platform import configure\n"
+        "configure()\n"
+        "import jax\n"
+        "print('PROBE-OK', [d.platform for d in jax.devices()], flush=True)\n"
+    )
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))) + os.pathsep + env.get("PYTHONPATH", ""))
+    try:
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              capture_output=True, text=True,
+                              timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        log(f"[platform] probe hung past {timeout_s:.0f}s (backend init "
+            "wedged — device held elsewhere?)")
+        return False
+    if proc.returncode == 0 and "PROBE-OK" in proc.stdout:
+        return True
+    tail = (proc.stderr or proc.stdout).strip().splitlines()
+    log(f"[platform] probe failed rc={proc.returncode}: "
+        f"{tail[-1][:200] if tail else '<no output>'}")
+    return False
+
+
+def initialize(platform: Optional[str] = None, retries: int = 3,
+               backoff_s: float = 5.0, probe_timeout_s: Optional[float] = None,
+               cpu_fallback: bool = True, kill_holders: bool = False,
+               log=None) -> str:
+    """Probe the requested (or site-default) backend out of process, then
+    configure + initialize in process; returns the platform of the device
+    actually obtained ("tpu", "cpu", ...).
+
+    Between failed probes: names kt_solverd processes that may hold the
+    chip (optionally SIGKILLs them when ``kill_holders`` — safe only for
+    the benchmark driver, which owns the machine) and retries with
+    backoff.  After all retries, falls back to CPU when ``cpu_fallback``
+    instead of crashing the artifact.
+    """
+    log = log or (lambda m: print(m, file=sys.stderr, flush=True))
+    want = platform or _env_platform()
+    if probe_timeout_s is None:
+        probe_timeout_s = float(os.environ.get(
+            "KARPENTER_TPU_PROBE_TIMEOUT", "180"))
+
+    if want == "cpu":
+        configure("cpu")
+        import jax
+        return jax.devices()[0].platform
+
+    ok = False
+    for attempt in range(max(1, retries)):
+        if _probe_subprocess(want, probe_timeout_s, log):
+            ok = True
+            break
+        for pid, args in _other_device_holders():
+            log(f"[platform] possible device holder: pid {pid}: {args[:120]}")
+            if kill_holders:
+                try:
+                    os.kill(pid, 9)
+                    log(f"[platform] killed pid {pid}")
+                except OSError:
+                    pass
+        if attempt + 1 < retries:
+            time.sleep(backoff_s * (attempt + 1))
+
+    if ok:
+        configure(want)
+        import jax
+        return jax.devices()[0].platform
+    if cpu_fallback:
+        log("[platform] accelerator unavailable after retries; falling "
+            "back to CPU so the artifact is still produced")
+        configure("cpu")
+        import jax
+        try:
+            jax.extend.backend.clear_backends()
+        except Exception:  # noqa: BLE001
+            pass
+        return jax.devices()[0].platform
+    raise RuntimeError(
+        f"JAX backend {want or 'default'} unavailable after {retries} probes")

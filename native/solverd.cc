@@ -204,6 +204,12 @@ void dispatch_batch(PyObject* handler, std::vector<Request>& batch) {
     if (item != nullptr && PyBytes_AsStringAndSize(item, &data, &len) == 0) {
       // release the GIL for the socket write? writes are short; keep it.
       send_response(batch[i].conn, batch[i].id, data, static_cast<size_t>(len));
+    } else {
+      // a non-bytes item or a too-short result list must still answer:
+      // the client would otherwise block on this id until its full
+      // timeout instead of failing fast
+      const char kItemErr[] = "\x80\x04N.";  // pickled None marker
+      send_response(batch[i].conn, batch[i].id, kItemErr, sizeof kItemErr - 1);
     }
     Py_XDECREF(item);
     if (PyErr_Occurred()) PyErr_Print();

@@ -20,3 +20,13 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the suite's wall-clock is dominated by XLA
+# compiles of the FFD kernel at a handful of bucketed shapes; caching them
+# on disk makes every pytest invocation after the first fast (and the
+# kt_solverd daemon subprocess shares the same cache via env, see
+# test_solver_service.py).
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -51,14 +51,31 @@ def daemon(tmp_path_factory):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["KARPENTER_TPU_FORCE_CPU"] = "1"  # never grab the real chip in tests
+    # the site bootstrap exports JAX_PLATFORMS=axon and registers the
+    # accelerator plugin in every interpreter (via sitecustomize) when
+    # PALLAS_AXON_POOL_IPS is set; drop both so the daemon is hermetic CPU
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    # small node axis + the shared persistent compile cache keep the
+    # daemon's first-solve XLA compile in seconds, not minutes, on CPU
+    env["KARPENTER_TPU_MAX_NODES"] = "128"
+    env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(REPO, ".jax_cache")
+    stderr_path = sock + ".stderr"
+    stderr_f = open(stderr_path, "wb")
     proc = subprocess.Popen(
         [DAEMON, "--socket", sock, "--idle-ms", "20", "--max-ms", "200"],
-        env=env, stderr=subprocess.PIPE)
+        env=env, stderr=stderr_f)
+
+    def dump():
+        stderr_f.flush()
+        with open(stderr_path, "rb") as f:
+            return f.read().decode(errors="replace")[-4000:]
+
     for _ in range(100):
         if os.path.exists(sock):
             break
         if proc.poll() is not None:
-            pytest.fail(f"daemon died: {proc.stderr.read().decode()[-2000:]}")
+            pytest.fail(f"daemon died: {dump()}")
         time.sleep(0.1)
     yield sock
     proc.terminate()
@@ -66,11 +83,18 @@ def daemon(tmp_path_factory):
         proc.wait(timeout=10)
     except subprocess.TimeoutExpired:
         proc.kill()
+    # surfaced by pytest on teardown so a hung/failed run shows the
+    # daemon's own diagnostics instead of a bare client timeout
+    out = dump()
+    stderr_f.close()
+    print(f"--- kt_solverd stderr ---\n{out}")
 
 
 @pytest.fixture(scope="module")
 def client(daemon):
-    c = SolverServiceClient(daemon, timeout=300)
+    # every wait is bounded: 120 s covers a cold first-solve compile at
+    # max_nodes=128 on CPU with margin; cached runs answer in milliseconds
+    c = SolverServiceClient(daemon, timeout=120)
     yield c
     c.close()
 

@@ -1,0 +1,169 @@
+"""Split solve: groups the tensor encoding can't express (required pod
+affinity, coupled selectors, custom topology keys) are solved host-side
+AFTER the device solve, instead of abandoning the whole batch to the
+oracle (VERDICT r1 #4; reference hot loop handles these in one engine,
+designs/bin-packing.md:28-42).
+
+Hard assertions: completeness (everything schedulable schedules), validity
+(anti/affinity/spread hold on the merged placement), and the path metric
+(a problem that is 99% plain pods must count as a split solve, not an
+oracle fallback)."""
+
+import collections
+
+from karpenter_tpu.models import (
+    NodePool,
+    ObjectMeta,
+    Pod,
+    PodAffinityTerm,
+    Resources,
+    TopologySpreadConstraint,
+    wellknown,
+)
+from karpenter_tpu.providers import generate_catalog
+from karpenter_tpu.providers.catalog import CatalogSpec
+from karpenter_tpu.scheduling import ScheduleInput, Scheduler
+from karpenter_tpu.solver import TPUSolver
+from karpenter_tpu.utils import metrics
+
+ZONE = wellknown.ZONE_LABEL
+HOST = wellknown.HOSTNAME_LABEL
+CATALOG = generate_catalog(CatalogSpec(max_types=12, include_gpu=False))
+
+
+def mkpod(name, labels=None, cpu="500m", mem="1Gi", **kw):
+    return Pod(meta=ObjectMeta(name=name, labels=labels or {}),
+               requests=Resources.parse({"cpu": cpu, "memory": mem}), **kw)
+
+
+def mkinput(pods):
+    pool = NodePool(meta=ObjectMeta(name="default"))
+    return ScheduleInput(pods=pods, nodepools=[pool],
+                         instance_types={"default": CATALOG})
+
+
+def pod_zones(inp, result):
+    """pod name → zone of its placement (claim zone requirement must be
+    pinned single-value for claims carrying topology-relevant pods)."""
+    node_zone = {en.name: en.node.labels.get(ZONE)
+                 for en in inp.existing_nodes}
+    out = {}
+    for pod_name, node in result.existing_assignments.items():
+        out[pod_name] = node_zone.get(node)
+    for claim in result.new_claims:
+        zreq = claim.requirements.get(ZONE)
+        z = None
+        if zreq is not None and zreq.is_finite() and len(zreq.values()) == 1:
+            (z,) = zreq.values()
+        for pod in claim.pods:
+            out[pod.meta.name] = z
+    return out
+
+
+def solves_path(path):
+    return metrics.SOLVER_SOLVES.value(path=path)
+
+
+class TestSplitSolve:
+    def test_required_affinity_minority_stays_on_device(self):
+        # 600 plain pods + 6 pods that require co-location with them: the
+        # 600 must ride the device, the 6 the oracle
+        pods = [mkpod(f"web-{i}", labels={"app": "web"}) for i in range(600)]
+        pods += [mkpod(f"sidecar-{i}", labels={"app": "sidecar"},
+                       pod_affinities=[PodAffinityTerm(
+                           label_selector={"app": "web"}, topology_key=ZONE,
+                           required=True, anti=False)])
+                 for i in range(6)]
+        inp = mkinput(pods)
+        before_split = solves_path("split")
+        before_oracle = solves_path("oracle")
+        res = TPUSolver().solve(inp)
+        assert not res.unschedulable
+        assert solves_path("split") == before_split + 1
+        assert solves_path("oracle") == before_oracle  # device not abandoned
+        # validity: every sidecar shares a zone with at least one web pod
+        zones = pod_zones(inp, res)
+        web_zones = {zones[f"web-{i}"] for i in range(600)}
+        for i in range(6):
+            z = zones[f"sidecar-{i}"]
+            assert z is not None and z in web_zones, (i, z, web_zones)
+        # all pods accounted for
+        placed = set(zones)
+        assert placed == {p.meta.name for p in pods}
+
+    def test_cross_group_anti_affinity_valid(self):
+        # group A repels group B by zone: A's selector couples a pending
+        # group (residue), B stays on device
+        pods = [mkpod(f"b-{i}", labels={"app": "b"}) for i in range(120)]
+        pods += [mkpod(f"a-{i}", labels={"app": "a"},
+                       pod_affinities=[PodAffinityTerm(
+                           label_selector={"app": "b"}, topology_key=ZONE,
+                           anti=True, required=True)])
+                 for i in range(3)]
+        inp = mkinput(pods)
+        res = TPUSolver().solve(inp)
+        zones = pod_zones(inp, res)
+        b_zones = {zones[f"b-{i}"] for i in range(120) if f"b-{i}" in zones}
+        for i in range(3):
+            name = f"a-{i}"
+            if name in res.unschedulable:
+                continue  # acceptable only if no b-free zone exists
+            assert zones[name] not in b_zones, (name, zones[name], b_zones)
+        # the b majority must fully schedule on the device path
+        assert all(f"b-{i}" not in res.unschedulable for i in range(120))
+
+    def test_custom_topology_key_goes_residue(self):
+        pods = [mkpod(f"p-{i}", labels={"app": "web"}) for i in range(200)]
+        pods += [mkpod(f"r-{i}", labels={"app": "rack"},
+                       topology_spread=[TopologySpreadConstraint(
+                           topology_key="example.com/rack", max_skew=1,
+                           when_unsatisfiable="DoNotSchedule",
+                           label_selector={"app": "rack"})])
+                 for i in range(4)]
+        res = TPUSolver().solve(mkinput(pods))
+        # custom-key spread over a cluster with no such domains: the
+        # oracle decides (fresh nodes carry no rack label); the 200 plain
+        # pods must schedule regardless
+        assert all(f"p-{i}" not in res.unschedulable for i in range(200))
+
+    def test_node_count_stays_near_oracle(self):
+        pods = [mkpod(f"web-{i}", labels={"app": "web"}) for i in range(300)]
+        pods += [mkpod(f"side-{i}", labels={"app": "side"},
+                       pod_affinities=[PodAffinityTerm(
+                           label_selector={"app": "web"}, topology_key=ZONE,
+                           required=True, anti=False)])
+                 for i in range(3)]
+        inp = mkinput(pods)
+        split_res = TPUSolver().solve(inp)
+        oracle_res = Scheduler(inp).solve()
+        assert not split_res.unschedulable and not oracle_res.unschedulable
+        # residue pods can at worst each open one extra node
+        assert split_res.node_count() <= oracle_res.node_count() + 3
+        # capacity validity: every claim's packed requests fit its top type
+        types = {it.name: it for it in CATALOG}
+        for claim in split_res.new_claims:
+            assert claim.instance_type_names, "claim lost all types"
+            top = types[claim.instance_type_names[0]]
+            assert claim.requests.fits(top.allocatable())
+
+    def test_pure_residue_problem_still_solves(self):
+        # every group inexpressible: the split path must still answer
+        # (device does nothing, oracle does everything)
+        pods = [mkpod(f"a-{i}", labels={"app": "a"},
+                      pod_affinities=[PodAffinityTerm(
+                          label_selector={"app": "b"}, topology_key=ZONE,
+                          anti=True, required=True)])
+                for i in range(5)]
+        pods += [mkpod(f"b-{i}", labels={"app": "b"},
+                       pod_affinities=[PodAffinityTerm(
+                           label_selector={"app": "a"}, topology_key=ZONE,
+                           anti=True, required=True)])
+                 for i in range(5)]
+        inp = mkinput(pods)
+        res = TPUSolver().solve(inp)
+        zones = pod_zones(inp, res)
+        a_zones = {zones[n] for n in zones if n.startswith("a-")}
+        b_zones = {zones[n] for n in zones if n.startswith("b-")}
+        assert not (a_zones & b_zones), (a_zones, b_zones)
+        oracle = Scheduler(inp).solve()
+        assert len(res.unschedulable) == len(oracle.unschedulable)

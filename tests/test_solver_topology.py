@@ -337,23 +337,27 @@ class TestCombined:
         assert solver.node_count() == oracle.node_count() == 0
         assert_skew_valid(zone_counts(inp, solver), {}, 1)
 
-    def test_unsupported_two_dynamic_keys(self):
-        p = mkpod("p", topology_spread=[spread(key=ZONE), spread(key=CT)])
-        with pytest.raises(UnsupportedPods):
-            TPUSolver().solve(mkinput([p]))
-
-    def test_gated_solver_falls_back_for_unsupported(self):
-        # the full provisioner path: unsupported constraints must still
-        # schedule via the oracle, never fail (SURVEY §5)
+    def test_two_dynamic_keys_solved_as_residue(self):
+        # two dynamic topology keys on one pod can't ride the kernel; the
+        # split path hands the group to the host oracle instead of raising
+        # (r1 behavior) — the result must match the oracle exactly
         p = mkpod("p", topology_spread=[spread(key=ZONE), spread(key=CT)])
         inp = mkinput([p])
-        from karpenter_tpu.solver import TPUSolver as TS
-        try:
-            TS().solve(inp)
-            assert False, "expected UnsupportedPods"
-        except UnsupportedPods:
-            res = Scheduler(inp).solve()
+        res = TPUSolver().solve(inp)
         assert not res.unschedulable
+        assert res.node_count() == Scheduler(inp).solve().node_count()
+
+    def test_mixed_residue_and_device_groups(self):
+        # the residue pod must not drag the plain majority off the device
+        pods = [mkpod(f"plain{i}", labels={"app": "other"})
+                for i in range(50)]
+        pods.append(mkpod("p", topology_spread=[spread(key=ZONE),
+                                                spread(key=CT)]))
+        res = TPUSolver().solve(mkinput(pods))
+        assert not res.unschedulable
+        placed = set(res.existing_assignments) | {
+            q.meta.name for c in res.new_claims for q in c.pods}
+        assert len(placed) == 51
 
 
 class TestScale:
