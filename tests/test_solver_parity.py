@@ -195,25 +195,50 @@ class TestParity:
         fams = {n.split(".")[0] for n in solver.new_claims[0].instance_type_names}
         assert fams == {"m6", "c6"}
 
-    def test_unsupported_raises(self):
-        # required pod *affinity* (non-anti) has no tensor encoding yet
+    def test_split_handles_required_pod_affinity(self):
+        # required pod *affinity* (non-anti) has no tensor encoding; the
+        # split path hands only the affinity pods to the host oracle and
+        # keeps the rest on device (solve.py _solve_split)
         from karpenter_tpu.models import PodAffinityTerm
+        from karpenter_tpu.utils import metrics
         p = mkpod("t", labels={"app": "web"}, pod_affinities=[PodAffinityTerm(
             label_selector={"app": "web"},
             topology_key=wellknown.ZONE_LABEL)])
-        with pytest.raises(UnsupportedPods):
-            TPUSolver().solve(mkinput([p]))
+        filler = [mkpod(f"f{i}") for i in range(10)]
+        residue_before = metrics.SOLVER_RESIDUE_PODS.value()
+        split_before = metrics.SOLVER_SOLVES.value(path="split")
+        res = TPUSolver().solve(mkinput([p] + filler))
+        assert not res.unschedulable
+        placed = {pn for c in res.new_claims for pn in (q.meta.name for q in c.pods)}
+        placed |= set(res.existing_assignments)
+        assert placed == {"t"} | {f"f{i}" for i in range(10)}
+        # the residue (1 affinity pod) was counted and the split path taken
+        assert metrics.SOLVER_RESIDUE_PODS.value() == residue_before + 1
+        assert metrics.SOLVER_SOLVES.value(path="split") == split_before + 1
+        # affinity is satisfied: "t" lives somewhere — self-affinity on a
+        # fresh cluster is satisfiable by co-locating with itself
+        by_name = {it.name: it for it in CATALOG}
+        for claim in res.new_claims:
+            it = by_name[claim.instance_type_names[0]]
+            assert claim.requests.fits(it.allocatable())
 
-    def test_unsupported_cross_group_coupling(self):
+    def test_split_cross_group_coupling(self):
         # a spread selector matching another pending group couples their
-        # placements mid-solve — oracle fallback
+        # placements mid-solve — both coupled groups go to the oracle as
+        # residue; placements must be valid and complete
         from karpenter_tpu.models import TopologySpreadConstraint
+        from karpenter_tpu.utils import metrics
         a = mkpod("a", labels={"team": "x"}, topology_spread=[
             TopologySpreadConstraint(topology_key=wellknown.ZONE_LABEL,
                                      label_selector={"team": "x"})])
         b = mkpod("b", cpu="1", labels={"team": "x"})
-        with pytest.raises(UnsupportedPods):
-            TPUSolver().solve(mkinput([a, b]))
+        residue_before = metrics.SOLVER_RESIDUE_PODS.value()
+        res = TPUSolver().solve(mkinput([a, b]))
+        assert not res.unschedulable
+        placed = {pn for c in res.new_claims for pn in (q.meta.name for q in c.pods)}
+        placed |= set(res.existing_assignments)
+        assert placed == {"a", "b"}
+        assert metrics.SOLVER_RESIDUE_PODS.value() > residue_before
 
     def test_large_scale_smoke(self):
         # 2000 pods across 4 equivalence classes
