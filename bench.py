@@ -5,22 +5,45 @@ against the full ~700-type catalog (~4.2k zonal spot/on-demand offerings),
 one NodePool, price-optimal packing on one TPU chip.
 
 North star (BASELINE.md): <200 ms on v5e-1, node count ≤ the FFD oracle.
-vs_baseline = 200ms-target / measured — >1.0 means beating the target.
+vs_baseline = 200ms-target / measured p50 — >1.0 means beating the target.
 
-Prints exactly ONE JSON line on stdout.  Platform handling: the axon site
-bootstrap pins jax_platforms via jax.config (beating JAX_PLATFORMS), so we
-bootstrap through karpenter_tpu.utils.platform — honor an explicit
-JAX_PLATFORMS/KARPENTER_TPU_PLATFORM for CPU smoke runs, otherwise take
-the site default (TPU), retrying UNAVAILABLE backend init with backoff and
-killing leftover kt_solverd daemons that hold the chip (the round-1
-failure mode), falling back to CPU rather than dying with rc=1.
+Prints exactly ONE JSON line on stdout; the line carries the headline
+(p50/p95, per-run latencies, per-run host share), the 50k oracle node
+bound (measured, not assumed — a one-off generously-budgeted oracle run),
+and all five BASELINE config lines from benchmarks/ (each its own
+subprocess; rc and parsed JSON per config).
+
+Resilience: the axon site bootstrap pins jax_platforms via jax.config
+(beating JAX_PLATFORMS), so platform selection goes through
+karpenter_tpu.utils.platform — subprocess probe with hard timeout, retries
+with backoff, kt_solverd holder kill, CPU fallback. The FIRST in-process
+solve gets its own retry-or-CPU-fallback: the probe subprocess releases
+the chip before the parent re-acquires it, and that race can surface as
+UNAVAILABLE at first *dispatch* even after a clean probe (the round-2
+rc=1 failure mode). Every attempt appends one record to
+BENCH_ATTEMPTS.jsonl so failure evidence survives artifact overwrites.
 """
 
 import json
+import os
 import statistics
+import subprocess
 import sys
 import threading
 import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ATTEMPTS_LOG = os.path.join(HERE, "BENCH_ATTEMPTS.jsonl")
+
+
+def log_attempt(record: dict) -> None:
+    """Append-only per-attempt evidence (ADVICE r2: the n=1 rc=1 record was
+    overwritten and unverifiable; JSONL preserves it)."""
+    try:
+        with open(ATTEMPTS_LOG, "a") as f:
+            f.write(json.dumps(record) + "\n")
+    except OSError:
+        pass
 
 
 def build_input(n_pods: int):
@@ -52,69 +75,185 @@ def build_input(n_pods: int):
 def oracle_nodes(inp, budget_s: float):
     """FFD-oracle node count for the same problem, bounded by a wall-clock
     budget (the per-pod Python oracle is the reference semantics, not a
-    fast path).  Returns None on timeout."""
+    fast path).  Returns (nodes, unsched, seconds) or (None, None, None)
+    on timeout."""
     from karpenter_tpu.scheduling import Scheduler
     out = {}
 
     def run():
+        t0 = time.perf_counter()
         res = Scheduler(inp).solve()
         out["nodes"] = res.node_count()
         out["unsched"] = len(res.unschedulable)
+        out["secs"] = round(time.perf_counter() - t0, 1)
 
     t = threading.Thread(target=run, daemon=True)
     t.start()
     t.join(budget_s)
-    return (out.get("nodes"), out.get("unsched")) if out else (None, None)
+    return (out.get("nodes"), out.get("unsched"), out.get("secs"))
+
+
+def first_solve_with_retry(solver, inp, platform: str,
+                           retries: int = 3, backoff_s: float = 5.0):
+    """The warm-up solve triggers the parent process's real backend init +
+    first dispatch — the step the probe's TOCTOU hole can still break.
+    Retry with backoff; on persistent backend failure fall back to CPU so
+    the artifact is produced (rc=0) with the degradation recorded.
+
+    Returns (solver, result, platform): the CPU fallback REBUILDS the
+    solver — a failed attempt may have left a half-built or TPU-resident
+    catalog cache and a resolved TPU mesh, which would poison every
+    subsequent solve on the fresh backend."""
+    for attempt in range(retries):
+        try:
+            res = solver.solve(inp)
+            return solver, res, platform
+        except Exception as e:  # noqa: BLE001
+            msg = str(e)
+            log_attempt({"stage": "first-solve", "attempt": attempt + 1,
+                         "platform": platform, "error": msg[:500],
+                         "ts": time.time()})
+            fatal_backend = any(s in msg for s in (
+                "UNAVAILABLE", "backend", "Unable to initialize",
+                "DEADLINE_EXCEEDED"))
+            if not fatal_backend:
+                raise
+            print(f"[bench] first solve failed (attempt {attempt + 1}): "
+                  f"{msg[:200]}", file=sys.stderr, flush=True)
+            time.sleep(backoff_s * (attempt + 1))
+            # a retry must not reuse buffers device_put onto a dead
+            # backend: drop the cached catalog encoding between attempts
+            solver._cat = None
+            solver._cat_key = None
+    # backend is wedged — rebuild everything on CPU rather than dying rc=1
+    print("[bench] backend unusable after retries; falling back to CPU",
+          file=sys.stderr, flush=True)
+    from karpenter_tpu.solver import TPUSolver
+    from karpenter_tpu.utils.platform import configure
+    import jax
+    configure("cpu")
+    try:
+        jax.extend.backend.clear_backends()
+    except Exception:  # noqa: BLE001
+        pass
+    fresh = TPUSolver(max_nodes=solver.max_nodes, mesh="off")
+    res = fresh.solve(inp)
+    return fresh, res, "cpu"
+
+
+def run_configs(timeout_s: float):
+    """All 5 BASELINE configs, each in its own subprocess (fresh backend,
+    bounded wall-clock); returns a list of {config, rc, parsed|error}.
+
+    MUST run before the parent initializes its own accelerator backend:
+    the chip admits one process at a time, so configs run while the
+    parent hasn't claimed it, each acquiring and releasing in turn (each
+    config resolves the platform itself and records it in its JSON)."""
+    out = []
+    configs = ["config1_inflate.py", "config2_mixed.py",
+               "config3_topology.py", "config4_consolidation.py",
+               "config5_burst.py"]
+    env = dict(os.environ)
+    for cfg in configs:
+        path = os.path.join(HERE, "benchmarks", cfg)
+        rec = {"config": cfg}
+        try:
+            proc = subprocess.run([sys.executable, path], env=env,
+                                  capture_output=True, text=True,
+                                  timeout=timeout_s)
+            rec["rc"] = proc.returncode
+            line = next((ln for ln in proc.stdout.splitlines()
+                         if ln.startswith("{")), None)
+            if line:
+                rec["parsed"] = json.loads(line)
+            if proc.returncode != 0:
+                tail = (proc.stderr or "").strip().splitlines()
+                rec["error"] = tail[-1][:300] if tail else "<no stderr>"
+        except subprocess.TimeoutExpired:
+            rec["rc"] = -1
+            rec["error"] = f"timeout after {timeout_s:.0f}s"
+        log_attempt({"stage": "config", **rec, "ts": time.time()})
+        out.append(rec)
+    return out
 
 
 def main() -> None:
+    # configs FIRST: their subprocesses need the chip, which admits one
+    # process at a time — after the parent initializes below, a config
+    # subprocess would burn its whole probe budget and fall back to CPU
+    configs = run_configs(timeout_s=float(
+        os.environ.get("KARPENTER_TPU_BENCH_TIMEOUT", "600")))
+
     from karpenter_tpu.utils.platform import initialize
     platform = initialize(kill_holders=True)
     print(f"platform={platform}", file=sys.stderr, flush=True)
+    log_attempt({"stage": "init", "platform": platform, "ts": time.time()})
 
     from karpenter_tpu.solver import TPUSolver
 
     inp = build_input(50_000)
     solver = TPUSolver(max_nodes=2048)
-    res = solver.solve(inp)  # compile + warm caches
+    solver, res, platform = first_solve_with_retry(solver, inp, platform)
     assert not res.unschedulable, "benchmark workload must fully schedule"
 
-    times = []
-    for _ in range(5):
+    times, host_shares, run_phases = [], [], []
+    for _ in range(7):
         t0 = time.perf_counter()
         res = solver.solve(inp)
         t1 = time.perf_counter()
-        times.append((t1 - t0) * 1000.0)
-    ms = statistics.median(times)
-    phases = {k: round(v, 1) for k, v in solver.last_phase_ms.items()}
+        ms = (t1 - t0) * 1000.0
+        times.append(ms)
+        phases = {k: round(v, 1) for k, v in solver.last_phase_ms.items()}
+        run_phases.append(phases)
+        host_ms = sum(v for k, v in phases.items() if k != "device")
+        # per-run share: this run's host phases over THIS run's latency
+        # (r2 divided the last run's phases by the median — meaningless)
+        host_shares.append(host_ms / ms if ms > 0 else 0.0)
+    p50 = statistics.median(times)
+    p95 = sorted(times)[max(0, int(round(0.95 * len(times))) - 1)]
 
-    # parity line: oracle vs solver on a 5k-pod subproblem of the same mix
-    # (the full 50k through the per-pod Python oracle takes minutes)
     sub = build_input(5_000)
     sub_res = solver.solve(sub)
-    onodes, ounsched = oracle_nodes(sub, budget_s=180.0)
-    parity = {
-        "solver_nodes_5k": sub_res.node_count(),
-        "oracle_nodes_5k": onodes,
-        "nodes_le_oracle": (None if onodes is None
-                            else sub_res.node_count() <= onodes),
-    }
+    onodes_5k, ounsched_5k, _ = oracle_nodes(sub, budget_s=180.0)
 
-    print(json.dumps({
+    # 50k node-count bound LAST: measured against the real oracle with a
+    # generous one-off budget (VERDICT r2 #3) — ordered after every timed
+    # measurement so a timed-out oracle daemon thread can't keep a core
+    # busy under them (the process exits right after printing)
+    budget_50k = float(os.environ.get("KARPENTER_TPU_ORACLE_BUDGET", "900"))
+    onodes_50k, ounsched_50k, osecs_50k = oracle_nodes(inp, budget_50k)
+
+    result = {
         "metric": "schedule 50k pods x 700 instance types (end-to-end, 1 chip)",
-        "value": round(ms, 1),
+        "value": round(p50, 1),
         "unit": "ms",
-        "vs_baseline": round(200.0 / ms, 3),
+        "vs_baseline": round(200.0 / p50, 3),
         "platform": platform,
+        "p50_ms": round(p50, 1),
+        "p95_ms": round(p95, 1),
+        "runs_ms": [round(t, 1) for t in times],
+        "host_share_per_run": [round(h, 2) for h in host_shares],
         "nodes": res.node_count(),
-        **parity,
-    }))
-    host_ms = sum(v for k, v in phases.items() if k != "device")
+        "oracle_nodes_50k": onodes_50k,
+        "oracle_unsched_50k": ounsched_50k,
+        "oracle_secs_50k": osecs_50k,
+        "nodes_le_oracle_50k": (None if onodes_50k is None
+                                else res.node_count() <= onodes_50k),
+        "solver_nodes_5k": sub_res.node_count(),
+        "oracle_nodes_5k": onodes_5k,
+        "nodes_le_oracle": (None if onodes_5k is None
+                            else sub_res.node_count() <= onodes_5k),
+        "configs": configs,
+    }
+    log_attempt({"stage": "result", **result, "ts": time.time()})
+    print(json.dumps(result))
     print(f"nodes={res.node_count()} total_price=${res.total_price():.2f}/h "
-          f"runs={[round(t) for t in times]} phases_ms={phases} "
-          f"host_share={host_ms / ms:.2f} "
-          f"oracle_5k={onodes} (unsched={ounsched}) "
-          f"solver_5k={sub_res.node_count()}", file=sys.stderr)
+          f"p50={p50:.1f}ms p95={p95:.1f}ms runs={[round(t) for t in times]} "
+          f"last_phases_ms={run_phases[-1]} "
+          f"host_share_per_run={[round(h, 2) for h in host_shares]} "
+          f"oracle_50k={onodes_50k} ({osecs_50k}s, unsched={ounsched_50k}) "
+          f"oracle_5k={onodes_5k} solver_5k={sub_res.node_count()}",
+          file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -409,7 +409,11 @@ class Disruption:
         looked at (per-simulation metrics recorded in GatedSolver)."""
         inps = [self._build_sim_input(cs, cap)
                 for cs, cap in zip(cand_sets, price_caps)]
-        results = self.solver.solve_batch(inps, source="disruption")
+        # admissibility allows at most ONE replacement node (_admissible),
+        # so a tiny new-node axis is exact: slot exhaustion reports
+        # unschedulable, rejected the same as a >1-claim result
+        results = self.solver.solve_batch(inps, source="disruption",
+                                          max_nodes=8)
         return (self._admissible(r) for r in results)
 
     def _acceptable(self, cands: List[Candidate],

@@ -32,7 +32,8 @@ class GatedSolver:
             self.tpu = SolverServiceClient(options.solver_endpoint)
         else:
             from karpenter_tpu.solver import TPUSolver
-            self.tpu = TPUSolver(max_nodes=options.solver_max_nodes)
+            self.tpu = TPUSolver(max_nodes=options.solver_max_nodes,
+                                 mesh=getattr(options, "solver_mesh", "auto"))
             # warm the native host-ops build at startup, never inside a
             # latency-sensitive solve
             from karpenter_tpu.native import hostops
@@ -54,7 +55,8 @@ class GatedSolver:
         return Scheduler(inp).solve()
 
     def solve_batch(self, inps: List[ScheduleInput],
-                    source: str = "disruption"):
+                    source: str = "disruption",
+                    max_nodes: Optional[int] = None):
         """Batched simulations sharing one cluster snapshot (consolidation's
         candidate axis). Returns an iterable: the device path is one eager
         vmapped call; the oracle fallback is LAZY, so a caller that stops at
@@ -69,7 +71,9 @@ class GatedSolver:
         if self.options.feature_gates.tpu_solver:
             try:
                 t0 = _time.perf_counter()
-                results = self.tpu.solve_batch(inps)
+                # both backends (in-process TPUSolver, SolverServiceClient)
+                # accept the per-call kernel cap
+                results = self.tpu.solve_batch(inps, max_nodes=max_nodes)
                 if results:
                     per = (_time.perf_counter() - t0) / len(results)
                     for _ in results:

@@ -53,11 +53,16 @@ class Environment:
         catalog: Optional[List[InstanceType]] = None,
         options: Optional[Options] = None,
         catalog_spec: Optional[CatalogSpec] = None,
+        cloud=None,
     ):
         self.clock = clock or FakeClock()
         self.options = options or Options()
-        self.cloud = FakeCloud(catalog=catalog, clock=self.clock,
-                               spec=catalog_spec)
+        # the cloud session is injectable (operator.go:105-116 resolves the
+        # AWS session the same way); default is the in-memory fake, the only
+        # cloud in this environment — a real TPU-pool/GCE session plugs in
+        # here without touching the wiring below
+        self.cloud = cloud if cloud is not None else FakeCloud(
+            catalog=catalog, clock=self.clock, spec=catalog_spec)
         self.pricing = PricingProvider(self.cloud)
         self.unavailable = UnavailableOfferings(clock=self.clock)
         self.instance_types = InstanceTypeProvider(

@@ -36,15 +36,31 @@ class Operator:
 
     def __init__(self, options: Optional[Options] = None,
                  metrics_port: int = 8000, health_port: int = 8081,
-                 reconcile_interval: float = 1.0):
+                 reconcile_interval: float = 1.0,
+                 env: Optional[Environment] = None, lease=None,
+                 identity: Optional[str] = None):
         self.options = options or Options.from_env()
-        self.env = Environment(clock=RealClock(), options=self.options)
+        # env is injectable so an HA pair (or a test) can run two replicas
+        # against one shared cluster store, the way two reference replicas
+        # share the kube-apiserver
+        self.env = env or Environment(clock=RealClock(), options=self.options)
         self.metrics_port = metrics_port
         self.health_port = health_port
         self.reconcile_interval = reconcile_interval
         self._stop = threading.Event()
         self._last_reconcile = 0.0
         self._servers: list = []
+        self.elector = None
+        if self.options.leader_elect or lease is not None:
+            from karpenter_tpu.operator.leaderelection import (
+                FileLease,
+                LeaderElector,
+            )
+            if lease is None:
+                if not self.options.lease_file:
+                    raise ValueError("leader_elect requires lease_file")
+                lease = FileLease(self.options.lease_file)
+            self.elector = LeaderElector(lease, identity=identity)
         # boot-time connectivity probe, the reference's CheckEC2Connectivity
         # (operator.go:209-218): fail fast if the cloud isn't reachable
         if not self.env.cloud.live():
@@ -124,11 +140,19 @@ class Operator:
         workqueues with periodic resync."""
         self.serve()
         while not self._stop.is_set():
+            if self.elector is not None and not self.elector.try_acquire_or_renew():
+                # standby: hold position, retry on the election cadence;
+                # liveness stays green (the loop IS advancing)
+                self._last_reconcile = time.monotonic()
+                self._stop.wait(self.elector.retry_period)
+                continue
             t0 = time.monotonic()
             self.env.manager.run_once()
             self._last_reconcile = time.monotonic()
             elapsed = self._last_reconcile - t0
             self._stop.wait(max(0.0, self.reconcile_interval - elapsed))
+        if self.elector is not None:
+            self.elector.release()
 
     def stop(self, *_args) -> None:
         self._stop.set()

@@ -49,10 +49,19 @@ class Options:
     registration_ttl: float = 15 * 60.0   # never-registered GC (designs/limits.md:23-25)
     # solver
     solver_max_nodes: int = 1024
+    # multi-chip: "auto" shards the solve's column axis over every local
+    # device when >1 is visible (SURVEY §2.3 ICI sharding); "off" forces
+    # single-device; an integer uses the first n devices
+    solver_mesh: str = "auto"
     # unix-socket path of a kt_solverd solver service (native/solverd.cc);
     # None = in-process solver. Lets control-plane replicas share one
     # TPU-owning process (SURVEY §2.3 leader-election note).
     solver_endpoint: "str | None" = None
+    # HA: active/passive replicas racing a shared lease (core LEADER_ELECT;
+    # charts/karpenter/values.yaml:35 runs 2 replicas). lease_file names a
+    # FileLease shared by replicas on one host.
+    leader_elect: bool = False
+    lease_file: "str | None" = None
     feature_gates: FeatureGates = field(default_factory=FeatureGates)
 
     @classmethod
@@ -67,4 +76,8 @@ class Options:
             opts.feature_gates = FeatureGates.parse(os.environ["FEATURE_GATES"])
         opts.solver_endpoint = os.environ.get(
             "SOLVER_ENDPOINT", opts.solver_endpoint)
+        opts.solver_mesh = os.environ.get("SOLVER_MESH", opts.solver_mesh)
+        opts.leader_elect = os.environ.get(
+            "LEADER_ELECT", "").strip().lower() in ("1", "true", "yes")
+        opts.lease_file = os.environ.get("LEASE_FILE", opts.lease_file)
         return opts

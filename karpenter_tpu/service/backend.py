@@ -52,14 +52,14 @@ def _get_solver():
     return _solver
 
 
-def _solve_group(inps: List) -> List:
+def _solve_group(inps: List, max_nodes: Optional[int] = None) -> List:
     """Device batch with per-input fallback (never fail — SURVEY §5):
     first the whole fused batch, then per-input device/split solves, and
     only a truly unsupported input reaches the host oracle."""
     from karpenter_tpu.scheduling import Scheduler
     from karpenter_tpu.solver import UnsupportedPods
     try:
-        return _get_solver().solve_batch(inps)
+        return _get_solver().solve_batch(inps, max_nodes=max_nodes)
     except UnsupportedPods:
         out = []
         for inp in inps:
@@ -104,9 +104,10 @@ def handle_batch(payloads: List[bytes]) -> List[bytes]:
             responses[i] = ("result", {"batch_sizes": list(_batch_log),
                                        "catalogs": len(_catalogs)})
 
-    # schedule requests grouped by catalog fingerprint → one device batch
-    # per group (the coalescing the C++ window exists to enable)
-    by_fp: Dict[str, List[int]] = {}
+    # schedule requests grouped by (catalog fingerprint, max_nodes) → one
+    # device batch per group (the coalescing the C++ window exists to
+    # enable; max_nodes is a static kernel shape, so it's a grouping key)
+    by_fp: Dict[tuple, List[int]] = {}
     for i, req in enumerate(requests):
         if req is None or responses[i] is not None:
             continue
@@ -121,9 +122,9 @@ def handle_batch(payloads: List[bytes]) -> List[bytes]:
         if fp not in _catalogs:
             responses[i] = ("need_catalog", None)
             continue
-        by_fp.setdefault(fp, []).append(i)
+        by_fp.setdefault((fp, body.get("max_nodes")), []).append(i)
 
-    for fp, idxs in by_fp.items():
+    for (fp, max_nodes), idxs in by_fp.items():
         _batch_log.append(len(idxs))
         nodepools, instance_types = _catalogs[fp]
         inps = []
@@ -139,7 +140,7 @@ def handle_batch(payloads: List[bytes]) -> List[bytes]:
                 price_cap=body.get("price_cap"),
             ))
         try:
-            results = _solve_group(inps)
+            results = _solve_group(inps, max_nodes=max_nodes)
             for i, res in zip(idxs, results):
                 responses[i] = ("result", res)
         except Exception as e:  # noqa: BLE001

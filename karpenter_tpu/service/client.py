@@ -211,7 +211,11 @@ class SolverServiceClient:
     def solve(self, inp: ScheduleInput) -> ScheduleResult:
         return self.solve_batch([inp])[0]
 
-    def solve_batch(self, inps: List[ScheduleInput]) -> List[ScheduleResult]:
+    def solve_batch(self, inps: List[ScheduleInput],
+                    max_nodes: Optional[int] = None) -> List[ScheduleResult]:
+        """`max_nodes` rides the schedule request so the disruption
+        simulator's tiny-kernel cap survives the solverd deployment — the
+        shared-TPU shape the cap matters most for."""
         if not inps:
             return []
         fp, payload = self._fingerprint(inps[0])
@@ -227,6 +231,7 @@ class SolverServiceClient:
                 "daemon_overhead": inp.daemon_overhead,
                 "remaining_limits": inp.remaining_limits,
                 "price_cap": inp.price_cap,
+                "max_nodes": max_nodes,
             }))
         out: List[ScheduleResult] = []
         try:

@@ -234,14 +234,24 @@ def _solve_ffd_impl(
                 k_full = jnp.max(jnp.where(cols_p, per_col, 0))
                 pool_room = jnp.all(limits[p] - pool_daemon[p] - req >= -EPS)
                 can = cols_p.any() & pool_room & (c_rem > 0) & (k_full > 0)
-                m_need = jnp.where(can, -(-c_rem // jnp.maximum(k_full, 1)), 0)
-                # per-node charge against the pool limit (full-node approx)
-                charge = pool_daemon[p] + k_full.astype(jnp.float32) * req
-                m_limit = _fit_count(limits[p][None, :], charge)[0]
-                m = jnp.minimum(jnp.minimum(m_need, m_limit), N - num_active_)
+                kf = jnp.maximum(k_full, 1)
+                # budget-exact node count: affordable PODS first, then the
+                # per-node daemon charge for the implied node count (two
+                # passes, m only shrinks — sound since t·req + m·daemon ≤
+                # limit after the second clamp). A full-node charge here
+                # would open ZERO nodes whenever the remaining budget is
+                # smaller than one maximal node, stranding pods that only
+                # need a sliver of it.
+                t = jnp.minimum(c_rem, _fit_count(limits[p][None, :], req)[0])
+                m_t = -(-t // kf)
+                t = jnp.minimum(t, _fit_count(
+                    (limits[p] - m_t.astype(jnp.float32) * pool_daemon[p]
+                     )[None, :], req)[0])
+                m_need = jnp.where(can, -(-t // kf), 0)
+                m = jnp.minimum(m_need, N - num_active_)
                 newmask = (idx >= num_active_) & (idx < num_active_ + m)
                 pos = idx - num_active_
-                taken_new = jnp.minimum(c_rem, m * k_full)
+                taken_new = jnp.minimum(t, m * k_full)
                 k_node = jnp.where(
                     newmask,
                     jnp.where(pos == m - 1, taken_new - (m - 1) * k_full, k_full),
@@ -306,8 +316,12 @@ def _solve_ffd_impl(
             # each in-flight node serves exactly ONE domain (placing a
             # zone-spread pod pins the node, as the oracle's requirement
             # narrowing does); break capacity ties by rotating over nodes
-            # so equal nodes spread across domains
-            score = cap_nd * jnp.int32(D + 1) + (idx[None, :] + dom_ids[:, None]) % D
+            # so equal nodes spread across domains. Capacity saturates at
+            # the group count: beyond cnt it buys nothing, and without the
+            # clamp a domain whose best column is marginally larger would
+            # win EVERY unpinned node and starve the other domains.
+            score = (jnp.minimum(cap_nd, cnt) * jnp.int32(D + 1)
+                     + (idx[None, :] + dom_ids[:, None]) % D)
             bd = jnp.argmax(score, axis=0).astype(jnp.int32)        # [N]
             sel_nd = dom_ids[:, None] == bd[None, :]
             cap_nd = jnp.where(sel_nd, cap_nd, 0)
@@ -323,10 +337,31 @@ def _solve_ffd_impl(
             rooms = jnp.stack([
                 jnp.all(limits[p] - pool_daemon[p] - req >= -EPS)
                 for p in range(P)])                                 # [P]
-            new_est = (N - num_active) * jnp.where(rooms[:, None], kfull_pd, 0
-                                                   ).max(0)         # [D]
+            # new-node pods per domain, clamped by what the pool budget can
+            # actually buy — an unclamped estimate makes the water-fill
+            # promise quotas the open-new step then can't honor
+            afford = jnp.stack([
+                _fit_count(limits[p][None, :], req)[0]
+                for p in range(P)])                                 # [P]
+            new_est = jnp.where(
+                rooms[:, None],
+                jnp.minimum((N - num_active) * kfull_pd, afford[:, None]),
+                0).max(0)                                           # [D]
             capacity = cap_ed.sum(-1) + cap_nd.sum(-1) + new_est    # [D]
-            want = _water_fill(cnt, dbase, jnp.minimum(capacity, dcap),
+            # the pool budget is SHARED across domains (existing-node fills
+            # don't consume it; in-flight and new nodes do): cap the group
+            # count by the total affordable so the water-fill plans quotas
+            # the budget can honor — an overshooting plan starves whichever
+            # domain fills last, and the repair pass then strips its
+            # placements back to the skew ceiling, stranding pods the
+            # oracle would have placed in a balanced [51,50,50] shape.
+            # NOT gated by `rooms`: in-flight fills charge only req (no
+            # per-node daemon), so a pool without room for one more whole
+            # node can still fund fills on already-open nodes
+            afford_total = afford.sum()
+            cnt_eff = jnp.minimum(
+                cnt, (cap_ed.sum() if E else 0) + afford_total)
+            want = _water_fill(cnt_eff, dbase, jnp.minimum(capacity, dcap),
                                delig, skew, mindom)                  # [D]
             unplaceable = cnt - want.sum()
 
@@ -372,12 +407,19 @@ def _solve_ffd_impl(
                 m_list, taken_list = [], []
                 for d in range(D):
                     can = (kfull_d[d] > 0) & (want[d] > 0)
-                    m_need = jnp.where(
-                        can, -(-want[d] // jnp.maximum(kfull_d[d], 1)), 0)
-                    charge = pool_daemon[p] + kfull_d[d].astype(jnp.float32) * req
-                    m_lim = _fit_count(rem_budget[None, :], charge)[0]
-                    m_d = jnp.minimum(jnp.minimum(m_need, m_lim), slots_left)
-                    taken_d = jnp.minimum(want[d], m_d * kfull_d[d])
+                    kf = jnp.maximum(kfull_d[d], 1)
+                    # budget-exact, as in the light branch: affordable pods
+                    # first, then daemon for the implied node count — never
+                    # the full-node overcharge
+                    t = jnp.minimum(want[d],
+                                    _fit_count(rem_budget[None, :], req)[0])
+                    m_t = -(-t // kf)
+                    t = jnp.minimum(t, _fit_count(
+                        (rem_budget - m_t.astype(jnp.float32) * pool_daemon[p]
+                         )[None, :], req)[0])
+                    m_need = jnp.where(can, -(-t // kf), 0)
+                    m_d = jnp.minimum(m_need, slots_left)
+                    taken_d = jnp.minimum(t, m_d * kfull_d[d])
                     rem_budget = rem_budget - (
                         m_d.astype(jnp.float32) * pool_daemon[p]
                         + taken_d.astype(jnp.float32) * req)

@@ -46,14 +46,74 @@ class UnsupportedPods(Exception):
 
 
 class TPUSolver:
-    def __init__(self, max_nodes: int = 1024):
+    def __init__(self, max_nodes: int = 1024, mesh="auto"):
+        """`mesh` selects the multi-chip story (SURVEY §2.3: shard the
+        column axis over ICI):
+
+        - "auto" (default): shard over every local device when more than
+          one is visible; single-device otherwise.
+        - None / 0 / "off": force the single-device path.
+        - an int n: mesh over the first n devices.
+        - a jax.sharding.Mesh: use as given (axis name "cat").
+
+        Resolution is lazy (first solve) so constructing a solver never
+        initializes a JAX backend.
+        """
         self.max_nodes = max_nodes
         self._cat_key = None
         self._cat = None
+        self._mesh_spec = mesh
+        self._mesh = None
+        self._mesh_resolved = False
         # per-solve host/device phase breakdown (ms), refreshed by
         # _solve_attempt — the observability the north-star budget needs
         # (encode+decode host share must stay well under the solve time)
         self.last_phase_ms: Dict[str, float] = {}
+
+    @property
+    def mesh(self):
+        """The resolved mesh (None = single-device)."""
+        return self._resolve_mesh()
+
+    def _resolve_mesh(self):
+        if self._mesh_resolved:
+            return self._mesh
+        self._mesh_resolved = True
+        spec = self._mesh_spec
+        if spec in (None, 0, False, "off", ""):
+            return None
+        import jax
+        from jax.sharding import Mesh
+        if isinstance(spec, Mesh):
+            self._mesh = spec if spec.size > 1 else None
+            return self._mesh
+        from karpenter_tpu.parallel import make_mesh
+        if spec == "auto":
+            n = len(jax.devices())
+        else:
+            n = int(spec)
+        if n > 1:
+            self._mesh = make_mesh(n)
+        return self._mesh
+
+    def _o_align(self) -> int:
+        """Column padding must stay divisible by the mesh size so the
+        sharded axis splits evenly (O_ALIGN=512 covers power-of-two
+        meshes; other sizes widen the alignment via lcm)."""
+        mesh = self._resolve_mesh()
+        if mesh is None:
+            return O_ALIGN
+        import math
+        return O_ALIGN * mesh.size // math.gcd(O_ALIGN, mesh.size)
+
+    def _shardings(self):
+        """(col, col2, gcol, rep) NamedShardings for the active mesh."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = self._resolve_mesh()
+        return (NamedSharding(mesh, P("cat")),
+                NamedSharding(mesh, P("cat", None)),
+                NamedSharding(mesh, P(None, "cat")),
+                NamedSharding(mesh, P()))
 
     def _catalog_encoding(self, inp: ScheduleInput):
         """Cache the catalog-side encoding + its device-resident padded
@@ -82,15 +142,26 @@ class TPUSolver:
             self._cat = encode_catalog(inp)
             self._cat_key = key
             cat = self._cat
-            O = -(-len(cat.columns) // O_ALIGN) * O_ALIGN
+            align = self._o_align()
+            O = -(-len(cat.columns) // align) * align
             import jax
+            mesh = self._resolve_mesh()
+            if mesh is not None:
+                # catalog columns shard over ICI; the kernel's column
+                # reductions (max/segment_max) lower to XLA collectives
+                col, col2, _, rep = self._shardings()
+                put_c = lambda a: jax.device_put(a, col)
+                put_c2 = lambda a: jax.device_put(a, col2)
+                put_r = lambda a: jax.device_put(a, rep)
+            else:
+                put_c = put_c2 = put_r = jax.device_put
             cat.device_args = dict(
-                col_alloc=jax.device_put(self._pad(cat.col_alloc, 0, O)),
-                col_daemon=jax.device_put(self._pad(cat.col_daemon, 0, O)),
-                col_pool=jax.device_put(self._pad(cat.col_pool, 0, O)),
-                col_zone=jax.device_put(self._pad(cat.col_zone, 0, O)),
-                col_ct=jax.device_put(self._pad(cat.col_ct, 0, O)),
-                pool_daemon=jax.device_put(cat.pool_daemon),
+                col_alloc=put_c2(self._pad(cat.col_alloc, 0, O)),
+                col_daemon=put_c2(self._pad(cat.col_daemon, 0, O)),
+                col_pool=put_c(self._pad(cat.col_pool, 0, O)),
+                col_zone=put_c(self._pad(cat.col_zone, 0, O)),
+                col_ct=put_c(self._pad(cat.col_ct, 0, O)),
+                pool_daemon=put_r(cat.pool_daemon),
                 O=O,
             )
         return self._cat
@@ -136,6 +207,23 @@ class TPUSolver:
             self._pad(enc.exist_zone, 0, E, value=-1),
             self._pad(enc.exist_ct, 0, E, value=-1),
         )
+
+    def _put_problem(self, prob, batched: bool = False):
+        """Commit per-problem arrays to the mesh: `group_mask` (the only
+        per-problem array with a column axis) shards like the catalog;
+        everything else replicates. Single-device: hand numpy straight to
+        jit (no extra transfers)."""
+        mesh = self._resolve_mesh()
+        if mesh is None:
+            return prob
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        _, _, gcol, rep = self._shardings()
+        if batched:
+            gcol = NamedSharding(mesh, P(None, None, "cat"))
+        return tuple(
+            jax.device_put(a, gcol if i == 2 else rep)
+            for i, a in enumerate(prob))
 
     @staticmethod
     def _assemble(dev, prob):
@@ -222,7 +310,8 @@ class TPUSolver:
         E = bucket(len(enc.existing), E_BUCKETS)
         Db = bucket(enc.n_domains, D_BUCKETS)
         dev = cat.device_args
-        args = self._assemble(dev, self._problem_args(enc, G, E, Db, dev["O"]))
+        prob = self._put_problem(self._problem_args(enc, G, E, Db, dev["O"]))
+        args = self._assemble(dev, prob)
         t2 = _time.perf_counter()
         packed = ffd.solve_ffd(*args, max_nodes=self.max_nodes)
         out = ffd.unpack(packed, G, E, self.max_nodes, R, Db)
@@ -338,20 +427,11 @@ class TPUSolver:
             remaining_limits=limits)
 
     @staticmethod
-    def _pin_claim(claim, types_by_name: Dict[str, object]) -> None:
-        """Narrow a claim to one concrete (zone, capacity-type): the
-        cheapest available offering of its top-ranked type consistent with
-        its requirements.  Residue topology terms need every already-
-        planned pod to live in a DEFINITE domain; launch keeps the pinned
-        choice (the oracle's _resolve_topology narrows claims the same
-        way)."""
-        if not claim.instance_type_names:
-            return
-        it = types_by_name.get(claim.instance_type_names[0])
-        if it is None:
-            return
-        zreq = claim.requirements.get(wellknown.ZONE_LABEL)
-        creq = claim.requirements.get(wellknown.CAPACITY_TYPE_LABEL)
+    def _best_offering(it, requirements):
+        """Cheapest available offering of `it` consistent with the claim's
+        zone/capacity-type requirements (None when nothing qualifies)."""
+        zreq = requirements.get(wellknown.ZONE_LABEL)
+        creq = requirements.get(wellknown.CAPACITY_TYPE_LABEL)
         zones = zreq.values() if zreq is not None and zreq.is_finite() else None
         cts = creq.values() if creq is not None and creq.is_finite() else None
         best = None
@@ -364,6 +444,22 @@ class TPUSolver:
                 continue
             if best is None or o.price < best.price:
                 best = o
+        return best
+
+    @classmethod
+    def _pin_claim(cls, claim, types_by_name: Dict[str, object]) -> None:
+        """Narrow a claim to one concrete (zone, capacity-type): the
+        cheapest available offering of its top-ranked type consistent with
+        its requirements.  Residue topology terms need every already-
+        planned pod to live in a DEFINITE domain; launch keeps the pinned
+        choice (the oracle's _resolve_topology narrows claims the same
+        way)."""
+        if not claim.instance_type_names:
+            return
+        it = types_by_name.get(claim.instance_type_names[0])
+        if it is None:
+            return
+        best = cls._best_offering(it, claim.requirements)
         if best is None:
             return
         reqs = claim.requirements
@@ -400,10 +496,19 @@ class TPUSolver:
             claim.instance_type_names = [
                 t for t in claim.instance_type_names
                 if t in tbn and claim.requests.fits(tbn[t].allocatable())]
+            # re-price against the surviving top type: consolidation ranks
+            # and gates replacements on claim.price, so a stale price
+            # (pre-fold top type) would mis-rank replace decisions
+            if claim.instance_type_names:
+                best = self._best_offering(
+                    tbn[claim.instance_type_names[0]], claim.requirements)
+                if best is not None:
+                    claim.price = best.price
         res.new_claims = list(dev_res.new_claims) + list(orc_res.new_claims)
         return res
 
-    def solve_batch(self, inps: List[ScheduleInput]) -> List[ScheduleResult]:
+    def solve_batch(self, inps: List[ScheduleInput],
+                    max_nodes: Optional[int] = None) -> List[ScheduleResult]:
         """Evaluate many scheduling problems that share one catalog — the
         consolidation simulator's candidate axis (SURVEY §3.3 HOT LOOP #2:
         'many candidates against one cluster state, a natural extra batch
@@ -412,51 +517,77 @@ class TPUSolver:
 
         All inputs must come from the same cluster snapshot (same nodepools
         and instance-type lists); `price_cap` may differ per input.
+
+        `max_nodes` caps the new-node axis for THIS call: consolidation
+        admissibility rejects any simulation needing more than one
+        replacement node, so the simulator passes a tiny cap and the
+        batched kernel shrinks ~128x vs the provisioning default — a
+        slot-exhausted sim reports unschedulable, which the admissibility
+        check rejects exactly like the over-budget claim list it would
+        have produced at full width.
         """
         if not inps:
             return []
+        mn = max_nodes or self.max_nodes
         # inputs carrying preference pods need the relaxation outer loop —
         # solve them individually; the rest share the batched device call
         if any(any(p.preferences for p in inp.pods) for inp in inps):
             plain = [(i, inp) for i, inp in enumerate(inps)
                      if not any(p.preferences for p in inp.pods)]
             out: List[Optional[ScheduleResult]] = [None] * len(inps)
-            for (i, _), res in zip(plain, self.solve_batch([x for _, x in plain])):
+            for (i, _), res in zip(plain, self.solve_batch(
+                    [x for _, x in plain], max_nodes=max_nodes)):
                 out[i] = res
             for i, inp in enumerate(inps):
                 if out[i] is None:
                     out[i] = self.solve(inp)
             return out
         cat = self._catalog_encoding(inps[0])
-        encs = [self._encode_checked(inp, cat) for inp in inps]
+        # per-input encoding: an inexpressible input routes through the
+        # individual solve (split path) WITHOUT demoting the rest of the
+        # batch — one affinity-heavy candidate in a 64-sim chunk must not
+        # de-batch the other 63 (the de-batching pattern the batch axis
+        # exists to kill)
+        encs: List = []          # (orig_index, EncodedProblem)
+        singles: List[int] = []  # orig indices needing individual solves
+        for i, inp in enumerate(inps):
+            try:
+                encs.append((i, self._encode_checked(inp, cat)))
+            except UnsupportedPods:
+                singles.append(i)
         if len(cat.columns) == 0:
             return [self.solve(inp) for inp in inps]
 
-        G = bucket(max(e.n_groups for e in encs), G_BUCKETS)
-        E = bucket(max(len(e.existing) for e in encs), E_BUCKETS)
-        Db = bucket(max(e.n_domains for e in encs), D_BUCKETS)
-        dev = cat.device_args
-        O = dev["O"]
+        out_results: List[Optional[ScheduleResult]] = [None] * len(inps)
+        for i in singles:
+            out_results[i] = self.solve(inps[i])
+        if encs:
+            G = bucket(max(e.n_groups for _, e in encs), G_BUCKETS)
+            E = bucket(max(len(e.existing) for _, e in encs), E_BUCKETS)
+            Db = bucket(max(e.n_domains for _, e in encs), D_BUCKETS)
+            dev = cat.device_args
+            O = dev["O"]
 
-        results: List[ScheduleResult] = []
-        chunk_size = B_BUCKETS[-1]
-        for start in range(0, len(encs), chunk_size):
-            chunk = encs[start:start + chunk_size]
-            B = bucket(len(chunk), B_BUCKETS)
-            probs = [self._problem_args(e, G, E, Db, O) for e in chunk]
-            # pad the batch axis with empty problems (zero groups = no work)
-            # so repeat calls hit the jit cache at bucketed shapes
-            while len(probs) < B:
-                probs.append(tuple(np.zeros_like(a) for a in probs[0]))
-            stacked = tuple(np.stack(parts) for parts in zip(*probs))
-            packed = ffd.solve_ffd_batch(
-                *self._assemble(dev, stacked), max_nodes=self.max_nodes)
-            packed = np.array(packed)
-            for bi, enc in enumerate(chunk):
-                out = ffd.unpack(packed[bi], G, E, self.max_nodes, R, Db)
-                self._repair_topology(enc, out)
-                results.append(self._decode(enc, out))
-        return results
+            chunk_size = B_BUCKETS[-1]
+            for start in range(0, len(encs), chunk_size):
+                chunk = encs[start:start + chunk_size]
+                B = bucket(len(chunk), B_BUCKETS)
+                probs = [self._problem_args(e, G, E, Db, O) for _, e in chunk]
+                # pad the batch axis with empty problems (zero groups = no
+                # work) so repeat calls hit the jit cache at bucketed shapes
+                while len(probs) < B:
+                    probs.append(tuple(np.zeros_like(a) for a in probs[0]))
+                stacked = self._put_problem(
+                    tuple(np.stack(parts) for parts in zip(*probs)),
+                    batched=True)
+                packed = ffd.solve_ffd_batch(
+                    *self._assemble(dev, stacked), max_nodes=mn)
+                packed = np.array(packed)
+                for bi, (i, enc) in enumerate(chunk):
+                    out = ffd.unpack(packed[bi], G, E, mn, R, Db)
+                    self._repair_topology(enc, out)
+                    out_results[i] = self._decode(enc, out)
+        return out_results
 
     def _existing_only(self, enc: EncodedProblem) -> ScheduleResult:
         """Host-side step-1-only fill when there are no columns to buy."""
@@ -548,7 +679,9 @@ class TPUSolver:
         num_active = int(out["num_active"])
 
         take_exist = out["take_exist"][:Gr, :Er].astype(int)
-        take_new = out["take_new"][:Gr, : self.max_nodes].astype(int)
+        # the node axis is sized by the CALL's max_nodes (solve_batch caps
+        # it per call), not the constructor default — slice by actual shape
+        take_new = out["take_new"][:Gr, :].astype(int)
         unsched = out["unsched"][:Gr].astype(int)
         node_pool = out["node_pool"]
         node_zone = out["node_zone"]

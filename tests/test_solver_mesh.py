@@ -1,0 +1,172 @@
+"""Multi-chip sharded solve == single-device solve, on the product path.
+
+conftest provisions 8 virtual CPU devices precisely so these paths run
+without TPU hardware (SURVEY §2.3: ICI sharding of the column axis; the
+kernel's column reductions lower to XLA collectives under GSPMD, so the
+sharded program must produce bit-identical placements).
+"""
+
+import jax
+import pytest
+
+from karpenter_tpu.models import (
+    Node,
+    NodePool,
+    ObjectMeta,
+    Pod,
+    PodAffinityTerm,
+    Requirement,
+    Requirements,
+    Resources,
+    TopologySpreadConstraint,
+    wellknown,
+)
+from karpenter_tpu.providers import generate_catalog
+from karpenter_tpu.scheduling import ExistingNode, ScheduleInput
+from karpenter_tpu.solver import TPUSolver
+
+CATALOG = generate_catalog()
+
+
+def mkpod(name, cpu="500m", mem="1Gi", **kw):
+    return Pod(meta=ObjectMeta(name=name, labels=kw.pop("labels", {})),
+               requests=Resources.parse({"cpu": cpu, "memory": mem}), **kw)
+
+
+def mkinput(pods, pools=None, **kw):
+    pools = pools or [NodePool(meta=ObjectMeta(name="default"))]
+    return ScheduleInput(pods=pods, nodepools=pools,
+                         instance_types={p.name: CATALOG for p in pools}, **kw)
+
+
+def canon(res):
+    """A ScheduleResult reduced to comparable structure."""
+    return (
+        sorted((c.nodepool, tuple(sorted(p.meta.name for p in c.pods)),
+                tuple(c.instance_type_names), round(c.price, 9))
+               for c in res.new_claims),
+        dict(res.existing_assignments),
+        set(res.unschedulable),
+    )
+
+
+@pytest.fixture(scope="module")
+def solvers():
+    single = TPUSolver(mesh="off")
+    sharded = TPUSolver(mesh="auto")
+    assert sharded.mesh is not None and sharded.mesh.size == 8
+    return single, sharded
+
+
+def assert_same(solvers, inp):
+    single, sharded = solvers
+    a = single.solve(inp)
+    b = sharded.solve(inp)
+    assert canon(a) == canon(b)
+    return b
+
+
+class TestShardedEqualsSingle:
+    def test_mesh_actually_sharded(self, solvers):
+        _, sharded = solvers
+        sharded.solve(mkinput([mkpod("probe")]))
+        da = sharded._cat.device_args["col_alloc"]
+        assert len(da.sharding.device_set) == 8
+        # column axis split over the mesh, resource axis whole
+        shard_shape = da.sharding.shard_shape(da.shape)
+        assert shard_shape[0] == da.shape[0] // 8
+        assert shard_shape[1] == da.shape[1]
+
+    def test_identical_pods(self, solvers):
+        res = assert_same(solvers, mkinput([mkpod(f"p{i}") for i in range(100)]))
+        assert res.node_count() == 1
+
+    def test_mixed_sizes(self, solvers):
+        pods = ([mkpod(f"s{i}", cpu="250m", mem="512Mi") for i in range(40)]
+                + [mkpod(f"m{i}", cpu="2", mem="4Gi") for i in range(25)]
+                + [mkpod(f"l{i}", cpu="15", mem="24Gi") for i in range(10)])
+        assert_same(solvers, mkinput(pods))
+
+    def test_node_selectors(self, solvers):
+        pods = []
+        for i in range(30):
+            p = mkpod(f"z{i}")
+            p.requirements = Requirements(Requirement.make(
+                wellknown.ZONE_LABEL, "In",
+                ["tpu-west-1a", "tpu-west-1b"][i % 2]))
+            pods.append(p)
+        assert_same(solvers, mkinput(pods))
+
+    def test_zonal_spread(self, solvers):
+        pods = []
+        for i in range(60):
+            p = mkpod(f"t{i}", labels={"app": "z"})
+            p.topology_spread = [TopologySpreadConstraint(
+                topology_key=wellknown.ZONE_LABEL, max_skew=1,
+                label_selector={"app": "z"})]
+            pods.append(p)
+        assert_same(solvers, mkinput(pods))
+
+    def test_anti_affinity_hostname(self, solvers):
+        pods = [mkpod(f"a{i}", labels={"app": "web"},
+                      pod_affinities=[PodAffinityTerm(
+                          label_selector={"app": "web"},
+                          topology_key=wellknown.HOSTNAME_LABEL,
+                          anti=True, required=True)])
+                for i in range(12)]
+        res = assert_same(solvers, mkinput(pods))
+        assert res.node_count() == 12
+
+    def test_existing_nodes(self, solvers):
+        existing = []
+        for i in range(4):
+            alloc = Resources.parse({"cpu": "8", "memory": "32Gi", "pods": "110"})
+            node = Node(meta=ObjectMeta(
+                name=f"node-{i}",
+                labels={wellknown.ZONE_LABEL: ["tpu-west-1a", "tpu-west-1b"][i % 2],
+                        wellknown.CAPACITY_TYPE_LABEL: "on-demand"}),
+                allocatable=alloc, ready=True)
+            existing.append(ExistingNode(node=node, available=alloc, pods=[]))
+        inp = mkinput([mkpod(f"p{i}") for i in range(40)])
+        inp.existing_nodes = existing
+        res = assert_same(solvers, inp)
+        assert res.existing_assignments  # some pods landed on the fleet
+
+    def test_pool_limits(self, solvers):
+        pool = NodePool(meta=ObjectMeta(name="capped"))
+        inp = mkinput([mkpod(f"p{i}", cpu="2") for i in range(10)], pools=[pool],
+                      remaining_limits={"capped": Resources.limits(cpu=9000)})
+        assert_same(solvers, inp)
+
+    def test_weighted_pools(self, solvers):
+        hi = NodePool(meta=ObjectMeta(name="hi"), weight=100)
+        lo = NodePool(meta=ObjectMeta(name="lo"), weight=1)
+        assert_same(solvers, mkinput([mkpod(f"p{i}") for i in range(20)],
+                                     pools=[hi, lo]))
+
+    def test_split_path(self, solvers):
+        # required pod affinity rides the split path on both solvers
+        p = mkpod("aff", labels={"app": "web"}, pod_affinities=[PodAffinityTerm(
+            label_selector={"app": "web"}, topology_key=wellknown.ZONE_LABEL)])
+        assert_same(solvers, mkinput([p] + [mkpod(f"f{i}") for i in range(8)]))
+
+    def test_solve_batch(self, solvers):
+        single, sharded = solvers
+        inps = []
+        for k in range(6):
+            inps.append(mkinput([mkpod(f"b{k}-{i}", cpu=f"{250 * (k + 1)}m")
+                                 for i in range(10 + k)]))
+        ra = single.solve_batch(inps)
+        rb = sharded.solve_batch(inps)
+        assert [canon(x) for x in ra] == [canon(x) for x in rb]
+
+    def test_explicit_device_count(self):
+        s2 = TPUSolver(mesh=2)
+        assert s2.mesh is not None and s2.mesh.size == 2
+        res = s2.solve(mkinput([mkpod(f"p{i}") for i in range(10)]))
+        assert res.node_count() == 1
+
+    def test_off_means_single(self):
+        s = TPUSolver(mesh="off")
+        assert s.mesh is None
+        assert len(jax.devices()) == 8  # sanity: the env really is multi-device

@@ -146,6 +146,52 @@ class TestSplitSolve:
             top = types[claim.instance_type_names[0]]
             assert claim.requests.fits(top.allocatable())
 
+    def test_split_claim_price_matches_top_type(self):
+        # consolidation ranks and gates on claim.price — after residue pods
+        # fold into a device claim, the price must equal the cheapest
+        # available offering of the surviving top-ranked type
+        pods = [mkpod(f"web-{i}", labels={"app": "web"}) for i in range(80)]
+        pods += [mkpod(f"side-{i}", labels={"app": "side"}, cpu="2", mem="3Gi",
+                       pod_affinities=[PodAffinityTerm(
+                           label_selector={"app": "web"}, topology_key=ZONE,
+                           required=True, anti=False)])
+                 for i in range(4)]
+        res = TPUSolver().solve(mkinput(pods))
+        assert not res.unschedulable
+        types = {it.name: it for it in CATALOG}
+        for claim in res.new_claims:
+            top = types[claim.instance_type_names[0]]
+            best = TPUSolver._best_offering(top, claim.requirements)
+            assert best is not None
+            assert abs(claim.price - best.price) < 1e-9, (
+                claim.hostname, claim.price, best.price)
+
+    def test_batch_one_unsupported_does_not_debatch(self):
+        # a batch where one input carries required affinity: that input
+        # takes the individual split path; the others stay in the fused
+        # device call (no per-input solve() — observable as exactly ONE
+        # split-path increment and zero oracle increments)
+        plain = [mkinput([mkpod(f"x{k}-{i}") for i in range(5 + k)])
+                 for k in range(5)]
+        hard = mkinput(
+            [mkpod("w", labels={"app": "web"})]
+            + [mkpod("s", labels={"app": "side"},
+                     pod_affinities=[PodAffinityTerm(
+                         label_selector={"app": "web"}, topology_key=ZONE,
+                         required=True, anti=False)])])
+        inps = plain[:2] + [hard] + plain[2:]
+        before_split = solves_path("split")
+        before_oracle = solves_path("oracle")
+        before_device = solves_path("device")
+        results = TPUSolver().solve_batch(inps)
+        assert len(results) == len(inps)
+        for res in results:
+            assert not res.unschedulable
+        assert solves_path("split") == before_split + 1
+        assert solves_path("oracle") == before_oracle
+        # the five plain inputs ride the batched call, not solve()
+        assert solves_path("device") == before_device
+
     def test_pure_residue_problem_still_solves(self):
         # every group inexpressible: the split path must still answer
         # (device does nothing, oracle does everything)
