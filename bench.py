@@ -162,10 +162,16 @@ def run_configs(timeout_s: float):
                                   capture_output=True, text=True,
                                   timeout=timeout_s)
             rec["rc"] = proc.returncode
-            line = next((ln for ln in proc.stdout.splitlines()
-                         if ln.startswith("{")), None)
-            if line:
-                rec["parsed"] = json.loads(line)
+            # a '{'-prefixed line may be a dict-repr log or truncated JSON
+            # (child killed mid-flush) — a parse failure must not kill the
+            # artifact, it IS the evidence
+            for ln in proc.stdout.splitlines():
+                if ln.startswith("{"):
+                    try:
+                        rec["parsed"] = json.loads(ln)
+                        break
+                    except ValueError:
+                        rec.setdefault("unparsed", ln[:300])
             if proc.returncode != 0:
                 tail = (proc.stderr or "").strip().splitlines()
                 rec["error"] = tail[-1][:300] if tail else "<no stderr>"
@@ -178,6 +184,18 @@ def run_configs(timeout_s: float):
 
 
 def main() -> None:
+    # evict stale chip holders (leftover kt_solverd — the round-1 failure
+    # mode) BEFORE the config subprocesses run: they probe with
+    # kill_holders=False and would silently degrade to CPU
+    from karpenter_tpu.utils.platform import _other_device_holders
+    for pid, args in _other_device_holders():
+        print(f"[bench] killing stale device holder pid {pid}: {args[:120]}",
+              file=sys.stderr, flush=True)
+        try:
+            os.kill(pid, 9)
+        except OSError:
+            pass
+
     # configs FIRST: their subprocesses need the chip, which admits one
     # process at a time — after the parent initializes below, a config
     # subprocess would burn its whole probe budget and fall back to CPU

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from karpenter_tpu.cluster import Cluster
 from karpenter_tpu.controllers.provisioning import NOMINATED_ANNOTATION
+from karpenter_tpu.models import wellknown
 from karpenter_tpu.models.taints import tolerates_all
 
 
@@ -36,5 +37,13 @@ class PodBinder:
                 continue  # startup/unregistered taints still present
             pod.node_name = node.name
             pod.phase = "Running"
+            # WaitForFirstConsumer volume binding: unbound claims bind to
+            # the zone the scheduler picked (scheduling.md:381-417) — from
+            # here on the pod (and any future reschedule) is zone-pinned
+            zone = node.labels.get(wellknown.ZONE_LABEL)
+            for claim in pod.volume_claims:
+                if not claim.bound:
+                    claim.bound = True
+                    claim.zone = zone
             del pod.meta.annotations[NOMINATED_ANNOTATION]
             self.cluster.pods.update(pod)

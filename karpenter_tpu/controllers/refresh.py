@@ -62,6 +62,7 @@ class InstanceTypeRefresh(_IntervalController):
     def refresh(self) -> None:
         # reading seqnum sweeps expired ICE entries (their disappearance
         # must invalidate downstream cache keys), then drop cached lists so
-        # the next scheduler call re-pulls the catalog
+        # the next scheduler call re-pulls the catalog (which logs the
+        # discovered count, change-gated, on its own fetch)
         _ = self.instance_types.unavailable.seqnum
         self.instance_types.invalidate()

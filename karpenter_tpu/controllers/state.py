@@ -49,6 +49,10 @@ class GatedSolver:
             except UnsupportedPods:
                 pass  # constraints the encoder can't express yet → oracle
             except Exception as e:  # noqa: BLE001
+                from karpenter_tpu.utils.logging import get_logger
+                get_logger("solver").warn(
+                    "device solve failed; falling back to oracle",
+                    source=source, error=str(e)[:200])
                 self.cluster.record_event(
                     "Provisioner", source, "SolverFallback", str(e))
         metrics.SOLVER_SOLVES.inc(path="oracle")

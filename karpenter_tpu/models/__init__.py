@@ -26,6 +26,7 @@ from karpenter_tpu.models.objects import (
     Offering,
     TopologySpreadConstraint,
     PodAffinityTerm,
+    VolumeClaim,
     Disruption,
     Budget,
 )
@@ -51,6 +52,7 @@ __all__ = [
     "Offering",
     "TopologySpreadConstraint",
     "PodAffinityTerm",
+    "VolumeClaim",
     "Disruption",
     "Budget",
     "wellknown",

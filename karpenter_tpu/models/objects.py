@@ -70,6 +70,25 @@ class PodAffinityTerm:
     anti: bool = False
     required: bool = True
     weight: int = 100  # for preferred terms
+    # True on the required=True copy the relaxation ladder makes of a
+    # preferred term: enforced for the pod's own placement, but excluded
+    # from the k8s anti-affinity SYMMETRY rule — a soft anti must never
+    # hard-block other pods (scheduling.md:282-379 scoring semantics)
+    promoted: bool = False
+
+
+@dataclass
+class VolumeClaim:
+    """A persistent-volume claim a pod mounts (PV topology —
+    scheduling.md:381-417): once bound to a zonal volume, the pod can only
+    schedule into that zone, and each claim consumes one of the node's
+    attachable-volume slots (the `volumes` resource axis). An unbound
+    claim (WaitForFirstConsumer) binds to whatever zone the scheduler
+    picks — the binder stamps it at bind time."""
+    name: str
+    zone: Optional[str] = None      # set once bound to a zonal volume
+    bound: bool = False
+    storage_class: str = "standard"
 
 
 @dataclass
@@ -84,6 +103,8 @@ class Pod:
     tolerations: List[Toleration] = field(default_factory=list)
     topology_spread: List[TopologySpreadConstraint] = field(default_factory=list)
     pod_affinities: List[PodAffinityTerm] = field(default_factory=list)
+    # persistent-volume claims this pod mounts (attach slots + zone pinning)
+    volume_claims: List[VolumeClaim] = field(default_factory=list)
     priority: int = 0
     # binding / lifecycle
     node_name: Optional[str] = None
@@ -115,27 +136,69 @@ class Pod:
     def do_not_disrupt(self) -> bool:
         return self.meta.annotations.get(wellknown.DO_NOT_DISRUPT_ANNOTATION) == "true"
 
+    def _soft_ladder(self) -> list:
+        """Every best-effort term, strongest first: preferred node affinity
+        (by weight), preferred pod (anti-)affinity (by weight), and
+        ScheduleAnyway topology spread (weakest — pure scoring in kube).
+        The relaxation loop drops them from the END of this list."""
+        terms = []
+        for i, (w, reqs) in enumerate(self.preferences):
+            terms.append((w, 2, i, ("pref", reqs)))
+        for i, t in enumerate(self.pod_affinities):
+            if not t.required:
+                terms.append((t.weight, 1, i, ("aff", t)))
+        for i, c in enumerate(self.topology_spread):
+            if c.when_unsatisfiable == "ScheduleAnyway":
+                terms.append((0, 0, i, ("spread", c)))
+        terms.sort(key=lambda x: (-x[0], -x[1], x[2]))
+        return terms
+
+    def relax_levels(self) -> int:
+        """How many relaxation steps this pod supports (0 = nothing soft)."""
+        return len(self._soft_ladder())
+
+    def has_soft_terms(self) -> bool:
+        return bool(self.preferences) \
+            or any(not t.required for t in self.pod_affinities) \
+            or any(c.when_unsatisfiable == "ScheduleAnyway"
+                   for c in self.topology_spread)
+
     def relaxed(self, level: int) -> "Pod":
-        """The pod with preferred node-affinity terms folded into its hard
-        requirements, the `level` lowest-weight terms dropped.
+        """The pod with its soft terms ENFORCED as hard constraints, the
+        `level` weakest dropped entirely.
 
         Mirrors the reference scheduler's preference handling
-        (website/content/en/preview/concepts/scheduling.md: preferences are
-        treated as required, then relaxed one at a time when the pod cannot
-        schedule). level 0 = all terms enforced; level == len(preferences)
-        = none. Returns a variant Pod with `preferences=[]` so variants at
-        equal effective requirements share a scheduling group.
+        (website/content/en/preview/concepts/scheduling.md:282-379:
+        preferences are treated as required, then relaxed one at a time
+        when the pod cannot schedule). Enforcement per kind: preferred node
+        affinity folds into the hard requirements; preferred pod
+        (anti-)affinity becomes a required term; ScheduleAnyway spread
+        becomes DoNotSchedule. level 0 = all enforced; level ==
+        relax_levels() = none (the pod's true hard constraints only).
+        Returns a variant with `preferences=[]` so variants at equal
+        effective constraints share a scheduling group.
         """
-        if not self.preferences:
+        ladder = self._soft_ladder()
+        if not ladder:
             return self
         import dataclasses
-        order = sorted(enumerate(self.preferences),
-                       key=lambda iw: (-iw[1][0], iw[0]))  # strongest first
-        keep = order[: max(0, len(order) - level)]
+        keep = ladder[: max(0, len(ladder) - level)]
         eff = self.requirements
-        for _, (_, reqs) in keep:
-            eff = eff.intersection(reqs)
-        return dataclasses.replace(self, requirements=eff, preferences=[])
+        affs = [t for t in self.pod_affinities if t.required]
+        spreads = [c for c in self.topology_spread
+                   if c.when_unsatisfiable != "ScheduleAnyway"]
+        for _, _, _, (kind, payload) in keep:
+            if kind == "pref":
+                eff = eff.intersection(payload)
+            elif kind == "aff":
+                affs.append(dataclasses.replace(payload, required=True,
+                                                promoted=True))
+            else:
+                spreads.append(dataclasses.replace(
+                    payload, when_unsatisfiable="DoNotSchedule"))
+        return dataclasses.replace(self, requirements=eff, preferences=[],
+                                   pod_affinities=affs,
+                                   topology_spread=spreads)
 
     def scheduling_key(self) -> tuple:
         """Equivalence-class key: pods with equal keys are interchangeable to
@@ -162,6 +225,10 @@ class Pod:
             # preferred node affinity participates in relaxation (pods at
             # different relax states are not interchangeable)
             tuple((w, r) for w, r in self.preferences),
+            # attach-slot count and bound zones change the packing
+            # footprint and the zone mask respectively
+            tuple(sorted((c.zone or "", c.bound)
+                         for c in self.volume_claims)),
             tuple(sorted(self.meta.labels.items())),
             self.priority,
             self.is_daemonset,

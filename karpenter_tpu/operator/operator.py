@@ -68,6 +68,12 @@ class Operator:
         # build the native host-ops extension now, not inside a solve
         from karpenter_tpu.native import hostops
         hostops()
+        # profiler server behind ENABLE_PROFILING (the reference gates
+        # pprof the same way, settings.md:23; ours serves JAX/XLA traces)
+        from karpenter_tpu.utils.logging import get_logger
+        from karpenter_tpu.utils.profiling import maybe_start_server
+        self.log = get_logger("operator")
+        maybe_start_server(log=lambda m: self.log.info(m))
 
     # -- HTTP endpoints ----------------------------------------------------
     def _make_handler(operator_self):  # noqa: N805 - closure over operator

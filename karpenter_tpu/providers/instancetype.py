@@ -40,6 +40,9 @@ class InstanceTypeProvider:
         self.pricing = pricing
         self.unavailable = unavailable
         self._cache = TTLCache(ttl=INSTANCE_TYPES_ZONES_TTL, clock=clock)
+        from karpenter_tpu.utils.logging import ChangeMonitor, get_logger
+        self._log = get_logger("instancetype")
+        self._changes = ChangeMonitor()
 
     def _cache_key(self, node_class: NodeClass) -> tuple:
         return (
@@ -102,6 +105,12 @@ class InstanceTypeProvider:
                 offerings=offerings,
                 overhead=shape.overhead,
             ))
+        # change-gated count log on the fetch the re-pull already performed
+        # (reference instancetype.go:151-153 via pretty.ChangeMonitor) —
+        # steady-state refreshes stay silent
+        if self._changes.has_changed(f"count/{node_class.name}", len(out)):
+            self._log.info("discovered instance types",
+                           node_class=node_class.name, count=len(out))
         self._cache.set(node_class.name, (key, out))
         return out
 

@@ -166,13 +166,15 @@ class Scheduler:
 
     # ------------------------------------------------------------------
     def _schedule_one(self, pod: Pod) -> None:
-        """Preferred node affinity is treated as required and relaxed one
+        """Soft terms (preferred node affinity, preferred pod affinity,
+        ScheduleAnyway spread) are enforced as required and relaxed one
         term at a time when the pod cannot place (reference scheduler
-        preference handling, scheduling.md) — a bounded outer loop around
-        the placement attempt (SURVEY §7 hard-parts)."""
+        preference handling, scheduling.md:282-379) — a bounded outer loop
+        around the placement attempt (SURVEY §7 hard-parts). Soft terms
+        thus shape placement when satisfiable and never block."""
         req = effective_request(pod)
         reason: Optional[str] = None
-        for level in range(len(pod.preferences) + 1):
+        for level in range(pod.relax_levels() + 1):
             variant = pod.relaxed(level)
             reason = self._place(variant, req)
             if reason is None:

@@ -64,8 +64,12 @@ class TopologyTracker:
         for (tkey, sel), counter in self._match_cache.items():
             if tkey in node_domains and _matches(sel, labels):
                 counter[node_domains[tkey]] += 1
+        # promoted (soft-origin) anti terms bind only the pod's own
+        # placement: the k8s symmetry rule applies to REQUIRED anti only,
+        # so a preferred anti must never hard-block other pods
         anti = [(t.topology_key, _sel(t.label_selector))
-                for t in pod.pod_affinities if t.anti and t.required]
+                for t in pod.pod_affinities
+                if t.anti and t.required and not t.promoted]
         self._placed.append((dict(labels), node_domains, anti))
         for tkey, sel in anti:
             if tkey in node_domains:

@@ -32,11 +32,42 @@ def min_values_violation(reqs: Requirements, types) -> "str | None":
 
 def effective_request(pod: Pod) -> Resources:
     """A pod's packing footprint: declared requests plus the one pod slot it
-    occupies. Shared by the oracle and the solver encoder — parity depends
-    on them agreeing."""
+    occupies, plus one attachable-volume slot per mounted claim (the
+    reference enforces per-node volume attach limits during scheduling —
+    scheduling.md:381-417). Shared by the oracle and the solver encoder —
+    parity depends on them agreeing."""
     r = pod.requests.copy()
     r.set("pods", r.get("pods") + 1.0)
+    if pod.volume_claims:
+        r.set("volumes", r.get("volumes") + len(pod.volume_claims))
     return r
+
+
+def fold_volume_topology(pods: List[Pod]) -> List[Pod]:
+    """PV zone pinning (SURVEY §7 step 5: 'PV zone pinning as
+    pre-masking'): a pod mounting a claim BOUND to a zonal volume can only
+    run in that zone — expressed by intersecting a zone requirement into
+    the pod, which pre-masks solver columns and constrains the oracle
+    identically. Unbound (WaitForFirstConsumer) claims impose nothing; the
+    binder stamps their zone at bind time. Pods are copied, not mutated
+    (specs are immutable post-admission and the grouping cache relies on
+    it). Idempotent: re-folding intersects an already-present zone."""
+    import dataclasses
+
+    from karpenter_tpu.models import wellknown
+    from karpenter_tpu.models.requirements import Requirement, Requirements
+
+    out = []
+    for p in pods:
+        zones = {c.zone for c in p.volume_claims if c.bound and c.zone}
+        if not zones:
+            out.append(p)
+            continue
+        pin = Requirements(*(
+            Requirement.make(wellknown.ZONE_LABEL, "In", z) for z in zones))
+        out.append(dataclasses.replace(
+            p, requirements=p.requirements.intersection(pin)))
+    return out
 
 
 @dataclass
@@ -73,6 +104,12 @@ class ScheduleInput:
     # field (not pre-filtered type lists) so the TPU solver can apply it as
     # a column mask without invalidating its cached catalog encoding.
     price_cap: Optional[float] = None
+
+    def __post_init__(self):
+        # PV zone pinning happens at the seam so BOTH engines (oracle and
+        # solver) see identical constraints no matter who built the input
+        if any(p.volume_claims for p in self.pods):
+            self.pods = fold_volume_topology(self.pods)
 
 
 def price_capped_types(types: List[InstanceType], price_cap: float) -> List[InstanceType]:

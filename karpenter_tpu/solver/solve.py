@@ -238,54 +238,73 @@ class TPUSolver:
                 group_skew, group_mindom, group_delig,
                 dev["col_zone"], dev["col_ct"], exist_zone, exist_ct)
 
-    def solve(self, inp: ScheduleInput) -> ScheduleResult:
+    def solve(self, inp: ScheduleInput,
+              max_nodes: Optional[int] = None) -> ScheduleResult:
         """One scheduling problem.  The fast path solves everything on
         device; when the encoding rejects some groups (required pod
         affinity, coupled selectors, custom topology keys), the split path
         keeps the supported majority on device and hands only the residue
         to the host oracle — one affinity pod in a 50k-pod batch must not
-        abandon the device."""
+        abandon the device.  Splitting happens PER RELAXATION VARIANT
+        (inside _solve_relaxed via _attempt_or_split): a promoted soft
+        term can make a variant inexpressible while the fully-relaxed pod
+        is plain, and vice versa."""
         from karpenter_tpu.utils import metrics
+        self._used_split = False
+        res = self._solve_relaxed(inp, max_nodes=max_nodes)
+        metrics.SOLVER_SOLVES.inc(
+            path="split" if self._used_split else "device")
+        return res
+
+    def _attempt_or_split(self, inp: ScheduleInput,
+                          max_nodes: Optional[int] = None) -> ScheduleResult:
+        """Device attempt; on inexpressible groups, the split path for
+        THIS exact input. Raises UnsupportedPods only when splitting can't
+        help either (the GatedSolver then falls back to the oracle)."""
         try:
-            res = self._solve_relaxed(inp)
-            metrics.SOLVER_SOLVES.inc(path="device")
-            return res
+            return self._solve_attempt(inp, max_nodes=max_nodes)
         except UnsupportedPods:
-            res = self._solve_split(inp)
-            metrics.SOLVER_SOLVES.inc(path="split")
+            res = self._solve_split(inp, max_nodes=max_nodes)
+            self._used_split = True
             return res
 
-    def _solve_relaxed(self, inp: ScheduleInput) -> ScheduleResult:
-        """Device solve with preference relaxation: preferred node
-        affinity is enforced as required, and pods that stay
+    def _solve_relaxed(self, inp: ScheduleInput,
+                       max_nodes: Optional[int] = None) -> ScheduleResult:
+        """Device solve with soft-term relaxation: preferred node
+        affinity, preferred pod affinity, and ScheduleAnyway spread are
+        enforced as required (Pod.relaxed), and pods that stay
         unschedulable get their weakest term dropped and the whole problem
         re-solved (bounded — SURVEY §7 hard-parts: 'an outer loop around
         the solver that must be bounded'). Re-solving whole keeps packing
-        globally consistent."""
-        if not any(p.preferences for p in inp.pods):
-            return self._solve_attempt(inp)
+        globally consistent. Soft terms therefore steer the kernel's
+        domain choice when satisfiable and never block a pod."""
+        if not any(p.has_soft_terms() for p in inp.pods):
+            return self._attempt_or_split(inp, max_nodes=max_nodes)
         import dataclasses
         by_name = {p.meta.name: p for p in inp.pods}
         relax: Dict[str, int] = {}
-        # bound by TOTAL preference terms (capped), not the deepest list:
-        # one pod's relaxation can reshuffle packing and un-place a
-        # different pod in a later round, so max-depth rounds can expire
-        # with relaxation headroom left (round-1 advisor finding)
-        rounds = 1 + min(sum(len(p.preferences) for p in inp.pods), 64)
+        # bound by TOTAL soft terms (capped), not the deepest list: one
+        # pod's relaxation can reshuffle packing and un-place a different
+        # pod in a later round, so max-depth rounds can expire with
+        # relaxation headroom left (round-1 advisor finding)
+        rounds = 1 + min(sum(p.relax_levels() for p in inp.pods), 64)
         res = ScheduleResult()
         for _ in range(rounds):
             variants = [p.relaxed(relax.get(p.meta.name, 0)) for p in inp.pods]
-            res = self._solve_attempt(dataclasses.replace(inp, pods=variants))
+            res = self._attempt_or_split(
+                dataclasses.replace(inp, pods=variants), max_nodes=max_nodes)
             bump = [n for n in res.unschedulable
                     if n in by_name
-                    and relax.get(n, 0) < len(by_name[n].preferences)]
+                    and relax.get(n, 0) < by_name[n].relax_levels()]
             if not bump:
                 return res
             for n in bump:
                 relax[n] = relax.get(n, 0) + 1
         return res
 
-    def _solve_attempt(self, inp: ScheduleInput) -> ScheduleResult:
+    def _solve_attempt(self, inp: ScheduleInput,
+                       max_nodes: Optional[int] = None) -> ScheduleResult:
+        mn = max_nodes or self.max_nodes
         import time as _time
         t0 = _time.perf_counter()
         cat = self._catalog_encoding(inp)
@@ -313,8 +332,10 @@ class TPUSolver:
         prob = self._put_problem(self._problem_args(enc, G, E, Db, dev["O"]))
         args = self._assemble(dev, prob)
         t2 = _time.perf_counter()
-        packed = ffd.solve_ffd(*args, max_nodes=self.max_nodes)
-        out = ffd.unpack(packed, G, E, self.max_nodes, R, Db)
+        from karpenter_tpu.utils.profiling import trace_solve
+        with trace_solve("ffd-solve"):
+            packed = ffd.solve_ffd(*args, max_nodes=mn)
+            out = ffd.unpack(packed, G, E, mn, R, Db)
         t3 = _time.perf_counter()
         self._repair_topology(enc, out)
         t4 = _time.perf_counter()
@@ -327,7 +348,8 @@ class TPUSolver:
 
     # -- split solve: device for the supported majority, host oracle for
     # -- the inexpressible residue (VERDICT r1 #4) -------------------------
-    def _solve_split(self, inp: ScheduleInput) -> ScheduleResult:
+    def _solve_split(self, inp: ScheduleInput,
+                     max_nodes: Optional[int] = None) -> ScheduleResult:
         import dataclasses
 
         from karpenter_tpu.solver.encode import encode
@@ -347,7 +369,8 @@ class TPUSolver:
 
         if supported_pods:
             dev_res = self._solve_relaxed(
-                dataclasses.replace(inp, pods=supported_pods))
+                dataclasses.replace(inp, pods=supported_pods),
+                max_nodes=max_nodes)
         else:
             dev_res = ScheduleResult()
 
@@ -529,18 +552,18 @@ class TPUSolver:
         if not inps:
             return []
         mn = max_nodes or self.max_nodes
-        # inputs carrying preference pods need the relaxation outer loop —
+        # inputs carrying soft-term pods need the relaxation outer loop —
         # solve them individually; the rest share the batched device call
-        if any(any(p.preferences for p in inp.pods) for inp in inps):
+        if any(any(p.has_soft_terms() for p in inp.pods) for inp in inps):
             plain = [(i, inp) for i, inp in enumerate(inps)
-                     if not any(p.preferences for p in inp.pods)]
+                     if not any(p.has_soft_terms() for p in inp.pods)]
             out: List[Optional[ScheduleResult]] = [None] * len(inps)
             for (i, _), res in zip(plain, self.solve_batch(
                     [x for _, x in plain], max_nodes=max_nodes)):
                 out[i] = res
             for i, inp in enumerate(inps):
                 if out[i] is None:
-                    out[i] = self.solve(inp)
+                    out[i] = self.solve(inp, max_nodes=max_nodes)
             return out
         cat = self._catalog_encoding(inps[0])
         # per-input encoding: an inexpressible input routes through the
@@ -556,11 +579,12 @@ class TPUSolver:
             except UnsupportedPods:
                 singles.append(i)
         if len(cat.columns) == 0:
-            return [self.solve(inp) for inp in inps]
+            return [self.solve(inp, max_nodes=max_nodes)
+                    for inp in inps]
 
         out_results: List[Optional[ScheduleResult]] = [None] * len(inps)
         for i in singles:
-            out_results[i] = self.solve(inps[i])
+            out_results[i] = self.solve(inps[i], max_nodes=max_nodes)
         if encs:
             G = bucket(max(e.n_groups for _, e in encs), G_BUCKETS)
             E = bucket(max(len(e.existing) for _, e in encs), E_BUCKETS)

@@ -1,0 +1,57 @@
+"""Profiling gate — the reference exposes pprof behind ENABLE_PROFILING
+(website/.../settings.md:23); our hot path is XLA programs, so the
+equivalent is the JAX profiler (SURVEY §5: "JAX profiler + XLA traces on
+the solver"), gated the same way:
+
+  ENABLE_PROFILING=true              start the profiler server (:9999 or
+                                     KARPENTER_TPU_PROFILE_PORT) at boot —
+                                     attach TensorBoard / xprof on demand
+  KARPENTER_TPU_PROFILE_DIR=<dir>    additionally trace every solve into
+                                     <dir> (one trace per solve, for
+                                     offline xprof analysis)
+
+Disabled (the default), `trace_solve` is a no-op context manager with one
+dict lookup of overhead — nothing rides the 200 ms budget.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Optional
+
+_server_started = False
+
+
+def profiling_enabled() -> bool:
+    return os.environ.get("ENABLE_PROFILING", "").strip().lower() in (
+        "1", "true", "yes")
+
+
+def maybe_start_server(log=None) -> Optional[int]:
+    """Start the JAX profiler server once, when ENABLE_PROFILING is set.
+    Returns the port or None."""
+    global _server_started
+    if not profiling_enabled() or _server_started:
+        return None
+    port = int(os.environ.get("KARPENTER_TPU_PROFILE_PORT", "9999"))
+    import jax
+    jax.profiler.start_server(port)
+    _server_started = True
+    if log is not None:
+        log(f"jax profiler server on :{port}")
+    return port
+
+
+@contextlib.contextmanager
+def trace_solve(name: str = "solve"):
+    """Trace one solve into KARPENTER_TPU_PROFILE_DIR when set; otherwise
+    a no-op. The annotation names the region in xprof."""
+    trace_dir = os.environ.get("KARPENTER_TPU_PROFILE_DIR")
+    if not trace_dir:
+        yield
+        return
+    import jax
+    with jax.profiler.trace(trace_dir):
+        with jax.profiler.TraceAnnotation(name):
+            yield

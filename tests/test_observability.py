@@ -1,0 +1,106 @@
+"""Observability floor (VERDICT r2 #10): structured logfmt logging with
+ChangeMonitor noise gating (reference pretty.ChangeMonitor,
+instancetype.go:151-153) and the ENABLE_PROFILING-gated JAX profiler
+(settings.md:23 analogue; SURVEY §5).
+"""
+
+import io
+import os
+
+import pytest
+
+from karpenter_tpu.utils.logging import ChangeMonitor, Logger, get_logger
+from karpenter_tpu.utils import profiling
+
+
+class TestLogger:
+    def test_logfmt_shape(self, capsys):
+        buf = io.StringIO()
+        log = Logger("prov", stream=buf)
+        log.info("provisioned node", pool="default", pods=3)
+        line = buf.getvalue().strip()
+        assert "level=info" in line
+        assert "logger=prov" in line
+        assert 'msg="provisioned node"' in line
+        assert "pool=default" in line and "pods=3" in line
+
+    def test_values_with_spaces_quoted(self):
+        buf = io.StringIO()
+        Logger("x", stream=buf).warn("oops", err="bad thing happened")
+        assert 'err="bad thing happened"' in buf.getvalue()
+
+    def test_level_gating(self, monkeypatch):
+        buf = io.StringIO()
+        log = Logger("x", stream=buf)
+        monkeypatch.setenv("LOG_LEVEL", "warn")
+        log.info("hidden")
+        log.warn("shown")
+        out = buf.getvalue()
+        assert "hidden" not in out and "shown" in out
+
+    def test_get_logger_interned(self):
+        assert get_logger("a") is get_logger("a")
+
+
+class TestChangeMonitor:
+    def test_gates_repeats(self):
+        t = {"now": 0.0}
+        cm = ChangeMonitor(ttl=100.0, now=lambda: t["now"])
+        assert cm.has_changed("count", 700)
+        assert not cm.has_changed("count", 700)   # same value: suppressed
+        assert cm.has_changed("count", 701)       # change: logged
+        assert not cm.has_changed("count", 701)
+        t["now"] = 200.0                           # TTL expiry: re-logged
+        assert cm.has_changed("count", 701)
+
+    def test_keys_independent(self):
+        cm = ChangeMonitor()
+        assert cm.has_changed("a", 1)
+        assert cm.has_changed("b", 1)
+        assert not cm.has_changed("a", 1)
+
+    def test_provider_repull_logs_once(self, capsys):
+        from karpenter_tpu.env import Environment
+        env = Environment()
+        nc = env.add_default_nodeclass()
+        env.instance_types.list(nc)
+        env.instancetype_refresh.refresh()   # invalidate → next list re-pulls
+        env.instance_types.list(nc)          # same count: change-gated silent
+        err = capsys.readouterr().err
+        assert err.count("discovered instance types") == 1
+
+
+class TestProfilerGate:
+    def test_disabled_is_noop(self, monkeypatch):
+        monkeypatch.delenv("ENABLE_PROFILING", raising=False)
+        monkeypatch.delenv("KARPENTER_TPU_PROFILE_DIR", raising=False)
+        assert not profiling.profiling_enabled()
+        assert profiling.maybe_start_server() is None
+        with profiling.trace_solve():
+            pass  # no jax import, no trace
+
+    def test_trace_dir_produces_trace(self, tmp_path, monkeypatch):
+        import jax.numpy as jnp
+        monkeypatch.setenv("KARPENTER_TPU_PROFILE_DIR", str(tmp_path))
+        with profiling.trace_solve("test-op"):
+            jnp.ones((8, 8)).sum().block_until_ready()
+        produced = [p for p in tmp_path.rglob("*") if p.is_file()]
+        assert produced, "profiler trace produced no files"
+
+    def test_solver_trace_integration(self, tmp_path, monkeypatch):
+        from karpenter_tpu.models import NodePool, ObjectMeta, Pod, Resources
+        from karpenter_tpu.providers import generate_catalog
+        from karpenter_tpu.providers.catalog import CatalogSpec
+        from karpenter_tpu.scheduling import ScheduleInput
+        from karpenter_tpu.solver import TPUSolver
+        monkeypatch.setenv("KARPENTER_TPU_PROFILE_DIR", str(tmp_path))
+        catalog = generate_catalog(CatalogSpec(max_types=8, include_gpu=False))
+        inp = ScheduleInput(
+            pods=[Pod(meta=ObjectMeta(name="p"),
+                      requests=Resources.parse({"cpu": "1", "memory": "1Gi"}))],
+            nodepools=[NodePool(meta=ObjectMeta(name="default"))],
+            instance_types={"default": catalog})
+        res = TPUSolver().solve(inp)
+        assert not res.unschedulable
+        produced = [p for p in tmp_path.rglob("*") if p.is_file()]
+        assert produced, "solve under profile dir produced no trace"

@@ -120,3 +120,106 @@ class TestPreferenceRelaxation:
             zones = {frozenset(claim_zone(c)) for c in res.new_claims}
             assert zones == {frozenset({"tpu-west-1a"}),
                              frozenset({"tpu-west-1b"})}
+
+
+class TestSoftPodAffinityAndScheduleAnyway:
+    """Preferred pod (anti-)affinity and ScheduleAnyway spread are folded
+    into the same relaxation ladder (VERDICT r2 #9): they change placement
+    when satisfiable and never block (scheduling.md:282-379)."""
+
+    def test_preferred_affinity_colocates_when_satisfiable(self):
+        from karpenter_tpu.models import PodAffinityTerm
+        web = [Pod(meta=ObjectMeta(name=f"web{i}", labels={"app": "web"}),
+                   requests=Resources.parse({"cpu": "500m", "memory": "1Gi"}),
+                   requirements=Requirements(Requirement.make(
+                       ZONE, "In", "tpu-west-1c")))
+               for i in range(4)]
+        buddy = Pod(meta=ObjectMeta(name="buddy", labels={"app": "buddy"}),
+                    requests=Resources.parse({"cpu": "500m", "memory": "1Gi"}),
+                    pod_affinities=[PodAffinityTerm(
+                        label_selector={"app": "web"}, topology_key=ZONE,
+                        required=False, weight=100)])
+        oracle, solver = both(mkinput(web + [buddy]))
+        for res in (oracle, solver):
+            assert not res.unschedulable
+            for c in res.new_claims:
+                if any(p.meta.name == "buddy" for p in c.pods):
+                    assert claim_zone(c) == {"tpu-west-1c"}, (
+                        "preferred affinity ignored when satisfiable")
+
+    def test_preferred_affinity_never_blocks(self):
+        from karpenter_tpu.models import PodAffinityTerm
+        # nothing matches the selector anywhere: the preference relaxes
+        # away and the pod still schedules
+        lonely = Pod(meta=ObjectMeta(name="lonely"),
+                     requests=Resources.parse({"cpu": "500m", "memory": "1Gi"}),
+                     pod_affinities=[PodAffinityTerm(
+                         label_selector={"app": "ghost"}, topology_key=ZONE,
+                         required=False, weight=50)])
+        oracle, solver = both(mkinput([lonely]))
+        assert not oracle.unschedulable
+        assert not solver.unschedulable
+
+    def test_preferred_anti_affinity_separates_when_satisfiable(self):
+        from karpenter_tpu.models import PodAffinityTerm
+        pods = [Pod(meta=ObjectMeta(name=f"a{i}", labels={"app": "spread-me"}),
+                    requests=Resources.parse({"cpu": "250m", "memory": "256Mi"}),
+                    pod_affinities=[PodAffinityTerm(
+                        label_selector={"app": "spread-me"}, topology_key=ZONE,
+                        anti=True, required=False, weight=100)])
+                for i in range(3)]
+        oracle, solver = both(mkinput(pods))
+        for res in (oracle, solver):
+            assert not res.unschedulable
+            zones = [frozenset(claim_zone(c)) for c in res.new_claims
+                     if claim_zone(c)]
+            assert len(set(zones)) == 3, f"soft anti ignored: {zones}"
+
+    def test_preferred_anti_affinity_never_blocks(self):
+        from karpenter_tpu.models import PodAffinityTerm
+        # 5 pods, 3 zones: hard zone-anti would strand 2; soft must not
+        pods = [Pod(meta=ObjectMeta(name=f"a{i}", labels={"app": "s"}),
+                    requests=Resources.parse({"cpu": "250m", "memory": "256Mi"}),
+                    pod_affinities=[PodAffinityTerm(
+                        label_selector={"app": "s"}, topology_key=ZONE,
+                        anti=True, required=False, weight=100)])
+                for i in range(5)]
+        oracle, solver = both(mkinput(pods))
+        assert not oracle.unschedulable
+        assert not solver.unschedulable
+
+    def test_schedule_anyway_spreads_when_satisfiable(self):
+        from karpenter_tpu.models import TopologySpreadConstraint
+        pods = [Pod(meta=ObjectMeta(name=f"s{i}", labels={"app": "sa"}),
+                    requests=Resources.parse({"cpu": "500m", "memory": "1Gi"}),
+                    topology_spread=[TopologySpreadConstraint(
+                        topology_key=ZONE, max_skew=1,
+                        when_unsatisfiable="ScheduleAnyway",
+                        label_selector={"app": "sa"})])
+                for i in range(9)]
+        oracle, solver = both(mkinput(pods))
+        for res in (oracle, solver):
+            assert not res.unschedulable
+            # balanced across the 3 zones — the soft spread steered it
+            counts = {}
+            for c in res.new_claims:
+                (z,) = claim_zone(c)
+                counts[z] = counts.get(z, 0) + len(c.pods)
+            assert max(counts.values()) - min(counts.values()) <= 1, counts
+
+    def test_schedule_anyway_never_blocks(self):
+        from karpenter_tpu.models import TopologySpreadConstraint
+        # one zone only via hard requirement + soft spread: spread is
+        # unsatisfiable but must not strand anything
+        pods = [Pod(meta=ObjectMeta(name=f"s{i}", labels={"app": "sa"}),
+                    requests=Resources.parse({"cpu": "500m", "memory": "1Gi"}),
+                    requirements=Requirements(Requirement.make(
+                        ZONE, "In", "tpu-west-1a")),
+                    topology_spread=[TopologySpreadConstraint(
+                        topology_key=ZONE, max_skew=1,
+                        when_unsatisfiable="ScheduleAnyway",
+                        label_selector={"app": "sa"})])
+                for i in range(6)]
+        oracle, solver = both(mkinput(pods))
+        assert not oracle.unschedulable
+        assert not solver.unschedulable

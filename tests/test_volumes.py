@@ -1,0 +1,184 @@
+"""PV topology (VERDICT r2 #6; scheduling.md:381-417): bound zonal claims
+pin pods to the volume's zone on BOTH engines, claims consume per-node
+attach slots, and WaitForFirstConsumer claims bind to the scheduler's
+chosen zone at bind time.
+"""
+
+import pytest
+
+from karpenter_tpu.env import Environment
+from karpenter_tpu.models import (
+    Node,
+    NodePool,
+    ObjectMeta,
+    Pod,
+    Resources,
+    VolumeClaim,
+    wellknown,
+)
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.providers import generate_catalog
+from karpenter_tpu.providers.catalog import CatalogSpec
+from karpenter_tpu.scheduling import ExistingNode, ScheduleInput, Scheduler
+from karpenter_tpu.scheduling.types import effective_request
+from karpenter_tpu.solver import TPUSolver
+
+ZONE = wellknown.ZONE_LABEL
+CATALOG = generate_catalog(CatalogSpec(max_types=16, include_gpu=False))
+
+
+def mkpod(name, cpu="500m", mem="1Gi", claims=(), **kw):
+    return Pod(meta=ObjectMeta(name=name, labels=kw.pop("labels", {})),
+               requests=Resources.parse({"cpu": cpu, "memory": mem}),
+               volume_claims=list(claims), **kw)
+
+
+def mkinput(pods, **kw):
+    pool = NodePool(meta=ObjectMeta(name="default"))
+    return ScheduleInput(pods=pods, nodepools=[pool],
+                         instance_types={"default": CATALOG}, **kw)
+
+
+def both(inp):
+    return Scheduler(inp).solve(), TPUSolver().solve(inp)
+
+
+def claim_zone(res, pod_name):
+    for c in res.new_claims:
+        if any(p.meta.name == pod_name for p in c.pods):
+            zr = c.requirements.get(ZONE)
+            if zr is not None and zr.is_finite() and len(zr.values()) == 1:
+                (z,) = zr.values()
+                return z
+            return None
+    return None
+
+
+class TestZonePinning:
+    def test_bound_claim_pins_zone_both_engines(self):
+        bound = VolumeClaim(name="data", zone="tpu-west-1b", bound=True)
+        pods = [mkpod("db", claims=[bound])] + [
+            mkpod(f"f{i}") for i in range(5)]
+        inp = mkinput(pods)
+        oracle, solver = both(inp)
+        assert not oracle.unschedulable and not solver.unschedulable
+        assert claim_zone(oracle, "db") == "tpu-west-1b"
+        assert claim_zone(solver, "db") == "tpu-west-1b"
+
+    def test_unbound_claim_imposes_nothing(self):
+        wffc = VolumeClaim(name="scratch")  # WaitForFirstConsumer
+        inp = mkinput([mkpod("p", claims=[wffc])])
+        oracle, solver = both(inp)
+        assert not oracle.unschedulable and not solver.unschedulable
+
+    def test_conflicting_bound_zones_unschedulable(self):
+        pods = [mkpod("torn", claims=[
+            VolumeClaim(name="a", zone="tpu-west-1a", bound=True),
+            VolumeClaim(name="b", zone="tpu-west-1b", bound=True)])]
+        oracle, solver = both(mkinput(pods))
+        assert "torn" in oracle.unschedulable
+        assert "torn" in solver.unschedulable
+
+    def test_fold_is_idempotent_and_copies(self):
+        bound = VolumeClaim(name="data", zone="tpu-west-1a", bound=True)
+        pod = mkpod("p", claims=[bound])
+        inp1 = mkinput([pod])
+        # the original pod object is untouched (spec immutability)
+        assert pod.requirements.get(ZONE) is None
+        folded = inp1.pods[0]
+        zr = folded.requirements.get(ZONE)
+        assert zr is not None and zr.values() == {"tpu-west-1a"}
+        # re-folding the folded pod changes nothing
+        inp2 = mkinput([folded])
+        zr2 = inp2.pods[0].requirements.get(ZONE)
+        assert zr2 is not None and zr2.values() == {"tpu-west-1a"}
+
+
+class TestAttachLimits:
+    def test_claims_consume_attach_slots(self):
+        p = mkpod("p", claims=[VolumeClaim(name=f"v{i}") for i in range(3)])
+        assert effective_request(p).get("volumes") == 3
+
+    def test_attach_limit_spills_to_second_node(self):
+        # the largest catalog types expose 40 attach slots; 8 pods x 6
+        # claims = 48 slots force a second node even though cpu/mem fit one
+        pods = [mkpod(f"p{i}", cpu="250m", mem="256Mi",
+                      claims=[VolumeClaim(name=f"v{i}-{j}")
+                              for j in range(6)])
+                for i in range(8)]
+        inp = mkinput(pods)
+        oracle, solver = both(inp)
+        assert not oracle.unschedulable and not solver.unschedulable
+        assert oracle.node_count() >= 2
+        assert solver.node_count() >= 2
+        types = {it.name: it for it in CATALOG}
+        for res in (oracle, solver):
+            for c in res.new_claims:
+                top = types[c.instance_type_names[0]]
+                assert c.requests.get("volumes") <= \
+                    top.allocatable().get("volumes")
+
+    def test_existing_node_attach_slots_respected(self):
+        # an existing node with 24 slots already holding 20 attached
+        # claims only takes 4 more single-claim pods
+        resident = [mkpod(f"r{i}", cpu="50m", mem="64Mi",
+                          claims=[VolumeClaim(name=f"rv{i}-{j}",
+                                              zone="tpu-west-1a", bound=True)
+                                  for j in range(5)])
+                    for i in range(4)]  # 20 slots held
+        alloc = Resources.parse({"cpu": "64", "memory": "256Gi",
+                                 "pods": "110"})
+        alloc.set("volumes", 24)
+        used = Resources()
+        for r in resident:
+            used += effective_request(r)
+        node = Node(meta=ObjectMeta(name="n1", labels={
+            ZONE: "tpu-west-1a",
+            wellknown.CAPACITY_TYPE_LABEL: "on-demand",
+            wellknown.HOSTNAME_LABEL: "n1",
+            wellknown.NODEPOOL_LABEL: "default"}),
+            allocatable=alloc, ready=True)
+        existing = [ExistingNode(node=node, available=alloc - used,
+                                 pods=resident)]
+        pods = [mkpod(f"p{i}", cpu="50m", mem="64Mi",
+                      claims=[VolumeClaim(name=f"pv{i}")])
+                for i in range(8)]
+        inp = mkinput(pods)
+        inp.existing_nodes = existing
+        oracle, solver = both(inp)
+        for res in (oracle, solver):
+            onto = [n for n in res.existing_assignments.values()
+                    if n == "n1"]
+            assert len(onto) <= 4, (
+                f"{len(onto)} pods onto a node with 4 free attach slots")
+
+
+class TestBindingE2E:
+    def test_wffc_claim_binds_to_scheduled_zone(self):
+        env = Environment(options=Options(batch_idle_duration=0))
+        env.add_default_nodeclass()
+        env.cluster.nodepools.create(NodePool(meta=ObjectMeta(name="default")))
+        claim = VolumeClaim(name="scratch")
+        env.cluster.pods.create(mkpod("p", claims=[claim]))
+        env.settle()
+        pod = env.cluster.pods.get("p")
+        assert pod.scheduled
+        node = env.cluster.nodes.get(pod.node_name)
+        assert claim.bound
+        assert claim.zone == node.labels.get(ZONE)
+
+    def test_rescheduled_pod_follows_bound_volume(self):
+        # after the claim binds, a reschedule (e.g. consolidation sim)
+        # must keep the pod in the volume's zone
+        env = Environment(options=Options(batch_idle_duration=0))
+        env.add_default_nodeclass()
+        env.cluster.nodepools.create(NodePool(meta=ObjectMeta(name="default")))
+        claim = VolumeClaim(name="data")
+        env.cluster.pods.create(mkpod("p", claims=[claim]))
+        env.settle()
+        zone = claim.zone
+        assert zone is not None
+        inp = mkinput([env.cluster.pods.get("p")])
+        oracle, solver = both(inp)
+        assert claim_zone(oracle, "p") == zone
+        assert claim_zone(solver, "p") == zone
