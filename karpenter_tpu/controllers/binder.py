@@ -41,9 +41,10 @@ class PodBinder:
             # the zone the scheduler picked (scheduling.md:381-417) — from
             # here on the pod (and any future reschedule) is zone-pinned
             zone = node.labels.get(wellknown.ZONE_LABEL)
-            for claim in pod.volume_claims:
-                if not claim.bound:
-                    claim.bound = True
-                    claim.zone = zone
+            if zone is not None:  # a zone-less node can't pin the volume;
+                for claim in pod.volume_claims:  # leave the claim unbound
+                    if not claim.bound:
+                        claim.bound = True
+                        claim.zone = zone
             del pod.meta.annotations[NOMINATED_ANNOTATION]
             self.cluster.pods.update(pod)

@@ -158,10 +158,15 @@ class Pod:
         return len(self._soft_ladder())
 
     def has_soft_terms(self) -> bool:
-        return bool(self.preferences) \
-            or any(not t.required for t in self.pod_affinities) \
-            or any(c.when_unsatisfiable == "ScheduleAnyway"
-                   for c in self.topology_spread)
+        if self.preferences:
+            return True
+        for t in self.pod_affinities:
+            if not t.required:
+                return True
+        for c in self.topology_spread:
+            if c.when_unsatisfiable == "ScheduleAnyway":
+                return True
+        return False
 
     def relaxed(self, level: int) -> "Pod":
         """The pod with its soft terms ENFORCED as hard constraints, the

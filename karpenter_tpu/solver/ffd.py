@@ -319,9 +319,13 @@ def _solve_ffd_impl(
             # so equal nodes spread across domains. Capacity saturates at
             # the group count: beyond cnt it buys nothing, and without the
             # clamp a domain whose best column is marginally larger would
-            # win EVERY unpinned node and starve the other domains.
+            # win EVERY unpinned node and starve the other domains. The
+            # rotation cycles over the REAL domain count (not the padded
+            # bucket D): modulo the pad width, the residues are skewed and
+            # most unpinned nodes land on one domain.
+            d_real = jnp.maximum(jnp.max(col_dom) + 1, 1)
             score = (jnp.minimum(cap_nd, cnt) * jnp.int32(D + 1)
-                     + (idx[None, :] + dom_ids[:, None]) % D)
+                     + (idx[None, :] + dom_ids[:, None]) % d_real)
             bd = jnp.argmax(score, axis=0).astype(jnp.int32)        # [N]
             sel_nd = dom_ids[:, None] == bd[None, :]
             cap_nd = jnp.where(sel_nd, cap_nd, 0)
@@ -375,6 +379,13 @@ def _solve_ffd_impl(
                 take_e = jnp.zeros((0,), jnp.int32)
 
             # -- 2. in-flight nodes, per domain -------------------------
+            # clamp by the domain's want BEFORE the budget cumsum: the
+            # collective-limit clamp reserves headroom for earlier-indexed
+            # nodes' caps, and an unclamped full-node cap (~the whole
+            # node) would eat the entire pool budget on the first few
+            # nodes, zeroing the later-indexed nodes the per-domain
+            # prefix fill actually needs
+            cap_nd = jnp.minimum(cap_nd, want[:, None])
             cap_n_flat = _clamp_pool_limits(cap_nd.sum(0), node_pool, limits, req)
             cap_nd = jnp.minimum(cap_nd, cap_n_flat[None, :])
             take_nd = jax.vmap(_prefix_fill)(cap_nd, want)           # [D, N]

@@ -251,10 +251,63 @@ class TPUSolver:
         is plain, and vice versa."""
         from karpenter_tpu.utils import metrics
         self._used_split = False
+        self._residue_counted = set()
         res = self._solve_relaxed(inp, max_nodes=max_nodes)
+        if res.unschedulable and max_nodes is None:
+            # consolidation sims pass an explicit max_nodes cap and WANT
+            # slot exhaustion reported cheaply (an infeasible sim is
+            # rejected either way) — rescuing there would pay a host
+            # oracle per infeasible candidate in the hot loop
+            res = self._rescue_stranded(inp, res)
         metrics.SOLVER_SOLVES.inc(
             path="split" if self._used_split else "device")
         return res
+
+    def _count_residue(self, pods: List[Pod]) -> None:
+        """Residue-pod metric, deduplicated per solve(): the relaxation
+        loop can hit the split path once per round for the same pods —
+        counting each round would inflate the metric ~65x."""
+        from karpenter_tpu.utils import metrics
+        counted = getattr(self, "_residue_counted", None)
+        if counted is None:
+            metrics.SOLVER_RESIDUE_PODS.inc(len(pods))
+            return
+        fresh = [p for p in pods if p.meta.name not in counted]
+        if fresh:
+            counted.update(p.meta.name for p in fresh)
+            metrics.SOLVER_RESIDUE_PODS.inc(len(fresh))
+
+    def _rescue_stranded(self, inp: ScheduleInput,
+                         dev_res: ScheduleResult) -> ScheduleResult:
+        """One host-side oracle pass for pods the kernel stranded.
+
+        The kernel's per-domain quotas are planned against capacity
+        ESTIMATES, and the water-fill is cost-blind — under a tight pool
+        budget it can pay for balanced placements where the oracle would
+        use free existing capacity at the skew ceiling, leaving later
+        groups stranded (fuzz seed 66 class). Stranded pods get re-judged
+        by the oracle against the residual state via the split path's
+        augment+merge machinery: they either place (existing-first,
+        cost-aware) or the verdict 'unschedulable' now carries oracle
+        authority. Runs only when something stranded — the happy path
+        pays nothing."""
+        from karpenter_tpu.scheduling import Scheduler
+        from karpenter_tpu.utils import metrics
+
+        by_name = {p.meta.name: p for p in inp.pods}
+        stranded = [by_name[n] for n in dev_res.unschedulable
+                    if n in by_name]
+        if not stranded:
+            return dev_res
+        placed = [p for p in inp.pods
+                  if p.meta.name not in dev_res.unschedulable]
+        self._count_residue(stranded)
+        self._used_split = True  # host help happened: the path metric
+        aug = self._augment_with_claims(inp, stranded, placed, dev_res)
+        orc_res = Scheduler(aug).solve()
+        # the oracle's verdict replaces the kernel's for the stranded set
+        dev_res.unschedulable = {}
+        return self._merge_split(inp, dev_res, orc_res, stranded)
 
     def _attempt_or_split(self, inp: ScheduleInput,
                           max_nodes: Optional[int] = None) -> ScheduleResult:
@@ -278,7 +331,13 @@ class TPUSolver:
         the solver that must be bounded'). Re-solving whole keeps packing
         globally consistent. Soft terms therefore steer the kernel's
         domain choice when satisfiable and never block a pod."""
-        if not any(p.has_soft_terms() for p in inp.pods):
+        # cheap attribute pre-filter first: at 50k pods the method-call scan
+        # alone costs ~40 ms — a third of the TPU latency budget — while
+        # plain pods (the bulk) are three falsy attribute checks
+        if not any(p.preferences
+                   or ((p.pod_affinities or p.topology_spread)
+                       and p.has_soft_terms())
+                   for p in inp.pods):
             return self._attempt_or_split(inp, max_nodes=max_nodes)
         import dataclasses
         by_name = {p.meta.name: p for p in inp.pods}
@@ -365,7 +424,7 @@ class TPUSolver:
             raise UnsupportedPods("no residue groups; plain solve failed")
         residue_pods = [p for g, _ in probe.residue for p in g]
         supported_pods = [p for g in probe.groups for p in g]
-        metrics.SOLVER_RESIDUE_PODS.inc(len(residue_pods))
+        self._count_residue(residue_pods)
 
         if supported_pods:
             dev_res = self._solve_relaxed(
@@ -415,6 +474,7 @@ class TPUSolver:
             pool: {it.name: it for it in lst}
             for pool, lst in inp.instance_types.items()}
         used_by_pool: Dict[str, Resources] = {}
+        synthetic: List = []
         for claim in dev_res.new_claims:
             self._pin_claim(claim, types_by_pool.get(claim.nodepool, {}))
             it = types_by_pool.get(claim.nodepool, {}).get(
@@ -429,13 +489,13 @@ class TPUSolver:
             labels[wellknown.INSTANCE_TYPE_LABEL] = \
                 claim.instance_type_names[0]
             alloc = it.allocatable()
-            existing.append(ExistingNode(
+            synthetic.append((claim, ExistingNode(
                 node=Node(meta=ObjectMeta(name=claim.hostname,
                                           labels=labels),
                           allocatable=alloc, taints=list(claim.taints),
                           ready=True),
                 available=alloc - claim.requests,
-                pods=list(claim.pods)))
+                pods=list(claim.pods))))
             u = used_by_pool.setdefault(claim.nodepool, Resources())
             used_by_pool[claim.nodepool] = u + claim.requests
 
@@ -444,6 +504,27 @@ class TPUSolver:
             lim = limits.get(pool)
             if lim is not None:
                 limits[pool] = lim - used
+
+        # synthetic nodes are PURCHASES, not free capacity: pods the oracle
+        # folds onto them still consume the pool limit (in-repo limit
+        # semantics charge requests, matching the kernel and the oracle's
+        # own accounting), but the oracle treats existing-node capacity as
+        # free. Grant each synthetic node fold-on headroom only out of the
+        # remaining budget, sequentially, so the merged result can never
+        # overdraw the limit (conservative: ungranted spare stays unusable)
+        budget = {p: (limits[p].copy() if limits.get(p) is not None else None)
+                  for p in limits}
+        for claim, en in synthetic:
+            rem = budget.get(claim.nodepool)
+            if rem is not None:
+                grant = Resources([max(0.0, min(a, b))
+                                   for a, b in zip(en.available.v, rem.v)])
+                budget[claim.nodepool] = rem - grant
+                en.available = grant
+            existing.append(en)
+        # the oracle's remaining limits are what's left AFTER the grants —
+        # grants and new-claim budget must not double-count
+        limits = {p: budget.get(p, limits.get(p)) for p in limits}
 
         return dataclasses.replace(
             inp, pods=residue_pods, existing_nodes=existing,

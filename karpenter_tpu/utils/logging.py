@@ -25,6 +25,9 @@ def _configured_level() -> int:
 
 def _fmt_value(v: object) -> str:
     s = str(v)
+    # newlines would split one logfmt record across lines (multi-line
+    # exception messages are common kv values)
+    s = s.replace("\n", "\\n").replace("\r", "\\r")
     if any(c in s for c in ' "='):
         s = '"' + s.replace('"', '\\"') + '"'
     return s

@@ -298,16 +298,19 @@ class TestFuzzParity:
     def test_seeded(self, solver, seed):
         """Validity is a HARD invariant (0 failures over the calibration
         run). Against the oracle, the grouped scan carries two measured,
-        bounded gaps on adversarial all-spread mixes (r3 calibration over
-        200 seeds — real divergences found and fixed this round: domain
-        starvation from winner-takes-all node pinning, full-node budget
-        overcharge, budget-blind water-fill planning):
+        tightly bounded gaps (r3 calibration over 200 seeds — real
+        divergences found and fixed this round: domain starvation from
+        winner-takes-all node pinning and a pad-width rotation modulus,
+        full-node budget overcharge, budget-blind water-fill planning,
+        budget over-reservation in the per-domain in-flight fill, plus a
+        host-side oracle rescue pass for kernel-stranded pods):
 
-          * coverage — worst +6 stranded pods (seed 66: minDomains under a
-            near-exhausted pool limit where only existing nodes remain);
-          * node count — worst +50% (seed 37: six interleaved spread
-            groups open per-domain nodes the oracle shares), typical +1/+2
-            on ~12% of seeds, price within ~6%.
+          * coverage — worst +4 stranded pods on 2/200 seeds (seed 66
+            class: tight pool limit where the cost-blind water-fill spent
+            budget the oracle kept; the rescue pass recovers the rest, and
+            on many budget-tight seeds the solver now covers MORE pods
+            than the oracle);
+          * node count — worst +2 on 7/200 seeds, price within ~6%.
         """
         inp = _gen_problem(seed)
         res = solver.solve(inp)
@@ -315,14 +318,13 @@ class TestFuzzParity:
         if len(inp.pods) <= ORACLE_CMP_MAX_PODS:
             oracle = Scheduler(inp).solve()
             uns_gap = len(res.unschedulable) - len(oracle.unschedulable)
-            assert uns_gap <= 6, (
+            assert uns_gap <= 4, (
                 f"SEED={seed}: solver strands {len(res.unschedulable)} vs "
                 f"oracle {len(oracle.unschedulable)} — beyond the known bound")
             node_gap = res.node_count() - oracle.node_count()
-            allowance = max(2, -(-oracle.node_count() // 2))
-            assert node_gap <= allowance, (
+            assert node_gap <= 2, (
                 f"SEED={seed}: solver {res.node_count()} nodes vs oracle "
-                f"{oracle.node_count()} (gap {node_gap} > {allowance})")
+                f"{oracle.node_count()} (gap {node_gap} > 2)")
 
 
 @pytest.mark.slow
