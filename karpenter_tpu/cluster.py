@@ -4,13 +4,19 @@ The reference's controllers watch CRs through controller-runtime's informer
 cache and reconcile; all durable state is CRDs in etcd (SURVEY §5
 checkpoint/resume: "recovery = relist"). Our control plane is in-process, so
 the store IS the cluster: typed collections with resource versions,
-finalizer-aware deletion, and a global mutation counter the controller
-manager uses to run reconcilers to a fixed point deterministically.
+finalizer-aware deletion, a global mutation counter the controller manager
+uses to run reconcilers to a fixed point deterministically, and WATCHES —
+subscribers receive typed (kind, op, name) events on every mutation, so the
+operator's run loop is event-driven (reconcile on change, wake instantly)
+with the poll cadence demoted to a periodic resync, matching
+controller-runtime's informer + resync model.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Iterable, List, Optional, TypeVar
+import threading
+from collections import deque
+from typing import Callable, Dict, Iterable, List, NamedTuple, Optional, TypeVar
 
 from karpenter_tpu.models.objects import (
     Node,
@@ -24,12 +30,49 @@ from karpenter_tpu.utils.clock import Clock, RealClock
 T = TypeVar("T")
 
 
+class WatchEvent(NamedTuple):
+    kind: str   # "pods", "nodes", "nodeclaims", ...
+    op: str     # "added" | "modified" | "deleting" | "deleted"
+    name: str
+
+
+class Watch:
+    """One subscriber's buffered event stream + wake signal.
+
+    `wait(timeout)` returns True as soon as any event lands (or
+    immediately if some are already buffered); `drain()` hands back and
+    clears the buffer. The buffer is bounded — a slow consumer loses OLD
+    events, never new ones, and the informer discipline (level-driven
+    reconcile + periodic resync) makes dropped edges harmless."""
+
+    def __init__(self, maxlen: int = 4096):
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._buffer: deque = deque(maxlen=maxlen)
+
+    def _publish(self, ev: WatchEvent) -> None:
+        with self._lock:
+            self._buffer.append(ev)
+        self._event.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+    def drain(self) -> List[WatchEvent]:
+        with self._lock:
+            out = list(self._buffer)
+            self._buffer.clear()
+            self._event.clear()
+        return out
+
+
 class Store:
     """One typed collection with k8s-ish semantics."""
 
-    def __init__(self, cluster: "Cluster"):
+    def __init__(self, cluster: "Cluster", kind: str = ""):
         self._items: Dict[str, object] = {}
         self._cluster = cluster
+        self.kind = kind
 
     def create(self, obj) -> object:
         name = obj.meta.name
@@ -37,7 +80,7 @@ class Store:
             raise ValueError(f"already exists: {name}")
         obj.meta.creation_time = self._cluster.clock.now()
         self._items[name] = obj
-        self._cluster.mutated()
+        self._cluster.mutated(self.kind, "added", name)
         return obj
 
     def get(self, name: str):
@@ -45,7 +88,7 @@ class Store:
 
     def update(self, obj) -> None:
         obj.meta.resource_version += 1
-        self._cluster.mutated()
+        self._cluster.mutated(self.kind, "modified", obj.meta.name)
 
     def delete(self, name: str) -> None:
         """Finalizer-aware: objects with finalizers are only marked deleting;
@@ -57,10 +100,10 @@ class Store:
         if obj.meta.finalizers:
             if obj.meta.deletion_time is None:
                 obj.meta.deletion_time = self._cluster.clock.now()
-                self._cluster.mutated()
+                self._cluster.mutated(self.kind, "deleting", name)
             return
         del self._items[name]
-        self._cluster.mutated()
+        self._cluster.mutated(self.kind, "deleted", name)
 
     def remove_finalizer(self, name: str, finalizer: str) -> None:
         obj = self._items.get(name)
@@ -68,10 +111,10 @@ class Store:
             return
         if finalizer in obj.meta.finalizers:
             obj.meta.finalizers.remove(finalizer)
-            self._cluster.mutated()
+            self._cluster.mutated(self.kind, "modified", name)
         if obj.meta.deleting and not obj.meta.finalizers:
             del self._items[name]
-            self._cluster.mutated()
+            self._cluster.mutated(self.kind, "deleted", name)
 
     def list(self, filter_: Optional[Callable[[T], bool]] = None) -> List:
         out = list(self._items.values())
@@ -90,18 +133,39 @@ class Cluster:
     def __init__(self, clock: Optional[Clock] = None):
         self.clock = clock or RealClock()
         self.generation = 0  # bumps on every mutation anywhere
-        self.pods = Store(self)
-        self.nodes = Store(self)
-        self.nodeclaims = Store(self)
-        self.nodepools = Store(self)
-        self.nodeclasses = Store(self)
-        self.pdbs = Store(self)
+        self.pods = Store(self, "pods")
+        self.nodes = Store(self, "nodes")
+        self.nodeclaims = Store(self, "nodeclaims")
+        self.nodepools = Store(self, "nodepools")
+        self.nodeclasses = Store(self, "nodeclasses")
+        self.pdbs = Store(self, "pdbs")
         self.events: List[tuple] = []  # (time, kind, object, reason, message)
         self._pdb_budget_cache: Dict[str, int] = {}
         self._pdb_budget_gen = -1
+        self._watches: List[Watch] = []
+        self._watch_lock = threading.Lock()
 
-    def mutated(self) -> None:
+    def watch(self) -> Watch:
+        """Subscribe to every store mutation (the informer-cache seam)."""
+        w = Watch()
+        with self._watch_lock:
+            self._watches.append(w)
+        return w
+
+    def unwatch(self, w: Watch) -> None:
+        with self._watch_lock:
+            if w in self._watches:
+                self._watches.remove(w)
+
+    def mutated(self, kind: str = "", op: str = "modified",
+                name: str = "") -> None:
         self.generation += 1
+        if self._watches:
+            ev = WatchEvent(kind, op, name)
+            with self._watch_lock:
+                watches = list(self._watches)
+            for w in watches:
+                w._publish(ev)
 
     def record_event(self, kind: str, obj_name: str, reason: str,
                      message: str = "") -> None:

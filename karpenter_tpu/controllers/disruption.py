@@ -399,7 +399,8 @@ class Disruption:
         one new (price-capped) node? None = infeasible."""
         inp = self._build_sim_input(cands, price_cap)
         with metrics.SCHEDULING_SIMULATION_DURATION.time():
-            return self._admissible(self.solver.solve(inp, source="disruption"))
+            return self._admissible(self.solver.solve(
+                inp, source="disruption", max_nodes=8))
 
     def _simulate_batch(self, cand_sets: List[List[Candidate]],
                         price_caps: List[Optional[float]]):

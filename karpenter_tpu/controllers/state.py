@@ -39,13 +39,14 @@ class GatedSolver:
             from karpenter_tpu.native import hostops
             hostops()
 
-    def solve(self, inp: ScheduleInput, source: str = "solver"):
+    def solve(self, inp: ScheduleInput, source: str = "solver",
+              max_nodes: Optional[int] = None):
         from karpenter_tpu.scheduling import Scheduler
         from karpenter_tpu.solver import UnsupportedPods
         from karpenter_tpu.utils import metrics
         if self.options.feature_gates.tpu_solver:
             try:
-                return self.tpu.solve(inp)
+                return self.tpu.solve(inp, max_nodes=max_nodes)
             except UnsupportedPods:
                 pass  # constraints the encoder can't express yet → oracle
             except Exception as e:  # noqa: BLE001
@@ -86,11 +87,15 @@ class GatedSolver:
             except UnsupportedPods:
                 # per-input retry: each simulation gets its own shot at
                 # the device (solve() split-solves inexpressible groups);
-                # only truly unsupported inputs reach the oracle inside
+                # only truly unsupported inputs reach the oracle inside.
+                # The caller's kernel cap rides along — dropping it here
+                # would put full-width kernels and the stranded-pod rescue
+                # into the consolidation hot loop
                 def _per_input():
                     for inp in inps:
                         with metrics.SCHEDULING_SIMULATION_DURATION.time():
-                            yield self.solve(inp, source=source)
+                            yield self.solve(inp, source=source,
+                                             max_nodes=max_nodes)
                 return _per_input()
             except Exception as e:  # noqa: BLE001
                 self.cluster.record_event(

@@ -139,26 +139,47 @@ class Operator:
 
     # -- the reconcile loop ------------------------------------------------
     def run(self) -> None:
-        """manager.Start: reconcile every controller on a wall-clock cadence
-        until stopped.  Controllers are internally idempotent and
-        clock-driven (batch windows, TTLs), so a fixed outer cadence gives
-        the same observable behavior as the reference's watch-driven
-        workqueues with periodic resync."""
+        """manager.Start: WATCH-DRIVEN reconcile with periodic resync,
+        matching controller-runtime's informer model — a store mutation
+        (pod created, claim updated, node deleted) wakes the loop
+        immediately instead of waiting out the poll cadence; with no
+        events, the loop still resyncs every `reconcile_interval` so
+        clock-driven work (batch windows, TTLs, GC) keeps advancing.
+        Controllers are level-driven and idempotent, so coalesced or
+        dropped watch edges are harmless."""
         self.serve()
-        while not self._stop.is_set():
-            if self.elector is not None and not self.elector.try_acquire_or_renew():
-                # standby: hold position, retry on the election cadence;
-                # liveness stays green (the loop IS advancing)
+        watch = self.env.cluster.watch()
+        try:
+            while not self._stop.is_set():
+                if self.elector is not None \
+                        and not self.elector.try_acquire_or_renew():
+                    # standby: hold position, retry on the election
+                    # cadence; liveness stays green (the loop IS
+                    # advancing). Drain so a takeover starts fresh.
+                    watch.drain()
+                    self._last_reconcile = time.monotonic()
+                    self._stop.wait(self.elector.retry_period)
+                    continue
+                watch.drain()  # reconcile covers everything seen so far
+                t0 = time.monotonic()
+                self.env.manager.run_once()
                 self._last_reconcile = time.monotonic()
-                self._stop.wait(self.elector.retry_period)
-                continue
-            t0 = time.monotonic()
-            self.env.manager.run_once()
-            self._last_reconcile = time.monotonic()
-            elapsed = self._last_reconcile - t0
-            self._stop.wait(max(0.0, self.reconcile_interval - elapsed))
-        if self.elector is not None:
-            self.elector.release()
+                elapsed = self._last_reconcile - t0
+                remaining = max(0.0, self.reconcile_interval - elapsed)
+                if self.elector is not None:
+                    # an idle leader must still renew its lease on time
+                    remaining = min(remaining, self.elector.renew_interval / 2)
+                # wake early on any store mutation; cap waits so stop()
+                # and lease renewal stay responsive
+                deadline = time.monotonic() + remaining
+                while not self._stop.is_set():
+                    left = deadline - time.monotonic()
+                    if left <= 0 or watch.wait(timeout=min(left, 0.25)):
+                        break
+        finally:
+            self.env.cluster.unwatch(watch)
+            if self.elector is not None:
+                self.elector.release()
 
     def stop(self, *_args) -> None:
         self._stop.set()

@@ -208,8 +208,9 @@ class SolverServiceClient:
         return body
 
     # -- the solver seam ---------------------------------------------------
-    def solve(self, inp: ScheduleInput) -> ScheduleResult:
-        return self.solve_batch([inp])[0]
+    def solve(self, inp: ScheduleInput,
+              max_nodes: Optional[int] = None) -> ScheduleResult:
+        return self.solve_batch([inp], max_nodes=max_nodes)[0]
 
     def solve_batch(self, inps: List[ScheduleInput],
                     max_nodes: Optional[int] = None) -> List[ScheduleResult]:

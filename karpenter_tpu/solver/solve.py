@@ -292,11 +292,14 @@ class TPUSolver:
         authority. Runs only when something stranded — the happy path
         pays nothing."""
         from karpenter_tpu.scheduling import Scheduler
-        from karpenter_tpu.utils import metrics
 
         by_name = {p.meta.name: p for p in inp.pods}
+        # pods the split path's oracle already judged carry oracle
+        # authority — re-judging them every batch cycle would double the
+        # host work for as long as they stay pending
+        seen = getattr(self, "_residue_counted", set())
         stranded = [by_name[n] for n in dev_res.unschedulable
-                    if n in by_name]
+                    if n in by_name and n not in seen]
         if not stranded:
             return dev_res
         placed = [p for p in inp.pods
@@ -305,8 +308,10 @@ class TPUSolver:
         self._used_split = True  # host help happened: the path metric
         aug = self._augment_with_claims(inp, stranded, placed, dev_res)
         orc_res = Scheduler(aug).solve()
-        # the oracle's verdict replaces the kernel's for the stranded set
-        dev_res.unschedulable = {}
+        # the oracle's verdict replaces the kernel's for the RESCUED set;
+        # already-judged pods keep their existing verdicts
+        for p in stranded:
+            dev_res.unschedulable.pop(p.meta.name, None)
         return self._merge_split(inp, dev_res, orc_res, stranded)
 
     def _attempt_or_split(self, inp: ScheduleInput,
