@@ -160,11 +160,26 @@ class Operator:
                     self._last_reconcile = time.monotonic()
                     self._stop.wait(self.elector.retry_period)
                     continue
-                watch.drain()  # reconcile covers everything seen so far
                 t0 = time.monotonic()
-                self.env.manager.run_once()
-                self._last_reconcile = time.monotonic()
-                elapsed = self._last_reconcile - t0
+                # run to a BOUNDED fixed point per wake: reconcile chains
+                # (pod → claim → launch → register → bind) span several
+                # passes, each advancing on the previous one's mutations
+                for _ in range(8):
+                    gen = self.env.cluster.generation
+                    self.env.manager.run_once()
+                    self._last_reconcile = time.monotonic()
+                    if self.env.cluster.generation == gen or self._stop.is_set():
+                        break
+                # drain AFTER the fixed point: mutations made by the
+                # reconcile itself (self-requeue patterns like the
+                # lifecycle's ICE retry, which deliberately never settles
+                # while capacity is short) must not wake the loop into a
+                # zero-delay hot spin — they get the resync cadence, the
+                # reference's workqueue-backoff analogue. An external edge
+                # racing the reconcile is drained too; level-driven
+                # controllers + resync cover it (informer discipline).
+                watch.drain()
+                elapsed = time.monotonic() - t0
                 remaining = max(0.0, self.reconcile_interval - elapsed)
                 if self.elector is not None:
                     # an idle leader must still renew its lease on time

@@ -65,6 +65,7 @@ class TPUSolver:
         self._mesh_spec = mesh
         self._mesh = None
         self._mesh_resolved = False
+        self._last_active: Optional[int] = None  # node-axis warm start
         # per-solve host/device phase breakdown (ms), refreshed by
         # _solve_attempt — the observability the north-star budget needs
         # (encode+decode host share must stay well under the solve time)
@@ -253,11 +254,16 @@ class TPUSolver:
         self._used_split = False
         self._residue_counted = set()
         res = self._solve_relaxed(inp, max_nodes=max_nodes)
-        if res.unschedulable and max_nodes is None:
-            # consolidation sims pass an explicit max_nodes cap and WANT
-            # slot exhaustion reported cheaply (an infeasible sim is
-            # rejected either way) — rescuing there would pay a host
-            # oracle per infeasible candidate in the hot loop
+        if res.unschedulable and not (
+                max_nodes is not None
+                and getattr(self, "_last_slots_exhausted", False)):
+            # rescue unless the caller's explicit node cap was itself the
+            # binding constraint: a slot-exhausted consolidation sim WANTS
+            # the cheap reject (a >cap result is inadmissible either way),
+            # but a capped sim stranded for capacity/topology reasons may
+            # be feasible — the kernel's quota planning is estimate-based
+            # and cost-blind, and a spurious verdict here would silently
+            # stop consolidation under price caps
             res = self._rescue_stranded(inp, res)
         metrics.SOLVER_SOLVES.inc(
             path="split" if self._used_split else "device")
@@ -294,10 +300,13 @@ class TPUSolver:
         from karpenter_tpu.scheduling import Scheduler
 
         by_name = {p.meta.name: p for p in inp.pods}
-        # pods the split path's oracle already judged carry oracle
-        # authority — re-judging them every batch cycle would double the
-        # host work for as long as they stay pending
-        seen = getattr(self, "_residue_counted", set())
+        # pods the FINAL attempt's split oracle already judged carry
+        # oracle authority — re-judging them in the same solve would just
+        # repeat the identical oracle pass. (Only the final attempt
+        # counts: a pod that was split residue at an earlier relaxation
+        # level but kernel-stranded as a plain pod at the final level
+        # still deserves the rescue.)
+        seen = getattr(self, "_last_oracle_judged", set())
         stranded = [by_name[n] for n in dev_res.unschedulable
                     if n in by_name and n not in seen]
         if not stranded:
@@ -366,10 +375,31 @@ class TPUSolver:
                 relax[n] = relax.get(n, 0) + 1
         return res
 
+    def _adaptive_max_nodes(self) -> int:
+        """Node-axis auto-tuning: the kernel's cost scales ~linearly with
+        the N axis, and real workloads need far fewer slots than the
+        configured ceiling (the 50k headline: 782 of 2048 — halving N
+        nearly halves device time). Warm-start from the previous solve's
+        active count with 30% headroom, bucketed for jit-cache stability;
+        slot exhaustion retries once at the full ceiling (_solve_attempt),
+        so correctness never depends on the guess."""
+        last = getattr(self, "_last_active", None)
+        if last is None:
+            return self.max_nodes
+        need = max(64, int(last * 1.3) + 1)
+        for b in (64, 256, 1024):
+            if b >= need and b < self.max_nodes:
+                return b
+        return self.max_nodes
+
     def _solve_attempt(self, inp: ScheduleInput,
                        max_nodes: Optional[int] = None) -> ScheduleResult:
-        mn = max_nodes or self.max_nodes
+        mn = max_nodes or self._adaptive_max_nodes()
         import time as _time
+        # a pure-device attempt carries no oracle verdicts; reaching the
+        # end of this method overwrites any sub-solve's leftovers
+        self._last_oracle_judged = set()
+        self._last_slots_exhausted = False
         t0 = _time.perf_counter()
         cat = self._catalog_encoding(inp)
         enc = self._encode_checked(inp, cat)
@@ -400,6 +430,20 @@ class TPUSolver:
         with trace_solve("ffd-solve"):
             packed = ffd.solve_ffd(*args, max_nodes=mn)
             out = ffd.unpack(packed, G, E, mn, R, Db)
+            if (max_nodes is None and mn < self.max_nodes
+                    and out["unsched"].sum() > 0
+                    and out["num_active"] >= mn):
+                # the warm-start bucket ran out of node slots: redo at the
+                # configured ceiling (one-time cost; the next solve's
+                # warm-start adapts to the real active count)
+                mn = self.max_nodes
+                packed = ffd.solve_ffd(*args, max_nodes=mn)
+                out = ffd.unpack(packed, G, E, mn, R, Db)
+        self._last_slots_exhausted = bool(
+            out["unsched"].sum() > 0 and out["num_active"] >= mn)
+        if max_nodes is None:
+            # capped sims (tiny explicit N) must not poison the warm-start
+            self._last_active = int(out["num_active"])
         t3 = _time.perf_counter()
         self._repair_topology(enc, out)
         t4 = _time.perf_counter()
@@ -442,6 +486,9 @@ class TPUSolver:
         aug = self._augment_with_claims(inp, residue_pods, supported_pods,
                                         dev_res)
         orc_res = Scheduler(aug).solve()
+        # set LAST (after internal sub-solves, which overwrite it): the
+        # rescue pass must see which pods the final attempt's oracle judged
+        self._last_oracle_judged = set(orc_res.unschedulable)
         return self._merge_split(inp, dev_res, orc_res, residue_pods)
 
     def _augment_with_claims(self, inp: ScheduleInput,

@@ -1,0 +1,146 @@
+"""Chaos tier (reference: test/suites/chaos — hammer scale-up/down loops
+looking for runaway behavior). Marked slow; run with -m slow.
+
+The runaway failure mode: provisioning and disruption fighting each
+other — consolidation deletes nodes while the provisioner replaces them,
+or flapping workloads leave orphaned claims/instances behind. The
+invariants after every storm: the fleet converges to the workload's
+actual demand, no claim leaks (cloud instances == live claims), and no
+pod is left pending.
+"""
+
+import sys
+import time
+
+import pytest
+
+from karpenter_tpu.env import Environment
+from karpenter_tpu.models import NodePool, ObjectMeta, Pod, Resources
+from karpenter_tpu.operator.options import Options
+
+pytestmark = pytest.mark.slow
+
+
+def mkpod(name, cpu="500m", mem="1Gi"):
+    return Pod(meta=ObjectMeta(name=name),
+               requests=Resources.parse({"cpu": cpu, "memory": mem}))
+
+
+def mkenv():
+    e = Environment(options=Options(batch_idle_duration=0))
+    e.add_default_nodeclass()
+    pool = NodePool(meta=ObjectMeta(name="default"))
+    pool.disruption.consolidate_after = 0.0
+    e.cluster.nodepools.create(pool)
+    return e
+
+
+def live_instances(env):
+    return [i for i in env.cloud.instances.values()
+            if i.state not in ("terminated",)]
+
+
+class TestChaos:
+    def test_scale_flapping_converges_without_runaway(self):
+        """10 rounds of grow-to-60 / shrink-to-6 pods; the fleet must track
+        demand, never exceed a sane ceiling, and leak nothing."""
+        env = mkenv()
+        t0 = time.perf_counter()
+        max_claims_seen = 0
+        for round_i in range(10):
+            # grow
+            for i in range(60):
+                name = f"r{round_i}-p{i}"
+                env.cluster.pods.create(mkpod(name, cpu="2"))
+            env.settle(max_rounds=200)
+            claims = env.cluster.nodeclaims.list(lambda c: not c.meta.deleting)
+            max_claims_seen = max(max_claims_seen, len(claims))
+            pods = env.cluster.pods.list(
+                lambda p: p.meta.name.startswith(f"r{round_i}-"))
+            assert all(p.scheduled for p in pods), f"round {round_i} pending"
+            # shrink: keep 6
+            for i in range(6, 60):
+                env.cluster.pods.delete(f"r{round_i}-p{i}")
+            for _ in range(40):
+                env.settle(max_rounds=200)
+                env.clock.step(30)
+                live = env.cluster.nodeclaims.list(
+                    lambda c: not c.meta.deleting)
+                if len(live) <= 3:
+                    break
+            # previous round's survivors removed before the next storm
+            for i in range(6):
+                env.cluster.pods.delete(f"r{round_i}-p{i}")
+            env.settle(max_rounds=200)
+        secs = time.perf_counter() - t0
+        # convergence: empty workload → empty fleet (emptiness + GC)
+        for _ in range(40):
+            env.settle(max_rounds=200)
+            env.clock.step(60)
+            if not env.cluster.nodeclaims.list(lambda c: not c.meta.deleting):
+                break
+        live_claims = env.cluster.nodeclaims.list(lambda c: not c.meta.deleting)
+        assert not live_claims, f"fleet stuck at {len(live_claims)} claims"
+        # a 60-pod × 2-cpu demand fits a handful of large nodes; runaway
+        # would show as dozens
+        assert max_claims_seen <= 30, f"runaway: {max_claims_seen} claims"
+        # no leaked cloud instances once claims are gone
+        env.clock.step(300)
+        env.settle(max_rounds=200)
+        leaked = live_instances(env)
+        assert not leaked, f"{len(leaked)} instances leaked"
+        print(f"chaos flapping: 10 rounds in {secs:.1f}s, "
+              f"peak {max_claims_seen} claims, clean teardown",
+              file=sys.stderr)
+
+    def test_interruption_storm_during_provisioning(self):
+        """Spot reclaims racing fresh launches: every interruption drains
+        its claim, replacements appear, and the workload ends up running."""
+        env = mkenv()
+        for i in range(40):
+            env.cluster.pods.create(mkpod(f"w{i}", cpu="4"))
+        env.settle(max_rounds=200)
+        assert all(p.scheduled for p in env.cluster.pods.list())
+        # reclaim ~half the fleet
+        claims = env.cluster.nodeclaims.list()
+        for c in claims[::2]:
+            if c.provider_id:
+                env.cloud.interrupt_spot(c.provider_id)
+        # storm: interleave reconciles and time so drains + relaunches run
+        for _ in range(60):
+            env.settle(max_rounds=200)
+            env.clock.step(30)
+            pods = env.cluster.pods.list()
+            if all(p.scheduled and p.phase == "Running" for p in pods):
+                break
+        pods = env.cluster.pods.list()
+        assert all(p.scheduled for p in pods), "workload lost after storm"
+        # interrupted pools are ICE-cached; claims all healthy
+        live = env.cluster.nodeclaims.list(lambda c: not c.meta.deleting)
+        by_pid = {c.provider_id for c in live}
+        for pid in by_pid:
+            inst = env.cloud.instances.get(pid)
+            assert inst is not None and inst.state == "running"
+
+    def test_create_delete_churn_leaks_nothing(self):
+        """Rapid create/delete of the same workload names — the classic
+        orphaned-claim generator."""
+        env = mkenv()
+        for cycle in range(15):
+            for i in range(12):
+                env.cluster.pods.create(mkpod(f"churn-{i}", cpu="1"))
+            env.manager.run_once()  # provisioner may or may not have fired
+            for i in range(12):
+                env.cluster.pods.delete(f"churn-{i}")
+            env.settle(max_rounds=200)
+            env.clock.step(45)
+        # converge: no pods → no fleet, no orphans
+        for _ in range(40):
+            env.settle(max_rounds=200)
+            env.clock.step(60)
+            if not env.cluster.nodeclaims.list(lambda c: not c.meta.deleting):
+                break
+        assert not env.cluster.nodeclaims.list(lambda c: not c.meta.deleting)
+        env.clock.step(300)
+        env.settle(max_rounds=200)
+        assert not live_instances(env), "cloud instances leaked by churn"
