@@ -170,6 +170,12 @@ class Operator:
                     self._last_reconcile = time.monotonic()
                     if self.env.cluster.generation == gen or self._stop.is_set():
                         break
+                    # a busy leader must keep renewing MID-fixed-point:
+                    # eight multi-second passes can outlive the lease, and
+                    # a silent expiry here means two active leaders
+                    if self.elector is not None \
+                            and not self.elector.try_acquire_or_renew():
+                        break  # lost the lease — stop mutating immediately
                 # drain AFTER the fixed point: mutations made by the
                 # reconcile itself (self-requeue patterns like the
                 # lifecycle's ICE retry, which deliberately never settles

@@ -197,6 +197,13 @@ class Scheduler:
                 sim.remaining = sim.remaining - req
                 self.result.existing_assignments[pod.meta.name] = sim.name
                 self.tracker.register(pod, sim.domains)
+                # synthetic claim-nodes are purchases: placements charge
+                # the pool limit (real existing nodes are free capacity)
+                cp = sim.en.charge_pool
+                if cp is not None:
+                    limit = self._remaining_limits.get(cp)
+                    if limit is not None:
+                        self._remaining_limits[cp] = limit - req
                 return None
             sim.failed_keys.add(key)
 
@@ -220,6 +227,12 @@ class Scheduler:
             return False
         if not req.fits(sim.remaining):
             return False
+        if sim.en.charge_pool is not None:
+            # a synthetic claim-node placement is a purchase: the pool's
+            # remaining limit must cover it
+            limit = self._remaining_limits.get(sim.en.charge_pool)
+            if limit is not None and not req.fits(limit):
+                return False
         return self._topology_ok_fixed(pod, sim.domains, sim)
 
     def _topology_ok_fixed(self, pod: Pod, domains: Dict[str, str],

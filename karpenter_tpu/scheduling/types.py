@@ -79,6 +79,11 @@ class ExistingNode:
     node: Node
     available: Resources            # allocatable − Σ(resident pod requests)
     pods: List[Pod] = field(default_factory=list)
+    # set on SYNTHETIC nodes (the split/rescue paths present the device
+    # solve's planned claims as existing nodes): placements onto them are
+    # still purchases and must charge this pool's remaining limit — real
+    # existing nodes are free capacity and leave this None
+    charge_pool: "str | None" = None
 
     @property
     def name(self) -> str:

@@ -569,6 +569,13 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None,
     the host oracle (VERDICT r1 #4: a 50k-pod problem with one affinity
     pod must not abandon the device)."""
     cat = cat or encode_catalog(inp)
+    if any(en.charge_pool is not None for en in inp.existing_nodes):
+        # synthetic claim-nodes (split/rescue augment outputs) charge the
+        # pool limit per placement — the kernel's existing-node fills
+        # don't, so such inputs must stay on the host oracle
+        raise Unsupported(
+            "existing nodes with charge_pool need host-side limit "
+            "accounting")
     pools = cat.pools
     vocab = cat.vocab
     columns = cat.columns

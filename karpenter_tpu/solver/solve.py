@@ -486,9 +486,13 @@ class TPUSolver:
         aug = self._augment_with_claims(inp, residue_pods, supported_pods,
                                         dev_res)
         orc_res = Scheduler(aug).solve()
-        # set LAST (after internal sub-solves, which overwrite it): the
-        # rescue pass must see which pods the final attempt's oracle judged
-        self._last_oracle_judged = set(orc_res.unschedulable)
+        # UNION after internal sub-solves: a nested split (a relaxation
+        # variant of the supported pods was itself inexpressible) already
+        # recorded its oracle's verdicts — overwriting would re-rescue
+        # those pods with a redundant third oracle pass in solve()
+        self._last_oracle_judged = (
+            getattr(self, "_last_oracle_judged", set())
+            | set(orc_res.unschedulable))
         return self._merge_split(inp, dev_res, orc_res, residue_pods)
 
     def _augment_with_claims(self, inp: ScheduleInput,
@@ -526,7 +530,6 @@ class TPUSolver:
             pool: {it.name: it for it in lst}
             for pool, lst in inp.instance_types.items()}
         used_by_pool: Dict[str, Resources] = {}
-        synthetic: List = []
         for claim in dev_res.new_claims:
             self._pin_claim(claim, types_by_pool.get(claim.nodepool, {}))
             it = types_by_pool.get(claim.nodepool, {}).get(
@@ -541,13 +544,20 @@ class TPUSolver:
             labels[wellknown.INSTANCE_TYPE_LABEL] = \
                 claim.instance_type_names[0]
             alloc = it.allocatable()
-            synthetic.append((claim, ExistingNode(
+            # synthetic nodes are PURCHASES, not free capacity: pods the
+            # oracle folds onto them still consume the pool limit (in-repo
+            # limit semantics charge requests, matching the kernel and the
+            # oracle's own accounting) — charge_pool makes the oracle
+            # check + decrement the pool budget per fold-on placement,
+            # exactly like its own new-node accounting
+            existing.append(ExistingNode(
                 node=Node(meta=ObjectMeta(name=claim.hostname,
                                           labels=labels),
                           allocatable=alloc, taints=list(claim.taints),
                           ready=True),
                 available=alloc - claim.requests,
-                pods=list(claim.pods))))
+                pods=list(claim.pods),
+                charge_pool=claim.nodepool))
             u = used_by_pool.setdefault(claim.nodepool, Resources())
             used_by_pool[claim.nodepool] = u + claim.requests
 
@@ -556,27 +566,6 @@ class TPUSolver:
             lim = limits.get(pool)
             if lim is not None:
                 limits[pool] = lim - used
-
-        # synthetic nodes are PURCHASES, not free capacity: pods the oracle
-        # folds onto them still consume the pool limit (in-repo limit
-        # semantics charge requests, matching the kernel and the oracle's
-        # own accounting), but the oracle treats existing-node capacity as
-        # free. Grant each synthetic node fold-on headroom only out of the
-        # remaining budget, sequentially, so the merged result can never
-        # overdraw the limit (conservative: ungranted spare stays unusable)
-        budget = {p: (limits[p].copy() if limits.get(p) is not None else None)
-                  for p in limits}
-        for claim, en in synthetic:
-            rem = budget.get(claim.nodepool)
-            if rem is not None:
-                grant = Resources([max(0.0, min(a, b))
-                                   for a, b in zip(en.available.v, rem.v)])
-                budget[claim.nodepool] = rem - grant
-                en.available = grant
-            existing.append(en)
-        # the oracle's remaining limits are what's left AFTER the grants —
-        # grants and new-claim budget must not double-count
-        limits = {p: budget.get(p, limits.get(p)) for p in limits}
 
         return dataclasses.replace(
             inp, pods=residue_pods, existing_nodes=existing,
@@ -743,7 +732,21 @@ class TPUSolver:
                 for bi, (i, enc) in enumerate(chunk):
                     out = ffd.unpack(packed[bi], G, E, mn, R, Db)
                     self._repair_topology(enc, out)
-                    out_results[i] = self._decode(enc, out)
+                    res = self._decode(enc, out)
+                    if res.unschedulable and not (
+                            out["unsched"].sum() > 0
+                            and out["num_active"] >= mn):
+                        # same verdict discipline as solve(): a sim the
+                        # kernel strands WITHOUT slot pressure (the
+                        # estimate-miss class) gets the oracle rescue —
+                        # otherwise price-capped consolidations are
+                        # spuriously rejected on this path while the
+                        # single-sim path accepts them. Slot-exhausted
+                        # sims keep the cheap reject.
+                        self._residue_counted = set()
+                        self._last_oracle_judged = set()
+                        res = self._rescue_stranded(inps[i], res)
+                    out_results[i] = res
         return out_results
 
     def _existing_only(self, enc: EncodedProblem) -> ScheduleResult:
