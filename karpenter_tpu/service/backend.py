@@ -41,11 +41,7 @@ def _get_solver():
         # KARPENTER_TPU_FORCE_CPU at the config level (site bootstraps pin
         # jax_platforms via jax.config, which beats the raw environment)
         from karpenter_tpu.utils.platform import configure
-        configure()
-        if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
-            import jax
-            jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                              1.0)
+        configure()  # also enables the shared persistent compile cache
         from karpenter_tpu.solver import TPUSolver
         _solver = TPUSolver(
             max_nodes=int(os.environ.get("KARPENTER_TPU_MAX_NODES", "2048")))

@@ -731,18 +731,22 @@ class TPUSolver:
                 packed = np.array(packed)
                 for bi, (i, enc) in enumerate(chunk):
                     out = ffd.unpack(packed[bi], G, E, mn, R, Db)
+                    # judged BEFORE topology repair: repair-stranded pods
+                    # are exactly the estimate-miss class the rescue is
+                    # for (solve() computes its flag pre-repair too)
+                    exhausted = bool(out["unsched"].sum() > 0
+                                     and out["num_active"] >= mn)
                     self._repair_topology(enc, out)
                     res = self._decode(enc, out)
                     if res.unschedulable and not (
-                            out["unsched"].sum() > 0
-                            and out["num_active"] >= mn):
+                            max_nodes is not None and exhausted):
                         # same verdict discipline as solve(): a sim the
-                        # kernel strands WITHOUT slot pressure (the
-                        # estimate-miss class) gets the oracle rescue —
-                        # otherwise price-capped consolidations are
-                        # spuriously rejected on this path while the
-                        # single-sim path accepts them. Slot-exhausted
-                        # sims keep the cheap reject.
+                        # kernel strands WITHOUT slot pressure gets the
+                        # oracle rescue — otherwise price-capped
+                        # consolidations are spuriously rejected on this
+                        # path while the single-sim path accepts them.
+                        # Only an EXPLICIT caller cap earns the cheap
+                        # slot-exhaustion reject, matching solve().
                         self._residue_counted = set()
                         self._last_oracle_judged = set()
                         res = self._rescue_stranded(inps[i], res)

@@ -67,6 +67,23 @@ def _other_device_holders() -> list:
     return holders
 
 
+def enable_compile_cache() -> None:
+    """Persistent XLA compile cache shared by every process touching the
+    repo (tests, benches, config subprocesses, kt_solverd): the kernel
+    compiles at a handful of bucketed shapes, and the first TPU compile
+    costs 20-40 s — paying it once per shape per MACHINE instead of once
+    per process keeps the 5-config bench artifact inside its wall-clock
+    budget. Opt out with KARPENTER_TPU_NO_COMPILE_CACHE=1."""
+    if os.environ.get("KARPENTER_TPU_NO_COMPILE_CACHE"):
+        return
+    import jax
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
 def configure(platform: Optional[str] = None) -> Optional[str]:
     """Pin jax_platforms explicitly (config-level, beating site bootstraps).
 
@@ -79,6 +96,7 @@ def configure(platform: Optional[str] = None) -> Optional[str]:
     if want:
         import jax
         jax.config.update("jax_platforms", want)
+    enable_compile_cache()
     return want
 
 
