@@ -361,10 +361,15 @@ def _solve_ffd_impl(
             # oracle would have placed in a balanced [51,50,50] shape.
             # NOT gated by `rooms`: in-flight fills charge only req (no
             # per-node daemon), so a pool without room for one more whole
-            # node can still fund fills on already-open nodes
-            afford_total = afford.sum()
+            # node can still fund fills on already-open nodes.
+            # Accumulate in f32: each pool's afford saturates at 2^30, so
+            # an int32 sum over 2+ unlimited pools wraps negative and the
+            # whole want-plan goes garbage (pods silently dropped)
+            afford_total = afford.astype(jnp.float32).sum()
             cnt_eff = jnp.minimum(
-                cnt, (cap_ed.sum() if E else 0) + afford_total)
+                cnt.astype(jnp.float32),
+                (cap_ed.sum().astype(jnp.float32) if E else 0.0)
+                + afford_total).astype(jnp.int32)
             want = _water_fill(cnt_eff, dbase, jnp.minimum(capacity, dcap),
                                delig, skew, mindom)                  # [D]
             unplaceable = cnt - want.sum()

@@ -77,9 +77,21 @@ def enable_compile_cache() -> None:
     if os.environ.get("KARPENTER_TPU_NO_COMPILE_CACHE"):
         return
     import jax
-    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
-        os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__)))), ".jax_cache")
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if not cache_dir:
+        # repo checkout: .jax_cache next to the package (gitignored).
+        # pip install: the package's parent is site-packages — often
+        # read-only, and never a place to grow cache files — so fall back
+        # to a per-user cache dir instead of silently losing the cache
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        candidate = os.path.join(repo_root, ".jax_cache")
+        if os.path.basename(repo_root) in ("site-packages", "dist-packages"):
+            candidate = os.path.join(
+                os.environ.get("XDG_CACHE_HOME")
+                or os.path.join(os.path.expanduser("~"), ".cache"),
+                "karpenter_tpu", "jax")
+        cache_dir = candidate
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 

@@ -334,3 +334,189 @@ class TestFuzzLarge:
         inp = _gen_problem(10_000 + seed, scale="slow")
         res = solver.solve(inp)
         check_validity(10_000 + seed, inp, res)
+
+
+# -- mixed tier: the newest machinery under adversarial mixes --------------
+#
+# Required co-location affinity (inexpressible → the split path's
+# augment+merge + synthetic charge_pool claim-nodes), bound/unbound volume
+# claims (zone pinning + attach slots), soft terms (the relaxation ladder),
+# and multiple pools with weights and taints — the surface the default tier
+# above doesn't touch.
+
+from karpenter_tpu.models import Taint, Toleration  # noqa: E402
+
+N_MIXED_SEEDS = int(os.environ.get("FUZZ_MIXED_SEEDS", "60"))
+MIXED_KINDS = ["plain", "coloc", "volbound", "volwait", "softzone",
+               "softanti", "sanyspread", "zspread", "tolburst"]
+
+
+def _gen_problem_mixed(seed: int) -> ScheduleInput:
+    from karpenter_tpu.models import VolumeClaim
+
+    rng = np.random.RandomState(100_000 + seed)
+    total_target = rng.randint(40, 600)
+    n_groups = rng.randint(2, 8)
+
+    pools = [NodePool(meta=ObjectMeta(name="default"), weight=100)]
+    burst_taint = Taint(key="dedicated", value="burst")
+    if rng.rand() < 0.5:
+        burst = NodePool(meta=ObjectMeta(name="burst"), weight=10,
+                         taints=[burst_taint])
+        if rng.rand() < 0.5:
+            burst.requirements = Requirements(
+                Requirement.make(CT, "In", "spot"))
+        pools.append(burst)
+
+    pods = []
+    for g in range(n_groups):
+        count = max(1, int(rng.poisson(total_target / n_groups)))
+        cpu = int(rng.choice([125, 250, 500, 1000, 2000]))
+        mem = int(rng.choice([256, 512, 1024, 2048]))
+        kind = MIXED_KINDS[rng.randint(0, len(MIXED_KINDS))]
+        labels = {"grp": f"g{g}"}
+        extra = {}
+        if kind == "coloc":
+            # required zone co-location: inexpressible on device → split
+            # path; 'co' label is never seeded on residents, so the group
+            # must land in exactly one zone
+            labels["co"] = f"c{g}"
+            count = min(count, 30)
+            extra["pod_affinities"] = [PodAffinityTerm(
+                label_selector={"co": f"c{g}"}, topology_key=ZONE,
+                anti=False, required=True)]
+        elif kind == "volbound":
+            zone = DEFAULT_ZONES[rng.randint(0, len(DEFAULT_ZONES))]
+            count = min(count, 60)
+            extra["volume_claims"] = [VolumeClaim(
+                name=f"pvc-g{g}", zone=zone, bound=True)]
+        elif kind == "volwait":
+            count = min(count, 60)
+            extra["volume_claims"] = [
+                VolumeClaim(name=f"pvc-g{g}-{j}", bound=False)
+                for j in range(rng.randint(1, 3))]
+        elif kind == "softzone":
+            zone = DEFAULT_ZONES[rng.randint(0, len(DEFAULT_ZONES))]
+            extra["preferences"] = [(100, Requirements(
+                Requirement.make(ZONE, "In", zone)))]
+        elif kind == "softanti":
+            count = min(count, 12)
+            extra["pod_affinities"] = [PodAffinityTerm(
+                label_selector={"grp": f"g{g}"}, topology_key=ZONE,
+                anti=True, required=False)]
+        elif kind == "sanyspread":
+            extra["topology_spread"] = [TopologySpreadConstraint(
+                topology_key=ZONE, max_skew=1,
+                when_unsatisfiable="ScheduleAnyway",
+                label_selector={"grp": f"g{g}"})]
+        elif kind == "zspread":
+            extra["topology_spread"] = [TopologySpreadConstraint(
+                topology_key=ZONE, max_skew=int(rng.randint(1, 3)),
+                label_selector={"grp": f"g{g}"})]
+        elif kind == "tolburst":
+            extra["tolerations"] = [Toleration(
+                key="dedicated", value="burst")]
+        for i in range(count):
+            pods.append(Pod(
+                meta=ObjectMeta(name=f"g{g}-p{i}", labels=dict(labels)),
+                requests=Resources.parse(
+                    {"cpu": f"{cpu}m", "memory": f"{mem}Mi"}),
+                **{k: list(v) if isinstance(v, list) else v
+                   for k, v in extra.items()}))
+
+    limits = {}
+    if rng.rand() < 0.25:
+        total_cpu = sum(p.requests.get("cpu") for p in pods)
+        limits["default"] = Resources.limits(
+            cpu=int(total_cpu * rng.uniform(0.6, 1.5)))
+
+    existing = []
+    for i in range(rng.randint(0, 6)):
+        zone = DEFAULT_ZONES[rng.randint(0, len(DEFAULT_ZONES))]
+        alloc = Resources.parse({"cpu": "16", "memory": "64Gi", "pods": "110"})
+        resident = []
+        if rng.rand() < 0.5:
+            g = rng.randint(0, n_groups)
+            for j in range(rng.randint(1, 3)):
+                resident.append(Pod(
+                    meta=ObjectMeta(name=f"res-{i}-{j}",
+                                    labels={"grp": f"g{g}"}),
+                    requests=Resources.parse(
+                        {"cpu": "250m", "memory": "256Mi"})))
+        used = Resources()
+        for p in resident:
+            used += effective_request(p)
+        existing.append(ExistingNode(
+            node=Node(meta=ObjectMeta(
+                name=f"exist-{i}",
+                labels={ZONE: zone, CT: "on-demand", HOST: f"exist-{i}",
+                        wellknown.NODEPOOL_LABEL: "default"}),
+                allocatable=alloc, ready=True),
+            available=alloc - used, pods=resident))
+
+    return ScheduleInput(
+        pods=pods, nodepools=pools,
+        instance_types={p.name: CATALOG for p in pools},
+        existing_nodes=existing,
+        remaining_limits={**{p.name: None for p in pools}, **limits},
+    )
+
+
+def check_validity_mixed(seed: int, inp: ScheduleInput, res) -> None:
+    check_validity(seed, inp, res)
+    ctx = f"MIXED_SEED={seed}"
+    placed = _placements(inp, res)
+    pod_by_name = {p.meta.name: p for p in inp.pods}
+    pools = {p.name: p for p in inp.nodepools}
+
+    # taints: every pod on a claim must tolerate its pool's taints
+    from karpenter_tpu.models.taints import untolerated
+    for claim in res.new_claims:
+        pool = pools[claim.nodepool]
+        for pod in claim.pods:
+            assert not untolerated(pool.taints, pod.tolerations), (
+                f"{ctx} pod {pod.meta.name} on tainted pool {pool.name} "
+                f"without toleration")
+
+    # required zone co-location: all placed members of a 'co' group share
+    # one zone (residents never carry 'co' labels, so there is exactly one
+    # seeded domain)
+    co_zones = {}
+    for name, (host, zone) in placed.items():
+        pod = pod_by_name[name]
+        co = pod.meta.labels.get("co")
+        if co is not None and any(
+                t.required and not t.anti for t in pod.pod_affinities):
+            assert zone is not None, (
+                f"{ctx} co-location pod {name} on zone-unpinned placement")
+            co_zones.setdefault(co, set()).add(zone)
+    for co, zones in co_zones.items():
+        assert len(zones) == 1, (
+            f"{ctx} co-location group {co} split across zones {zones}")
+
+    # bound volume claims pin the pod's zone
+    for name, (host, zone) in placed.items():
+        pod = pod_by_name[name]
+        bound = {c.zone for c in pod.volume_claims if c.bound and c.zone}
+        if bound:
+            assert zone in bound, (
+                f"{ctx} pod {name} with volume bound to {bound} "
+                f"placed in zone {zone}")
+
+
+class TestFuzzMixed:
+    @pytest.mark.parametrize("seed", range(N_MIXED_SEEDS))
+    def test_seeded_mixed(self, solver, seed):
+        inp = _gen_problem_mixed(seed)
+        res = solver.solve(inp)
+        check_validity_mixed(seed, inp, res)
+        if len(inp.pods) <= ORACLE_CMP_MAX_PODS:
+            oracle = Scheduler(inp).solve()
+            uns_gap = len(res.unschedulable) - len(oracle.unschedulable)
+            assert uns_gap <= 4, (
+                f"MIXED_SEED={seed}: solver strands {len(res.unschedulable)} "
+                f"vs oracle {len(oracle.unschedulable)}")
+            node_gap = res.node_count() - oracle.node_count()
+            assert node_gap <= 2, (
+                f"MIXED_SEED={seed}: solver {res.node_count()} nodes vs "
+                f"oracle {oracle.node_count()} (gap {node_gap} > 2)")
