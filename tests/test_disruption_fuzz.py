@@ -1,0 +1,111 @@
+"""Seeded consolidation invariant fuzzing.
+
+Random workloads provision, then a random subset scales away and the
+disruption controller consolidates. Whatever the seed, four invariants
+must hold after the cluster settles (reference semantics: consolidation
+exists only to reduce cost and must never break workloads —
+designs/consolidation.md, website/.../concepts/disruption.md):
+
+  * every surviving pod is scheduled and Running;
+  * total fleet price never increases from consolidating a shrunk
+    workload;
+  * no leaks: running instances ↔ node claims are 1:1, and terminated
+    instances hold no claim;
+  * quiescence: a second settle changes nothing (no oscillation).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.env import Environment
+from karpenter_tpu.models import NodePool, ObjectMeta, Pod, Resources
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.providers.fake_cloud import INSTANCE_RUNNING
+
+N_SEEDS = int(os.environ.get("DISRUPTION_FUZZ_SEEDS", "25"))
+
+
+def cluster_price(env) -> float:
+    """Σ offering price of running instances, resolved against the
+    catalog by (type, zone, capacity-type)."""
+    catalog = {it.name: it for it in env.cloud.describe_instance_types()}
+    total = 0.0
+    for inst in env.cloud.instances.values():
+        if inst.state != INSTANCE_RUNNING:
+            continue
+        it = catalog[inst.instance_type]
+        prices = [o.price for o in it.offerings
+                  if o.zone == inst.zone
+                  and o.capacity_type == inst.capacity_type]
+        assert prices, (
+            f"instance {inst.instance_id} runs {it.name} in "
+            f"({inst.zone}, {inst.capacity_type}) with no such offering")
+        total += min(prices)
+    return total
+
+
+def check_no_leaks(env, ctx: str) -> None:
+    claims = env.cluster.nodeclaims.list()
+    running = {i.instance_id: i for i in env.cloud.instances.values()
+               if i.state == INSTANCE_RUNNING}
+    claim_ids = {c.provider_id for c in claims}
+    assert claim_ids == set(running), (
+        f"{ctx}: claims↔instances diverged: "
+        f"orphan_instances={set(running) - claim_ids} "
+        f"orphan_claims={claim_ids - set(running)}")
+    nodes = {n.name for n in env.cluster.nodes.list()}
+    assert nodes == {c.node_name for c in claims}, ctx
+
+
+@pytest.mark.parametrize("seed", range(N_SEEDS))
+def test_consolidation_invariants(seed):
+    rng = np.random.RandomState(7_000 + seed)
+    env = Environment(options=Options(batch_idle_duration=0))
+    env.add_default_nodeclass()
+    env.cluster.nodepools.create(NodePool(meta=ObjectMeta(name="default")))
+
+    n_classes = rng.randint(2, 5)
+    pod_names = []
+    for g in range(n_classes):
+        cpu = int(rng.choice([250, 500, 1000, 2000, 4000, 8000]))
+        mem = int(rng.choice([512, 1024, 2048, 8192]))
+        for i in range(rng.randint(3, 20)):
+            name = f"g{g}-p{i}"
+            env.cluster.pods.create(Pod(
+                meta=ObjectMeta(name=name),
+                requests=Resources.parse(
+                    {"cpu": f"{cpu}m", "memory": f"{mem}Mi"})))
+            pod_names.append(name)
+    env.settle()
+    ctx = f"SEED={seed}"
+    assert all(p.scheduled and p.phase == "Running"
+               for p in env.cluster.pods.list()), ctx
+    check_no_leaks(env, ctx)
+    price_full = cluster_price(env)
+
+    # workload scales down: a random 40-80% of pods go away
+    drop = rng.choice(pod_names, size=max(1, int(
+        len(pod_names) * rng.uniform(0.4, 0.8))), replace=False)
+    for name in drop:
+        p = env.cluster.pods.get(name)
+        p.node_name = None
+        env.cluster.pods.delete(name)
+    env.settle()
+
+    survivors = env.cluster.pods.list()
+    assert {p.meta.name for p in survivors} == set(pod_names) - set(drop), ctx
+    assert all(p.scheduled and p.phase == "Running" for p in survivors), ctx
+    check_no_leaks(env, f"{ctx} post-consolidation")
+    price_shrunk = cluster_price(env)
+    assert price_shrunk <= price_full + 1e-9, (
+        f"{ctx}: consolidating a shrunk workload RAISED the fleet price "
+        f"{price_full:.4f} -> {price_shrunk:.4f}")
+
+    # quiescence: another settle must not move anything
+    claims_before = {c.name for c in env.cluster.nodeclaims.list()}
+    env.settle()
+    assert {c.name for c in env.cluster.nodeclaims.list()} == claims_before, (
+        f"{ctx}: disruption oscillates after convergence")
+    assert abs(cluster_price(env) - price_shrunk) < 1e-9, ctx

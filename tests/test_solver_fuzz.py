@@ -335,6 +335,46 @@ class TestFuzzLarge:
         res = solver.solve(inp)
         check_validity(10_000 + seed, inp, res)
 
+    @pytest.mark.parametrize("seed", range(10))
+    def test_seeded_mixed_large(self, solver, seed):
+        """The mixed-constraint surface at 1k-5k pods: volumes, co-location
+        (split path), soft terms, weighted/tainted pools — full validity
+        checks, no oracle node-count comparison (the per-pod oracle is too
+        slow at this scale)."""
+        COPIES = 8
+        inp = _gen_problem_mixed(20_000 + seed)
+        # scale the group counts up ~8x by concatenating independent
+        # copies with disjoint names/labels (and limits scaled to match —
+        # otherwise a 1x-sized pool limit makes most pods trivially
+        # unschedulable and the constraint surface goes untested)
+        import dataclasses
+        pods = []
+        for k in range(COPIES):
+            for p in inp.pods:
+                q = dataclasses.replace(
+                    p, meta=dataclasses.replace(
+                        p.meta, name=f"c{k}-{p.meta.name}",
+                        labels={kk: f"c{k}-{vv}"
+                                for kk, vv in p.meta.labels.items()}))
+                # re-key selectors to the copy's label namespace so copies
+                # stay independent constraint groups
+                q.topology_spread = [
+                    dataclasses.replace(c, label_selector={
+                        kk: f"c{k}-{vv}"
+                        for kk, vv in c.label_selector.items()})
+                    for c in p.topology_spread]
+                q.pod_affinities = [
+                    dataclasses.replace(t, label_selector={
+                        kk: f"c{k}-{vv}"
+                        for kk, vv in t.label_selector.items()})
+                    for t in p.pod_affinities]
+                pods.append(q)
+        limits = {pool: (lim * COPIES if lim is not None else None)
+                  for pool, lim in inp.remaining_limits.items()}
+        inp = dataclasses.replace(inp, pods=pods, remaining_limits=limits)
+        res = solver.solve(inp)
+        check_validity_mixed(20_000 + seed, inp, res)
+
 
 # -- mixed tier: the newest machinery under adversarial mixes --------------
 #
