@@ -162,14 +162,34 @@ def run_configs(timeout_s: float):
         path = os.path.join(HERE, "benchmarks", cfg)
         rec = {"config": cfg}
         try:
-            proc = subprocess.run([sys.executable, path], env=env,
-                                  capture_output=True, text=True,
-                                  timeout=timeout_s)
+            # own session per config: on timeout the WHOLE process group
+            # dies — a killed config must not leak grandchildren (platform
+            # probes, nested subprocesses) that keep holding the chip and
+            # starve every later stage's backend init
+            proc = subprocess.Popen([sys.executable, path], env=env,
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.PIPE, text=True,
+                                    start_new_session=True)
+            try:
+                stdout, stderr = proc.communicate(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(proc.pid, 9)
+                except OSError:
+                    pass
+                # drain what the child flushed before dying — partial
+                # output IS the evidence the attempts log exists for
+                stdout, stderr = proc.communicate()
+                if stdout:
+                    rec["stdout_tail"] = stdout[-300:]
+                if stderr:
+                    rec["stderr_tail"] = stderr.strip()[-300:]
+                raise
             rec["rc"] = proc.returncode
             # a '{'-prefixed line may be a dict-repr log or truncated JSON
             # (child killed mid-flush) — a parse failure must not kill the
             # artifact, it IS the evidence
-            for ln in proc.stdout.splitlines():
+            for ln in stdout.splitlines():
                 if ln.startswith("{"):
                     try:
                         rec["parsed"] = json.loads(ln)
@@ -177,7 +197,7 @@ def run_configs(timeout_s: float):
                     except ValueError:
                         rec.setdefault("unparsed", ln[:300])
             if proc.returncode != 0:
-                tail = (proc.stderr or "").strip().splitlines()
+                tail = (stderr or "").strip().splitlines()
                 rec["error"] = tail[-1][:300] if tail else "<no stderr>"
         except subprocess.TimeoutExpired:
             rec["rc"] = -1

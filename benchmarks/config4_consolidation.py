@@ -55,7 +55,13 @@ def make_input():
 
 
 def solve(solver, inps):
-    return solver.solve_batch(inps)
+    # mirror the product's consolidation sweep (controllers/disruption.py:416):
+    # admissibility rejects any sim needing more than one replacement node,
+    # so the sweep passes a tiny new-node cap and the batched kernel runs
+    # ~256x narrower than the provisioning width — uncapped, each of the
+    # 2000 sims would pay the full 2048-slot kernel and the config blows
+    # its wall-clock on compile+execute
+    return solver.solve_batch(inps, max_nodes=8)
 
 
 if __name__ == "__main__":

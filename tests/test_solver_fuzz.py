@@ -350,23 +350,19 @@ class TestFuzzLarge:
         import dataclasses
         pods = []
         for k in range(COPIES):
+            # one namespace map per copy: labels AND every selector go
+            # through it, so copies stay independent constraint groups
+            remap = lambda d: {kk: f"c{k}-{vv}" for kk, vv in d.items()}  # noqa: E731
             for p in inp.pods:
                 q = dataclasses.replace(
                     p, meta=dataclasses.replace(
                         p.meta, name=f"c{k}-{p.meta.name}",
-                        labels={kk: f"c{k}-{vv}"
-                                for kk, vv in p.meta.labels.items()}))
-                # re-key selectors to the copy's label namespace so copies
-                # stay independent constraint groups
+                        labels=remap(p.meta.labels)))
                 q.topology_spread = [
-                    dataclasses.replace(c, label_selector={
-                        kk: f"c{k}-{vv}"
-                        for kk, vv in c.label_selector.items()})
+                    dataclasses.replace(c, label_selector=remap(c.label_selector))
                     for c in p.topology_spread]
                 q.pod_affinities = [
-                    dataclasses.replace(t, label_selector={
-                        kk: f"c{k}-{vv}"
-                        for kk, vv in t.label_selector.items()})
+                    dataclasses.replace(t, label_selector=remap(t.label_selector))
                     for t in p.pod_affinities]
                 pods.append(q)
         limits = {pool: (lim * COPIES if lim is not None else None)
