@@ -173,13 +173,28 @@ def run_configs(timeout_s: float):
             try:
                 stdout, stderr = proc.communicate(timeout=timeout_s)
             except subprocess.TimeoutExpired:
+                # TERM first so chip-holding processes run their PJRT
+                # teardown and release the claim (a SIGKILLed holder can
+                # wedge the device behind its remote lease); escalate to
+                # KILL for whatever ignores it
+                import signal as _signal
                 try:
-                    os.killpg(proc.pid, 9)
+                    os.killpg(proc.pid, _signal.SIGTERM)
                 except OSError:
                     pass
-                # drain what the child flushed before dying — partial
-                # output IS the evidence the attempts log exists for
-                stdout, stderr = proc.communicate()
+                try:
+                    # communicate (not wait): keeps draining the pipes, so
+                    # a child flushing >64KiB during teardown can't block
+                    # on write and eat the grace period
+                    stdout, stderr = proc.communicate(timeout=10)
+                except subprocess.TimeoutExpired:
+                    try:
+                        os.killpg(proc.pid, 9)
+                    except OSError:
+                        pass
+                    # drain what the child flushed before dying — partial
+                    # output IS the evidence the attempts log exists for
+                    stdout, stderr = proc.communicate()
                 if stdout:
                     rec["stdout_tail"] = stdout[-300:]
                 if stderr:
@@ -211,14 +226,12 @@ def main() -> None:
     # evict stale chip holders (leftover kt_solverd — the round-1 failure
     # mode) BEFORE the config subprocesses run: they probe with
     # kill_holders=False and would silently degrade to CPU
-    from karpenter_tpu.utils.platform import _other_device_holders
+    from karpenter_tpu.utils.platform import (_other_device_holders,
+                                              terminate_holder)
     for pid, args in _other_device_holders():
-        print(f"[bench] killing stale device holder pid {pid}: {args[:120]}",
+        print(f"[bench] evicting stale device holder pid {pid}: {args[:120]}",
               file=sys.stderr, flush=True)
-        try:
-            os.kill(pid, 9)
-        except OSError:
-            pass
+        terminate_holder(pid)
 
     # configs FIRST: their subprocesses need the chip, which admits one
     # process at a time — after the parent initializes below, a config

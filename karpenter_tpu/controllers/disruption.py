@@ -28,7 +28,9 @@ from typing import Dict, List, Optional, Set
 from karpenter_tpu.cloudprovider import TPUCloudProvider
 from karpenter_tpu.cluster import Cluster
 from karpenter_tpu.controllers.provisioning import create_claim_from_spec
-from karpenter_tpu.controllers.state import GatedSolver, build_schedule_input
+from karpenter_tpu.controllers.state import (GatedSolver,
+                                             build_existing_nodes,
+                                             build_schedule_input)
 from karpenter_tpu.models import wellknown
 from karpenter_tpu.models.objects import (
     CONSOLIDATE_WHEN_EMPTY,
@@ -376,14 +378,15 @@ class Disruption:
 
     # -- simulation -------------------------------------------------------
     def _build_sim_input(self, cands: List[Candidate],
-                         price_cap: Optional[float]) -> ScheduleInput:
+                         price_cap: Optional[float],
+                         prebuilt=None) -> ScheduleInput:
         pods = [p for c in cands for p in c.reschedulable]
         exclude = {c.node.name for c in cands}
         exclude_claims = {c.claim.name for c in cands}
         return build_schedule_input(
             self.cluster, self.cp, pods,
             exclude_nodes=exclude, exclude_claims=exclude_claims,
-            price_cap=price_cap)
+            price_cap=price_cap, prebuilt_existing=prebuilt)
 
     @staticmethod
     def _admissible(result: ScheduleResult) -> Optional[ScheduleResult]:
@@ -408,7 +411,11 @@ class Disruption:
         underlying solve runs per-consumed item, so a caller that acts on
         the first acceptable candidate pays for exactly the simulations it
         looked at (per-simulation metrics recorded in GatedSolver)."""
-        inps = [self._build_sim_input(cs, cap)
+        # one node snapshot shared by every simulation: wrappers are
+        # reused, so the controller-side build is O(nodes + sims) and the
+        # solver's per-batch union cache keys work by object identity
+        prebuilt = build_existing_nodes(self.cluster)
+        inps = [self._build_sim_input(cs, cap, prebuilt=prebuilt)
                 for cs, cap in zip(cand_sets, price_caps)]
         # admissibility allows at most ONE replacement node (_admissible),
         # so a tiny new-node axis is exact: slot exhaustion reports

@@ -140,6 +140,23 @@ def remaining_limit(cluster: Cluster, pool: NodePool,
 
 
 
+def build_existing_nodes(cluster: Cluster) -> List[ExistingNode]:
+    """Snapshot every live node as an ExistingNode. The consolidation
+    sweep builds this ONCE and shares the wrapper objects across its
+    candidate simulations — both to avoid the O(nodes) rebuild per
+    simulation and so the solver's per-batch union cache
+    (SharedExistEncoding) can key work by object identity."""
+    existing: List[ExistingNode] = []
+    for node in cluster.nodes.list(lambda n: not n.meta.deleting):
+        resident = cluster.pods_on_node(node.name)
+        used = Resources()
+        for pod in resident:
+            used += effective_request(pod)
+        existing.append(ExistingNode(
+            node=node, available=node.allocatable - used, pods=resident))
+    return existing
+
+
 def build_schedule_input(
     cluster: Cluster,
     cp: TPUCloudProvider,
@@ -147,6 +164,7 @@ def build_schedule_input(
     exclude_nodes: Set[str] = frozenset(),
     exclude_claims: Set[str] = frozenset(),
     price_cap: Optional[float] = None,
+    prebuilt_existing: Optional[List[ExistingNode]] = None,
 ) -> ScheduleInput:
     pools: List[NodePool] = cluster.nodepools.list(
         lambda np_: not np_.meta.deleting)
@@ -156,16 +174,12 @@ def build_schedule_input(
     instance_types: Dict[str, List[InstanceType]] = {
         p.name: cp.get_instance_types(p.node_class_ref) for p in pools}
 
-    existing: List[ExistingNode] = []
-    for node in cluster.nodes.list(lambda n: not n.meta.deleting):
-        if node.name in exclude_nodes:
-            continue
-        resident = cluster.pods_on_node(node.name)
-        used = Resources()
-        for pod in resident:
-            used += effective_request(pod)
-        existing.append(ExistingNode(
-            node=node, available=node.allocatable - used, pods=resident))
+    if prebuilt_existing is not None:
+        existing = [en for en in prebuilt_existing
+                    if en.name not in exclude_nodes]
+    else:
+        existing = [en for en in build_existing_nodes(cluster)
+                    if en.name not in exclude_nodes]
 
     return ScheduleInput(
         pods=pods,

@@ -214,6 +214,15 @@ class Operator:
 
 def main() -> int:
     import os
+
+    # pin the JAX platform from env BEFORE anything dispatches: without
+    # this, KARPENTER_TPU_PLATFORM/KARPENTER_TPU_FORCE_CPU are silently
+    # ignored (the site bootstrap pins jax_platforms via jax.config,
+    # which beats env vars) and the first solve initializes whatever
+    # backend the site chose — hanging boot if the device is wedged
+    from karpenter_tpu.utils.platform import configure
+    configure()
+
     op = Operator(
         metrics_port=int(os.environ.get("KARPENTER_TPU_METRICS_PORT", 8000)),
         health_port=int(os.environ.get("KARPENTER_TPU_HEALTH_PORT", 8081)))

@@ -318,6 +318,130 @@ def _matches(sel: Dict[str, str], labels: Dict[str, str]) -> bool:
     return all(labels.get(k) == v for k, v in sel.items())
 
 
+class SharedExistEncoding:
+    """Union cache of existing-node encodings for ONE solve_batch call.
+
+    The consolidation sweep (SURVEY §3.3 hot loop #2) encodes ~N
+    near-identical node sets N times — at 2k candidates × 2k nodes the
+    per-simulation label interning and per-node Python checks dominate
+    the whole sweep (profiled: ~85% of wall-clock). Everything determined
+    by the Node object alone — label matrices, readiness, zone/ct ids,
+    per-group requirement+toleration verdicts — is computed once over
+    the union of nodes and gathered per simulation by row index.
+
+    Sound only within one batch: TPUSolver.solve_batch's contract is
+    that all inputs come from the same cluster snapshot, so a node
+    object's labels/taints/readiness — and its resident-pod set, which
+    the required-anti activity check reads — are fixed for the batch.
+    """
+
+    def __init__(self, cat: "CatalogEncoding"):
+        self._index: Dict[int, int] = {}
+        # strong refs: id() keys stay unambiguous while the cache lives
+        self._nodes: List = []
+        self._wrappers: List[ExistingNode] = []
+        self._res_anti: List[bool] = []
+        self.zone_ids = dict(cat.zone_ids)
+        self.ct_ids = dict(cat.ct_ids)
+        self._frozen = False
+
+    def add_input(self, inp: ScheduleInput) -> None:
+        assert not self._frozen
+        for en in inp.existing_nodes:
+            node = en.node
+            if id(node) in self._index:
+                continue
+            self._index[id(node)] = len(self._nodes)
+            self._nodes.append(node)
+            self._wrappers.append(en)
+            self._res_anti.append(any(
+                t.required and t.anti
+                for p in en.pods for t in p.pod_affinities))
+
+    def freeze(self) -> None:
+        if self._frozen:
+            return
+        self._frozen = True
+        nodes = self._nodes
+        self.vocab = _Vocab()
+        keys = sorted({k for n in nodes for k in n.labels})
+        self.matrices = _label_matrix(
+            self.vocab, keys, [n.labels for n in nodes])
+        self.usable = np.array(
+            [not n.meta.deleting and n.ready for n in nodes], dtype=bool)
+        for n in nodes:
+            z = n.labels.get(wellknown.ZONE_LABEL)
+            if z is not None:
+                self.zone_ids.setdefault(z, len(self.zone_ids))
+            t = n.labels.get(wellknown.CAPACITY_TYPE_LABEL)
+            if t is not None:
+                self.ct_ids.setdefault(t, len(self.ct_ids))
+        self.zone = np.array(
+            [self.zone_ids.get(n.labels.get(wellknown.ZONE_LABEL), -1)
+             for n in nodes], dtype=np.int32)
+        self.ct = np.array(
+            [self.ct_ids.get(n.labels.get(wellknown.CAPACITY_TYPE_LABEL), -1)
+             for n in nodes], dtype=np.int32)
+        self.res_anti = np.array(self._res_anti, dtype=bool)
+        # nodes with taints are rare; only they need the per-group loop
+        self._tainted = [i for i, n in enumerate(nodes) if n.taints]
+        self._group_ok: Dict[int, np.ndarray] = {}
+        # available-capacity rows keyed by the WRAPPER seen at add time:
+        # sims that share ExistingNode objects (the sweep's common case)
+        # skip the 2k-row nested-list conversion; a sim carrying a fresh
+        # wrapper for a known node gets its row rebuilt from its own
+        # values, so a differing snapshot can never be silently shadowed
+        self._avail = np.array([en.available.v for en in self._wrappers],
+                               dtype=np.float32).reshape(len(nodes), R)
+        self._wrapper_id = [id(en) for en in self._wrappers]
+
+    def exist_remaining(self, existing: Sequence[ExistingNode],
+                        rows: np.ndarray) -> np.ndarray:
+        out = self._avail[rows]
+        wid = self._wrapper_id
+        for j, en in enumerate(existing):
+            if id(en) != wid[rows[j]]:
+                out[j] = en.available.v
+        return out
+
+    def res_anti_any(self, existing: Sequence[ExistingNode],
+                     rows: np.ndarray) -> bool:
+        """Whether any resident pod carries required anti-affinity — with
+        the same wrapper-divergence guard as exist_remaining: a sim whose
+        fresh wrapper carries a different resident set than the snapshot
+        must be judged on ITS pods, not the cached flag."""
+        wid = self._wrapper_id
+        for j, en in enumerate(existing):
+            if id(en) == wid[rows[j]]:
+                if self.res_anti[rows[j]]:
+                    return True
+            elif any(t.required and t.anti
+                     for p in en.pods for t in p.pod_affinities):
+                return True
+        return False
+
+    def rows(self, existing: Sequence[ExistingNode]) -> np.ndarray:
+        """Union row index per ExistingNode (identity-keyed on .node)."""
+        return np.fromiter((self._index[id(en.node)] for en in existing),
+                           dtype=np.int64, count=len(existing))
+
+    def group_ok(self, rep: Pod) -> np.ndarray:
+        """Usable ∧ requirements-matched ∧ taints-tolerated over the
+        union, cached per pod equivalence class."""
+        gid = rep.scheduling_group_id()
+        ok = self._group_ok.get(gid)
+        if ok is None:
+            ok = _eval_requirements(rep.requirements, self.vocab,
+                                    self.matrices, len(self._nodes))
+            ok = ok & self.usable
+            for i in self._tainted:
+                if ok[i] and not tolerates_all(self._nodes[i].taints,
+                                               rep.tolerations):
+                    ok[i] = False
+            self._group_ok[gid] = ok
+        return ok
+
+
 class _TopologyEncoder:
     """Classifies each group's spread / (anti-)affinity constraints and
     produces the kernel's topology tensors; raises `Unsupported` for shapes
@@ -329,7 +453,9 @@ class _TopologyEncoder:
     """
 
     def __init__(self, inp: ScheduleInput, cat: "CatalogEncoding",
-                 groups: List[List[Pod]], split_mode: bool = False):
+                 groups: List[List[Pod]], split_mode: bool = False,
+                 shared: Optional[SharedExistEncoding] = None,
+                 shared_rows: Optional[np.ndarray] = None):
         # split mode: groups that raise Unsupported become host-side
         # residue solved AFTER the device solve, so the victim-side
         # coupling check (another pending group's anti matching this one)
@@ -343,11 +469,16 @@ class _TopologyEncoder:
         # carries required anti-affinity (the only way existing state can
         # constrain unconstrained pods). This keeps consolidation's batched
         # per-candidate encodes O(pods), not O(cluster).
-        self.active = (
-            any(g[0].topology_spread or g[0].pod_affinities for g in groups)
-            or any(t.required and t.anti
-                   for en in inp.existing_nodes for p in en.pods
-                   for t in p.pod_affinities))
+        has_constraints = any(
+            g[0].topology_spread or g[0].pod_affinities for g in groups)
+        if shared is not None:
+            self.active = has_constraints or shared.res_anti_any(
+                inp.existing_nodes, shared_rows)
+        else:
+            self.active = has_constraints or any(
+                t.required and t.anti
+                for en in inp.existing_nodes for p in en.pods
+                for t in p.pod_affinities)
         self.tracker = TopologyTracker()
         if self.active:
             for en in inp.existing_nodes:
@@ -362,23 +493,31 @@ class _TopologyEncoder:
                 wellknown.CAPACITY_TYPE_LABEL,
                 {c.capacity_type for c in cat.columns})
         # domain vocab: catalog ids first (stable across calls), existing-node
-        # domains appended per call
-        self.zone_ids = dict(cat.zone_ids)
-        self.ct_ids = dict(cat.ct_ids)
-        for en in inp.existing_nodes:
-            z = en.node.labels.get(wellknown.ZONE_LABEL)
-            if z is not None:
-                self.zone_ids.setdefault(z, len(self.zone_ids))
-            t = en.node.labels.get(wellknown.CAPACITY_TYPE_LABEL)
-            if t is not None:
-                self.ct_ids.setdefault(t, len(self.ct_ids))
+        # domains appended per call (union-wide when a batch cache is shared,
+        # so every simulation in the batch agrees on D and the jit cache
+        # sees one bucketed domain shape)
         self.existing = inp.existing_nodes
-        self.exist_zone = np.array(
-            [self.zone_ids.get(en.node.labels.get(wellknown.ZONE_LABEL), -1)
-             for en in self.existing], dtype=np.int32).reshape(len(self.existing))
-        self.exist_ct = np.array(
-            [self.ct_ids.get(en.node.labels.get(wellknown.CAPACITY_TYPE_LABEL), -1)
-             for en in self.existing], dtype=np.int32).reshape(len(self.existing))
+        if shared is not None:
+            self.zone_ids = shared.zone_ids
+            self.ct_ids = shared.ct_ids
+            self.exist_zone = shared.zone[shared_rows]
+            self.exist_ct = shared.ct[shared_rows]
+        else:
+            self.zone_ids = dict(cat.zone_ids)
+            self.ct_ids = dict(cat.ct_ids)
+            for en in inp.existing_nodes:
+                z = en.node.labels.get(wellknown.ZONE_LABEL)
+                if z is not None:
+                    self.zone_ids.setdefault(z, len(self.zone_ids))
+                t = en.node.labels.get(wellknown.CAPACITY_TYPE_LABEL)
+                if t is not None:
+                    self.ct_ids.setdefault(t, len(self.ct_ids))
+            self.exist_zone = np.array(
+                [self.zone_ids.get(en.node.labels.get(wellknown.ZONE_LABEL), -1)
+                 for en in self.existing], dtype=np.int32).reshape(len(self.existing))
+            self.exist_ct = np.array(
+                [self.ct_ids.get(en.node.labels.get(wellknown.CAPACITY_TYPE_LABEL), -1)
+                 for en in self.existing], dtype=np.int32).reshape(len(self.existing))
         self.group_labels = [g[0].meta.labels for g in groups]
         self.D = max(len(self.zone_ids), len(self.ct_ids), 1)
         self._sel_cache: Dict[tuple, set] = {}
@@ -561,13 +700,16 @@ class _TopologyEncoder:
 
 
 def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None,
-           split: bool = False) -> EncodedProblem:
+           split: bool = False,
+           exist_shared: Optional[SharedExistEncoding] = None) -> EncodedProblem:
     """split=False: raise Unsupported on the first inexpressible group
     (caller falls back wholesale).  split=True: collect inexpressible
     groups into `.residue` and encode the rest — the solver runs the
     device kernel on the supported majority and hands only the residue to
     the host oracle (VERDICT r1 #4: a 50k-pod problem with one affinity
-    pod must not abandon the device)."""
+    pod must not abandon the device).  exist_shared: a frozen per-batch
+    union cache of existing-node encodings (consolidation sweep — the
+    per-simulation node work collapses to row gathers)."""
     cat = cat or encode_catalog(inp)
     if any(en.charge_pool is not None for en in inp.existing_nodes):
         # synthetic claim-nodes (split/rescue augment outputs) charge the
@@ -586,15 +728,19 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None,
     E = len(inp.existing_nodes)
     G = len(groups)
 
-    topo = _TopologyEncoder(inp, cat, groups, split_mode=split)
+    shared_rows = (exist_shared.rows(inp.existing_nodes)
+                   if exist_shared is not None else None)
+    topo = _TopologyEncoder(inp, cat, groups, split_mode=split,
+                            shared=exist_shared, shared_rows=shared_rows)
     D = topo.D
 
-    # existing-node labels (hostnames are per-node-unique) go into a
-    # per-call vocab so node churn can't grow the cached catalog vocab
-    exist_vocab = _Vocab()
-    exist_keys = sorted({k for en in inp.existing_nodes for k in en.node.labels})
-    exist_matrices = _label_matrix(
-        exist_vocab, exist_keys, [en.node.labels for en in inp.existing_nodes])
+    if exist_shared is None:
+        # existing-node labels (hostnames are per-node-unique) go into a
+        # per-call vocab so node churn can't grow the cached catalog vocab
+        exist_vocab = _Vocab()
+        exist_keys = sorted({k for en in inp.existing_nodes for k in en.node.labels})
+        exist_matrices = _label_matrix(
+            exist_vocab, exist_keys, [en.node.labels for en in inp.existing_nodes])
 
     group_req = np.zeros((G, R), dtype=np.float32)
     group_count = np.zeros(G, dtype=np.int32)
@@ -683,16 +829,20 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None,
         merged_reqs.append(merged_per_pool)
 
         if E:
-            ok = _eval_requirements(rep.requirements, exist_vocab,
-                                    exist_matrices, E)
-            for ei, en in enumerate(inp.existing_nodes):
-                if not ok[ei]:
-                    continue
-                node = en.node
-                if node.meta.deleting or not node.ready:
-                    ok[ei] = False
-                elif not tolerates_all(node.taints, rep.tolerations):
-                    ok[ei] = False
+            if exist_shared is not None:
+                # union verdict cached per pod class; usable+taints folded in
+                ok = exist_shared.group_ok(rep)[shared_rows]
+            else:
+                ok = _eval_requirements(rep.requirements, exist_vocab,
+                                        exist_matrices, E)
+                for ei, en in enumerate(inp.existing_nodes):
+                    if not ok[ei]:
+                        continue
+                    node = en.node
+                    if node.meta.deleting or not node.ready:
+                        ok[ei] = False
+                    elif not tolerates_all(node.taints, rep.tolerations):
+                        ok[ei] = False
             cap_row = np.where(ok, t["ecap"], 0).astype(np.int32)
             # static topology domain restrictions → per-node allowance
             for key, (_, ex_ids) in dom_arrays.items():
@@ -721,9 +871,13 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None,
         groups = [g for gi, g in enumerate(groups) if keep[gi]]
         # static_allowed / merged_reqs were only appended for kept groups
 
-    exist_remaining = np.array(
-        [en.available.v for en in inp.existing_nodes], dtype=np.float32
-    ).reshape(E, R)
+    if exist_shared is not None:
+        exist_remaining = exist_shared.exist_remaining(
+            inp.existing_nodes, shared_rows)
+    else:
+        exist_remaining = np.array(
+            [en.available.v for en in inp.existing_nodes], dtype=np.float32
+        ).reshape(E, R)
 
     pool_limit = np.full((max(len(pools), 1), R), np.inf, dtype=np.float32)
     for pidx, pool in enumerate(pools):

@@ -51,7 +51,10 @@ import jax
 import jax.numpy as jnp
 
 EPS = 1e-3
-BIG = jnp.int32(2 ** 29)
+# NOTE: no module-level jnp constants here — materializing a device array
+# at import time eagerly initializes whatever backend the site default
+# points at; importing the solver must never touch a device. The BIG
+# sentinel lives in encode.py (the sole definition).
 
 
 def _fit_count(avail: jnp.ndarray, req: jnp.ndarray) -> jnp.ndarray:

@@ -27,6 +27,7 @@ from karpenter_tpu.solver.encode import (
     BIG,
     D_BUCKETS,
     EncodedProblem,
+    SharedExistEncoding,
     Unsupported,
     bucket,
     encode,
@@ -35,7 +36,7 @@ from karpenter_tpu.solver.encode import (
 R = len(RESOURCE_AXIS)
 
 G_BUCKETS = (8, 32, 128, 512, 2048)
-E_BUCKETS = (0, 64, 512, 4096)
+E_BUCKETS = (0, 64, 512, 2048, 4096)
 B_BUCKETS = (4, 16, 64)  # simulate-batch axis (SURVEY §7 step 6)
 O_ALIGN = 512
 
@@ -177,9 +178,10 @@ class TPUSolver:
         widths[axis] = (0, pad)
         return np.pad(arr, widths, constant_values=value)
 
-    def _encode_checked(self, inp: ScheduleInput, cat) -> EncodedProblem:
+    def _encode_checked(self, inp: ScheduleInput, cat,
+                        exist_shared=None) -> EncodedProblem:
         try:
-            enc = encode(inp, cat)
+            enc = encode(inp, cat, exist_shared=exist_shared)
         except Unsupported as e:
             raise UnsupportedPods(str(e)) from e
         if inp.price_cap is not None:
@@ -693,11 +695,30 @@ class TPUSolver:
         # batch — one affinity-heavy candidate in a 64-sim chunk must not
         # de-batch the other 63 (the de-batching pattern the batch axis
         # exists to kill)
+        # per-batch union cache of existing-node encodings: the candidate
+        # sweep's simulations share one cluster snapshot's node OBJECTS,
+        # so node-keyed work (label interning, per-node checks, per-class
+        # verdicts) is done once over the union instead of once per
+        # simulation. Identity keying is deliberate: the solverd daemon
+        # fuses independently-unpickled requests (possibly from different
+        # clients/snapshots) into one batch, where no objects are shared
+        # and name-keyed trust would be unsound — there the union would
+        # just balloon to ~Σ|nodes|, so when sharing doesn't materialize
+        # we drop the cache and keep the classic per-sim encode
+        shared = SharedExistEncoding(cat)
+        for inp in inps:
+            shared.add_input(inp)
+        max_e = max((len(inp.existing_nodes) for inp in inps), default=0)
+        if max_e == 0 or len(shared._nodes) > 2 * max_e:
+            shared = None
+        else:
+            shared.freeze()
         encs: List = []          # (orig_index, EncodedProblem)
         singles: List[int] = []  # orig indices needing individual solves
         for i, inp in enumerate(inps):
             try:
-                encs.append((i, self._encode_checked(inp, cat)))
+                encs.append((i, self._encode_checked(
+                    inp, cat, exist_shared=shared)))
             except UnsupportedPods:
                 singles.append(i)
         if len(cat.columns) == 0:

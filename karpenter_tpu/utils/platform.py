@@ -25,6 +25,7 @@ diagnostic (/root/reference/pkg/operator/operator.go:209-218).
 from __future__ import annotations
 
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -147,6 +148,32 @@ def _probe_subprocess(platform: Optional[str], timeout_s: float,
     return False
 
 
+def terminate_holder(pid: int, grace_s: float = 10.0, log=None) -> None:
+    """Evict a chip-holding process GRACEFULLY: SIGTERM, wait for exit,
+    SIGKILL only as the last resort. A SIGKILLed holder never runs its
+    PJRT teardown, and the remote pool can then keep the dead client's
+    claim until its lease times out — wedging the device for every later
+    process far longer than the grace period spent here."""
+    log = log or (lambda m: print(m, file=sys.stderr, flush=True))
+    try:
+        os.kill(pid, signal.SIGTERM)
+    except OSError:
+        return
+    deadline = time.time() + grace_s
+    while time.time() < deadline:
+        try:
+            os.kill(pid, 0)
+        except OSError:
+            return  # exited cleanly
+        time.sleep(0.25)
+    try:
+        os.kill(pid, signal.SIGKILL)
+        log(f"[platform] pid {pid} ignored SIGTERM for {grace_s:.0f}s; "
+            "SIGKILLed (device lease may linger)")
+    except OSError:
+        pass
+
+
 def initialize(platform: Optional[str] = None, retries: int = 3,
                backoff_s: float = 5.0, probe_timeout_s: Optional[float] = None,
                cpu_fallback: bool = True, kill_holders: bool = False,
@@ -180,11 +207,8 @@ def initialize(platform: Optional[str] = None, retries: int = 3,
         for pid, args in _other_device_holders():
             log(f"[platform] possible device holder: pid {pid}: {args[:120]}")
             if kill_holders:
-                try:
-                    os.kill(pid, 9)
-                    log(f"[platform] killed pid {pid}")
-                except OSError:
-                    pass
+                terminate_holder(pid, log=log)
+                log(f"[platform] evicted pid {pid}")
         if attempt + 1 < retries:
             time.sleep(backoff_s * (attempt + 1))
 

@@ -112,16 +112,31 @@ class TestModuleEntryPoint:
             [sys.executable, "-m", "karpenter_tpu"], env=env, cwd=REPO,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
         try:
-            # the entry point prints the bound ports once serving
-            line = ""
+            # the entry point prints the bound ports once serving.
+            # raw fd reads behind select: a bare readline() blocks forever
+            # if the child hangs before printing (the deadline would never
+            # fire and the whole suite stalls behind this test), and
+            # select on the TextIOWrapper misses lines the wrapper already
+            # buffered — so read bytes straight off the fd
+            import select
+            fd = proc.stdout.fileno()
+            buf = b""
             deadline = time.monotonic() + 60
             while time.monotonic() < deadline:
-                line = proc.stdout.readline()
-                if "metrics=" in line:
+                if b"metrics=" in buf:
                     break
-                assert proc.poll() is None, "operator process died at boot"
+                readable, _, _ = select.select([fd], [], [], 1.0)
+                if not readable:
+                    assert proc.poll() is None, "operator died at boot"
+                    continue
+                chunk = os.read(fd, 4096)
+                assert chunk or proc.poll() is None, \
+                    "operator process died at boot"
+                buf += chunk
             else:
-                pytest.fail(f"no serving banner; last line: {line!r}")
+                pytest.fail(f"no serving banner; output: {buf[-300:]!r}")
+            line = next(ln for ln in buf.decode(errors="replace").splitlines()
+                        if "metrics=" in ln)
             health = int(line.split("health=:")[1].split()[0])
             status, body = get(health, "/healthz", timeout=10)
             assert status == 200 and body == "ok\n"

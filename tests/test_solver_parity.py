@@ -396,6 +396,58 @@ class TestSolveBatch:
         # oracle agrees on the capped case
         assert Scheduler(capped).solve().unschedulable
 
+    def test_batch_shared_exist_cache_matches_sequential(self):
+        """The candidate-sweep shape: many sims sharing one cluster's node
+        OBJECTS (the SharedExistEncoding fast path), with the node states
+        the union cache folds into its verdicts — tainted, not-ready,
+        deleting, and label-restricted nodes, plus tolerating and
+        selecting pods. Batch results must be identical to per-input
+        solve() (which takes the uncached path)."""
+        from karpenter_tpu.models import Node, Taint, Toleration
+        shared = list(CATALOG)
+        pool = NodePool(meta=ObjectMeta(name="default"))
+        mk = lambda i, **kw: Node(
+            meta=ObjectMeta(name=f"n{i}", labels={
+                wellknown.ZONE_LABEL: ["tpu-west-1a", "tpu-west-1b"][i % 2],
+                wellknown.NODEPOOL_LABEL: "default",
+                wellknown.ARCH_LABEL: "amd64",
+                wellknown.OS_LABEL: "linux",
+                wellknown.HOSTNAME_LABEL: f"n{i}",
+                **kw.pop("labels", {})}),
+            allocatable=Resources.of(cpu=8000, memory=16384, pods=29),
+            ready=kw.pop("ready", True), **kw)
+        nodes = [
+            mk(0),
+            mk(1, taints=[Taint(key="dedicated", value="x")]),
+            mk(2, ready=False),
+            mk(3, labels={"disk": "ssd"}),
+            mk(4),
+        ]
+        nodes[4].meta.deletion_time = 1.0  # deleting: excluded by both paths
+        ens = [ExistingNode(node=n, available=n.allocatable.copy())
+               for n in nodes]
+        inps = []
+        for i in range(len(ens)):  # exclude one node per sim, sweep-style
+            rest = ens[:i] + ens[i + 1:]
+            pods = [mkpod(f"c{i}-p0", cpu="1"),
+                    mkpod(f"c{i}-p1", cpu="500m",
+                          tolerations=[Toleration(key="dedicated",
+                                                  value="x")])]
+            pods[0].requirements = Requirements(
+                Requirement.make("disk", "In", "ssd"))
+            inps.append(ScheduleInput(
+                pods=pods, nodepools=[pool],
+                instance_types={"default": shared},
+                existing_nodes=rest))
+        solver = TPUSolver()
+        batched = solver.solve_batch(inps)
+        for inp, res in zip(inps, batched):
+            single = TPUSolver().solve(inp)
+            assert dict(res.existing_assignments) == dict(
+                single.existing_assignments)
+            assert set(res.unschedulable) == set(single.unschedulable)
+            assert res.node_count() == single.node_count()
+
     def test_batch_empty_and_topology(self):
         from karpenter_tpu.models import TopologySpreadConstraint
         pool = NodePool(meta=ObjectMeta(name="default"))
