@@ -140,14 +140,20 @@ def remaining_limit(cluster: Cluster, pool: NodePool,
 
 
 
-def build_existing_nodes(cluster: Cluster) -> List[ExistingNode]:
+def build_existing_nodes(
+        cluster: Cluster,
+        exclude_nodes: Set[str] = frozenset()) -> List[ExistingNode]:
     """Snapshot every live node as an ExistingNode. The consolidation
-    sweep builds this ONCE and shares the wrapper objects across its
-    candidate simulations — both to avoid the O(nodes) rebuild per
-    simulation and so the solver's per-batch union cache
-    (SharedExistEncoding) can key work by object identity."""
+    sweep builds this ONCE (no exclusions) and shares the wrapper objects
+    across its candidate simulations — both to avoid the O(nodes) rebuild
+    per simulation and so the solver's per-batch union cache
+    (SharedExistEncoding) can key work by object identity. `exclude_nodes`
+    skips candidates BEFORE the resident-pod walk so single-simulation
+    callers don't pay for wrappers they immediately discard."""
     existing: List[ExistingNode] = []
     for node in cluster.nodes.list(lambda n: not n.meta.deleting):
+        if node.name in exclude_nodes:
+            continue
         resident = cluster.pods_on_node(node.name)
         used = Resources()
         for pod in resident:
@@ -178,8 +184,7 @@ def build_schedule_input(
         existing = [en for en in prebuilt_existing
                     if en.name not in exclude_nodes]
     else:
-        existing = [en for en in build_existing_nodes(cluster)
-                    if en.name not in exclude_nodes]
+        existing = build_existing_nodes(cluster, exclude_nodes)
 
     return ScheduleInput(
         pods=pods,

@@ -318,6 +318,16 @@ def _matches(sel: Dict[str, str], labels: Dict[str, str]) -> bool:
     return all(labels.get(k) == v for k, v in sel.items())
 
 
+def _has_required_anti(pods) -> bool:
+    """Whether any resident pod's required anti-affinity can constrain
+    pending pods (the only way existing state constrains otherwise-
+    unconstrained pods — the k8s symmetry rule). ONE definition shared by
+    the union cache, its divergent-wrapper fallback, and the per-sim
+    topology encoder: these must never disagree."""
+    return any(t.required and t.anti
+               for p in pods for t in p.pod_affinities)
+
+
 class SharedExistEncoding:
     """Union cache of existing-node encodings for ONE solve_batch call.
 
@@ -354,9 +364,7 @@ class SharedExistEncoding:
             self._index[id(node)] = len(self._nodes)
             self._nodes.append(node)
             self._wrappers.append(en)
-            self._res_anti.append(any(
-                t.required and t.anti
-                for p in en.pods for t in p.pod_affinities))
+            self._res_anti.append(_has_required_anti(en.pods))
 
     def freeze(self) -> None:
         if self._frozen:
@@ -415,8 +423,7 @@ class SharedExistEncoding:
             if id(en) == wid[rows[j]]:
                 if self.res_anti[rows[j]]:
                     return True
-            elif any(t.required and t.anti
-                     for p in en.pods for t in p.pod_affinities):
+            elif _has_required_anti(en.pods):
                 return True
         return False
 
@@ -476,9 +483,7 @@ class _TopologyEncoder:
                 inp.existing_nodes, shared_rows)
         else:
             self.active = has_constraints or any(
-                t.required and t.anti
-                for en in inp.existing_nodes for p in en.pods
-                for t in p.pod_affinities)
+                _has_required_anti(en.pods) for en in inp.existing_nodes)
         self.tracker = TopologyTracker()
         if self.active:
             for en in inp.existing_nodes:

@@ -123,7 +123,12 @@ class TestModuleEntryPoint:
             buf = b""
             deadline = time.monotonic() + 60
             while time.monotonic() < deadline:
-                if b"metrics=" in buf:
+                # only a COMPLETE banner line counts: os.read can split
+                # the line across chunks, and parsing a partial one would
+                # crash instead of reaching the diagnostics below
+                i = buf.find(b"metrics=")
+                if i >= 0 and b"health=:" in buf[i:] \
+                        and b"\n" in buf[i:]:
                     break
                 readable, _, _ = select.select([fd], [], [], 1.0)
                 if not readable:
