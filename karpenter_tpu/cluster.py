@@ -170,12 +170,18 @@ class Cluster:
     def record_event(self, kind: str, obj_name: str, reason: str,
                      message: str = "") -> None:
         """Deduplicated event recorder (reference: sigs.k8s.io/karpenter
-        pkg/events)."""
-        recent = [(k, o, r) for _, k, o, r, _ in self.events[-50:]]
+        pkg/events; k8s events carry a TTL — here the list is bounded so a
+        long-running operator emitting per-candidate reasons every
+        reconcile pass can't grow it without limit). The dedup window
+        covers more candidates than the largest supported consolidation
+        sweep so per-pass repeats collapse."""
+        recent = [(k, o, r) for _, k, o, r, _ in self.events[-512:]]
         if (kind, obj_name, reason) in recent:
             return
         self.events.append(
             (self.clock.now(), kind, obj_name, reason, message))
+        if len(self.events) > 5000:
+            del self.events[:2500]
 
     # -- convenience views ------------------------------------------------
     def pending_pods(self) -> List[Pod]:

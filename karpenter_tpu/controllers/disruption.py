@@ -374,6 +374,13 @@ class Disruption:
                 if sim is not None and self._acceptable([cand], sim):
                     self._execute(REASON_UNDERUTILIZED, [cand], sim)
                     return True
+                # user-facing reason a node stays up (disruption.md:109-117
+                # Unconsolidatable events; the recorder deduplicates)
+                self.cluster.record_event(
+                    "NodeClaim", cand.claim.name, "Unconsolidatable",
+                    "pods cannot reschedule onto remaining capacity or a "
+                    "single cheaper node" if sim is None
+                    else self._unacceptable_reason([cand], sim))
         return False
 
     # -- simulation -------------------------------------------------------
@@ -426,12 +433,20 @@ class Disruption:
 
     def _acceptable(self, cands: List[Candidate],
                     sim: ScheduleResult) -> bool:
+        return self._unacceptable_reason(cands, sim) is None
+
+    def _unacceptable_reason(self, cands: List[Candidate],
+                             sim: ScheduleResult) -> Optional[str]:
+        """None = acceptable; else the user-facing reason (the accurate
+        message matters: pointing an operator at pricing when the
+        spot-flexibility rule is what blocked the replacement sends the
+        debugging in the wrong direction)."""
         if not sim.new_claims:
-            return True  # pure delete: always saves money
+            return None  # pure delete: always saves money
         total_price = sum(c.price for c in cands)
         rep = sim.new_claims[0]
         if rep.price >= total_price:
-            return False
+            return "replacement would not reduce cost"
         # spot→spot: replacement must keep ≥15 types of flexibility so it
         # lands on reliable spot capacity (disruption.md:123-132)
         all_spot = all(
@@ -442,10 +457,14 @@ class Disruption:
         rep_spot = rep_spot or (rep_ct is None)
         if all_spot and rep_spot:
             if not self.options.feature_gates.spot_to_spot_consolidation:
-                return False
+                return ("spot-to-spot consolidation is disabled "
+                        "(SpotToSpotConsolidation feature gate)")
             if len(rep.instance_type_names) < SPOT_TO_SPOT_MIN_TYPES:
-                return False
-        return True
+                return (f"spot-to-spot replacement keeps only "
+                        f"{len(rep.instance_type_names)} instance types of "
+                        f"the {SPOT_TO_SPOT_MIN_TYPES} required for "
+                        f"reliable spot capacity")
+        return None
 
     # -- execution --------------------------------------------------------
     def _execute(self, reason: str, cands: List[Candidate],

@@ -186,6 +186,13 @@ class SolverServiceClient:
         return cached[0], cached[1]
 
     def _ensure_catalog(self, fp: str, payload: bytes) -> None:
+        # connect FIRST: the upload ledger is per-connection state (a
+        # reconnect clears it), so consulting it before the connection is
+        # established reads a stale ledger from the previous daemon and
+        # skips an upload the fresh daemon never saw — the need_catalog
+        # retry in solve_batch then remains as the backstop for the
+        # check-then-die race, not the primary path
+        self._ensure_connected()
         if fp in self._uploaded:
             return
         body = pickle.loads(payload)
@@ -213,7 +220,8 @@ class SolverServiceClient:
         return self.solve_batch([inp], max_nodes=max_nodes)[0]
 
     def solve_batch(self, inps: List[ScheduleInput],
-                    max_nodes: Optional[int] = None) -> List[ScheduleResult]:
+                    max_nodes: Optional[int] = None,
+                    _retry: bool = True) -> List[ScheduleResult]:
         """`max_nodes` rides the schedule request so the disruption
         simulator's tiny-kernel cap survives the solverd deployment — the
         shared-TPU shape the cap matters most for."""
@@ -235,14 +243,15 @@ class SolverServiceClient:
                 "max_nodes": max_nodes,
             }))
         out: List[ScheduleResult] = []
+        lost_catalog = False
         try:
             for rid in rids:
                 kind, body = self._wait(rid)
                 if kind == "result":
                     out.append(body)
                 elif kind == "need_catalog":
-                    raise SolverServiceError(
-                        "service lost the catalog (restarted?); reconnect")
+                    lost_catalog = True
+                    break
                 else:
                     raise SolverServiceError(f"solver service error: {body}")
         finally:
@@ -253,4 +262,16 @@ class SolverServiceClient:
                     for rid in rids[len(out):]:
                         self._pending.pop(rid, None)
                         self._responses.pop(rid, None)
+        if lost_catalog:
+            # the daemon restarted empty: the upload ledger is stale — a
+            # raise alone would leave it stale FOREVER (every later call
+            # skips the upload, gets need_catalog again, and the control
+            # plane stays demoted to the oracle). Invalidate and replay
+            # once; schedule requests are stateless, so re-solving the
+            # already-answered inputs is harmless.
+            self._uploaded.clear()
+            if not _retry:
+                raise SolverServiceError(
+                    "service lost the catalog again after re-upload")
+            return self.solve_batch(inps, max_nodes=max_nodes, _retry=False)
         return out

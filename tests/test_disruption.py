@@ -92,6 +92,18 @@ class TestConsolidation:
         names = {p.node_name for p in pods}
         assert len(names) == 1
 
+    def test_unconsolidatable_event(self, env):
+        """A node that can't consolidate gets a user-facing reason
+        (reference: Unconsolidatable events, disruption.md:109-117)."""
+        env.cluster.pods.create(mkpod("p", cpu="500m"))
+        env.settle()
+        assert len(env.cluster.nodeclaims.list()) == 1
+        env.settle()  # consolidation pass: replacement can't be cheaper
+        reasons = {r for _, _, _, r, _ in env.cluster.events}
+        assert "Unconsolidatable" in reasons
+        # and the node is untouched
+        assert len(env.cluster.nodeclaims.list()) == 1
+
     def test_do_not_disrupt_blocks(self, env):
         self._two_underutilized_nodes(env)
         for p in env.cluster.pods.list():

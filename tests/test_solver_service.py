@@ -40,14 +40,16 @@ def mkinp(tag, n=20, cpu="500m"):
                          instance_types={"default": CATALOG})
 
 
-@pytest.fixture(scope="module")
-def daemon(tmp_path_factory):
+def build_daemon():
     try:
         subprocess.run(["make", "-s", "solverd"], cwd=NATIVE, timeout=180,
                        check=True, capture_output=True)
     except Exception as e:  # noqa: BLE001
         pytest.skip(f"native toolchain unavailable: {e}")
-    sock = str(tmp_path_factory.mktemp("svc") / "kt.sock")
+
+
+def spawn_daemon(sock: str):
+    """Start kt_solverd on `sock`; returns (proc, dump_fn)."""
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["KARPENTER_TPU_FORCE_CPU"] = "1"  # never grab the real chip in tests
@@ -60,14 +62,17 @@ def daemon(tmp_path_factory):
     # daemon's first-solve XLA compile in seconds, not minutes, on CPU
     env["KARPENTER_TPU_MAX_NODES"] = "128"
     env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(REPO, ".jax_cache")
+    if os.path.exists(sock):
+        os.unlink(sock)  # a dead daemon's socket file blocks rebinding
     stderr_path = sock + ".stderr"
-    stderr_f = open(stderr_path, "wb")
-    proc = subprocess.Popen(
-        [DAEMON, "--socket", sock, "--idle-ms", "20", "--max-ms", "200"],
-        env=env, stderr=stderr_f)
+    with open(stderr_path, "ab") as stderr_f:
+        proc = subprocess.Popen(
+            [DAEMON, "--socket", sock, "--idle-ms", "20", "--max-ms", "200"],
+            env=env, stderr=stderr_f)
+    # Popen dup'd the fd into the child; the parent copy is closed, so
+    # repeated spawns (restart tests) can't leak descriptors
 
     def dump():
-        stderr_f.flush()
         with open(stderr_path, "rb") as f:
             return f.read().decode(errors="replace")[-4000:]
 
@@ -77,6 +82,14 @@ def daemon(tmp_path_factory):
         if proc.poll() is not None:
             pytest.fail(f"daemon died: {dump()}")
         time.sleep(0.1)
+    return proc, dump
+
+
+@pytest.fixture(scope="module")
+def daemon(tmp_path_factory):
+    build_daemon()
+    sock = str(tmp_path_factory.mktemp("svc") / "kt.sock")
+    proc, dump = spawn_daemon(sock)
     yield sock
     proc.terminate()
     try:
@@ -85,9 +98,7 @@ def daemon(tmp_path_factory):
         proc.kill()
     # surfaced by pytest on teardown so a hung/failed run shows the
     # daemon's own diagnostics instead of a bare client timeout
-    out = dump()
-    stderr_f.close()
-    print(f"--- kt_solverd stderr ---\n{out}")
+    print(f"--- kt_solverd stderr ---\n{dump()}")
 
 
 @pytest.fixture(scope="module")
@@ -179,3 +190,47 @@ class TestSolverService:
         gs.tpu.socket_path = "/nonexistent/kt.sock"
         res2 = gs.solve(mkinp("gate2", 10))
         assert not res2.unschedulable and res2.node_count() == 1
+
+
+class TestDaemonRestart:
+    def test_client_reconnects_and_reuploads_after_restart(self, tmp_path):
+        """Replica-survives-solver-restart: kill the daemon hard, assert
+        the control plane degrades to the oracle (never fails), restart
+        on the same socket, and assert the SAME client reconnects and
+        re-uploads the catalog (the daemon restarted empty — the
+        need_catalog handshake must recover it transparently)."""
+        from karpenter_tpu.cluster import Cluster
+        from karpenter_tpu.controllers.state import GatedSolver
+        from karpenter_tpu.operator.options import Options
+
+        build_daemon()
+        sock = str(tmp_path / "kt.sock")
+        proc1, dump1 = spawn_daemon(sock)
+        try:
+            gs = GatedSolver(Options(solver_endpoint=sock), Cluster())
+            gs.tpu.timeout = 120  # bounded waits incl. cold compile
+            res = gs.solve(mkinp("before", 10))
+            assert not res.unschedulable and res.node_count() == 1
+            uploads_before = gs.tpu.stats()["catalogs"]
+            assert uploads_before == 1
+        finally:
+            proc1.kill()
+            proc1.wait()
+
+        # daemon down: degrade to oracle, never fail (SURVEY §5)
+        res = gs.solve(mkinp("down", 10))
+        assert not res.unschedulable and res.node_count() == 1
+
+        proc2, dump2 = spawn_daemon(sock)
+        try:
+            # same client object: must reconnect AND re-upload the catalog
+            res = gs.tpu.solve(mkinp("after", 10))
+            assert not res.unschedulable and res.node_count() == 1
+            assert gs.tpu.stats()["catalogs"] == 1  # fresh daemon, one upload
+        finally:
+            gs.tpu.close()
+            proc2.terminate()
+            try:
+                proc2.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc2.kill()
