@@ -175,8 +175,11 @@ class Cluster:
         reconcile pass can't grow it without limit). The dedup window
         covers more candidates than the largest supported consolidation
         sweep so per-pass repeats collapse."""
-        recent = [(k, o, r) for _, k, o, r, _ in self.events[-512:]]
-        if (kind, obj_name, reason) in recent:
+        # message participates in the key: a node's reason label (e.g.
+        # Unconsolidatable) can stay the same while the CAUSE changes —
+        # the refreshed message must land, only true repeats drop
+        recent = [(k, o, r, m) for _, k, o, r, m in self.events[-512:]]
+        if (kind, obj_name, reason, message) in recent:
             return
         self.events.append(
             (self.clock.now(), kind, obj_name, reason, message))

@@ -371,16 +371,16 @@ class Disruption:
             sims = self._simulate_batch(
                 [[c] for c in chunk], [c.price for c in chunk])
             for cand, sim in zip(chunk, sims):
-                if sim is not None and self._acceptable([cand], sim):
+                reason = ("pods cannot reschedule onto remaining capacity "
+                          "or a single cheaper node" if sim is None
+                          else self._unacceptable_reason([cand], sim))
+                if reason is None:
                     self._execute(REASON_UNDERUTILIZED, [cand], sim)
                     return True
                 # user-facing reason a node stays up (disruption.md:109-117
                 # Unconsolidatable events; the recorder deduplicates)
                 self.cluster.record_event(
-                    "NodeClaim", cand.claim.name, "Unconsolidatable",
-                    "pods cannot reschedule onto remaining capacity or a "
-                    "single cheaper node" if sim is None
-                    else self._unacceptable_reason([cand], sim))
+                    "NodeClaim", cand.claim.name, "Unconsolidatable", reason)
         return False
 
     # -- simulation -------------------------------------------------------

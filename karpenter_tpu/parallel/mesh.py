@@ -33,16 +33,19 @@ def make_mesh(n_devices: "int | None" = None, axis: str = "cat") -> Mesh:
 def sharded_solve_ffd(
     mesh: Mesh,
     group_req, group_count, group_mask, exist_cap, exist_remaining,
-    col_alloc, col_daemon, col_pool, pool_daemon, pool_limit,
+    col_alloc, col_daemon, pt_alloc, col_pool, pool_daemon,
+    pool_limit,
     group_ncap, group_dsel, group_dbase, group_dcap, group_skew,
     group_mindom, group_delig, col_zone, col_ct, exist_zone, exist_ct,
     max_nodes: int = 1024,
+    zc: int = 1,
     axis: str = "cat",
 ):
     """solve_ffd with the column axis sharded over `mesh`.
 
-    The caller must pad O to a multiple of mesh size (the usual bucket
-    alignment of 512 covers meshes up to 512 chips).
+    The caller must pad the (pool,type) axis to a multiple of mesh size
+    (O = PT × zc then splits on block boundaries; TPUSolver's PT_ALIGN
+    covers meshes up to 64 chips, wider via the lcm in _pt_align).
     """
     col = NamedSharding(mesh, P(axis))        # [O]
     col2 = NamedSharding(mesh, P(axis, None)) # [O, R]
@@ -57,6 +60,7 @@ def sharded_solve_ffd(
         jax.device_put(exist_remaining, rep),
         jax.device_put(col_alloc, col2),
         jax.device_put(col_daemon, col2),
+        jax.device_put(pt_alloc, rep),  # PT axis unsharded (small)
         jax.device_put(col_pool, col),
         jax.device_put(pool_daemon, rep),
         jax.device_put(pool_limit, rep),
@@ -73,4 +77,4 @@ def sharded_solve_ffd(
         jax.device_put(exist_ct, rep),
     )
     with mesh:
-        return ffd.solve_ffd(*args, max_nodes=max_nodes)
+        return ffd.solve_ffd(*args, max_nodes=max_nodes, zc=zc)

@@ -247,33 +247,57 @@ class CatalogEncoding:
     ct_ids: Dict[str, int] = field(default_factory=dict)
     col_zone: np.ndarray = None  # [O] i32
     col_ct: np.ndarray = None    # [O] i32
+    # capacity dedup: allocatable varies only per (pool, instance type) —
+    # the column axis is a fixed-stride grid of ZC (zone, capacity-type)
+    # pairs per (pool,type) block, so the kernel's fit math runs at
+    # [N,PT] (= [N,O/ZC]) via pure reshapes. Grid combos with no
+    # available offering are masked out by col_valid.
+    zc: int = 1                  # grid stride (len of the zone×ct grid)
+    pt_alloc: np.ndarray = None  # [PT, R] f32 (PT = O // zc)
+    col_valid: np.ndarray = None # [O] bool
     device_args: Optional[dict] = None  # device-resident padded arrays
 
 
 def encode_catalog(inp: ScheduleInput) -> CatalogEncoding:
+    """Column layout is a FIXED-STRIDE grid: for every (pool, type) block,
+    one column per (zone, capacity-type) pair of the global grid, in grid
+    order — combos with no available offering become masked-out columns
+    (col_valid False, price inf) instead of being skipped. The uniform
+    stride ZC is what lets the kernel run its capacity math at (pool,type)
+    granularity with pure reshapes (no scatter/segment ops): allocatable
+    only varies per type, so zones × capacity-types were repeating the
+    same fit computation ~ZC times."""
     pools = sorted(inp.nodepools, key=lambda np_: (-np_.weight, np_.meta.name))
     vocab = _Vocab()
+    zc_pairs = sorted({
+        (o.zone, o.capacity_type)
+        for p in pools for it in inp.instance_types.get(p.name, [])
+        for o in it.offerings})
     columns: List[Column] = []
+    col_valid_list: List[bool] = []
     for pidx, pool in enumerate(pools):
         for it in inp.instance_types.get(pool.name, []):
             base_labels: Dict[str, str] = {}
             for req in it.requirements:
                 if req.is_finite() and len(req.values()) == 1:
                     (base_labels[req.key],) = req.values()
-            for o in it.offerings:
-                if not o.available:
-                    continue
+            offmap = {(o.zone, o.capacity_type): o for o in it.offerings}
+            alloc = it.allocatable()
+            for zone, ct in zc_pairs:
+                o = offmap.get((zone, ct))
                 labels = dict(base_labels)
-                labels[wellknown.ZONE_LABEL] = o.zone
-                labels[wellknown.CAPACITY_TYPE_LABEL] = o.capacity_type
+                labels[wellknown.ZONE_LABEL] = zone
+                labels[wellknown.CAPACITY_TYPE_LABEL] = ct
                 labels[wellknown.NODEPOOL_LABEL] = pool.name
                 labels.update(pool.labels)
                 columns.append(Column(
                     pool=pool.name, pool_idx=pidx, type_name=it.name,
-                    zone=o.zone, capacity_type=o.capacity_type, price=o.price,
-                    labels=labels, allocatable=it.allocatable(),
+                    zone=zone, capacity_type=ct,
+                    price=(o.price if o is not None else float("inf")),
+                    labels=labels, allocatable=alloc,
                     instance_type=it,
                 ))
+                col_valid_list.append(o is not None and o.available)
     col_keys = sorted({k for c in columns for k in c.labels})
     col_matrices = _label_matrix(vocab, col_keys, [c.labels for c in columns])
     O = len(columns)
@@ -303,6 +327,10 @@ def encode_catalog(inp: ScheduleInput) -> CatalogEncoding:
         ct_ids.setdefault(c.capacity_type, len(ct_ids))
     col_zone = np.array([zone_ids[c.zone] for c in columns], dtype=np.int32)
     col_ct = np.array([ct_ids[c.capacity_type] for c in columns], dtype=np.int32)
+    zc = max(len(zc_pairs), 1)
+    pt_alloc = (col_alloc[::zc].copy() if O
+                else np.zeros((0, R), dtype=np.float32))
+    col_valid = np.array(col_valid_list, dtype=bool)
     return CatalogEncoding(
         pools=pools, columns=columns, vocab=vocab, col_matrices=col_matrices,
         col_alloc=col_alloc, col_daemon=col_daemon, col_price=col_price,
@@ -311,6 +339,7 @@ def encode_catalog(inp: ScheduleInput) -> CatalogEncoding:
         pool_cols=pool_cols, pool_matrices=pool_matrices,
         pool_provides=pool_provides,
         zone_ids=zone_ids, ct_ids=ct_ids, col_zone=col_zone, col_ct=col_ct,
+        zc=zc, pt_alloc=pt_alloc, col_valid=col_valid,
     )
 
 
@@ -830,7 +859,8 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None,
             if al is not None:
                 gmask &= np.isin(col_ids, list(al))
         static_allowed.append(t["allowed"])
-        group_mask[gi] = gmask
+        # grid combos with no available offering are dead columns
+        group_mask[gi] = gmask & cat.col_valid
         merged_reqs.append(merged_per_pool)
 
         if E:

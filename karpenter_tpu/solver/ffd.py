@@ -135,6 +135,7 @@ def _solve_ffd_impl(
     exist_remaining: jnp.ndarray, # [E, R]
     col_alloc: jnp.ndarray,       # [O, R]
     col_daemon: jnp.ndarray,      # [O, R]
+    pt_alloc: jnp.ndarray,        # [PT, R] — allocatable per (pool,type)
     col_pool: jnp.ndarray,        # [O] i32
     pool_daemon: jnp.ndarray,     # [P, R]
     pool_limit: jnp.ndarray,      # [P, R]
@@ -150,10 +151,24 @@ def _solve_ffd_impl(
     exist_zone: jnp.ndarray,      # [E] i32
     exist_ct: jnp.ndarray,        # [E] i32
     max_nodes: int = 1024,
+    zc: int = 1,                  # grid stride: columns per (pool,type)
 ):
     G, RDIM = group_req.shape
     E = exist_remaining.shape[0]
     O = col_alloc.shape[0]
+    PT = pt_alloc.shape[0]
+    assert O == PT * zc, (O, PT, zc)
+
+    def pt_expand(a_pt):
+        # [N,PT] → [N,O]: the grid layout makes the (pool,type) axis a
+        # pure reshape of the column axis — no gather, no scatter
+        return jnp.broadcast_to(
+            a_pt[:, :, None], (a_pt.shape[0], PT, zc)).reshape(
+                a_pt.shape[0], O)
+
+    def pt_any(a_col):
+        # [N,O] bool → [N,PT] bool: any column of the block
+        return a_col.reshape(a_col.shape[0], PT, zc).max(axis=-1)
     P = pool_limit.shape[0]
     D = group_dbase.shape[1]
     N = max_nodes
@@ -207,17 +222,28 @@ def _solve_ffd_impl(
             c1 = cnt - (take_e.sum() if E else 0)
 
             # -- 2. in-flight nodes -------------------------------------
-            avail = col_alloc[None, :, :] - used[:, None, :]       # [N,O,R]
-            cap_no = _fit_count(avail, req)                        # [N,O]
-            cap_no = jnp.where(colmask & gmask[None, :], cap_no, 0)
-            cap_n = jnp.where(active, jnp.minimum(cap_no.max(axis=1), ncap), 0)
+            # Capacity varies only per (pool,type): the fit math runs at
+            # [N,PT] (≈6x narrower than [N,O] — zones×capacity-types
+            # repeat the same allocatable row), and the per-column mask
+            # reduces to PT eligibility by a segment-max. The [N,O,R]
+            # chains this replaces were the kernel's dominant HBM traffic.
+            avail_pt = pt_alloc[None, :, :] - used[:, None, :]     # [N,PT,R]
+            cap_npt = _fit_count(avail_pt, req)                    # [N,PT]
+            elig_pt = pt_any(colmask & gmask[None, :])             # [N,PT]
+            cap_n = jnp.where(
+                active,
+                jnp.minimum(
+                    jnp.where(elig_pt, cap_npt, 0).max(axis=1), ncap),
+                0)
             cap_n = _clamp_pool_limits(cap_n, node_pool, limits, req)
             take_n = _prefix_fill(cap_n, c1)
             used = used + take_n[:, None] * req
             touched = take_n > 0
             colmask = jnp.where(touched[:, None], colmask & gmask[None, :], colmask)
-            col_ok = jnp.all(col_alloc[None, :, :] - used[:, None, :] >= -EPS, axis=-1)
-            colmask = colmask & col_ok
+            ok_pt = jnp.all(
+                pt_alloc[None, :, :] - used[:, None, :] >= -EPS,
+                axis=-1)                                           # [N,PT]
+            colmask = colmask & pt_expand(ok_pt)
             pool_take = jax.ops.segment_sum(take_n.astype(jnp.float32), node_pool,
                                             num_segments=P)
             limits = limits - pool_take[:, None] * req
@@ -261,8 +287,10 @@ def _solve_ffd_impl(
                     0)
                 new_used = pool_daemon[p][None, :] + k_node[:, None].astype(jnp.float32) * req
                 used = jnp.where(newmask[:, None], new_used, used)
-                new_colmask = cols_p[None, :] & jnp.all(
-                    col_alloc[None, :, :] - new_used[:, None, :] >= -EPS, axis=-1)
+                new_ok_pt = jnp.all(
+                    pt_alloc[None, :, :] - new_used[:, None, :] >= -EPS,
+                    axis=-1)
+                new_colmask = cols_p[None, :] & pt_expand(new_ok_pt)
                 colmask = jnp.where(newmask[:, None], new_colmask, colmask)
                 active_ = active_ | newmask
                 node_pool_ = jnp.where(newmask, jnp.int32(p), node_pool_)
@@ -307,9 +335,13 @@ def _solve_ffd_impl(
             cap_ed = (jnp.where(dom_ex, cap_e[None, :], 0)
                       if E else jnp.zeros((D, 0), jnp.int32))      # [D, E]
 
-            avail = col_alloc[None, :, :] - used[:, None, :]
-            cap_no = _fit_count(avail, req)
-            cap_no = jnp.where(colmask & gmask[None, :], cap_no, 0)  # [N,O]
+            # same pt-granular fit as the light branch ([N,PT] then a
+            # reshape-expand) — the grid layout inflates O with invalid
+            # combos, so the [N,O,R] chain would now cost MORE than before
+            cap_npt_h = _fit_count(
+                pt_alloc[None, :, :] - used[:, None, :], req)     # [N,PT]
+            cap_no = jnp.where(colmask & gmask[None, :],
+                               pt_expand(cap_npt_h), 0)           # [N,O]
             # segment-max over the column axis: no [D,N,O] intermediate
             cap_nd = jax.ops.segment_max(cap_no.T, col_dom, num_segments=D,
                                          indices_are_sorted=False)   # [D, N]
@@ -403,8 +435,9 @@ def _solve_ffd_impl(
             node_dcols = dom_cols[bd]                                # [N, O] bool
             colmask = jnp.where(touched[:, None],
                                 colmask & gmask[None, :] & node_dcols, colmask)
-            col_ok = jnp.all(col_alloc[None, :, :] - used[:, None, :] >= -EPS, axis=-1)
-            colmask = colmask & col_ok
+            ok_pt = jnp.all(
+                pt_alloc[None, :, :] - used[:, None, :] >= -EPS, axis=-1)
+            colmask = colmask & pt_expand(ok_pt)
             node_zone = jnp.where(touched & (dsel == 1), bd, node_zone)
             node_ct = jnp.where(touched & (dsel == 2), bd, node_ct)
             pool_take = jax.ops.segment_sum(take_n.astype(jnp.float32), node_pool,
@@ -464,8 +497,10 @@ def _solve_ffd_impl(
                 used = jnp.where(newmask[:, None], new_used, used)
                 new_bd = (in_dom * dom_ids[:, None]).sum(0).astype(jnp.int32)
                 nd_cols = dom_cols[new_bd]                           # [N, O]
-                new_colmask = nd_cols & cols_p[None, :] & jnp.all(
-                    col_alloc[None, :, :] - new_used[:, None, :] >= -EPS, axis=-1)
+                new_ok_pt = jnp.all(
+                    pt_alloc[None, :, :] - new_used[:, None, :] >= -EPS,
+                    axis=-1)
+                new_colmask = nd_cols & cols_p[None, :] & pt_expand(new_ok_pt)
                 colmask = jnp.where(newmask[:, None], new_colmask, colmask)
                 node_zone = jnp.where(newmask & (dsel == 1), new_bd, node_zone)
                 node_ct = jnp.where(newmask & (dsel == 2), new_bd, node_ct)
@@ -515,22 +550,23 @@ def _solve_ffd_impl(
     return packed
 
 
-solve_ffd = partial(jax.jit, static_argnames=("max_nodes",))(_solve_ffd_impl)
+solve_ffd = partial(jax.jit, static_argnames=("max_nodes", "zc"))(_solve_ffd_impl)
 
 # The consolidation simulator's batch axis (SURVEY §7 step 6): many
 # candidate-removal simulations against one cluster state share the catalog
 # (columns replicated) while per-candidate pods/existing/limits vmap over
 # the leading axis — one device call evaluates the whole candidate set.
 _BATCH_AXES = (0, 0, 0, 0, 0,          # group_req..exist_remaining
-               None, None, None, None,  # col_alloc..pool_daemon (shared)
+               None, None, None,        # col_alloc, col_daemon, pt_alloc
+               None, None,              # col_pool, pool_daemon (shared)
                0,                       # pool_limit
                0, 0, 0, 0, 0, 0, 0,     # topology group arrays
                None, None,              # col_zone, col_ct (shared)
                0, 0)                    # exist_zone, exist_ct
 
-@partial(jax.jit, static_argnames=("max_nodes",))
-def solve_ffd_batch(*args, max_nodes: int = 1024):
-    return jax.vmap(partial(_solve_ffd_impl, max_nodes=max_nodes),
+@partial(jax.jit, static_argnames=("max_nodes", "zc"))
+def solve_ffd_batch(*args, max_nodes: int = 1024, zc: int = 1):
+    return jax.vmap(partial(_solve_ffd_impl, max_nodes=max_nodes, zc=zc),
                     in_axes=_BATCH_AXES)(*args)
 
 

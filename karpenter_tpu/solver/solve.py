@@ -38,7 +38,7 @@ R = len(RESOURCE_AXIS)
 G_BUCKETS = (8, 32, 128, 512, 2048)
 E_BUCKETS = (0, 64, 512, 2048, 4096)
 B_BUCKETS = (4, 16, 64)  # simulate-batch axis (SURVEY §7 step 6)
-O_ALIGN = 512
+PT_ALIGN = 64  # (pool,type) axis padding; column axis O = PT_pad × ZC
 
 
 class UnsupportedPods(Exception):
@@ -98,15 +98,17 @@ class TPUSolver:
             self._mesh = make_mesh(n)
         return self._mesh
 
-    def _o_align(self) -> int:
-        """Column padding must stay divisible by the mesh size so the
-        sharded axis splits evenly (O_ALIGN=512 covers power-of-two
-        meshes; other sizes widen the alignment via lcm)."""
+    def _pt_align(self) -> int:
+        """The (pool,type) axis pads to a bucket (jit-cache stability)
+        that also divides evenly over the mesh: the column axis O =
+        PT_pad × ZC shards over PT_pad blocks, so PT_pad must be a
+        multiple of the mesh size."""
+        align = PT_ALIGN
         mesh = self._resolve_mesh()
         if mesh is None:
-            return O_ALIGN
+            return align
         import math
-        return O_ALIGN * mesh.size // math.gcd(O_ALIGN, mesh.size)
+        return align * mesh.size // math.gcd(align, mesh.size)
 
     def _shardings(self):
         """(col, col2, gcol, rep) NamedShardings for the active mesh."""
@@ -144,13 +146,21 @@ class TPUSolver:
             self._cat = encode_catalog(inp)
             self._cat_key = key
             cat = self._cat
-            align = self._o_align()
-            O = -(-len(cat.columns) // align) * align
+            # the column axis is a PT×ZC grid: padding whole (pool,type)
+            # blocks keeps the grid stride uniform, so the kernel's
+            # pt-granular capacity math stays a pure reshape. Padded
+            # blocks carry zero allocatable (fits nothing) and pads are
+            # never in any group mask.
+            ZC = cat.zc
+            PT = len(cat.columns) // ZC if ZC else 0
+            align = self._pt_align()
+            PT_pad = max(-(-PT // align) * align, align)
+            O = PT_pad * ZC
             import jax
             mesh = self._resolve_mesh()
             if mesh is not None:
                 # catalog columns shard over ICI; the kernel's column
-                # reductions (max/segment_max) lower to XLA collectives
+                # reductions lower to XLA collectives
                 col, col2, _, rep = self._shardings()
                 put_c = lambda a: jax.device_put(a, col)
                 put_c2 = lambda a: jax.device_put(a, col2)
@@ -160,11 +170,13 @@ class TPUSolver:
             cat.device_args = dict(
                 col_alloc=put_c2(self._pad(cat.col_alloc, 0, O)),
                 col_daemon=put_c2(self._pad(cat.col_daemon, 0, O)),
+                pt_alloc=put_r(self._pad(cat.pt_alloc, 0, PT_pad)),
                 col_pool=put_c(self._pad(cat.col_pool, 0, O)),
                 col_zone=put_c(self._pad(cat.col_zone, 0, O)),
                 col_ct=put_c(self._pad(cat.col_ct, 0, O)),
                 pool_daemon=put_r(cat.pool_daemon),
                 O=O,
+                ZC=ZC,
             )
         return self._cat
 
@@ -235,7 +247,8 @@ class TPUSolver:
          pool_limit, group_ncap, group_dsel, group_dbase, group_dcap,
          group_skew, group_mindom, group_delig, exist_zone, exist_ct) = prob
         return (group_req, group_count, group_mask, exist_cap, exist_remaining,
-                dev["col_alloc"], dev["col_daemon"], dev["col_pool"],
+                dev["col_alloc"], dev["col_daemon"],
+                dev["pt_alloc"], dev["col_pool"],
                 dev["pool_daemon"], pool_limit,
                 group_ncap, group_dsel, group_dbase, group_dcap,
                 group_skew, group_mindom, group_delig,
@@ -430,7 +443,7 @@ class TPUSolver:
         t2 = _time.perf_counter()
         from karpenter_tpu.utils.profiling import trace_solve
         with trace_solve("ffd-solve"):
-            packed = ffd.solve_ffd(*args, max_nodes=mn)
+            packed = ffd.solve_ffd(*args, max_nodes=mn, zc=dev["ZC"])
             out = ffd.unpack(packed, G, E, mn, R, Db)
             if (max_nodes is None and mn < self.max_nodes
                     and out["unsched"].sum() > 0
@@ -439,7 +452,7 @@ class TPUSolver:
                 # configured ceiling (one-time cost; the next solve's
                 # warm-start adapts to the real active count)
                 mn = self.max_nodes
-                packed = ffd.solve_ffd(*args, max_nodes=mn)
+                packed = ffd.solve_ffd(*args, max_nodes=mn, zc=dev["ZC"])
                 out = ffd.unpack(packed, G, E, mn, R, Db)
         self._last_slots_exhausted = bool(
             out["unsched"].sum() > 0 and out["num_active"] >= mn)
@@ -748,7 +761,8 @@ class TPUSolver:
                     tuple(np.stack(parts) for parts in zip(*probs)),
                     batched=True)
                 packed = ffd.solve_ffd_batch(
-                    *self._assemble(dev, stacked), max_nodes=mn)
+                    *self._assemble(dev, stacked), max_nodes=mn,
+                    zc=dev["ZC"])
                 packed = np.array(packed)
                 for bi, (i, enc) in enumerate(chunk):
                     out = ffd.unpack(packed[bi], G, E, mn, R, Db)
