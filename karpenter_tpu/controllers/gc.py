@@ -47,6 +47,22 @@ class GarbageCollection:
                     "Instance", inst.instance_id, "LeakedInstanceReclaimed",
                     "no NodeClaim references this instance")
 
+        # owner cascade: the reference deletes a NodePool's nodes with it
+        # (owner references on NodeClaims; nodepools.md — deleting a
+        # NodePool drains its nodes gracefully). A claim whose pool is
+        # gone or deleting is deleted here, which routes through the
+        # termination controller's finalizer drain, not a hard kill.
+        live_pools = {p.name for p in self.cluster.nodepools.list(
+            lambda p: not p.meta.deleting)}
+        for claim in claims:
+            if claim.meta.deleting:
+                continue
+            if claim.nodepool not in live_pools:
+                self.cluster.record_event(
+                    "NodeClaim", claim.name, "OwnerDeleted",
+                    f"nodepool {claim.nodepool} was deleted; draining")
+                self.cluster.nodeclaims.delete(claim.name)
+
         # vanished: claim exists, instance doesn't (or is terminated)
         for claim in claims:
             if not claim.provider_id or claim.meta.deleting:

@@ -113,6 +113,36 @@ class TestGC:
         assert all(env.cluster.nodes.get(p.node_name) is not None for p in pods)
 
 
+class TestNodePoolCascade:
+    def test_deleting_nodepool_drains_its_claims(self, env):
+        """The reference deletes a NodePool's nodes with it (owner
+        references; nodepools.md) — gracefully, through the termination
+        drain, not a hard kill."""
+        provision(env)
+        assert env.cluster.nodeclaims.list()
+        env.cluster.nodepools.delete("default")
+        env.settle()
+        assert not env.cluster.nodeclaims.list()
+        assert all(i.state == "terminated"
+                   for i in env.cloud.instances.values())
+        # no pool left: pods are pending again, not silently lost
+        pods = env.cluster.pods.list()
+        assert pods and all(not p.scheduled for p in pods)
+        reasons = {r for _, _, _, r, _ in env.cluster.events}
+        assert "OwnerDeleted" in reasons
+
+    def test_claims_migrate_to_surviving_pool(self, env):
+        provision(env)
+        env.cluster.nodepools.create(
+            NodePool(meta=ObjectMeta(name="fallback"), weight=1))
+        env.cluster.nodepools.delete("default")
+        env.settle()
+        pods = env.cluster.pods.list()
+        assert pods and all(p.scheduled for p in pods)
+        assert all(c.nodepool == "fallback"
+                   for c in env.cluster.nodeclaims.list())
+
+
 class TestExpiration:
     def test_expired_claims_replaced(self, env):
         pool = env.cluster.nodepools.get("default")
