@@ -157,8 +157,17 @@ def run_configs(timeout_s: float):
     # configs share the persistent compile cache (platform bootstrap), so
     # a generous per-probe budget isn't needed — keep failures quick so
     # five configs can't eat the artifact's whole wall-clock
+    operator_set = "KARPENTER_TPU_PROBE_TIMEOUT" in env
     env.setdefault("KARPENTER_TPU_PROBE_TIMEOUT", "90")
+    degraded = False
     for cfg in configs:
+        if degraded and not operator_set:
+            # an earlier config already burned its probe budget and fell
+            # back to CPU (wedged/held chip): keep trying the device, but
+            # briefly — rediscovering the same dead chip at full budget
+            # per config would cost the artifact ~5 extra minutes each.
+            # An operator-exported probe timeout is respected as-is.
+            env["KARPENTER_TPU_PROBE_TIMEOUT"] = "20"
         path = os.path.join(HERE, "benchmarks", cfg)
         rec = {"config": cfg}
         try:
@@ -217,6 +226,9 @@ def run_configs(timeout_s: float):
         except subprocess.TimeoutExpired:
             rec["rc"] = -1
             rec["error"] = f"timeout after {timeout_s:.0f}s"
+        parsed = rec.get("parsed")
+        if isinstance(parsed, dict) and parsed.get("platform") == "cpu":
+            degraded = True
         log_attempt({"stage": "config", **rec, "ts": time.time()})
         out.append(rec)
     return out
@@ -240,7 +252,13 @@ def main() -> None:
         os.environ.get("KARPENTER_TPU_BENCH_TIMEOUT", "600")))
 
     from karpenter_tpu.utils.platform import initialize
-    platform = initialize(kill_holders=True)
+    parsed = [c["parsed"] for c in configs if isinstance(c.get("parsed"), dict)]
+    all_cpu = bool(parsed) and all(
+        p.get("platform") == "cpu" for p in parsed)
+    # every config already fell back: probe briefly (the chip may have
+    # recovered) instead of re-spending the full multi-minute budget
+    platform = initialize(kill_holders=True,
+                          probe_timeout_s=30.0 if all_cpu else None)
     print(f"platform={platform}", file=sys.stderr, flush=True)
     log_attempt({"stage": "init", "platform": platform, "ts": time.time()})
 
