@@ -161,13 +161,14 @@ def run_configs(timeout_s: float):
     env.setdefault("KARPENTER_TPU_PROBE_TIMEOUT", "90")
     degraded = False
     for cfg in configs:
-        if degraded and not operator_set:
-            # an earlier config already burned its probe budget and fell
-            # back to CPU (wedged/held chip): keep trying the device, but
-            # briefly — rediscovering the same dead chip at full budget
-            # per config would cost the artifact ~5 extra minutes each.
-            # An operator-exported probe timeout is respected as-is.
-            env["KARPENTER_TPU_PROBE_TIMEOUT"] = "20"
+        if not operator_set:
+            # once an earlier config burned its probe budget and fell
+            # back to CPU (wedged/held chip), later configs keep trying
+            # the device but briefly — rediscovering the same dead chip
+            # at full budget per config would cost ~5 extra minutes each.
+            # A config that reaches the device resets the budget, and an
+            # operator-exported probe timeout is respected as-is.
+            env["KARPENTER_TPU_PROBE_TIMEOUT"] = "20" if degraded else "90"
         path = os.path.join(HERE, "benchmarks", cfg)
         rec = {"config": cfg}
         try:
@@ -229,6 +230,14 @@ def run_configs(timeout_s: float):
         parsed = rec.get("parsed")
         if isinstance(parsed, dict) and parsed.get("platform") == "cpu":
             degraded = True
+        elif isinstance(parsed, dict) and parsed.get("platform"):
+            # the chip answered this config: any earlier fallback was
+            # transient — later configs deserve the full budget again
+            degraded = False
+        elif rec.get("rc") != 0:
+            # timeout/crash before printing JSON is degradation evidence
+            # too (a wedged chip can hang a config past its wall-clock)
+            degraded = True
         log_attempt({"stage": "config", **rec, "ts": time.time()})
         out.append(rec)
     return out
@@ -258,7 +267,7 @@ def main() -> None:
     # every config already fell back: probe briefly (the chip may have
     # recovered) instead of re-spending the full multi-minute budget
     platform = initialize(kill_holders=True,
-                          probe_timeout_s=30.0 if all_cpu else None)
+                          probe_timeout_s=60.0 if all_cpu else None)
     print(f"platform={platform}", file=sys.stderr, flush=True)
     log_attempt({"stage": "init", "platform": platform, "ts": time.time()})
 

@@ -205,6 +205,13 @@ class Disruption:
             node = self.cluster.node_for_claim(claim)
             if node is None or node.meta.deleting or not node.ready:
                 continue
+            # the do-not-disrupt annotation blocks voluntary disruption at
+            # the node/claim level too, not just per pod (reference:
+            # disruption.md — karpenter.sh/do-not-disrupt on the node)
+            if any(o.meta.annotations.get(
+                    wellknown.DO_NOT_DISRUPT_ANNOTATION) == "true"
+                   for o in (node, claim)):
+                continue
             pool = self.cluster.nodepools.get(claim.nodepool)
             if pool is None:
                 continue

@@ -29,6 +29,12 @@ _INTERN_LIMIT = 100_000
 _sched_gid_counter = itertools.count(1)
 
 
+def do_not_disrupt(meta: "ObjectMeta") -> bool:
+    """The karpenter.sh/do-not-disrupt annotation — ONE definition for
+    every level it applies at (pod, node, nodeclaim)."""
+    return meta.annotations.get(wellknown.DO_NOT_DISRUPT_ANNOTATION) == "true"
+
+
 def new_uid() -> str:
     return f"uid-{next(_uid_counter)}"
 
@@ -134,7 +140,7 @@ class Pod:
             return 0.0
 
     def do_not_disrupt(self) -> bool:
-        return self.meta.annotations.get(wellknown.DO_NOT_DISRUPT_ANNOTATION) == "true"
+        return do_not_disrupt(self.meta)
 
     def _soft_ladder(self) -> list:
         """Every best-effort term, strongest first: preferred node affinity

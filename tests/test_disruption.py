@@ -111,6 +111,22 @@ class TestConsolidation:
         env.settle()
         assert len(env.cluster.nodeclaims.list()) == 2  # untouched
 
+    def test_do_not_disrupt_on_node_blocks(self, env):
+        """The annotation blocks at the node level too, not just per pod
+        (reference: karpenter.sh/do-not-disrupt on the node)."""
+        self._two_underutilized_nodes(env)
+        for n in env.cluster.nodes.list():
+            n.meta.annotations[wellknown.DO_NOT_DISRUPT_ANNOTATION] = "true"
+        env.settle()
+        assert len(env.cluster.nodeclaims.list()) == 2  # untouched
+
+    def test_do_not_disrupt_on_claim_blocks(self, env):
+        self._two_underutilized_nodes(env)
+        for c in env.cluster.nodeclaims.list():
+            c.meta.annotations[wellknown.DO_NOT_DISRUPT_ANNOTATION] = "true"
+        env.settle()
+        assert len(env.cluster.nodeclaims.list()) == 2  # untouched
+
     def test_zero_budget_blocks(self, env):
         pool = env.cluster.nodepools.get("default")
         pool.disruption.budgets = [Budget(nodes="0")]
