@@ -169,6 +169,15 @@ def _solve_ffd_impl(
     def pt_any(a_col):
         # [N,O] bool → [N,PT] bool: any column of the block
         return a_col.reshape(a_col.shape[0], PT, zc).max(axis=-1)
+
+    def slot_expand(a_slot):
+        # [N,ZC] → [N,O]: tile a per-grid-slot mask across every
+        # (pool,type) block — the grid makes domain membership a pure
+        # function of the slot, so node→domain column masks need no
+        # [D,O] gather
+        return jnp.broadcast_to(
+            a_slot[:, None, :], (a_slot.shape[0], PT, zc)).reshape(
+                a_slot.shape[0], O)
     P = pool_limit.shape[0]
     D = group_dbase.shape[1]
     N = max_nodes
@@ -342,10 +351,15 @@ def _solve_ffd_impl(
                 pt_alloc[None, :, :] - used[:, None, :], req)     # [N,PT]
             cap_no = jnp.where(colmask & gmask[None, :],
                                pt_expand(cap_npt_h), 0)           # [N,O]
-            # segment-max over the column axis: no [D,N,O] intermediate
-            cap_nd = jax.ops.segment_max(cap_no.T, col_dom, num_segments=D,
-                                         indices_are_sorted=False)   # [D, N]
-            cap_nd = jnp.maximum(cap_nd, 0)
+            # per-domain max via the grid: max over (pool,type) blocks
+            # per slot, then combine the ZC slots by their domain id — a
+            # reshape + tiny [N,ZC,D] combine instead of a scatter-based
+            # segment_max over the O axis
+            zc_dom = col_dom[:zc]                              # [ZC]
+            slotmax = cap_no.reshape(-1, PT, zc).max(axis=1)   # [N, ZC]
+            cap_nd = jnp.where(
+                zc_dom[None, :, None] == dom_ids[None, None, :],
+                slotmax[:, :, None], 0).max(axis=1).T          # [D, N]
             cap_nd = jnp.minimum(cap_nd, ncap)
             cap_nd = jnp.where(active[None, :], cap_nd, 0)
             # each in-flight node serves exactly ONE domain (placing a
@@ -432,7 +446,7 @@ def _solve_ffd_impl(
             take_n = take_nd.sum(0)
             used = used + take_n[:, None] * req
             touched = take_n > 0
-            node_dcols = dom_cols[bd]                                # [N, O] bool
+            node_dcols = slot_expand(zc_dom[None, :] == bd[:, None])  # [N, O]
             colmask = jnp.where(touched[:, None],
                                 colmask & gmask[None, :] & node_dcols, colmask)
             ok_pt = jnp.all(
@@ -496,7 +510,7 @@ def _solve_ffd_impl(
                             + k_node[:, None].astype(jnp.float32) * req)
                 used = jnp.where(newmask[:, None], new_used, used)
                 new_bd = (in_dom * dom_ids[:, None]).sum(0).astype(jnp.int32)
-                nd_cols = dom_cols[new_bd]                           # [N, O]
+                nd_cols = slot_expand(zc_dom[None, :] == new_bd[:, None])
                 new_ok_pt = jnp.all(
                     pt_alloc[None, :, :] - new_used[:, None, :] >= -EPS,
                     axis=-1)
