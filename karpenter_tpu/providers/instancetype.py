@@ -111,6 +111,38 @@ class InstanceTypeProvider:
         if self._changes.has_changed(f"count/{node_class.name}", len(out)):
             self._log.info("discovered instance types",
                            node_class=node_class.name, count=len(out))
+        # per-type catalog gauges, refreshed on the (rare) catalog rebuild
+        # (reference instancetype.go:156-161,302-311); series for vanished
+        # types/offerings are deleted, not left stale
+        from karpenter_tpu.utils import metrics
+        for it in out:
+            caps = it.capacity.to_dict()  # solver units → cores/bytes
+            metrics.INSTANCE_TYPE_CPU.set(
+                caps.get("cpu", 0.0), instance_type=it.name)
+            metrics.INSTANCE_TYPE_MEMORY.set(
+                caps.get("memory", 0.0), instance_type=it.name)
+            for o in it.offerings:
+                metrics.INSTANCE_TYPE_OFFERING_PRICE.set(
+                    o.price, instance_type=it.name, zone=o.zone,
+                    capacity_type=o.capacity_type)
+                metrics.INSTANCE_TYPE_OFFERING_AVAILABLE.set(
+                    1.0 if o.available else 0.0, instance_type=it.name,
+                    zone=o.zone, capacity_type=o.capacity_type)
+        if cached is not None:
+            new_types = {it.name for it in out}
+            new_offs = {(it.name, o.zone, o.capacity_type)
+                        for it in out for o in it.offerings}
+            for it in cached[1]:
+                if it.name not in new_types:
+                    metrics.INSTANCE_TYPE_CPU.remove(instance_type=it.name)
+                    metrics.INSTANCE_TYPE_MEMORY.remove(instance_type=it.name)
+                for o in it.offerings:
+                    if (it.name, o.zone, o.capacity_type) not in new_offs:
+                        labels = dict(instance_type=it.name, zone=o.zone,
+                                      capacity_type=o.capacity_type)
+                        metrics.INSTANCE_TYPE_OFFERING_PRICE.remove(**labels)
+                        metrics.INSTANCE_TYPE_OFFERING_AVAILABLE.remove(
+                            **labels)
         self._cache.set(node_class.name, (key, out))
         return out
 

@@ -85,6 +85,14 @@ class Gauge(Counter):
         with self._lock:
             self._values[self._key(labels)] = value
 
+    def remove(self, **labels) -> None:
+        """Drop one series — catalog gauges delete series for vanished
+        types/offerings on rebuild (the reference deletes per-type series
+        the same way), or a removed offering keeps reporting stale
+        values forever."""
+        with self._lock:
+            self._values.pop(self._key(labels), None)
+
 
 class Histogram(_Metric):
     kind = "histogram"
@@ -263,6 +271,22 @@ SOLVER_SOLVES = _c(
 SOLVER_RESIDUE_PODS = _c(
     "karpenter_tpu_solver_residue_pods_total",
     "Pods solved host-side as split-solve residue.")
+# per-instance-type catalog gauges (reference:
+# pkg/providers/instancetype/instancetype.go:156-161,302-311 + metrics.go)
+INSTANCE_TYPE_CPU = _g(
+    "karpenter_cloudprovider_instance_type_cpu_cores",
+    "vCPUs per instance type.", ("instance_type",))
+INSTANCE_TYPE_MEMORY = _g(
+    "karpenter_cloudprovider_instance_type_memory_bytes",
+    "Memory per instance type.", ("instance_type",))
+INSTANCE_TYPE_OFFERING_PRICE = _g(
+    "karpenter_cloudprovider_instance_type_offering_price_estimate",
+    "Last known price per offering.",
+    ("instance_type", "zone", "capacity_type"))
+INSTANCE_TYPE_OFFERING_AVAILABLE = _g(
+    "karpenter_cloudprovider_instance_type_offering_available",
+    "Offering availability (0 = ICE-blocked).",
+    ("instance_type", "zone", "capacity_type"))
 
 
 class DecoratedCloudProvider:

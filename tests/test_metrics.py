@@ -248,3 +248,55 @@ class TestErrorsTaxonomy:
         assert errors.is_retryable(CloudAPIError("cloud unreachable"))
         assert not errors.is_retryable(LaunchTemplateNotFound("lt"))
         assert not errors.is_retryable(RuntimeError("bug"))
+
+
+class TestInstanceTypeGauges:
+    def test_catalog_gauges_exported(self):
+        """Per-type cpu/memory/offering gauges (reference
+        instancetype.go:156-161,302-311 + metrics.md)."""
+        from karpenter_tpu.env import Environment
+        from karpenter_tpu.utils import metrics
+        env = Environment()
+        env.add_default_nodeclass()
+        nc = env.cluster.nodeclasses.list()[0]
+        types = env.instance_types.list(nc)
+        assert types
+        text = metrics.REGISTRY.render()
+        assert "karpenter_cloudprovider_instance_type_cpu_cores{" in text
+        assert "karpenter_cloudprovider_instance_type_memory_bytes{" in text
+        assert ("karpenter_cloudprovider_instance_type_offering_price_estimate{"
+                in text)
+        it = types[0]
+        o = it.offerings[0]
+        # declared label order: (instance_type, zone, capacity_type)
+        line = (f'karpenter_cloudprovider_instance_type_offering_available{{'
+                f'instance_type="{it.name}",zone="{o.zone}",'
+                f'capacity_type="{o.capacity_type}"}} '
+                f'{1.0 if o.available else 0.0}')
+        assert line in text, line
+
+    def test_stale_offering_series_removed_on_rebuild(self):
+        """Series for vanished offerings are deleted, not left reporting
+        their last value (the reference deletes per-type series on
+        update)."""
+        from karpenter_tpu.env import Environment
+        from karpenter_tpu.utils import metrics
+        metrics.REGISTRY.reset()
+        env = Environment()
+        env.add_default_nodeclass()
+        nc = env.cluster.nodeclasses.list()[0]
+        types = env.instance_types.list(nc)
+        zones = sorted({o.zone for it in types for o in it.offerings})
+        assert len(zones) > 1
+        keep = zones[0]
+        nc.zones = [keep]  # static_hash changes → rebuild drops other zones
+        env.instance_types.list(nc)
+        text = metrics.REGISTRY.render()
+        offering_lines = [
+            ln for ln in text.splitlines()
+            if ln.startswith("karpenter_cloudprovider_instance_type_offering")
+            and "{" in ln]
+        assert offering_lines
+        for dropped in zones[1:]:
+            assert not any(f'zone="{dropped}"' in ln
+                           for ln in offering_lines), dropped
