@@ -129,10 +129,12 @@ class NodeClassStatus:
 class NodeClassTermination:
     name = "nodeclass-termination"
 
-    def __init__(self, cluster: Cluster, launch_templates, instance_profiles):
+    def __init__(self, cluster: Cluster, launch_templates, instance_profiles,
+                 instance_types=None):
         self.cluster = cluster
         self.launch_templates = launch_templates
         self.instance_profiles = instance_profiles
+        self.instance_types = instance_types
 
     def reconcile(self) -> None:
         for nc in self.cluster.nodeclasses.list():
@@ -149,6 +151,10 @@ class NodeClassTermination:
                 continue
             self.launch_templates.delete_all(nc)
             self.instance_profiles.delete(nc)
+            if self.instance_types is not None:
+                # drop the view's catalog gauge series (series another
+                # nodeclass still exports survive)
+                self.instance_types.forget(nc.name)
             self.cluster.record_event("NodeClass", nc.name, "Terminated", "")
             self.cluster.nodeclasses.remove_finalizer(
                 nc.name, NODECLASS_FINALIZER)

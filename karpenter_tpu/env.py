@@ -117,7 +117,8 @@ class Environment:
             self.cluster, self.subnets, self.security_groups, self.images,
             self.instance_profiles)
         self.nodeclass_termination = NodeClassTermination(
-            self.cluster, self.launch_templates, self.instance_profiles)
+            self.cluster, self.launch_templates, self.instance_profiles,
+            instance_types=self.instance_types)
         self.tagging = NodeClaimTagging(
             self.cluster, self.cloud, cluster_name=cluster_name)
         self.pricing_refresh = PricingRefresh(self.pricing, clock=self.clock)

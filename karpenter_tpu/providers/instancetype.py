@@ -43,6 +43,12 @@ class InstanceTypeProvider:
         from karpenter_tpu.utils.logging import ChangeMonitor, get_logger
         self._log = get_logger("instancetype")
         self._changes = ChangeMonitor()
+        # gauge-series ownership per nodeclass VIEW, surviving cache
+        # flushes: removal must consider every nodeclass's last-listed
+        # catalog, or one nodeclass's narrowed view would delete series
+        # another still exports — and TTL expiry/invalidate() would skip
+        # removal entirely (the cache entry is gone by then)
+        self._exported: dict = {}   # name → (types set, offering-key set)
 
     def _cache_key(self, node_class: NodeClass) -> tuple:
         return (
@@ -128,23 +134,39 @@ class InstanceTypeProvider:
                 metrics.INSTANCE_TYPE_OFFERING_AVAILABLE.set(
                     1.0 if o.available else 0.0, instance_type=it.name,
                     zone=o.zone, capacity_type=o.capacity_type)
-        if cached is not None:
-            new_types = {it.name for it in out}
-            new_offs = {(it.name, o.zone, o.capacity_type)
-                        for it in out for o in it.offerings}
-            for it in cached[1]:
-                if it.name not in new_types:
-                    metrics.INSTANCE_TYPE_CPU.remove(instance_type=it.name)
-                    metrics.INSTANCE_TYPE_MEMORY.remove(instance_type=it.name)
-                for o in it.offerings:
-                    if (it.name, o.zone, o.capacity_type) not in new_offs:
-                        labels = dict(instance_type=it.name, zone=o.zone,
-                                      capacity_type=o.capacity_type)
-                        metrics.INSTANCE_TYPE_OFFERING_PRICE.remove(**labels)
-                        metrics.INSTANCE_TYPE_OFFERING_AVAILABLE.remove(
-                            **labels)
+        new_types = {it.name for it in out}
+        new_offs = {(it.name, o.zone, o.capacity_type)
+                    for it in out for o in it.offerings}
+        prev = self._exported.get(node_class.name, (set(), set()))
+        self._exported[node_class.name] = (new_types, new_offs)
+        self._remove_unclaimed(prev[0] - new_types, prev[1] - new_offs)
         self._cache.set(node_class.name, (key, out))
         return out
+
+    def _remove_unclaimed(self, stale_types, stale_offs) -> None:
+        """Delete gauge series no nodeclass's last-listed view exports
+        anymore (removal keyed on the union, not one view)."""
+        if not stale_types and not stale_offs:
+            return
+        from karpenter_tpu.utils import metrics
+        live_types = set().union(
+            *(t for t, _ in self._exported.values()), set())
+        live_offs = set().union(
+            *(o for _, o in self._exported.values()), set())
+        for name in stale_types - live_types:
+            metrics.INSTANCE_TYPE_CPU.remove(instance_type=name)
+            metrics.INSTANCE_TYPE_MEMORY.remove(instance_type=name)
+        for (name, zone, ct) in stale_offs - live_offs:
+            labels = dict(instance_type=name, zone=zone, capacity_type=ct)
+            metrics.INSTANCE_TYPE_OFFERING_PRICE.remove(**labels)
+            metrics.INSTANCE_TYPE_OFFERING_AVAILABLE.remove(**labels)
+
+    def forget(self, node_class_name: str) -> None:
+        """A NodeClass is gone: drop its view and delete the series only
+        it exported (called from the nodeclass termination flow)."""
+        ent = self._exported.pop(node_class_name, None)
+        if ent is not None:
+            self._remove_unclaimed(*ent)
 
     def invalidate(self) -> None:
         """Drop cached lists so the next call re-pulls the catalog (the

@@ -300,3 +300,40 @@ class TestInstanceTypeGauges:
         for dropped in zones[1:]:
             assert not any(f'zone="{dropped}"' in ln
                            for ln in offering_lines), dropped
+
+    def test_series_ownership_across_views_and_invalidation(self):
+        """Removal keys on the UNION of nodeclass views: a narrowed view
+        must not delete series another nodeclass still exports, removal
+        must survive invalidate()/TTL expiry (the exported-series ledger
+        outlives the list cache), and a terminated nodeclass's exclusive
+        series go away via forget()."""
+        from karpenter_tpu.env import Environment
+        from karpenter_tpu.models.objects import NodeClass, ObjectMeta
+        from karpenter_tpu.utils import metrics
+        metrics.REGISTRY.reset()
+        env = Environment()
+        env.add_default_nodeclass()
+        a = env.cluster.nodeclasses.list()[0]
+        types = env.instance_types.list(a)
+        zones = sorted({o.zone for it in types for o in it.offerings})
+        z1, z2 = zones[0], zones[1]
+        b = NodeClass(meta=ObjectMeta(name="narrow"), zones=[z1])
+        env.cluster.nodeclasses.create(b)
+        env.instance_types.list(b)
+
+        # narrow A to z2 THROUGH an invalidation (the ledger, not the
+        # list cache, must drive removal)
+        a.zones = [z2]
+        env.instance_types.invalidate()
+        env.instance_types.list(a)
+        text = metrics.REGISTRY.render()
+        # z1 survives: B still exports it
+        assert f'zone="{z1}"' in text
+        for dropped in zones[2:]:
+            assert f'zone="{dropped}"' not in text, dropped
+
+        # B goes away entirely: its exclusive z1 series follow
+        env.instance_types.forget(b.name)
+        text = metrics.REGISTRY.render()
+        assert f'zone="{z1}"' not in text
+        assert f'zone="{z2}"' in text  # A's view unaffected

@@ -136,6 +136,42 @@ class TestProvisioningScale:
         assert secs < 300
 
 
+class TestSolverScale:
+    def test_100k_pods_double_north_star(self):
+        """2x the north-star problem size through the raw solver seam:
+        no silent capacity cliffs, overflows, or conservation holes past
+        the benchmarked 50k scale."""
+        from karpenter_tpu.models import NodePool, ObjectMeta, Pod, Resources
+        from karpenter_tpu.providers import generate_catalog
+        from karpenter_tpu.scheduling import ScheduleInput
+        from karpenter_tpu.solver import TPUSolver
+        catalog = generate_catalog()
+        sizes = [{"cpu": "250m", "memory": "512Mi"},
+                 {"cpu": "1", "memory": "2Gi"},
+                 {"cpu": "2", "memory": "8Gi"},
+                 {"cpu": "4", "memory": "8Gi"}]
+        pods = [Pod(meta=ObjectMeta(name=f"x{i}"),
+                    requests=Resources.parse(sizes[i % len(sizes)]))
+                for i in range(100_000)]
+        inp = ScheduleInput(
+            pods=pods, nodepools=[NodePool(meta=ObjectMeta(name="default"))],
+            instance_types={"default": catalog})
+        solver = TPUSolver(max_nodes=4096)
+        t0 = time.perf_counter()
+        res = solver.solve(inp)
+        secs = time.perf_counter() - t0
+        assert not res.unschedulable
+        placed = sum(len(c.pods) for c in res.new_claims)
+        assert placed == 100_000
+        names = set()
+        for c in res.new_claims:
+            for p in c.pods:
+                names.add(p.meta.name)
+        assert len(names) == 100_000  # each pod exactly once
+        print(f"100k pods -> {res.node_count()} nodes in {secs:.1f}s "
+              f"(incl. compile)", file=sys.stderr)
+
+
 class TestConsolidationScale:
     def test_200_node_consolidation(self):
         """An under-utilized 200-node fleet consolidates down."""
