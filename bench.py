@@ -75,8 +75,8 @@ def build_input(n_pods: int):
 def oracle_nodes(inp, budget_s: float):
     """FFD-oracle node count for the same problem, bounded by a wall-clock
     budget (the per-pod Python oracle is the reference semantics, not a
-    fast path).  Returns (nodes, unsched, seconds) or (None, None, None)
-    on timeout."""
+    fast path).  Returns (nodes, unsched, seconds, price) — all None on
+    timeout."""
     from karpenter_tpu.scheduling import Scheduler
     out = {}
 
@@ -86,11 +86,13 @@ def oracle_nodes(inp, budget_s: float):
         out["nodes"] = res.node_count()
         out["unsched"] = len(res.unschedulable)
         out["secs"] = round(time.perf_counter() - t0, 1)
+        out["price"] = res.total_price()  # unrounded: parity compares exact
 
     t = threading.Thread(target=run, daemon=True)
     t.start()
     t.join(budget_s)
-    return (out.get("nodes"), out.get("unsched"), out.get("secs"))
+    return (out.get("nodes"), out.get("unsched"), out.get("secs"),
+            out.get("price"))
 
 
 def first_solve_with_retry(solver, inp, platform: str,
@@ -300,14 +302,15 @@ def main() -> None:
 
     sub = build_input(5_000)
     sub_res = solver.solve(sub)
-    onodes_5k, ounsched_5k, _ = oracle_nodes(sub, budget_s=180.0)
+    onodes_5k, ounsched_5k, _, oprice_5k = oracle_nodes(sub, budget_s=180.0)
 
     # 50k node-count bound LAST: measured against the real oracle with a
     # generous one-off budget (VERDICT r2 #3) — ordered after every timed
     # measurement so a timed-out oracle daemon thread can't keep a core
     # busy under them (the process exits right after printing)
     budget_50k = float(os.environ.get("KARPENTER_TPU_ORACLE_BUDGET", "900"))
-    onodes_50k, ounsched_50k, osecs_50k = oracle_nodes(inp, budget_50k)
+    onodes_50k, ounsched_50k, osecs_50k, oprice_50k = oracle_nodes(
+        inp, budget_50k)
 
     result = {
         "metric": "schedule 50k pods x 700 instance types (end-to-end, 1 chip)",
@@ -323,6 +326,11 @@ def main() -> None:
         "oracle_nodes_50k": onodes_50k,
         "oracle_unsched_50k": ounsched_50k,
         "oracle_secs_50k": osecs_50k,
+        "price_50k": round(res.total_price(), 2),
+        "oracle_price_50k": (None if oprice_50k is None
+                             else round(oprice_50k, 2)),
+        "price_le_oracle_50k": (None if oprice_50k is None
+                                else res.total_price() <= oprice_50k + 1e-6),
         "nodes_le_oracle_50k": (None if onodes_50k is None
                                 else res.node_count() <= onodes_50k),
         "solver_nodes_5k": sub_res.node_count(),
