@@ -45,7 +45,7 @@ from karpenter_tpu.operator.options import Options
 from karpenter_tpu.scheduling import ScheduleResult
 from karpenter_tpu.scheduling.types import ScheduleInput
 from karpenter_tpu.solver.solve import B_BUCKETS as SOLVER_B_BUCKETS
-from karpenter_tpu.utils import errors, metrics
+from karpenter_tpu.utils import cron, errors, metrics
 from karpenter_tpu.utils.clock import Clock
 
 SPOT_TO_SPOT_MIN_TYPES = 15  # disruption.md:123-132
@@ -271,6 +271,17 @@ class Disruption:
         for budget in pool.disruption.budgets:
             if budget.reasons is not None and reason not in budget.reasons:
                 continue
+            # cron-windowed budgets only bind while their window is open
+            # (schedule fires in UTC; active for `duration` seconds). An
+            # unparseable schedule fails SAFE: the budget binds — a typo
+            # must neither drop a configured freeze nor kill the operator
+            try:
+                if not cron.in_window(budget.schedule, budget.duration,
+                                      self.clock.now()):
+                    continue
+            except cron.CronError as e:
+                self.cluster.record_event(
+                    "NodePool", pool.name, "InvalidBudgetSchedule", str(e))
             a = budget.allowed_disruptions(total)
             allowed = a if allowed is None else min(allowed, a)
         if allowed is None:

@@ -59,27 +59,31 @@ class TestEmptiness:
             c.name for c in claims}
 
 
+def two_underutilized_nodes(env):
+    """Build two nodes whose remaining pods jointly fit on one cheaper
+    machine. Anchors are sized to fill their node so nothing else fits
+    (16-vCPU shapes keep ~15.9 cores after kube-reserved); deleting them
+    leaves two nearly-empty nodes each holding one small pod."""
+    env.cluster.pods.create(mkpod("anchor-1", cpu="15", mem="20Gi"))
+    env.cluster.pods.create(mkpod("small-1", cpu="700m", mem="512Mi"))
+    env.settle()
+    env.cluster.pods.create(mkpod("anchor-2", cpu="15", mem="20Gi"))
+    env.cluster.pods.create(mkpod("small-2", cpu="700m", mem="512Mi"))
+    env.settle()
+    assert len(env.cluster.nodeclaims.list()) == 2
+    smalls = {env.cluster.pods.get("small-1").node_name,
+              env.cluster.pods.get("small-2").node_name}
+    assert len(smalls) == 2  # one small per node
+    # anchors scale away: both nodes now nearly empty
+    for name in ("anchor-1", "anchor-2"):
+        p = env.cluster.pods.get(name)
+        p.node_name = None
+        env.cluster.pods.delete(name)
+
+
 class TestConsolidation:
     def _two_underutilized_nodes(self, env):
-        """Build two nodes whose remaining pods jointly fit on one cheaper
-        machine. Anchors are sized to fill their node so nothing else fits
-        (16-vCPU shapes keep ~15.9 cores after kube-reserved); deleting them
-        leaves two nearly-empty nodes each holding one small pod."""
-        env.cluster.pods.create(mkpod("anchor-1", cpu="15", mem="20Gi"))
-        env.cluster.pods.create(mkpod("small-1", cpu="700m", mem="512Mi"))
-        env.settle()
-        env.cluster.pods.create(mkpod("anchor-2", cpu="15", mem="20Gi"))
-        env.cluster.pods.create(mkpod("small-2", cpu="700m", mem="512Mi"))
-        env.settle()
-        assert len(env.cluster.nodeclaims.list()) == 2
-        smalls = {env.cluster.pods.get("small-1").node_name,
-                  env.cluster.pods.get("small-2").node_name}
-        assert len(smalls) == 2  # one small per node
-        # anchors scale away: both nodes now nearly empty
-        for name in ("anchor-1", "anchor-2"):
-            p = env.cluster.pods.get(name)
-            p.node_name = None
-            env.cluster.pods.delete(name)
+        two_underutilized_nodes(env)
 
     def test_multi_or_single_node_consolidation(self, env):
         self._two_underutilized_nodes(env)
@@ -226,3 +230,59 @@ class TestReviewRegressions:
         assert {p.node_name for p in pods} == {claims[0].node_name}
         # only 3 instances were ever launched (2 originals + 1 replacement)
         assert len(env.cloud.instances) == 3
+
+
+class TestScheduledBudgets:
+    """Cron-windowed budgets (karpenter.sh_nodepools.yaml budget
+    schedule+duration): a zero-budget only binds while its window is
+    open. The fake clock's epoch 0 is 1970-01-01 00:00 UTC (a Thursday),
+    so "0 0 * * *" fires at t=0 and every 86400s."""
+
+    def test_window_blocks_then_releases(self, env):
+        pool = env.cluster.nodepools.get("default")
+        # hourly zero-budget open for 30 minutes
+        pool.disruption.budgets = [Budget(
+            nodes="0", schedule="0 * * * *", duration=1800.0)]
+        two_underutilized_nodes(env)
+        # step to just after the next hourly fire: window open, budget binds
+        now = env.clock.now()
+        env.clock.step(3600.0 - (now % 3600.0) + 60.0)
+        env.settle()
+        assert len(env.cluster.nodeclaims.list()) == 2  # frozen
+        # past the 30-minute window: the zero budget no longer applies
+        env.clock.step(1800.0)
+        env.settle()
+        assert len(env.cluster.nodeclaims.list()) == 1  # consolidated
+
+    def test_cron_primitives(self):
+        from karpenter_tpu.utils.cron import in_window, last_fire, parse
+        # epoch 0 = Thu 1970-01-01 00:00 UTC
+        assert last_fire("0 0 * * *", 0.0) == 0.0
+        assert last_fire("0 0 * * *", 86399.0) == 0.0
+        assert last_fire("0 0 * * *", 86400.0) == 86400.0
+        # every 15 min
+        assert last_fire("*/15 * * * *", 16 * 60.0) == 15 * 60.0
+        # Thursday-only (cron dow 4) matches epoch day; Friday schedule
+        # first fires a day later
+        assert last_fire("0 0 * * 4", 3600.0) == 0.0
+        assert last_fire("0 0 * * 5", 3600.0) is None or \
+            last_fire("0 0 * * 5", 3600.0) < 0
+        assert in_window(None, None, 123.0)
+        assert in_window("0 0 * * *", 3600.0, 1800.0)
+        assert not in_window("0 0 * * *", 3600.0, 7200.0)
+        import pytest as _pytest
+        from karpenter_tpu.utils.cron import CronError
+        with _pytest.raises(CronError):
+            parse("not a cron")
+
+    def test_invalid_schedule_fails_safe(self, env):
+        """A typo'd schedule must BIND the budget (never drop a freeze)
+        and must not kill the operator loop."""
+        pool = env.cluster.nodepools.get("default")
+        pool.disruption.budgets = [Budget(
+            nodes="0", schedule="not a cron", duration=60.0)]
+        two_underutilized_nodes(env)
+        env.settle()  # must not raise
+        assert len(env.cluster.nodeclaims.list()) == 2  # frozen
+        reasons = {r for _, _, _, r, _ in env.cluster.events}
+        assert "InvalidBudgetSchedule" in reasons
