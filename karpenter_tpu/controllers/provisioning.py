@@ -153,6 +153,8 @@ def create_claim_from_spec(cluster: Cluster, cp: TPUCloudProvider,
         taints=list(spec.taints),
         startup_taints=list(spec.startup_taints),
         instance_type_options=list(spec.instance_type_names),
+        termination_grace_period=(
+            pool.termination_grace_period if pool else None),
     )
     cluster.nodeclaims.create(claim)
     return claim

@@ -42,7 +42,12 @@ class Termination:
                        for t in node.taints):
                 node.taints.append(DISRUPTED_TAINT)
                 self.cluster.nodes.update(node)
-            remaining = self._drain(node.name)
+            # terminationGracePeriod (NodePool template): once a deleting
+            # claim has waited this long, the drain stops honoring PDBs —
+            # the bounded-drain contract; pods are force-evicted and the
+            # instance released (reference: NodeClaim terminationGracePeriod)
+            force = self._grace_expired(claim)
+            remaining = self._drain(node.name, force=force)
             if remaining > 0:
                 return  # PDBs throttle the drain; retry next round
         # drained (or node never joined): release the instance + objects.
@@ -66,14 +71,35 @@ class Termination:
         self.cluster.record_event(
             "NodeClaim", claim.name, "Terminated", "instance released")
 
-    def _drain(self, node_name: str) -> int:
-        """Evict what the budgets allow; returns count of pods still to
-        evict (excluding daemonsets)."""
+    def _grace_expired(self, claim: NodeClaim) -> bool:
+        # stamped on the claim at creation; live-pool fallback covers
+        # claims created before the field existed. Claims whose pool was
+        # deleted (the gc owner cascade) keep their stamped grace.
+        grace = claim.termination_grace_period
+        if grace is None:
+            pool = self.cluster.nodepools.get(claim.nodepool)
+            grace = (pool.termination_grace_period
+                     if pool is not None else None)
+        if grace is None or claim.meta.deletion_time is None:
+            return False
+        expired = (self.cluster.clock.now() - claim.meta.deletion_time
+                   >= grace)
+        if expired:
+            self.cluster.record_event(
+                "NodeClaim", claim.name, "TerminationGraceElapsed",
+                f"draining past terminationGracePeriod={grace}s; "
+                "eviction no longer waits for PDBs")
+        return expired
+
+    def _drain(self, node_name: str, force: bool = False) -> int:
+        """Evict what the budgets allow (everything evictable when
+        `force` — grace elapsed); returns count of pods still to evict
+        (excluding daemonsets)."""
         remaining = 0
         for pod in self.cluster.pods_on_node(node_name):
             if pod.is_daemonset:
                 continue
-            if not self.cluster.can_evict(pod):
+            if not force and not self.cluster.can_evict(pod):
                 remaining += 1
                 continue
             pod.node_name = None

@@ -405,6 +405,11 @@ class NodeClaim:
     # ranked candidate instance types (cheapest-first), as the reference's
     # NodeClaim carries instance-type requirements ranked by price
     instance_type_options: List[str] = field(default_factory=list)
+    # max drain time before PDBs stop being honored, stamped from the
+    # NodePool template at creation (reference: NodeClaim
+    # spec.terminationGracePeriod) — read from the CLAIM, not the live
+    # pool, so claims orphaned by pool deletion still force-drain
+    termination_grace_period: Optional[float] = None
     # status
     provider_id: Optional[str] = None
     node_name: Optional[str] = None

@@ -82,11 +82,8 @@ def two_underutilized_nodes(env):
 
 
 class TestConsolidation:
-    def _two_underutilized_nodes(self, env):
-        two_underutilized_nodes(env)
-
     def test_multi_or_single_node_consolidation(self, env):
-        self._two_underutilized_nodes(env)
+        two_underutilized_nodes(env)
         env.settle()
         # the two smalls end up on ONE (cheaper) node
         claims = env.cluster.nodeclaims.list()
@@ -109,7 +106,7 @@ class TestConsolidation:
         assert len(env.cluster.nodeclaims.list()) == 1
 
     def test_do_not_disrupt_blocks(self, env):
-        self._two_underutilized_nodes(env)
+        two_underutilized_nodes(env)
         for p in env.cluster.pods.list():
             p.meta.annotations[wellknown.DO_NOT_DISRUPT_ANNOTATION] = "true"
         env.settle()
@@ -118,14 +115,14 @@ class TestConsolidation:
     def test_do_not_disrupt_on_node_blocks(self, env):
         """The annotation blocks at the node level too, not just per pod
         (reference: karpenter.sh/do-not-disrupt on the node)."""
-        self._two_underutilized_nodes(env)
+        two_underutilized_nodes(env)
         for n in env.cluster.nodes.list():
             n.meta.annotations[wellknown.DO_NOT_DISRUPT_ANNOTATION] = "true"
         env.settle()
         assert len(env.cluster.nodeclaims.list()) == 2  # untouched
 
     def test_do_not_disrupt_on_claim_blocks(self, env):
-        self._two_underutilized_nodes(env)
+        two_underutilized_nodes(env)
         for c in env.cluster.nodeclaims.list():
             c.meta.annotations[wellknown.DO_NOT_DISRUPT_ANNOTATION] = "true"
         env.settle()
@@ -134,14 +131,14 @@ class TestConsolidation:
     def test_zero_budget_blocks(self, env):
         pool = env.cluster.nodepools.get("default")
         pool.disruption.budgets = [Budget(nodes="0")]
-        self._two_underutilized_nodes(env)
+        two_underutilized_nodes(env)
         env.settle()
         assert len(env.cluster.nodeclaims.list()) == 2
 
     def test_consolidate_after_delays(self, env):
         pool = env.cluster.nodepools.get("default")
         pool.disruption.consolidate_after = 300.0
-        self._two_underutilized_nodes(env)
+        two_underutilized_nodes(env)
         env.settle()
         assert len(env.cluster.nodeclaims.list()) == 2  # too young
         env.clock.step(301)
@@ -207,7 +204,7 @@ class TestReviewRegressions:
         """A budget of 1 must not let one multi-node command take 2 nodes."""
         pool = env.cluster.nodepools.get("default")
         pool.disruption.budgets = [Budget(nodes="1")]
-        TestConsolidation()._two_underutilized_nodes(env)
+        two_underutilized_nodes(env)
         env.manager.run_once()
         cmds = env.disruption.commands
         for cmd in cmds:
@@ -220,7 +217,7 @@ class TestReviewRegressions:
         """A 100% budget must not let emptiness eat a fresh replacement."""
         pool = env.cluster.nodepools.get("default")
         pool.disruption.budgets = [Budget(nodes="100%")]
-        TestConsolidation()._two_underutilized_nodes(env)
+        two_underutilized_nodes(env)
         env.settle()
         claims = env.cluster.nodeclaims.list()
         assert len(claims) == 1

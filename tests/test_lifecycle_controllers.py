@@ -67,6 +67,32 @@ class TestTermination:
         env.settle()
         assert env.cluster.nodeclaims.get(claim.name) is None
 
+    def test_termination_grace_overrides_pdb(self, env):
+        """NodePool terminationGracePeriod bounds the drain: past it, a
+        PDB can no longer hold the node hostage (reference: NodeClaim
+        terminationGracePeriod force-drains at expiry)."""
+        pool = env.cluster.nodepools.get("default")
+        pool.termination_grace_period = 600.0
+        for i in range(3):
+            env.cluster.pods.create(mkpod(f"g{i}", labels={"app": "held"}))
+        env.settle()
+        env.cluster.pdbs.create(PodDisruptionBudget(
+            meta=ObjectMeta(name="pdb0"), selector={"app": "held"},
+            max_unavailable=0))
+        claim = env.cluster.nodeclaims.list()[0]
+        env.cluster.nodeclaims.delete(claim.name)
+        env.settle()
+        held = env.cluster.nodeclaims.get(claim.name)
+        assert held is not None and held.meta.deleting  # PDB blocks
+        env.clock.step(601.0)
+        env.settle()
+        # grace elapsed: force-drained and released despite the PDB
+        assert env.cluster.nodeclaims.get(claim.name) is None
+        reasons = {r for _, _, _, r, _ in env.cluster.events}
+        assert "TerminationGraceElapsed" in reasons
+        # pods rescheduled elsewhere
+        assert all(p.scheduled for p in env.cluster.pods.list())
+
 
 class TestInterruption:
     def test_spot_interruption_drains_and_marks_unavailable(self, env):
