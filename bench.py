@@ -154,7 +154,7 @@ def run_configs(timeout_s: float):
     out = []
     configs = ["config1_inflate.py", "config2_mixed.py",
                "config3_topology.py", "config4_consolidation.py",
-               "config5_burst.py"]
+               "config5_burst.py", "config6_interruption.py"]
     env = dict(os.environ)
     # configs share the persistent compile cache (platform bootstrap), so
     # a generous per-probe budget isn't needed — keep failures quick so
@@ -269,7 +269,8 @@ def main() -> None:
     # every config already fell back: probe briefly (the chip may have
     # recovered) instead of re-spending the full multi-minute budget
     platform = initialize(kill_holders=True,
-                          probe_timeout_s=60.0 if all_cpu else None)
+                          probe_timeout_s=60.0 if all_cpu else None,
+                          attempt_log=log_attempt)
     print(f"platform={platform}", file=sys.stderr, flush=True)
     log_attempt({"stage": "init", "platform": platform, "ts": time.time()})
 

@@ -21,7 +21,13 @@ def run(metric: str, target_ms: float, make_input, solve=None, repeats: int = 5,
     # JAX_PLATFORMS/KARPENTER_TPU_PLATFORM (CPU smoke), else site default
     # (TPU) with UNAVAILABLE retry + CPU fallback — never die with rc=1
     from karpenter_tpu.utils.platform import initialize
-    platform = initialize()
+
+    # failed-probe evidence lands in the repo-root attempts log even when
+    # the parent bench only captures this config's stdout JSON (VERDICT
+    # r3 #1: record the actual probe error, not just the fallback); one
+    # writer shared with the headline bench
+    from bench import log_attempt
+    platform = initialize(attempt_log=log_attempt)
     from karpenter_tpu.solver import TPUSolver
 
     inp = make_input()
@@ -43,6 +49,8 @@ def run(metric: str, target_ms: float, make_input, solve=None, repeats: int = 5,
     }
     if extra:
         line.update(extra(res))
+    if "per_sim" in solver.last_phase_ms:
+        line["per_sim_ms"] = round(solver.last_phase_ms["per_sim"], 3)
     print(json.dumps(line))
     phases = {k: round(v, 1) for k, v in solver.last_phase_ms.items()}
     print(f"runs={[round(t) for t in times]} phases_ms={phases}",

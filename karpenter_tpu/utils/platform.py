@@ -113,11 +113,40 @@ def configure(platform: Optional[str] = None) -> Optional[str]:
     return want
 
 
+def listening_ports() -> Optional[list]:
+    """TCP ports in LISTEN state, for probe-failure evidence: the axon
+    device tunnel's claim leg dials a loopback relay (sitecustomize:
+    AXON_POOL_SVC_OVERRIDE=127.0.0.1), so the listener set distinguishes
+    'relay absent from this VM' (observed in round 4: only the VM control
+    API on :2024 was listening while jax.devices() hung forever in the
+    claim retry loop) from 'chip busy/held'. None = no /proc/net."""
+    ports = set()
+    seen_any = False
+    for path in ("/proc/net/tcp", "/proc/net/tcp6"):
+        try:
+            with open(path) as f:
+                lines = f.readlines()[1:]
+        except OSError:
+            continue
+        seen_any = True
+        for line in lines:
+            parts = line.split()
+            if len(parts) > 3 and parts[3] == "0A":  # LISTEN
+                try:
+                    ports.add(int(parts[1].split(":")[1], 16))
+                except (IndexError, ValueError):
+                    continue
+    return sorted(ports) if seen_any else None
+
+
 def _probe_subprocess(platform: Optional[str], timeout_s: float,
-                      log) -> bool:
+                      log, attempt_log=None) -> bool:
     """Initialize the backend in a THROWAWAY subprocess with a hard kill
     timeout — the only way to survive an init that hangs rather than
-    raises.  Returns True if the device came up."""
+    raises.  Returns True if the device came up.  Failure evidence (rc,
+    stderr tail, hang-vs-error, relay reachability) goes through
+    ``attempt_log`` so artifacts record the ACTUAL probe error, not just
+    the eventual fallback (VERDICT r3 #1)."""
     env = dict(os.environ)
     if platform:
         env["JAX_PLATFORMS"] = platform
@@ -132,19 +161,36 @@ def _probe_subprocess(platform: Optional[str], timeout_s: float,
     )
     env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))) + os.pathsep + env.get("PYTHONPATH", ""))
+    rec = {"stage": "probe", "want": platform or "<site-default>",
+           "listening_ports": listening_ports(), "ts": time.time()}
     try:
         proc = subprocess.run([sys.executable, "-c", code], env=env,
                               capture_output=True, text=True,
                               timeout=timeout_s)
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        # a hang (vs an error) is the signature of the claim leg spinning
+        # against a dead/absent relay: the axon client retries the
+        # /v1/claim dial forever instead of raising
+        err = (e.stderr or b"")
+        if isinstance(err, bytes):
+            err = err.decode(errors="replace")
+        rec.update(outcome="hang", timeout_s=timeout_s,
+                   stderr_tail=err.strip()[-400:])
         log(f"[platform] probe hung past {timeout_s:.0f}s (backend init "
-            "wedged — device held elsewhere?)")
+            "wedged — relay down or device held elsewhere?); "
+            f"listening_ports={rec['listening_ports']}")
+        if attempt_log:
+            attempt_log(rec)
         return False
     if proc.returncode == 0 and "PROBE-OK" in proc.stdout:
         return True
-    tail = (proc.stderr or proc.stdout).strip().splitlines()
+    tail = (proc.stderr or proc.stdout).strip()
+    rec.update(outcome="error", rc=proc.returncode,
+               stderr_tail=tail[-400:])
     log(f"[platform] probe failed rc={proc.returncode}: "
-        f"{tail[-1][:200] if tail else '<no output>'}")
+        f"{tail.splitlines()[-1][:200] if tail else '<no output>'}")
+    if attempt_log:
+        attempt_log(rec)
     return False
 
 
@@ -177,7 +223,7 @@ def terminate_holder(pid: int, grace_s: float = 10.0, log=None) -> None:
 def initialize(platform: Optional[str] = None, retries: int = 3,
                backoff_s: float = 5.0, probe_timeout_s: Optional[float] = None,
                cpu_fallback: bool = True, kill_holders: bool = False,
-               log=None) -> str:
+               log=None, attempt_log=None) -> str:
     """Probe the requested (or site-default) backend out of process, then
     configure + initialize in process; returns the platform of the device
     actually obtained ("tpu", "cpu", ...).
@@ -201,7 +247,8 @@ def initialize(platform: Optional[str] = None, retries: int = 3,
 
     ok = False
     for attempt in range(max(1, retries)):
-        if _probe_subprocess(want, probe_timeout_s, log):
+        if _probe_subprocess(want, probe_timeout_s, log,
+                             attempt_log=attempt_log):
             ok = True
             break
         for pid, args in _other_device_holders():
