@@ -42,7 +42,10 @@ def _cluster():
 
 def make_input():
     """One simulation input per candidate: its pod against the rest of the
-    cluster, price-capped at the candidate's cost."""
+    cluster, price-capped at the candidate's cost. Carries the shared
+    snapshot + exclusion provenance exactly as build_schedule_input does
+    for the product's sweep (ScheduleInput.exist_base), which enables the
+    solver's leave-k-out device path."""
     nodes = _cluster()
     inps = []
     for i in range(N_CANDIDATES):
@@ -50,7 +53,8 @@ def make_input():
             pods=list(nodes[i].pods), nodepools=[POOL],
             instance_types={"default": SHARED},
             existing_nodes=nodes[:i] + nodes[i + 1:],
-            price_cap=0.5))
+            price_cap=0.5,
+            exist_base=nodes, exist_excluded=(i,)))
     return inps
 
 

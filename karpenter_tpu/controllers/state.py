@@ -180,9 +180,18 @@ def build_schedule_input(
     instance_types: Dict[str, List[InstanceType]] = {
         p.name: cp.get_instance_types(p.node_class_ref) for p in pools}
 
+    exist_base = None
+    exist_excluded = None
     if prebuilt_existing is not None:
         existing = [en for en in prebuilt_existing
                     if en.name not in exclude_nodes]
+        # leave-k-out provenance for the batched sweep: the solver encodes
+        # the shared snapshot once and expresses this input as exclusion
+        # indices on the device (ScheduleInput.exist_base contract)
+        exist_base = prebuilt_existing
+        exist_excluded = tuple(
+            i for i, en in enumerate(prebuilt_existing)
+            if en.name in exclude_nodes)
     else:
         existing = build_existing_nodes(cluster, exclude_nodes)
 
@@ -195,4 +204,6 @@ def build_schedule_input(
         remaining_limits={
             p.name: remaining_limit(cluster, p, exclude_claims) for p in pools},
         price_cap=price_cap,
+        exist_base=exist_base,
+        exist_excluded=exist_excluded,
     )

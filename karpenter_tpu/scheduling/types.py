@@ -6,7 +6,7 @@ solver so they are drop-in interchangeable behind the provisioner.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from karpenter_tpu.models.objects import InstanceType, Node, NodePool, Pod
 from karpenter_tpu.models.requirements import Requirements
@@ -109,6 +109,16 @@ class ScheduleInput:
     # field (not pre-filtered type lists) so the TPU solver can apply it as
     # a column mask without invalidating its cached catalog encoding.
     price_cap: Optional[float] = None
+    # leave-k-out provenance: when the builder derived `existing_nodes`
+    # from a shared snapshot list by dropping a few rows (the consolidation
+    # sweep — every simulation is 'the cluster minus this candidate'), it
+    # records the snapshot and the dropped row indices here. The batched
+    # solver then encodes the snapshot ONCE and expresses each simulation
+    # as an exclusion index on the device, instead of re-encoding ~N nodes
+    # per simulation (SURVEY §3.3 hot loop #2). Invariant (builder-owned):
+    # existing_nodes == [exist_base[i] for i not in exist_excluded].
+    exist_base: Optional[List[ExistingNode]] = None
+    exist_excluded: Optional[Tuple[int, ...]] = None
 
     def __post_init__(self):
         # PV zone pinning happens at the seam so BOTH engines (oracle and

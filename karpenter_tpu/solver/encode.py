@@ -255,6 +255,10 @@ class CatalogEncoding:
     zc: int = 1                  # grid stride (len of the zone×ct grid)
     pt_alloc: np.ndarray = None  # [PT, R] f32 (PT = O // zc)
     col_valid: np.ndarray = None # [O] bool
+    # real offerings / grid columns — how much of the column axis is
+    # masked-out inflation; layout is "grid" or "dense" (the fallback)
+    fill_factor: float = 1.0
+    layout: str = "grid"
     device_args: Optional[dict] = None  # device-resident padded arrays
 
 
@@ -273,6 +277,18 @@ def encode_catalog(inp: ScheduleInput) -> CatalogEncoding:
         (o.zone, o.capacity_type)
         for p in pools for it in inp.instance_types.get(p.name, [])
         for o in it.offerings})
+    # grid fill factor: the global (zone, ct) pair set replicates per
+    # (pool,type) block, so zone-disjoint pools / capacity-type-disjoint
+    # types inflate O with masked-out columns (ADVICE r3). When the grid
+    # would be mostly dead, fall back to a DENSE layout — one column per
+    # real offering, zc=1 — which keeps every downstream reshape valid
+    # (PT == O) at the cost of per-column instead of per-block fit math.
+    n_blocks = sum(len(inp.instance_types.get(p.name, [])) for p in pools)
+    n_real = sum(len(it.offerings)
+                 for p in pools for it in inp.instance_types.get(p.name, []))
+    grid_cols = n_blocks * max(len(zc_pairs), 1)
+    fill = (n_real / grid_cols) if grid_cols else 1.0
+    dense = grid_cols > 512 and fill < 0.5
     columns: List[Column] = []
     col_valid_list: List[bool] = []
     for pidx, pool in enumerate(pools):
@@ -283,7 +299,8 @@ def encode_catalog(inp: ScheduleInput) -> CatalogEncoding:
                     (base_labels[req.key],) = req.values()
             offmap = {(o.zone, o.capacity_type): o for o in it.offerings}
             alloc = it.allocatable()
-            for zone, ct in zc_pairs:
+            pairs = (sorted(offmap) if dense else zc_pairs)
+            for zone, ct in pairs:
                 o = offmap.get((zone, ct))
                 labels = dict(base_labels)
                 labels[wellknown.ZONE_LABEL] = zone
@@ -327,7 +344,7 @@ def encode_catalog(inp: ScheduleInput) -> CatalogEncoding:
         ct_ids.setdefault(c.capacity_type, len(ct_ids))
     col_zone = np.array([zone_ids[c.zone] for c in columns], dtype=np.int32)
     col_ct = np.array([ct_ids[c.capacity_type] for c in columns], dtype=np.int32)
-    zc = max(len(zc_pairs), 1)
+    zc = 1 if dense else max(len(zc_pairs), 1)
     pt_alloc = (col_alloc[::zc].copy() if O
                 else np.zeros((0, R), dtype=np.float32))
     col_valid = np.array(col_valid_list, dtype=bool)
@@ -340,6 +357,7 @@ def encode_catalog(inp: ScheduleInput) -> CatalogEncoding:
         pool_provides=pool_provides,
         zone_ids=zone_ids, ct_ids=ct_ids, col_zone=col_zone, col_ct=col_ct,
         zc=zc, pt_alloc=pt_alloc, col_valid=col_valid,
+        fill_factor=round(fill, 4), layout=("dense" if dense else "grid"),
     )
 
 
@@ -385,8 +403,14 @@ class SharedExistEncoding:
         self._frozen = False
 
     def add_input(self, inp: ScheduleInput) -> None:
+        self.add_nodes(inp.existing_nodes)
+
+    def add_nodes(self, existing: Sequence[ExistingNode]) -> None:
+        """Register wrappers directly — the sweep path seeds the cache
+        from the shared snapshot list (ScheduleInput.exist_base) instead
+        of per-input node sets, so union row i == snapshot row i."""
         assert not self._frozen
-        for en in inp.existing_nodes:
+        for en in existing:
             node = en.node
             if id(node) in self._index:
                 continue
@@ -500,6 +524,7 @@ class _TopologyEncoder:
         # registers the device placements before placing it, which
         # enforces the symmetry.
         self.split_mode = split_mode
+        self.dense_layout = cat.layout == "dense"
         # seeding the tracker walks every resident pod — skip it entirely
         # when no pending pod carries a constraint and no resident pod
         # carries required anti-affinity (the only way existing state can
@@ -716,6 +741,13 @@ class _TopologyEncoder:
         dsel = 0
         delig = np.zeros(self.D, dtype=bool)
         if dyn_key is not None:
+            if self.dense_layout:
+                # the kernel's heavy branch reads a column's domain from
+                # its slot index (ffd.py zc_dom = col_dom[:zc], valid only
+                # for the fixed-stride grid); the dense fallback breaks
+                # that invariant, so domain-spread groups go to the oracle
+                raise Unsupported(
+                    "domain spread on a dense catalog layout")
             dsel = 1 if dyn_key == wellknown.ZONE_LABEL else 2
             ids = self._dom_ids(dyn_key)
             for d in self.tracker.eligible_domains(rep, dyn_key):
@@ -731,6 +763,53 @@ class _TopologyEncoder:
         return dict(ncap=ncap, ecap=ecap, dsel=dsel, dbase=dbase, dcap=dcap,
                     skew=skew, mindom=mindom, delig=delig,
                     allowed=allowed, requires=requires)
+
+
+def group_column_mask(cat: "CatalogEncoding", rep: Pod):
+    """Per-pod-class catalog column mask + per-pool merged requirements —
+    a pure function of (catalog, pod class), shared by the per-problem
+    encoder and the batched sweep path (which caches it per class across
+    thousands of simulations). Dead grid combos (no available offering)
+    are folded in via col_valid."""
+    O = len(cat.columns)
+    merged_per_pool: List[Optional[Requirements]] = []
+    gmask = np.zeros(O, dtype=bool)
+    for pidx, pool in enumerate(cat.pools):
+        if not tolerates_all(pool.taints, rep.tolerations):
+            merged_per_pool.append(None)
+            continue
+        template = cat.templates[pidx]
+        if not template.compatible(rep.requirements):
+            merged_per_pool.append(None)
+            continue
+        merged = template.intersection(rep.requirements)
+        merged_per_pool.append(merged)
+        sel = cat.pool_cols[pidx]
+        if len(sel) == 0:
+            continue
+        # Split merged requirements three ways (oracle's open-world type
+        # check, tensorized):
+        #   column-provided key   → vectorized closed-world check
+        #   template-provided key → already validated by the template ∩
+        #                           pod intersection; the node itself
+        #                           will carry the label
+        #   neither               → satisfiable only by absence
+        col_checked = Requirements()
+        feasible = True
+        for req_ in merged:
+            if req_.key in cat.pool_provides[pidx]:
+                col_checked.add(req_)
+            elif template.get(req_.key) is not None:
+                continue
+            elif not req_.matches_absent():
+                feasible = False
+                break
+        if not feasible:
+            continue
+        ok = _eval_requirements(col_checked, cat.vocab,
+                                cat.pool_matrices[pidx], len(sel))
+        gmask[sel[ok]] = True
+    return gmask & cat.col_valid, merged_per_pool
 
 
 def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None,
@@ -816,51 +895,14 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None,
         group_mindom[gi] = t["mindom"]
         group_delig[gi] = t["delig"]
 
-        merged_per_pool: List[Optional[Requirements]] = []
-        gmask = np.zeros(O, dtype=bool)
-        for pidx, pool in enumerate(pools):
-            if not tolerates_all(pool.taints, rep.tolerations):
-                merged_per_pool.append(None)
-                continue
-            template = cat.templates[pidx]
-            if not template.compatible(rep.requirements):
-                merged_per_pool.append(None)
-                continue
-            merged = template.intersection(rep.requirements)
-            merged_per_pool.append(merged)
-            sel = cat.pool_cols[pidx]
-            if len(sel) == 0:
-                continue
-            # Split merged requirements three ways (oracle's open-world type
-            # check, tensorized):
-            #   column-provided key   → vectorized closed-world check
-            #   template-provided key → already validated by the template ∩
-            #                           pod intersection; the node itself
-            #                           will carry the label
-            #   neither               → satisfiable only by absence
-            col_checked = Requirements()
-            feasible = True
-            for req_ in merged:
-                if req_.key in cat.pool_provides[pidx]:
-                    col_checked.add(req_)
-                elif template.get(req_.key) is not None:
-                    continue
-                elif not req_.matches_absent():
-                    feasible = False
-                    break
-            if not feasible:
-                continue
-            ok = _eval_requirements(col_checked, vocab,
-                                    cat.pool_matrices[pidx], len(sel))
-            gmask[sel[ok]] = True
+        gmask, merged_per_pool = group_column_mask(cat, rep)
         # static topology domain restrictions → column mask
         for key, (col_ids, _) in dom_arrays.items():
             al = t["allowed"][key]
             if al is not None:
-                gmask &= np.isin(col_ids, list(al))
+                gmask = gmask & np.isin(col_ids, list(al))
         static_allowed.append(t["allowed"])
-        # grid combos with no available offering are dead columns
-        group_mask[gi] = gmask & cat.col_valid
+        group_mask[gi] = gmask
         merged_reqs.append(merged_per_pool)
 
         if E:

@@ -584,6 +584,71 @@ def solve_ffd_batch(*args, max_nodes: int = 1024, zc: int = 1):
                     in_axes=_BATCH_AXES)(*args)
 
 
+_BIG = 2 ** 29  # mirrors encode.BIG (no import: encode must stay jax-free)
+
+
+@partial(jax.jit, static_argnames=("max_nodes", "zc"))
+def solve_ffd_sweep(
+    # per-simulation (vmapped axis 0)
+    group_req,      # [B, G, R]
+    group_count,    # [B, G]
+    group_class,    # [B, G] i32 — row into the class tables
+    exclude_idx,    # [B, X] i32 — union rows this sim removes (-1 = pad)
+    price_cap,      # [B] f32 — +inf when uncapped
+    pool_limit,     # [B, P, R]
+    # shared across the batch (replicated)
+    class_mask,     # [C, O] bool — per-class catalog column mask
+    class_cap,      # [C, E] i32 — per-class per-union-node allowance
+    exist_remaining,  # [E, R]
+    exist_zone,     # [E] i32
+    exist_ct,       # [E] i32
+    col_alloc, col_daemon, pt_alloc, col_pool, pool_daemon,
+    col_price,      # [O] f32
+    col_zone, col_ct,
+    max_nodes: int = 8, zc: int = 1,
+):
+    """The consolidation-sweep kernel: every simulation is 'the shared
+    cluster snapshot minus a few candidate nodes' (SURVEY §3.3 hot loop
+    #2), so the batch axis carries only (pod groups, exclusion indices,
+    price cap) — the snapshot's node tensors and the per-class column
+    masks upload once and are indexed on device. This removes the
+    per-simulation host encode/stack of [E,*] arrays that dominated the
+    generic batched path (profiled ~85% of the config4 sweep).
+
+    Topology-inactive by construction: the caller routes any simulation
+    with spread/affinity activity through the generic path, so the
+    domain tensors are zeros and every group takes the kernel's light
+    branch.
+    """
+    E = exist_remaining.shape[0]
+
+    def one(greq, gcount, gcls, excl, pcap, plim):
+        keep = jnp.all(
+            jnp.arange(E, dtype=jnp.int32)[None, :] != excl[:, None],
+            axis=0)                                             # [E]
+        er = exist_remaining * keep[:, None]
+        ecap = class_cap[gcls] * keep[None, :].astype(class_cap.dtype)
+        gmask = class_mask[gcls] & (col_price < pcap)[None, :]
+        G = greq.shape[0]
+        zG = jnp.zeros((G,), jnp.int32)
+        zGD = jnp.zeros((G, 1), jnp.int32)
+        return _solve_ffd_impl(
+            greq, gcount, gmask, ecap, er,
+            col_alloc, col_daemon, pt_alloc, col_pool, pool_daemon, plim,
+            jnp.full((G,), _BIG, jnp.int32),   # ncap (no hostname caps)
+            zG,                                 # dsel = none
+            zGD,                                # dbase
+            jnp.full((G, 1), _BIG, jnp.int32),  # dcap
+            jnp.full((G,), _BIG, jnp.int32),    # skew (unbounded)
+            zG,                                 # mindom
+            jnp.zeros((G, 1), bool),            # delig
+            col_zone, col_ct, exist_zone, exist_ct,
+            max_nodes=max_nodes, zc=zc)
+
+    return jax.vmap(one)(group_req, group_count, group_class,
+                         exclude_idx, price_cap, pool_limit)
+
+
 def unpack(packed, G: int, E: int, N: int, RDIM: int, D: int):
     """Split the flat result buffer back into named host arrays."""
     import numpy as np
