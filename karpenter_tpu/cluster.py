@@ -67,7 +67,14 @@ class Watch:
 
 
 class Store:
-    """One typed collection with k8s-ish semantics."""
+    """One typed collection with k8s-ish semantics.
+
+    The `_items` dict is an INFORMER CACHE (reference: controller-runtime
+    informers over kube-apiserver); writes go through and are forwarded
+    to the cluster's `StoreBackend`, whose authoritative copies survive
+    this process and feed peer replicas' caches. With the default
+    in-memory backend the forward is a no-op and the cache is the store.
+    """
 
     def __init__(self, cluster: "Cluster", kind: str = ""):
         self._items: Dict[str, object] = {}
@@ -80,6 +87,7 @@ class Store:
             raise ValueError(f"already exists: {name}")
         obj.meta.creation_time = self._cluster.clock.now()
         self._items[name] = obj
+        self._cluster.backend.put(self.kind, name, obj, verb="added")
         self._cluster.mutated(self.kind, "added", name)
         return obj
 
@@ -87,7 +95,13 @@ class Store:
         return self._items.get(name)
 
     def update(self, obj) -> None:
+        if obj.meta.name not in self._items:
+            # an update through a stale reference to a deleted object must
+            # not resurrect it (kube-apiserver returns a conflict here;
+            # informer discipline = drop and let the next reconcile relist)
+            return
         obj.meta.resource_version += 1
+        self._cluster.backend.put(self.kind, obj.meta.name, obj)
         self._cluster.mutated(self.kind, "modified", obj.meta.name)
 
     def delete(self, name: str) -> None:
@@ -100,9 +114,12 @@ class Store:
         if obj.meta.finalizers:
             if obj.meta.deletion_time is None:
                 obj.meta.deletion_time = self._cluster.clock.now()
+                self._cluster.backend.put(self.kind, name, obj,
+                                          verb="deleting")
                 self._cluster.mutated(self.kind, "deleting", name)
             return
         del self._items[name]
+        self._cluster.backend.delete(self.kind, name)
         self._cluster.mutated(self.kind, "deleted", name)
 
     def remove_finalizer(self, name: str, finalizer: str) -> None:
@@ -111,9 +128,11 @@ class Store:
             return
         if finalizer in obj.meta.finalizers:
             obj.meta.finalizers.remove(finalizer)
+            self._cluster.backend.put(self.kind, name, obj)
             self._cluster.mutated(self.kind, "modified", name)
         if obj.meta.deleting and not obj.meta.finalizers:
             del self._items[name]
+            self._cluster.backend.delete(self.kind, name)
             self._cluster.mutated(self.kind, "deleted", name)
 
     def list(self, filter_: Optional[Callable[[T], bool]] = None) -> List:
@@ -130,8 +149,10 @@ class Store:
 
 
 class Cluster:
-    def __init__(self, clock: Optional[Clock] = None):
+    def __init__(self, clock: Optional[Clock] = None, backend=None):
+        from karpenter_tpu.store import InMemoryBackend
         self.clock = clock or RealClock()
+        self.backend = backend or InMemoryBackend()
         self.generation = 0  # bumps on every mutation anywhere
         self.pods = Store(self, "pods")
         self.nodes = Store(self, "nodes")
@@ -139,11 +160,43 @@ class Cluster:
         self.nodepools = Store(self, "nodepools")
         self.nodeclasses = Store(self, "nodeclasses")
         self.pdbs = Store(self, "pdbs")
+        self._stores = {s.kind: s for s in (
+            self.pods, self.nodes, self.nodeclaims, self.nodepools,
+            self.nodeclasses, self.pdbs)}
+        # recovery = relist (SURVEY §5): hydrate the informer cache from
+        # whatever authoritative state the backend already holds
+        for kind, store in self._stores.items():
+            store._items.update(self.backend.load(kind))
         self.events: List[tuple] = []  # (time, kind, object, reason, message)
+        # rolling dedup window over the last 512 event keys, maintained
+        # incrementally (ADVICE r3: re-slicing events[-512:] per call made
+        # a 2k-candidate sweep's per-candidate events quadratic)
+        self._recent_event_keys: "deque" = deque(maxlen=512)
+        self._recent_event_set: set = set()
         self._pdb_budget_cache: Dict[str, int] = {}
         self._pdb_budget_gen = -1
         self._watches: List[Watch] = []
         self._watch_lock = threading.Lock()
+
+    def sync_backend(self) -> int:
+        """Apply peer replicas' mutations to the informer cache (the
+        informer-update half of the seam; no-op on the in-memory
+        backend). Returns the number of events applied. The controller
+        manager calls this at the top of every reconcile round, so a
+        peer's writes are visible with informer latency, not resync
+        latency."""
+        n = 0
+        for kind, verb, name, obj in self.backend.events():
+            store = self._stores.get(kind)
+            if store is None:
+                continue
+            if verb == "deleted":
+                store._items.pop(name, None)
+            elif obj is not None:
+                store._items[name] = obj
+            self.mutated(kind, verb, name)
+            n += 1
+        return n
 
     def watch(self) -> Watch:
         """Subscribe to every store mutation (the informer-cache seam)."""
@@ -178,11 +231,14 @@ class Cluster:
         # message participates in the key: a node's reason label (e.g.
         # Unconsolidatable) can stay the same while the CAUSE changes —
         # the refreshed message must land, only true repeats drop
-        recent = [(k, o, r, m) for _, k, o, r, m in self.events[-512:]]
-        if (kind, obj_name, reason, message) in recent:
+        key = (kind, obj_name, reason, message)
+        if key in self._recent_event_set:
             return
-        self.events.append(
-            (self.clock.now(), kind, obj_name, reason, message))
+        if len(self._recent_event_keys) == self._recent_event_keys.maxlen:
+            self._recent_event_set.discard(self._recent_event_keys[0])
+        self._recent_event_keys.append(key)
+        self._recent_event_set.add(key)
+        self.events.append((self.clock.now(), *key))
         if len(self.events) > 5000:
             del self.events[:2500]
 

@@ -27,6 +27,9 @@ class ControllerManager:
         self.controllers = list(controllers)
 
     def run_once(self) -> None:
+        # peer replicas' writes land in the informer cache before any
+        # reconciler reads it (no-op on the in-memory backend)
+        self.cluster.sync_backend()
         for c in self.controllers:
             c.reconcile()
 

@@ -46,6 +46,17 @@ from karpenter_tpu.utils.cache import UnavailableOfferings
 from karpenter_tpu.utils.clock import Clock, FakeClock
 
 
+def _close_store(backend, daemon, sockdir: str) -> None:
+    """Module-level so the Environment finalizer holds no self-reference
+    (a bound method would keep the environment alive forever)."""
+    try:
+        backend.close()
+    finally:
+        daemon.close()
+    import shutil
+    shutil.rmtree(sockdir, ignore_errors=True)
+
+
 class Environment:
     def __init__(
         self,
@@ -54,9 +65,33 @@ class Environment:
         options: Optional[Options] = None,
         catalog_spec: Optional[CatalogSpec] = None,
         cloud=None,
+        store_backend=None,
     ):
         self.clock = clock or FakeClock()
         self.options = options or Options()
+        # the cluster-store seam (store/__init__.py): explicit backend >
+        # KARPENTER_TPU_STORE_BACKEND=remote (per-environment daemon, the
+        # whole suite then runs against the external store) > in-memory
+        self.store_daemon = None
+        self._store_finalizer = None
+        if store_backend is None:
+            import os
+            if os.environ.get("KARPENTER_TPU_STORE_BACKEND") == "remote":
+                import tempfile
+                import weakref
+                from karpenter_tpu.store import RemoteBackend, StoreDaemon
+                sockdir = tempfile.mkdtemp(prefix="kt_store_")
+                self.store_daemon = StoreDaemon(
+                    os.path.join(sockdir, "store.sock"))
+                store_backend = RemoteBackend(self.store_daemon.path)
+                # environments are created by the hundred in fixtures with
+                # no teardown hook; a GC-driven finalizer keeps a
+                # full-suite remote-store run from accumulating daemon
+                # threads, sockets, and tmp dirs
+                self._store_finalizer = weakref.finalize(
+                    self, _close_store, store_backend, self.store_daemon,
+                    sockdir)
+        self.store_backend = store_backend
         # the cloud session is injectable (operator.go:105-116 resolves the
         # AWS session the same way); default is the in-memory fake, the only
         # cloud in this environment — a real TPU-pool/GCE session plugs in
@@ -67,7 +102,7 @@ class Environment:
         self.unavailable = UnavailableOfferings(clock=self.clock)
         self.instance_types = InstanceTypeProvider(
             self.cloud, self.pricing, self.unavailable, clock=self.clock)
-        self.cluster = Cluster(clock=self.clock)
+        self.cluster = Cluster(clock=self.clock, backend=self.store_backend)
         # cloud plumbing providers (operator.go:140-182 construction order)
         cluster_name = self.options.cluster_name
         # the fake cloud seeds its defaults under "default-cluster"
@@ -153,3 +188,9 @@ class Environment:
 
     def settle(self, max_rounds: int = 50) -> int:
         return self.manager.run_until_idle(max_rounds)
+
+    def close(self) -> None:
+        """Release the external store (no-op with the in-memory backend);
+        also runs automatically when the environment is garbage-collected."""
+        if self._store_finalizer is not None:
+            self._store_finalizer()

@@ -1,0 +1,31 @@
+"""Pluggable cluster-store backends.
+
+The reference's controllers never own their state: they watch CRs through
+controller-runtime's informer cache and write back to kube-apiserver
+(/root/reference/cmd/controller/main.go:46-54 hands every controller one
+`client.Client`; recovery is relist — SURVEY §5 checkpoint/resume). This
+package gives our `Cluster` the same split: the in-process object dicts
+become an INFORMER CACHE, and a `StoreBackend` decides where the
+authoritative copies live.
+
+Two backends:
+
+- `InMemoryBackend` — the cache IS the store (the default; zero overhead,
+  identical semantics to the pre-seam Cluster).
+- `RemoteBackend` (`remote.py`) — a process-external store daemon spoken
+  to over a unix socket with a watch stream, the solverd pattern applied
+  to state. Writes forward to the daemon; peers' writes stream back and
+  update the local cache. A kube-apiserver client would attach exactly
+  here: implement `StoreBackend` with list/put/delete bridged to a k8s
+  client and the watch loop bridged to informers (docs/store-backends.md).
+"""
+
+from karpenter_tpu.store.backend import InMemoryBackend, StoreBackend
+from karpenter_tpu.store.remote import RemoteBackend, StoreDaemon
+
+__all__ = [
+    "InMemoryBackend",
+    "RemoteBackend",
+    "StoreBackend",
+    "StoreDaemon",
+]

@@ -1,0 +1,242 @@
+"""Process-external store backend over a unix socket.
+
+The solverd pattern (native/solverd.cc: one daemon, length-prefixed
+frames, many clients) applied to cluster state: `StoreDaemon` holds the
+authoritative pickled copies and fans mutation events out to every
+watcher; `RemoteBackend` is the client — it forwards writes, drains peer
+events, and hydrates relists. Two operator replicas pointed at one
+daemon see one cluster, which is the 2-replica active/passive layout the
+reference deploys (charts/karpenter/values.yaml:35) reduced to this
+environment.
+
+Wire format: 4-byte big-endian length + pickle. Messages are dicts:
+  {op: "hello", client: id}                      → {ok}
+  {op: "list", kind}                             → {items: {name: bytes}}
+  {op: "put", kind, name, data, verb}            → {ok}
+  {op: "delete", kind, name}                     → {ok}
+  {op: "watch", client: id}                      → stream of
+      {op: "event", kind, verb, name, data|None, origin}
+
+Pickle is safe here the same way it is for solverd: the socket is a
+file-permission-guarded unix socket owned by the operator deployment,
+not a network listener.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+_LEN = struct.Struct(">I")
+
+
+def _send(sock: socket.socket, msg: dict) -> None:
+    data = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv(sock: socket.socket) -> Optional[dict]:
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            return None
+        hdr += chunk
+    (n,) = _LEN.unpack(hdr)
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(min(65536, n - len(buf)))
+        if not chunk:
+            return None
+        buf += chunk
+    return pickle.loads(buf)
+
+
+class StoreDaemon:
+    """Authoritative store: kind → name → pickled object."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._data: Dict[str, Dict[str, bytes]] = {}
+        self._watchers: List[Tuple[str, socket.socket]] = []
+        if os.path.exists(path):
+            os.unlink(path)
+        self._srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._srv.bind(path)
+        self._srv.listen(16)
+        self._closed = False
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="store-daemon")
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        client = "?"
+        try:
+            while True:
+                msg = _recv(conn)
+                if msg is None:
+                    return
+                op = msg.get("op")
+                if op == "hello":
+                    client = msg.get("client", "?")
+                    _send(conn, {"ok": True})
+                elif op == "list":
+                    with self._lock:
+                        items = dict(self._data.get(msg["kind"], {}))
+                    _send(conn, {"items": items})
+                elif op == "put":
+                    verb = msg.get("verb", "modified")
+                    with self._lock:
+                        kind_map = self._data.setdefault(msg["kind"], {})
+                        if verb != "added" and msg["name"] not in kind_map:
+                            # modify/deleting against a name the store no
+                            # longer holds: a peer deleted it first. A bare
+                            # upsert would RESURRECT the object cluster-wide
+                            # (kube-apiserver rejects this with a conflict);
+                            # the writer's cache converges on its next sync.
+                            conflict = True
+                        else:
+                            conflict = False
+                            kind_map[msg["name"]] = msg["data"]
+                    if conflict:
+                        _send(conn, {"ok": False, "conflict": True})
+                    else:
+                        self._broadcast(msg.get("origin", client), {
+                            "op": "event", "kind": msg["kind"],
+                            "verb": verb,
+                            "name": msg["name"], "data": msg["data"]})
+                        _send(conn, {"ok": True})
+                elif op == "delete":
+                    with self._lock:
+                        self._data.get(msg["kind"], {}).pop(msg["name"], None)
+                    self._broadcast(msg.get("origin", client), {
+                        "op": "event", "kind": msg["kind"], "verb": "deleted",
+                        "name": msg["name"], "data": None})
+                    _send(conn, {"ok": True})
+                elif op == "watch":
+                    with self._lock:
+                        self._watchers.append((msg.get("client", client),
+                                               conn))
+                    return  # connection now belongs to the broadcast side
+                else:
+                    _send(conn, {"error": f"unknown op {op!r}"})
+        except OSError:
+            return
+
+    def _broadcast(self, origin: str, event: dict) -> None:
+        event = dict(event, origin=origin)
+        with self._lock:
+            watchers = list(self._watchers)
+        dead = []
+        for client, sock in watchers:
+            if client == origin:
+                continue  # echo suppression: the writer's cache is newer
+            try:
+                _send(sock, event)
+            except OSError:
+                dead.append((client, sock))
+        if dead:
+            with self._lock:
+                self._watchers = [w for w in self._watchers if w not in dead]
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class RemoteBackend:
+    """Client half: synchronous RPCs over one connection, a watch stream
+    on a second, peer events buffered for the cluster to drain on its
+    reconcile cadence (informer semantics: level-driven, resync-safe)."""
+
+    def __init__(self, path: str):
+        self.client_id = uuid.uuid4().hex
+        self._rpc = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._rpc.connect(path)
+        self._rpc_lock = threading.Lock()
+        _send(self._rpc, {"op": "hello", "client": self.client_id})
+        _recv(self._rpc)
+        self._watch_sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._watch_sock.connect(path)
+        _send(self._watch_sock, {"op": "watch", "client": self.client_id})
+        self._events: List[Tuple[str, str, str, Optional[object]]] = []
+        self._events_lock = threading.Lock()
+        self._closed = False
+        self._reader = threading.Thread(target=self._watch_loop, daemon=True,
+                                        name="store-watch")
+        self._reader.start()
+
+    def _watch_loop(self) -> None:
+        while not self._closed:
+            try:
+                msg = _recv(self._watch_sock)
+            except OSError:
+                return
+            if msg is None:
+                return
+            obj = (pickle.loads(msg["data"])
+                   if msg.get("data") is not None else None)
+            with self._events_lock:
+                self._events.append(
+                    (msg["kind"], msg["verb"], msg["name"], obj))
+
+    def _call(self, msg: dict) -> dict:
+        with self._rpc_lock:
+            _send(self._rpc, dict(msg, origin=self.client_id))
+            out = _recv(self._rpc)
+        if out is None:
+            raise ConnectionError("store daemon closed the connection")
+        return out
+
+    # -- StoreBackend interface -------------------------------------------
+    def load(self, kind: str) -> Dict[str, object]:
+        items = self._call({"op": "list", "kind": kind})["items"]
+        return {name: pickle.loads(data) for name, data in items.items()}
+
+    def put(self, kind: str, name: str, obj: object,
+            verb: str = "modified") -> None:
+        # a conflict reply (modify of a peer-deleted object) is silently
+        # dropped: the watch stream delivers the delete and the local
+        # cache converges — same shape as an informer absorbing a 409
+        self._call({"op": "put", "kind": kind, "name": name, "verb": verb,
+                    "data": pickle.dumps(
+                        obj, protocol=pickle.HIGHEST_PROTOCOL)})
+
+    def delete(self, kind: str, name: str) -> None:
+        self._call({"op": "delete", "kind": kind, "name": name})
+
+    def events(self) -> List[Tuple[str, str, str, Optional[object]]]:
+        with self._events_lock:
+            out = self._events
+            self._events = []
+        return out
+
+    def close(self) -> None:
+        self._closed = True
+        for s in (self._rpc, self._watch_sock):
+            try:
+                s.close()
+            except OSError:
+                pass

@@ -1,0 +1,193 @@
+"""The pluggable cluster-store seam (VERDICT r3 #3): the same controllers
+run against the in-memory backend and a process-external store daemon.
+Reference shape: controllers own no state — they watch an informer cache
+backed by kube-apiserver (/root/reference/cmd/controller/main.go:46-54);
+`RemoteBackend` stands where a kube client would attach
+(docs/store-backends.md).
+"""
+
+import tempfile
+
+import pytest
+
+from karpenter_tpu.cluster import Cluster
+from karpenter_tpu.env import Environment
+from karpenter_tpu.models import (
+    NodePool,
+    ObjectMeta,
+    Pod,
+    Resources,
+)
+from karpenter_tpu.store import InMemoryBackend, RemoteBackend, StoreDaemon
+from karpenter_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture()
+def daemon():
+    sock = tempfile.mktemp(prefix="kt_store_test_", suffix=".sock")
+    d = StoreDaemon(sock)
+    yield d
+    d.close()
+
+
+def mkpod(name, cpu="500m", mem="1Gi"):
+    return Pod(meta=ObjectMeta(name=name),
+               requests=Resources.parse({"cpu": cpu, "memory": mem}))
+
+
+class TestRemoteBackendProtocol:
+    def test_put_list_delete_roundtrip(self, daemon):
+        be = RemoteBackend(daemon.path)
+        pod = mkpod("p1")
+        be.put("pods", "p1", pod, verb="added")
+        loaded = be.load("pods")
+        assert set(loaded) == {"p1"}
+        # a fresh deserialized copy, not the same reference
+        assert loaded["p1"] is not pod
+        assert loaded["p1"].meta.name == "p1"
+        assert loaded["p1"].requests.v == pod.requests.v
+        be.delete("pods", "p1")
+        assert be.load("pods") == {}
+        be.close()
+
+    def test_echo_suppression(self, daemon):
+        """A client's own writes must not come back as peer events."""
+        be = RemoteBackend(daemon.path)
+        be.put("pods", "p1", mkpod("p1"))
+        import time
+        time.sleep(0.1)
+        assert be.events() == []
+        be.close()
+
+    def test_peer_events_flow(self, daemon):
+        a = RemoteBackend(daemon.path)
+        b = RemoteBackend(daemon.path)
+        a.put("nodes", "n1", mkpod("n1"), verb="added")
+        a.delete("nodes", "n1")
+        import time
+        deadline = time.time() + 5
+        evs = []
+        while len(evs) < 2 and time.time() < deadline:
+            evs += b.events()
+            time.sleep(0.01)
+        assert [(k, v, n) for k, v, n, _ in evs] == [
+            ("nodes", "added", "n1"), ("nodes", "deleted", "n1")]
+        a.close()
+        b.close()
+
+
+class TestClusterOnRemoteBackend:
+    def test_relist_recovery(self, daemon):
+        """Recovery = relist (SURVEY §5): a fresh cluster hydrates its
+        informer cache from the daemon's authoritative copies."""
+        c1 = Cluster(clock=FakeClock(), backend=RemoteBackend(daemon.path))
+        c1.nodepools.create(NodePool(meta=ObjectMeta(name="default")))
+        c1.pods.create(mkpod("p1"))
+        c2 = Cluster(clock=FakeClock(), backend=RemoteBackend(daemon.path))
+        assert c2.nodepools.get("default") is not None
+        assert c2.pods.get("p1") is not None
+        # distinct object graphs: no cross-process identity assumptions
+        assert c2.pods.get("p1") is not c1.pods.get("p1")
+
+    def test_two_replicas_converge(self, daemon):
+        a = Cluster(clock=FakeClock(), backend=RemoteBackend(daemon.path))
+        b = Cluster(clock=FakeClock(), backend=RemoteBackend(daemon.path))
+        a.pods.create(mkpod("p1"))
+        import time
+        deadline = time.time() + 5
+        while b.pods.get("p1") is None and time.time() < deadline:
+            b.sync_backend()
+            time.sleep(0.01)
+        assert b.pods.get("p1") is not None
+        # modify through b; a observes it
+        pod_b = b.pods.get("p1")
+        pod_b.phase = "Running"
+        b.pods.update(pod_b)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            a.sync_backend()
+            if a.pods.get("p1").phase == "Running":
+                break
+            time.sleep(0.01)
+        assert a.pods.get("p1").phase == "Running"
+
+    def test_finalizer_flow_replicates(self, daemon):
+        from karpenter_tpu.models import wellknown
+        a = Cluster(clock=FakeClock(), backend=RemoteBackend(daemon.path))
+        b = Cluster(clock=FakeClock(), backend=RemoteBackend(daemon.path))
+        pod = mkpod("f1")
+        pod.meta.finalizers = ["test/finalizer"]
+        a.pods.create(pod)
+        a.pods.delete("f1")  # only marks deleting
+        import time
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            b.sync_backend()
+            got = b.pods.get("f1")
+            if got is not None and got.meta.deleting:
+                break
+            time.sleep(0.01)
+        assert b.pods.get("f1").meta.deleting
+        a.pods.remove_finalizer("f1", "test/finalizer")
+        deadline = time.time() + 5
+        while b.pods.get("f1") is not None and time.time() < deadline:
+            b.sync_backend()
+            time.sleep(0.01)
+        assert b.pods.get("f1") is None
+
+
+class TestEnvironmentOnRemoteBackend:
+    def test_e2e_provisioning_against_remote_store(self, monkeypatch):
+        """The full controller stack runs unchanged against the external
+        store: pending pods → NodeClaims → fake-cloud instances → bound
+        pods, with every mutation round-tripping through the daemon."""
+        from karpenter_tpu.operator.options import Options
+        monkeypatch.setenv("KARPENTER_TPU_STORE_BACKEND", "remote")
+        env = Environment(options=Options(batch_idle_duration=0))
+        assert env.store_daemon is not None
+        env.add_default_nodeclass()
+        env.cluster.nodepools.create(
+            NodePool(meta=ObjectMeta(name="default")))
+        for i in range(10):
+            env.cluster.pods.create(mkpod(f"p{i}"))
+        env.settle()
+        pods = env.cluster.pods.list()
+        assert pods and all(p.scheduled for p in pods)
+        assert env.cluster.nodeclaims.list()
+        # the daemon's authoritative copies match the informer cache
+        be = RemoteBackend(env.store_daemon.path)
+        authoritative = be.load("nodeclaims")
+        assert set(authoritative) == {
+            c.name for c in env.cluster.nodeclaims.list()}
+        be.close()
+        env.close()
+
+    def test_stale_update_cannot_resurrect(self, daemon):
+        """A modify through a stale reference after a peer's delete must
+        NOT re-create the object (kube-apiserver's resourceVersion
+        conflict, reduced to the daemon's unknown-name reject)."""
+        a = Cluster(clock=FakeClock(), backend=RemoteBackend(daemon.path))
+        b = Cluster(clock=FakeClock(), backend=RemoteBackend(daemon.path))
+        a.pods.create(mkpod("z1"))
+        import time
+        deadline = time.time() + 5
+        while b.pods.get("z1") is None and time.time() < deadline:
+            b.sync_backend()
+            time.sleep(0.01)
+        stale = b.pods.get("z1")
+        a.pods.delete("z1")
+        # b holds a stale reference and hasn't synced the delete yet; its
+        # cache still contains z1, so the guard that matters is daemon-side
+        b.pods.update(stale)
+        deadline = time.time() + 5
+        while b.pods.get("z1") is not None and time.time() < deadline:
+            b.sync_backend()
+            time.sleep(0.01)
+        assert b.pods.get("z1") is None
+        # authoritative store agrees: no zombie
+        fresh = RemoteBackend(daemon.path)
+        assert "z1" not in fresh.load("pods")
+        fresh.close()
+        # and a LOCAL stale update (cache already dropped it) is a no-op
+        a.pods.update(stale)
+        assert a.pods.get("z1") is None
