@@ -49,15 +49,26 @@ class GarbageCollection:
 
         # owner cascade: the reference deletes a NodePool's nodes with it
         # (owner references on NodeClaims; nodepools.md — deleting a
-        # NodePool drains its nodes gracefully). A claim whose pool is
-        # gone or deleting is deleted here, which routes through the
-        # termination controller's finalizer drain, not a hard kill.
-        live_pools = {p.name for p in self.cluster.nodepools.list(
-            lambda p: not p.meta.deleting)}
+        # NodePool drains its nodes gracefully). Ownership is keyed on the
+        # pool UID like a k8s ownerReference (ADVICE r3: name-keying
+        # conflated 'pool deleted' with 'pool recreated under the same
+        # name between GC passes' and drained the recreated fleet). A
+        # claim whose owner UID matches no live pool is deleted here,
+        # which routes through the termination controller's finalizer
+        # drain, not a hard kill.
+        live = self.cluster.nodepools.list(lambda p: not p.meta.deleting)
+        live_uids = {p.meta.uid for p in live}
+        live_names = {p.name for p in live}
         for claim in claims:
             if claim.meta.deleting:
                 continue
-            if claim.nodepool not in live_pools:
+            if claim.nodepool_uid is not None:
+                orphaned = claim.nodepool_uid not in live_uids
+            else:
+                # claims predating UID stamping (adopted via relist): the
+                # name check is the only ownership signal available
+                orphaned = claim.nodepool not in live_names
+            if orphaned:
                 self.cluster.record_event(
                     "NodeClaim", claim.name, "OwnerDeleted",
                     f"nodepool {claim.nodepool} was deleted; draining")

@@ -147,6 +147,7 @@ def create_claim_from_spec(cluster: Cluster, cp: TPUCloudProvider,
             finalizers=[wellknown.TERMINATION_FINALIZER],
         ),
         nodepool=spec.nodepool,
+        nodepool_uid=(pool.meta.uid if pool else None),
         node_class_ref=spec.node_class_ref,
         requirements=spec.requirements.copy(),
         resource_requests=spec.requests.copy(),

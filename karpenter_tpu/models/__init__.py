@@ -21,6 +21,10 @@ from karpenter_tpu.models.objects import (
     Node,
     NodeClaim,
     NodePool,
+    BlockDevice,
+    BlockDeviceMapping,
+    KubeletConfiguration,
+    MetadataOptions,
     NodeClass,
     InstanceType,
     Offering,
@@ -47,6 +51,10 @@ __all__ = [
     "Node",
     "NodeClaim",
     "NodePool",
+    "BlockDevice",
+    "BlockDeviceMapping",
+    "KubeletConfiguration",
+    "MetadataOptions",
     "NodeClass",
     "InstanceType",
     "Offering",

@@ -398,6 +398,11 @@ class NodeClaim:
     meta: ObjectMeta
     nodepool: str
     node_class_ref: str
+    # owning pool's UID, the k8s ownerReference analogue: GC cascades only
+    # for claims whose owner UID no longer matches a live pool, so a
+    # delete+recreate of a NodePool under the same name between GC passes
+    # does not drain the recreated fleet
+    nodepool_uid: Optional[str] = None
     requirements: Requirements = field(default_factory=Requirements)
     resource_requests: Resources = field(default_factory=Resources)  # aggregate of packed pods
     taints: List[Taint] = field(default_factory=list)
@@ -549,6 +554,95 @@ def match_selector_terms(terms: List[SelectorTerm], obj_id: str,
 
 
 @dataclass
+class BlockDevice:
+    """Volume parameters for a block-device mapping
+    (pkg/apis/v1/ec2nodeclass.go:319-382 BlockDevice). Sizes are GiB; the
+    TPU cloud's volume types mirror the reference's enum so selector
+    semantics carry over."""
+    volume_size_gib: Optional[int] = None
+    volume_type: str = "gp3"
+    iops: Optional[int] = None
+    throughput: Optional[int] = None
+    encrypted: bool = True
+    kms_key_id: Optional[str] = None
+    snapshot_id: Optional[str] = None
+    delete_on_termination: bool = True
+
+    def key(self) -> tuple:
+        return (self.volume_size_gib, self.volume_type, self.iops,
+                self.throughput, self.encrypted, self.kms_key_id,
+                self.snapshot_id, self.delete_on_termination)
+
+
+@dataclass
+class BlockDeviceMapping:
+    """One device attach (pkg/apis/v1/ec2nodeclass.go:305-317): a list of
+    these, not a single scalar GiB — the root volume (at most one) sizes
+    the node's ephemeral-storage capacity."""
+    device_name: str
+    ebs: BlockDevice = field(default_factory=BlockDevice)
+    root_volume: bool = False
+
+    def key(self) -> tuple:
+        return (self.device_name, self.ebs.key(), self.root_volume)
+
+
+@dataclass
+class MetadataOptions:
+    """Instance metadata service exposure
+    (pkg/apis/v1/ec2nodeclass.go:255-300). Defaults mirror the
+    reference's hardened defaults (IMDSv2-style required tokens,
+    hop limit 1)."""
+    http_endpoint: str = "enabled"      # enabled | disabled
+    http_protocol_ipv6: str = "disabled"
+    http_put_response_hop_limit: int = 1
+    http_tokens: str = "required"       # required | optional
+
+    def key(self) -> tuple:
+        return (self.http_endpoint, self.http_protocol_ipv6,
+                self.http_put_response_hop_limit, self.http_tokens)
+
+
+# instance-store policy enum (pkg/apis/v1/ec2nodeclass.go:384-394): RAID0
+# stripes all local NVMe disks into the node's ephemeral storage
+INSTANCE_STORE_RAID0 = "RAID0"
+
+
+@dataclass
+class KubeletConfiguration:
+    """Per-NodeClass kubelet args (pkg/apis/v1/ec2nodeclass.go:186-253),
+    the subset that feeds allocatable math: max-pods / pods-per-core
+    override the catalog's ENI-style ladder; reserved and eviction maps
+    override the reserved-resource formulas
+    (pkg/providers/instancetype/types.go:363-431). Quantities are
+    k8s-style strings ("100m", "1Gi", "5%" for eviction signals)."""
+    cluster_dns: List[str] = field(default_factory=list)
+    max_pods: Optional[int] = None
+    pods_per_core: Optional[int] = None
+    system_reserved: Dict[str, str] = field(default_factory=dict)
+    kube_reserved: Dict[str, str] = field(default_factory=dict)
+    eviction_hard: Dict[str, str] = field(default_factory=dict)
+    eviction_soft: Dict[str, str] = field(default_factory=dict)
+    eviction_soft_grace_period: Dict[str, str] = field(default_factory=dict)
+    eviction_max_pod_grace_period: Optional[int] = None
+    image_gc_high_threshold_percent: Optional[int] = None
+    image_gc_low_threshold_percent: Optional[int] = None
+    cpu_cfs_quota: Optional[bool] = None
+
+    def key(self) -> tuple:
+        return (tuple(self.cluster_dns), self.max_pods, self.pods_per_core,
+                tuple(sorted(self.system_reserved.items())),
+                tuple(sorted(self.kube_reserved.items())),
+                tuple(sorted(self.eviction_hard.items())),
+                tuple(sorted(self.eviction_soft.items())),
+                tuple(sorted(self.eviction_soft_grace_period.items())),
+                self.eviction_max_pod_grace_period,
+                self.image_gc_high_threshold_percent,
+                self.image_gc_low_threshold_percent,
+                self.cpu_cfs_quota)
+
+
+@dataclass
 class NodeClass:
     """Provider node configuration — the EC2NodeClass analogue
     (pkg/apis/v1/ec2nodeclass.go:29-128). Carries zone/network/boot
@@ -571,7 +665,16 @@ class NodeClass:
     image_family: str = "cos"  # AMIFamily analogue (resolver.go:163-180)
     role: str = "default-node-role"
     user_data: str = ""  # appended to the family bootstrap script
+    # legacy single-scalar root size, used only when no mapping is given
     block_device_gib: int = 100
+    # full spec surface (pkg/apis/v1/ec2nodeclass.go:186-394): device
+    # mapping LIST, metadata options, instance-store policy, per-class
+    # kubelet config — all drift-hashed and fed into allocatable math
+    # (providers/instancetype.py apply_node_class)
+    block_device_mappings: Optional[List[BlockDeviceMapping]] = None
+    metadata_options: Optional[MetadataOptions] = None
+    instance_store_policy: Optional[str] = None  # None | "RAID0"
+    kubelet: Optional[KubeletConfiguration] = None
     tags: Dict[str, str] = field(default_factory=dict)
     ready: bool = True
     # status (mirrors EC2NodeClass.status discovered resources,
@@ -587,6 +690,19 @@ class NodeClass:
     def name(self) -> str:
         return self.meta.name
 
+    def root_volume_gib(self) -> int:
+        """Root volume size: the mapping flagged root_volume (at most one,
+        per the reference's CEL rule), else the first mapping, else the
+        legacy scalar."""
+        for m in self.block_device_mappings or []:
+            if m.root_volume and m.ebs.volume_size_gib:
+                return m.ebs.volume_size_gib
+        if self.block_device_mappings:
+            first = self.block_device_mappings[0]
+            if first.ebs.volume_size_gib:
+                return first.ebs.volume_size_gib
+        return self.block_device_gib
+
     def static_hash(self) -> str:
         """Drift input — spec-only, status excluded
         (pkg/apis/v1/ec2nodeclass.go:421-427)."""
@@ -599,6 +715,12 @@ class NodeClass:
             "role": self.role,
             "user_data": self.user_data,
             "block_device_gib": self.block_device_gib,
+            "block_device_mappings": [
+                m.key() for m in self.block_device_mappings or []],
+            "metadata_options": (self.metadata_options.key()
+                                 if self.metadata_options else None),
+            "instance_store_policy": self.instance_store_policy,
+            "kubelet": self.kubelet.key() if self.kubelet else None,
             "tags": sorted(self.tags.items()),
             "subnet_terms": sorted(
                 t.key() for t in self.subnet_selector_terms or []),

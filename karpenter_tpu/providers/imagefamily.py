@@ -39,6 +39,12 @@ class ResolvedLaunchConfig:
     user_data: str
     block_device_gib: int = 100
     security_group_ids: List[str] = field(default_factory=list)
+    # full device list + metadata exposure (resolver.go:94-100 carries
+    # the family's default block devices and the class's metadata
+    # options into every launch template)
+    block_device_mappings: Optional[list] = None
+    metadata_options: Optional[object] = None
+    instance_store_policy: Optional[str] = None
 
 
 class ImageFamily:
@@ -154,8 +160,11 @@ class ImageProvider:
         return [
             ResolvedLaunchConfig(
                 image=by_id[iid], instance_type_names=names, user_data=ud,
-                block_device_gib=nc.block_device_gib,
-                security_group_ids=list(security_group_ids or []))
+                block_device_gib=nc.root_volume_gib(),
+                security_group_ids=list(security_group_ids or []),
+                block_device_mappings=nc.block_device_mappings,
+                metadata_options=nc.metadata_options,
+                instance_store_policy=nc.instance_store_policy)
             for iid, names in assigned.items()
         ]
 

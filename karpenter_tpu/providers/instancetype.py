@@ -14,8 +14,11 @@ from __future__ import annotations
 
 from typing import List, Optional, TYPE_CHECKING
 
+from typing import Dict
+
 from karpenter_tpu.models import wellknown
 from karpenter_tpu.models.objects import InstanceType, NodeClass, Offering
+from karpenter_tpu.models.resources import RESOURCE_AXIS, Resources
 from karpenter_tpu.providers.pricing import PricingProvider
 from karpenter_tpu.utils.cache import (
     INSTANCE_TYPES_ZONES_TTL,
@@ -26,6 +29,117 @@ from karpenter_tpu.utils.clock import Clock
 
 if TYPE_CHECKING:
     from karpenter_tpu.providers.fake_cloud import FakeCloud
+
+
+def _parse_eviction_signal(value: str, capacity_mib: float) -> float:
+    """MiB from an eviction signal value: '5%' of capacity or an absolute
+    quantity (pkg/providers/instancetype/types.go computeEvictionSignal)."""
+    value = value.strip()
+    if value.endswith("%"):
+        return capacity_mib * float(value[:-1]) / 100.0
+    return Resources.parse({"memory": value}).get("memory")
+
+
+def _kube_reserved_cpu_millis(vcpus: int) -> float:
+    """The reference's core-count staircase
+    (pkg/providers/instancetype/types.go:380-402): 6% of the first core,
+    1% of the second, 0.5% of the next two, 0.25% of the rest."""
+    cpu = 0.0
+    remaining = vcpus
+    for n, frac in ((1, 0.06), (1, 0.01), (2, 0.005)):
+        take = min(remaining, n)
+        cpu += take * 1000 * frac
+        remaining -= take
+    cpu += max(remaining, 0) * 1000 * 0.0025
+    return cpu
+
+
+def apply_node_class(shape: InstanceType, nc: NodeClass) -> InstanceType:
+    """Fold the NodeClass's kubelet config, block-device mappings, and
+    instance-store policy into the per-type capacity/overhead — the role
+    of the reference's per-nodepool InstanceType construction
+    (pkg/providers/instancetype/types.go:193-210 capacity,
+    :338-352 ENI/max-pods override, :369-431 reserved + eviction).
+
+    Identity when none of those fields are set: the catalog's shape
+    already carries the default ladder, and returning the SAME object
+    preserves the provider's list-identity cache contract."""
+    kub = nc.kubelet
+    if (kub is None and nc.block_device_mappings is None
+            and nc.instance_store_policy is None):
+        return shape
+
+    caps = dict(zip(RESOURCE_AXIS, shape.capacity.v))
+    vcpus = int(round(caps.get("cpu", 0.0) / 1000.0))
+    # -- max pods (kubelet override beats the catalog's ENI ladder) ------
+    pods = caps.get("pods", 0.0)
+    if kub is not None and kub.max_pods is not None:
+        pods = float(kub.max_pods)
+    if kub is not None and kub.pods_per_core is not None:
+        # podsPerCore cannot exceed maxPods (ec2nodeclass.go:203-206)
+        pods = min(pods, float(kub.pods_per_core * max(vcpus, 1)))
+    # -- ephemeral storage from mappings / instance store ----------------
+    ephemeral_mib = caps.get("ephemeral-storage", 0.0)
+    nvme_req = shape.requirements.get(wellknown.INSTANCE_LOCAL_NVME_LABEL)
+    nvme_gib = 0
+    if nvme_req is not None and nvme_req.values():
+        try:
+            nvme_gib = int(next(iter(nvme_req.values())))
+        except ValueError:
+            nvme_gib = 0
+    if nc.instance_store_policy == "RAID0" and nvme_gib > 0:
+        # RAID0 over the local disks IS the node's ephemeral storage
+        # (ec2nodeclass.go:384-394)
+        ephemeral_mib = nvme_gib * 1024.0
+    elif nc.block_device_mappings is not None:
+        ephemeral_mib = nc.root_volume_gib() * 1024.0
+
+    # -- reserved + eviction overhead ------------------------------------
+    mem_mib = caps.get("memory", 0.0)
+    kube_reserved = {
+        "cpu": _kube_reserved_cpu_millis(vcpus),
+        "memory": 11.0 * pods + 255.0,
+        "ephemeral-storage": 1024.0,
+    }
+    system_reserved: Dict[str, float] = {}
+    eviction = {"memory": 100.0,
+                "ephemeral-storage": ephemeral_mib * 0.10}
+    if kub is not None:
+        # pid is a legal reserved key in the CRD but not a schedulable
+        # axis — it is accepted and ignored, like the reference's
+        # allocatable math which only folds cpu/memory/ephemeral-storage
+        axes = ("cpu", "memory", "ephemeral-storage")
+        for k, v in kub.kube_reserved.items():
+            if k in axes:
+                kube_reserved[k] = Resources.parse({k: v}).get(k)
+        for k, v in kub.system_reserved.items():
+            if k in axes:
+                system_reserved[k] = Resources.parse({k: v}).get(k)
+        for signals in (kub.eviction_hard, kub.eviction_soft):
+            if not signals:
+                continue
+            override = {}
+            if "memory.available" in signals:
+                override["memory"] = _parse_eviction_signal(
+                    signals["memory.available"], mem_mib)
+            if "nodefs.available" in signals:
+                override["ephemeral-storage"] = _parse_eviction_signal(
+                    signals["nodefs.available"], ephemeral_mib)
+            for k, v in override.items():
+                eviction[k] = max(eviction.get(k, 0.0), v)
+
+    overhead = Resources()
+    for src in (kube_reserved, system_reserved, eviction):
+        for k, v in src.items():
+            overhead.set(k, overhead.get(k) + v)
+
+    capacity = shape.capacity.copy()
+    capacity.set("pods", pods)
+    capacity.set("ephemeral-storage", ephemeral_mib)
+    return InstanceType(
+        name=shape.name, capacity=capacity,
+        requirements=shape.requirements, offerings=shape.offerings,
+        overhead=overhead)
 
 
 class InstanceTypeProvider:
@@ -104,13 +218,13 @@ class InstanceTypeProvider:
                 ))
             if not offerings:
                 continue
-            out.append(InstanceType(
+            out.append(apply_node_class(InstanceType(
                 name=shape.name,
                 capacity=shape.capacity,
                 requirements=shape.requirements,
                 offerings=offerings,
                 overhead=shape.overhead,
-            ))
+            ), node_class))
         # change-gated count log on the fetch the re-pull already performed
         # (reference instancetype.go:151-153 via pretty.ChangeMonitor) —
         # steady-state refreshes stay silent

@@ -48,6 +48,13 @@ class LaunchTemplateProvider:
             "user_data": cfg.user_data,
             "sgs": sorted(cfg.security_group_ids),
             "block_gib": cfg.block_device_gib,
+            # device list / metadata exposure / instance-store policy are
+            # launch parameters: a spec change must mint a NEW template,
+            # not silently reuse one with stale devices
+            "mappings": [m.key() for m in cfg.block_device_mappings or []],
+            "metadata": (cfg.metadata_options.key()
+                         if cfg.metadata_options else None),
+            "store_policy": cfg.instance_store_policy,
         }, sort_keys=True)
         return hashlib.sha256(payload.encode()).hexdigest()[:12]
 

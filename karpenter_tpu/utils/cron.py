@@ -120,24 +120,34 @@ def _best_time(minute, hour, before=None):
     return None
 
 
-_MAX_LOOKBACK_DAYS = 36  # covers monthly schedules
+_MAX_LOOKBACK_DAYS = 400  # covers yearly schedules (the sparsest the
+# 5-field grammar can express: one date per year)
 _last_fire_cache: dict = {}
 
 
-def last_fire(schedule: str, now_ts: float) -> Optional[float]:
+def last_fire(schedule: str, now_ts: float,
+              lookback_days: int = _MAX_LOOKBACK_DAYS) -> Optional[float]:
     """Epoch seconds of the most recent fire at/before now (UTC), or None
     if none within the lookback. Steps by DAY (date-field match first,
     then the latest in-day time arithmetically) instead of scanning
     minute-by-minute — a monthly schedule costs ~35 date checks, not
-    ~50k datetime decrements. Cached per (schedule, minute)."""
+    ~50k datetime decrements. Cached per (schedule, lookback, minute).
+
+    `lookback_days` exists for callers whose window extends further than
+    a year past the fire (a duration like '9000h' is legal in the
+    reference CRD): the in-window check must see a fire as old as its
+    duration, or an open freeze silently reads as closed — the unsafe
+    direction. The reference's robfig-based check has no horizon at all;
+    ours is day-stepped, so a wide horizon costs one date check per day.
+    """
     minute_bucket = int(now_ts // 60)
-    key = (schedule, minute_bucket)
+    key = (schedule, lookback_days, minute_bucket)
     if key in _last_fire_cache:
         return _last_fire_cache[key]
     parsed = parse(schedule)
     now_dt = datetime.fromtimestamp(now_ts, tz=timezone.utc)
     out: Optional[float] = None
-    for day_off in range(_MAX_LOOKBACK_DAYS):
+    for day_off in range(lookback_days):
         d = (now_dt - timedelta(days=day_off)).date()
         if not _date_matches(parsed, d):
             continue
@@ -166,7 +176,13 @@ def in_window(schedule: Optional[str], duration: Optional[float],
         return True
     if duration is None:
         return True
-    fire = last_fire(schedule, now_ts)
+    # the lookback must reach at least `duration` into the past: a fire
+    # older than the default horizon can still hold the window open when
+    # its duration spans months (ADVICE r3: yearly schedule + multi-month
+    # duration read as closed — the direction that silently drops a
+    # configured disruption freeze)
+    lookback = max(_MAX_LOOKBACK_DAYS, int(float(duration) // 86400) + 2)
+    fire = last_fire(schedule, now_ts, lookback_days=lookback)
     if fire is None:
         return False
     return fire <= now_ts < fire + float(duration)

@@ -272,6 +272,26 @@ class TestScheduledBudgets:
         with _pytest.raises(CronError):
             parse("not a cron")
 
+    def test_cron_window_longer_than_lookback(self):
+        """A sparse schedule whose duration holds the window open for
+        months must still read OPEN long after the fire (ADVICE r3: a
+        fixed 36-day lookback reported a yearly freeze closed once the
+        fire aged out — silently dropping a configured freeze). The
+        reference's robfig-based check has no horizon at all; ours must
+        scale the lookback with the duration ('1440h'-style durations are
+        legal in the CRD)."""
+        from karpenter_tpu.utils.cron import in_window
+        yearly = "0 0 1 1 *"  # Jan 1 00:00 UTC
+        jan1_1971 = 365 * 86400.0  # epoch year is not a leap year
+        half_year = 180 * 86400.0
+        # 90 days after the fire, with a 180-day duration: open
+        assert in_window(yearly, half_year, jan1_1971 + 90 * 86400.0)
+        # past the duration: closed
+        assert not in_window(yearly, half_year, jan1_1971 + 181 * 86400.0)
+        # monthly schedule + multi-month duration stays open mid-window
+        monthly = "0 0 1 * *"
+        assert in_window(monthly, 70 * 86400.0, jan1_1971 + 60 * 86400.0)
+
     def test_invalid_schedule_fails_safe(self, env):
         """A typo'd schedule must BIND the budget (never drop a freeze)
         and must not kill the operator loop."""
