@@ -1,0 +1,58 @@
+#!/usr/bin/env python3
+"""Regenerate the checked-in default catalog table — the codegen pipeline
+(role of the reference's `make codegen` running hack/code/{vpc_limits_gen,
+bandwidth_gen,prices_gen} against live AWS APIs,
+/root/reference/Makefile:160-162).
+
+The data source here is the fake cloud's describe API (whose internals
+are the synthesis formulas in providers/catalog.py — max-pods ladder,
+bandwidth ladder, deterministic prices). Against a real TPU cloud this
+script would hit the provider's describe/pricing endpoints instead; the
+table format and loader stay identical.
+
+Usage:
+    python hack/gen_catalog.py            # write the table + print a summary
+    python hack/gen_catalog.py --check    # exit 1 if the table is stale
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from karpenter_tpu.providers.catalog import (  # noqa: E402
+    GENERATED_CATALOG_PATH,
+    dump_catalog,
+    synthesize_catalog,
+)
+
+
+def main() -> int:
+    table = dump_catalog(synthesize_catalog())
+    payload = json.dumps(table, indent=None, sort_keys=True,
+                         separators=(",", ":")) + "\n"
+    if "--check" in sys.argv:
+        try:
+            with open(GENERATED_CATALOG_PATH) as f:
+                current = f.read()
+        except OSError:
+            current = ""
+        if current != payload:
+            print("catalog table is STALE — run hack/gen_catalog.py",
+                  file=sys.stderr)
+            return 1
+        print("catalog table is up to date")
+        return 0
+    os.makedirs(os.path.dirname(GENERATED_CATALOG_PATH), exist_ok=True)
+    with open(GENERATED_CATALOG_PATH, "w") as f:
+        f.write(payload)
+    n_types = len(table["types"])
+    n_off = sum(len(t["offerings"]) for t in table["types"])
+    print(f"wrote {GENERATED_CATALOG_PATH}: {n_types} types, "
+          f"{n_off} offerings, {len(payload)//1024} KiB")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -205,6 +205,12 @@ class Resources:
             if val != 0.0
         }
 
+    def to_dict_solver(self) -> Dict[str, float]:
+        """Solver units as-is (millicores / MiB / count) — the catalog
+        table's lossless serialization (providers/catalog.py dump_catalog)."""
+        return {name: val for name, val in zip(RESOURCE_AXIS, self.v)
+                if val != 0.0}
+
     # magnitude used for FFD descending sort (reference sorts pods by
     # resource size — designs/bin-packing.md:28-29; core uses cpu then mem).
     def sort_key(self) -> tuple[float, float]:

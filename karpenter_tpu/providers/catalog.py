@@ -92,11 +92,14 @@ def _max_pods(vcpus: int) -> int:
     return 737
 
 
-def _overhead(vcpus: int, max_pods: int) -> Resources:
+def _overhead(vcpus: int, max_pods: int, ephemeral_mib: float) -> Resources:
     """kube-reserved + eviction threshold, shaped like the reference
     (pkg/providers/instancetype/types.go:369-431): CPU reserved on a
-    sliding scale of cores, memory 255Mi + 11Mi/pod, 100Mi eviction.
-    """
+    sliding scale of cores, memory 255Mi + 11Mi/pod + 100Mi eviction,
+    ephemeral 1Gi kube-reserved + 10% nodefs eviction. The SAME terms as
+    providers/instancetype.apply_node_class's defaults, so equivalent
+    NodeClass spellings (legacy scalar vs mapping list, kubelet set vs
+    unset) yield identical allocatable."""
     cores = vcpus
     cpu_reserved = 0.0  # millicores
     ladder = [(1, 0.06), (1, 0.01), (2, 0.005)]
@@ -108,7 +111,17 @@ def _overhead(vcpus: int, max_pods: int) -> Resources:
     cpu_reserved += max(remaining, 0) * 1000 * 0.0025
     mem_reserved = 255.0 + 11.0 * max_pods
     eviction = 100.0
-    return Resources.of(cpu=cpu_reserved, memory=mem_reserved + eviction)
+    return Resources.of(cpu=cpu_reserved, memory=mem_reserved + eviction,
+                        ephemeral_storage=1024.0 + ephemeral_mib * 0.10)
+
+
+def _bandwidth_mbps(vcpus: int, variant_network_optimized: bool) -> int:
+    """Network bandwidth ladder (role of the reference's measured
+    zz_generated.bandwidth.go table): ~ linear in vCPUs, network-optimized
+    variants ~2x, capped at 100 Gbps, floored at 750 Mbps like the small
+    EC2 shapes."""
+    per_cpu = 1250 if variant_network_optimized else 600
+    return max(750, min(100_000, vcpus * per_cpu))
 
 
 def _vm_overhead(mem_gib: float) -> float:
@@ -133,6 +146,7 @@ def _make_type(
     nvme: bool = False,
     gpus: int = 0,
     gpu_name: str = "",
+    network_optimized: bool = False,
 ) -> InstanceType:
     mem_mib = mem_gib * 1024 - _vm_overhead(mem_gib)
     max_pods = _max_pods(vcpus)
@@ -158,6 +172,8 @@ def _make_type(
         wellknown.INSTANCE_CPU_LABEL: str(vcpus),
         wellknown.INSTANCE_MEMORY_LABEL: str(int(mem_gib * 1024)),
         wellknown.INSTANCE_LOCAL_NVME_LABEL: str(ephemeral_gib) if nvme else "0",
+        wellknown.INSTANCE_NETWORK_BANDWIDTH_LABEL:
+            str(_bandwidth_mbps(vcpus, network_optimized)),
     }
     if gpus:
         labels[wellknown.INSTANCE_GPU_COUNT_LABEL] = str(gpus)
@@ -185,11 +201,25 @@ def _make_type(
         capacity=capacity,
         requirements=reqs,
         offerings=offerings,
-        overhead=_overhead(vcpus, max_pods),
+        overhead=_overhead(vcpus, max_pods, ephemeral_gib * 1024.0),
     )
 
 
 def generate_catalog(spec: Optional[CatalogSpec] = None) -> List[InstanceType]:
+    """The catalog for a spec. The DEFAULT catalog loads from the
+    checked-in generated table (hack/gen_catalog.py — the codegen
+    pipeline, role of `make codegen` + zz_generated tables,
+    /root/reference/Makefile:160-162); the synthesis formulas below are
+    the GENERATOR's internals and serve non-default specs (tests that
+    shrink/reshape the fleet)."""
+    if spec is None or spec == CatalogSpec():
+        loaded = load_generated_catalog()
+        if loaded is not None:
+            return loaded
+    return synthesize_catalog(spec)
+
+
+def synthesize_catalog(spec: Optional[CatalogSpec] = None) -> List[InstanceType]:
     spec = spec or CatalogSpec()
     out: List[InstanceType] = []
 
@@ -210,6 +240,7 @@ def generate_catalog(spec: Optional[CatalogSpec] = None) -> List[InstanceType]:
                         family=family, generation=gen, vcpus=vcpus,
                         mem_gib=mem_gib, arch=vinfo["arch"], size=size,
                         zones=spec.zones, od_price=price, nvme=vinfo["nvme"],
+                        network_optimized=(variant == "n"),
                     ))
 
     if spec.include_burstable:
@@ -249,3 +280,87 @@ def generate_catalog(spec: Optional[CatalogSpec] = None) -> List[InstanceType]:
 
 def catalog_by_name(catalog: List[InstanceType]) -> Dict[str, InstanceType]:
     return {it.name: it for it in catalog}
+
+
+# ---------------------------------------------------------------------------
+# Generated-table plumbing (the codegen pipeline's data side). The table is
+# written by hack/gen_catalog.py and checked in, replacing formula-only
+# synthesis for the default catalog — the role of the reference's
+# zz_generated.{vpclimits,bandwidth,pricing}.go regenerated by hack/code/
+# (/root/reference/Makefile:160-162).
+# ---------------------------------------------------------------------------
+
+GENERATED_CATALOG_PATH = __import__("os").path.join(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__)),
+    "generated", "catalog_default.json")
+_loaded_catalog: Optional[List[InstanceType]] = None
+_loaded_failed = False
+
+
+def dump_catalog(catalog: List[InstanceType]) -> dict:
+    """Serializable table: per type — capacity/overhead vectors (solver
+    units), the single-valued labels (incl. the max-pods and bandwidth
+    ladders' outputs), and per-offering prices."""
+    types = []
+    for it in catalog:
+        labels = {}
+        for req in it.requirements:
+            if req.is_finite() and len(req.values()) == 1:
+                (labels[req.key],) = req.values()
+        types.append({
+            "name": it.name,
+            "capacity": it.capacity.to_dict_solver(),
+            "overhead": it.overhead.to_dict_solver(),
+            "labels": labels,
+            "offerings": [[o.zone, o.capacity_type, o.price, o.available]
+                          for o in it.offerings],
+        })
+    return {"version": 1, "types": types}
+
+
+def catalog_from_table(table: dict) -> List[InstanceType]:
+    from karpenter_tpu.models.resources import AXIS_INDEX
+    out = []
+    for rec in table["types"]:
+        cap = Resources()
+        for k, v in rec["capacity"].items():
+            cap.v[AXIS_INDEX[k]] = float(v)
+        ovh = Resources()
+        for k, v in rec["overhead"].items():
+            ovh.v[AXIS_INDEX[k]] = float(v)
+        reqs = Requirements(*(Requirement.single(k, v)
+                              for k, v in rec["labels"].items()))
+        zones = sorted({o[0] for o in rec["offerings"]})
+        cts = sorted({o[1] for o in rec["offerings"]})
+        reqs.add(Requirement.make(wellknown.ZONE_LABEL, "In", *zones))
+        reqs.add(Requirement.make(wellknown.CAPACITY_TYPE_LABEL, "In", *cts))
+        out.append(InstanceType(
+            name=rec["name"], capacity=cap, requirements=reqs,
+            offerings=[Offering(z, ct, price, avail)
+                       for z, ct, price, avail in rec["offerings"]],
+            overhead=ovh))
+    return out
+
+
+def load_generated_catalog(path: Optional[str] = None) -> Optional[List[InstanceType]]:
+    """The checked-in default catalog, memoized (None when the table is
+    absent — synthesis then serves the default too, so a fresh checkout
+    without generated data still works)."""
+    global _loaded_catalog, _loaded_failed
+    if path is None:
+        if _loaded_catalog is not None:
+            return _loaded_catalog
+        if _loaded_failed:
+            return None
+        path = GENERATED_CATALOG_PATH
+    import json
+    import os
+    if not os.path.exists(path):
+        _loaded_failed = True
+        return None
+    with open(path) as f:
+        table = json.load(f)
+    cat = catalog_from_table(table)
+    if path == GENERATED_CATALOG_PATH:
+        _loaded_catalog = cat
+    return cat
