@@ -1,0 +1,98 @@
+"""Config #6: interruption-message throughput — the analogue of the
+reference's only in-tree benchmark, which drives 100/1k/5k/15k queued SQS
+messages through the interruption controller against infrastructure it
+provisions itself
+(/root/reference/pkg/controllers/interruption/interruption_benchmark_test.go:62-77).
+
+Here: 15k messages (a spot/rebalance/scheduled/state mix) over a 15k-claim
+fleet in the fake cloud, drained by the real controller. Measures msgs/s,
+claims deleted, and offering-unavailable markings under load. No recorded
+reference number exists (BASELINE.md); the target is the reference
+harness's top tier — 15k messages — drained in under 60 s (>250 msgs/s),
+far above any plausible EventBridge arrival rate.
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from karpenter_tpu.env import Environment
+from karpenter_tpu.models import NodeClaim, NodePool, ObjectMeta, wellknown
+from karpenter_tpu.providers.fake_cloud import FleetCandidate
+
+N_MESSAGES = 15_000
+TARGET_SECS = 60.0
+
+
+def build_env():
+    env = Environment()
+    env.add_default_nodeclass()
+    env.cluster.nodepools.create(NodePool(meta=ObjectMeta(name="default")))
+    zones = env.cloud.zones
+    # fleet: one instance + claim per message target (claims carry no
+    # finalizer here so deletion is immediate — the benchmark measures the
+    # interruption path, not the drain state machine)
+    for i in range(N_MESSAGES):
+        zone = zones[i % len(zones)]
+        ct = ["spot", "on-demand"][i % 2]
+        inst, _ = env.cloud.create_fleet(
+            [FleetCandidate(f"m6.large", zone, ct, 0.05)],
+            tags={"karpenter.sh/managed-by": "default-cluster"})
+        claim = NodeClaim(
+            meta=ObjectMeta(name=f"c{i}",
+                            labels={wellknown.NODEPOOL_LABEL: "default"}),
+            nodepool="default", node_class_ref="default",
+            provider_id=inst.instance_id)
+        claim.set_condition("Launched")
+        env.cluster.nodeclaims.create(claim)
+    return env
+
+
+def enqueue(env):
+    kinds = 0
+    for i, claim in enumerate(env.cluster.nodeclaims.list()):
+        iid = claim.provider_id
+        k = i % 4
+        if k in (0, 1):  # spot majority, like real interruption storms
+            env.cloud.interrupt_spot(iid)
+        elif k == 2:
+            env.cloud.send_state_change(iid, "stopping")
+        else:
+            env.cloud.send_rebalance_recommendation(iid)
+        kinds += 1
+    return kinds
+
+
+def main() -> None:
+    env = build_env()
+    n = enqueue(env)
+    assert n == N_MESSAGES
+    t0 = time.perf_counter()
+    env.interruption.reconcile()
+    secs = time.perf_counter() - t0
+    assert not env.cloud.interruption_queue, "queue must be fully drained"
+    remaining = len(env.cluster.nodeclaims.list(
+        lambda c: not c.meta.deleting))
+    deleted = N_MESSAGES - remaining
+    unavailable = len(env.unavailable._cache)
+    rate = N_MESSAGES / secs
+    print(json.dumps({
+        "metric": "config#6 interruption: drain 15k queued messages",
+        "value": round(rate, 1),
+        "unit": "msgs/s",
+        "vs_baseline": round(rate / (N_MESSAGES / TARGET_SECS), 3),
+        "drain_secs": round(secs, 2),
+        "claims_deleted": deleted,
+        "offerings_marked_unavailable": unavailable,
+    }))
+    print(f"drained {N_MESSAGES} in {secs:.2f}s = {rate:.0f} msgs/s; "
+          f"deleted {deleted} claims, {unavailable} offerings marked",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -32,24 +32,35 @@ class Interruption:
         self.queue = queue
         self.unavailable = unavailable
 
+    # long-poll batches drained per reconcile: the reference requeues
+    # immediately after each poll (controller.go:124 — effectively a
+    # continuous drain); a bounded in-reconcile drain gets the same
+    # throughput without starving the other controllers in our
+    # single-threaded manager
+    MAX_BATCHES_PER_RECONCILE = 1000
+
     def reconcile(self) -> None:
-        try:
-            msgs = list(self.queue.receive())
-        except Exception as e:  # noqa: BLE001 — queue outage: poll next round
-            if not errors.is_retryable(e):
-                raise
-            return
-        if not msgs:
-            return
-        # one claim index per poll batch: the reference fans messages out
-        # over 10 workers against the informer cache (controller.go:108);
-        # a per-message linear scan is quadratic at benchmark volumes
-        # (interruption_benchmark_test.go drives up to 15k messages)
-        by_pid = {c.provider_id: c for c in self.cluster.nodeclaims.list()
-                  if c.provider_id}
-        for msg in msgs:
-            self._handle(msg, by_pid)
-            self.queue.delete(msg)
+        by_pid = None
+        for _ in range(self.MAX_BATCHES_PER_RECONCILE):
+            try:
+                msgs = list(self.queue.receive())
+            except Exception as e:  # noqa: BLE001 — outage: poll next round
+                if not errors.is_retryable(e):
+                    raise
+                return
+            if not msgs:
+                return
+            if by_pid is None:
+                # ONE claim index per drain: messages only ever REMOVE
+                # claims, so the index stays valid across batches —
+                # rebuilding per 20-message poll is quadratic at benchmark
+                # volumes (interruption_benchmark_test.go drives 15k)
+                by_pid = {c.provider_id: c
+                          for c in self.cluster.nodeclaims.list()
+                          if c.provider_id}
+            for msg in msgs:
+                self._handle(msg, by_pid)
+                self.queue.delete(msg)
 
     def _handle(self, msg: dict, by_pid=None) -> None:
         metrics.INTERRUPTION_MESSAGES.inc(
@@ -74,7 +85,7 @@ class Interruption:
                 self.cluster.record_event(
                     "NodeClaim", claim.name, "SpotInterrupted",
                     f"instance {instance_id} reclaim imminent")
-                self.cluster.nodeclaims.delete(claim.name)
+                self._delete_claim(claim, by_pid, instance_id)
         elif kind == "rebalance_recommendation":
             if claim is not None:
                 self.cluster.record_event(
@@ -85,11 +96,19 @@ class Interruption:
                 self.cluster.record_event(
                     "NodeClaim", claim.name, "ScheduledChange",
                     "cloud maintenance event")
-                self.cluster.nodeclaims.delete(claim.name)
+                self._delete_claim(claim, by_pid, instance_id)
         elif kind == "state_change":
             if msg.get("state") in ("stopping", "stopped", "terminated") \
                     and claim is not None:
                 self.cluster.record_event(
                     "NodeClaim", claim.name, "InstanceStateChange",
                     msg.get("state", ""))
-                self.cluster.nodeclaims.delete(claim.name)
+                self._delete_claim(claim, by_pid, instance_id)
+
+    def _delete_claim(self, claim, by_pid, instance_id) -> None:
+        """Delete + drop from the drain index: a duplicate message for the
+        same instance later in the drain must see the claim gone, exactly
+        as a fresh informer read would."""
+        self.cluster.nodeclaims.delete(claim.name)
+        if by_pid is not None:
+            by_pid.pop(instance_id, None)

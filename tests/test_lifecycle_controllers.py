@@ -117,6 +117,35 @@ class TestInterruption:
                 (inst.capacity_type, inst.instance_type, inst.zone)
 
 
+    def test_bulk_drain_single_reconcile(self, env):
+        """A message storm drains in ONE reconcile with one claim index
+        (interruption_benchmark_test.go volumes): every message consumed,
+        duplicate messages for one instance are harmless, and spot pools
+        are marked unavailable under load."""
+        from karpenter_tpu.models import NodeClaim, ObjectMeta, wellknown
+        from karpenter_tpu.providers.fake_cloud import FleetCandidate
+        n = 300
+        for i in range(n):
+            inst, _ = env.cloud.create_fleet(
+                [FleetCandidate("m6.large", env.cloud.zones[i % 3],
+                                "spot", 0.05)], tags={})
+            claim = NodeClaim(
+                meta=ObjectMeta(name=f"bulk{i}", labels={
+                    wellknown.NODEPOOL_LABEL: "default"}),
+                nodepool="default", node_class_ref="default",
+                provider_id=inst.instance_id)
+            env.cluster.nodeclaims.create(claim)
+            env.cloud.interrupt_spot(inst.instance_id)
+            if i % 50 == 0:  # duplicates interleaved
+                env.cloud.interrupt_spot(inst.instance_id)
+        env.interruption.reconcile()
+        assert not env.cloud.interruption_queue
+        assert not env.cluster.nodeclaims.list(
+            lambda c: c.meta.name.startswith("bulk") and not c.meta.deleting)
+        assert env.unavailable.is_unavailable(
+            "spot", "m6.large", env.cloud.zones[0])
+
+
 class TestGC:
     def test_leaked_instance_reclaimed(self, env):
         from karpenter_tpu.providers.fake_cloud import FleetCandidate
@@ -156,6 +185,29 @@ class TestNodePoolCascade:
         assert pods and all(not p.scheduled for p in pods)
         reasons = {r for _, _, _, r, _ in env.cluster.events}
         assert "OwnerDeleted" in reasons
+
+    def test_recreated_pool_same_name_keeps_fleet(self, env):
+        """Ownership is keyed on pool UID (k8s ownerReference semantics):
+        deleting a NodePool and recreating it under the same name in the
+        gap between GC passes must NOT drain the recreated fleet
+        (ADVICE r3: name-keyed cascade conflated the two)."""
+        provision(env)
+        assert env.cluster.nodeclaims.list()
+        # delete + recreate in the gap between GC passes (no settle in
+        # between): the recreated pool has a fresh UID, same name
+        env.cluster.nodepools.delete("default")
+        env.cluster.nodepools.create(
+            NodePool(meta=ObjectMeta(name="default")))
+        env.settle()
+        # claims stamped with the OLD uid drain as orphans; whatever pool
+        # claims exist afterwards belong to the NEW pool, and every pod is
+        # running — the recreated fleet was never mass-drained into limbo
+        pods = env.cluster.pods.list()
+        assert pods and all(p.scheduled for p in pods)
+        new_uid = env.cluster.nodepools.get("default").meta.uid
+        for c in env.cluster.nodeclaims.list():
+            assert c.nodepool == "default"
+            assert c.nodepool_uid == new_uid
 
     def test_claims_migrate_to_surviving_pool(self, env):
         provision(env)
