@@ -61,6 +61,22 @@ class TPUSolver:
         initializes a JAX backend.
         """
         self.max_nodes = max_nodes
+        # relaxation-loop wall-clock budget (seconds; None = unbounded,
+        # spelled "", "none", or "off" in the env). Stragglers still
+        # relaxable when it expires go to the oracle via the rescue path
+        # rather than re-solving the whole problem again. A malformed
+        # value falls back to the default — a config typo must degrade a
+        # knob, never crash the operator at boot.
+        import os as _os
+        raw = _os.environ.get("KARPENTER_TPU_RELAX_BUDGET", "30").strip()
+        if raw.lower() in ("", "none", "off"):
+            self.relax_budget_s: Optional[float] = None
+        else:
+            try:
+                self.relax_budget_s = float(raw)
+            except ValueError:
+                self.relax_budget_s = 30.0
+        self._relax_deadline: Optional[float] = None
         self._cat_key = None
         self._cat = None
         self._mesh_spec = mesh
@@ -369,6 +385,8 @@ class TPUSolver:
                    for p in inp.pods):
             return self._attempt_or_split(inp, max_nodes=max_nodes)
         import dataclasses
+        import time as _time
+        from karpenter_tpu.utils import metrics
         by_name = {p.meta.name: p for p in inp.pods}
         relax: Dict[str, int] = {}
         # bound by TOTAL soft terms (capped), not the deepest list: one
@@ -376,19 +394,56 @@ class TPUSolver:
         # pod in a later round, so max-depth rounds can expire with
         # relaxation headroom left (round-1 advisor finding)
         rounds = 1 + min(sum(p.relax_levels() for p in inp.pods), 64)
+        # ... and by WALL-CLOCK (SURVEY §7 hard-parts: "an outer loop
+        # around the solver that must be bounded"): at the 50k shape one
+        # re-solve costs ~100 ms on device, so a pathological soft-term
+        # workload could otherwise stretch one solve to 65 rounds × full
+        # solves. Past the budget, remaining stragglers degrade to the
+        # oracle via the caller's rescue path instead of re-solving whole.
+        # The deadline is PER SOLVE, not per invocation: the split path
+        # re-enters this method on sub-problems, which must inherit the
+        # outer clock (and only the outermost invocation observes the
+        # duration metric — the same per-solve discipline as
+        # _count_residue).
+        t0 = _time.perf_counter()
+        outer = getattr(self, "_relax_deadline", None) is None
+        if outer:
+            self._relax_deadline = (
+                t0 + self.relax_budget_s
+                if self.relax_budget_s is not None else float("inf"))
         res = ScheduleResult()
-        for _ in range(rounds):
-            variants = [p.relaxed(relax.get(p.meta.name, 0)) for p in inp.pods]
-            res = self._attempt_or_split(
-                dataclasses.replace(inp, pods=variants), max_nodes=max_nodes)
-            bump = [n for n in res.unschedulable
-                    if n in by_name
-                    and relax.get(n, 0) < by_name[n].relax_levels()]
-            if not bump:
-                return res
-            for n in bump:
-                relax[n] = relax.get(n, 0) + 1
-        return res
+        try:
+            for round_i in range(rounds):
+                variants = [p.relaxed(relax.get(p.meta.name, 0))
+                            for p in inp.pods]
+                res = self._attempt_or_split(
+                    dataclasses.replace(inp, pods=variants),
+                    max_nodes=max_nodes)
+                bump = [n for n in res.unschedulable
+                        if n in by_name
+                        and relax.get(n, 0) < by_name[n].relax_levels()]
+                if not bump:
+                    return res
+                if _time.perf_counter() > self._relax_deadline:
+                    if outer:
+                        metrics.RELAXATION_BUDGET_EXCEEDED.inc()
+                    # stragglers with relax headroom must be RE-judged by
+                    # the rescue oracle on their ORIGINAL soft semantics:
+                    # a split-pass verdict reached with preferences still
+                    # promoted to required carries no authority for them
+                    # (without this, a budget exit could report pods
+                    # unschedulable that the unbudgeted path places)
+                    judged = getattr(self, "_last_oracle_judged", set())
+                    self._last_oracle_judged = judged - set(bump)
+                    return res
+                for n in bump:
+                    relax[n] = relax.get(n, 0) + 1
+            return res
+        finally:
+            if outer:
+                self._relax_deadline = None
+                metrics.RELAXATION_DURATION.observe(
+                    _time.perf_counter() - t0)
 
     def _adaptive_max_nodes(self) -> int:
         """Node-axis auto-tuning: the kernel's cost scales ~linearly with

@@ -227,6 +227,17 @@ SCHEDULING_SIMULATION_DURATION = _h(
 SCHEDULING_QUEUE_DEPTH = _g(
     "karpenter_provisioner_scheduling_queue_depth",
     "Pending pods awaiting a scheduling pass.")
+RELAXATION_DURATION = _h(
+    "karpenter_tpu_solver_relaxation_duration_seconds",
+    "Wall-clock of the preference-relaxation outer loop per solve.")
+RELAXATION_BUDGET_EXCEEDED = _c(
+    "karpenter_tpu_solver_relaxation_budget_exceeded_total",
+    "Solves whose relaxation loop hit its wall-clock budget and degraded "
+    "remaining stragglers to the oracle.")
+SOLVER_SHED_PODS = _c(
+    "karpenter_tpu_solver_fallback_shed_pods_total",
+    "Pods deferred to the next provisioning pass because the oracle "
+    "fallback capped its batch (device path unavailable).")
 DISRUPTION_EVALUATION_DURATION = _h(
     "karpenter_disruption_evaluation_duration_seconds",
     "Duration of one disruption evaluation pass.", ("method",))

@@ -223,3 +223,54 @@ class TestSoftPodAffinityAndScheduleAnyway:
         oracle, solver = both(mkinput(pods))
         assert not oracle.unschedulable
         assert not solver.unschedulable
+
+
+class TestRelaxationBudget:
+    """The relaxation outer loop is wall-clock-bounded (SURVEY §7
+    hard-parts; VERDICT r3 #9): past the budget, stragglers degrade to
+    the oracle instead of re-solving the whole problem round after
+    round — and the loop's duration is exported as a metric."""
+
+    def _pathological(self, n=30, levels=6):
+        # each pod carries a LADDER of unsatisfiable preferences, so every
+        # enforced round leaves it unschedulable with relax headroom — the
+        # worst case the round cap alone bounds only loosely
+        pods = []
+        for i in range(n):
+            prefs = [(100 - j, Requirements(Requirement.make(
+                ZONE, "In", f"mars-{j}"))) for j in range(levels)]
+            pods.append(mkpod(f"p{i}", prefs=prefs))
+        return mkinput(pods)
+
+    def test_budget_caps_wall_clock_and_rescues(self):
+        import time
+        inp = self._pathological()
+        solver = TPUSolver()
+        solver.solve(inp)  # warm the jit caches: the budget bounds
+        solver.relax_budget_s = 0.0  # round 0 only, then degrade
+        t0 = time.perf_counter()
+        res = solver.solve(inp)
+        elapsed = time.perf_counter() - t0
+        # correctness: the oracle rescue relaxes preferences itself, so
+        # nothing is lost — only the path differs
+        assert not res.unschedulable
+        # the loop did not run its ~levels*n rounds of device solves: one
+        # round plus the rescue stays far under the unbudgeted worst case
+        assert elapsed < 20.0
+
+    def test_budget_metric_exported(self):
+        from karpenter_tpu.utils import metrics
+        text = metrics.REGISTRY.render()
+        assert "karpenter_tpu_solver_relaxation_duration_seconds" in text
+        assert "karpenter_tpu_solver_relaxation_budget_exceeded_total" in text
+
+    def test_unbudgeted_matches_budgeted_result_quality(self):
+        inp = self._pathological(n=10, levels=3)
+        fast = TPUSolver()
+        fast.relax_budget_s = 0.0
+        slow = TPUSolver()
+        slow.relax_budget_s = None
+        a = fast.solve(inp)
+        b = slow.solve(inp)
+        assert not a.unschedulable and not b.unschedulable
+        assert a.node_count() == b.node_count()
