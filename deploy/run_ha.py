@@ -1,0 +1,86 @@
+#!/usr/bin/env python3
+"""Supervised-process HA runner — the docker-compose layout without
+docker: one store daemon + two operator replica PROCESSES sharing its
+socket and a file lease. Exactly one replica leads; kill it (SIGKILL)
+and watch the standby take over within a lease duration.
+
+    python deploy/run_ha.py [workdir]
+
+Notes for this environment: the operators run against the in-memory fake
+cloud, which is per-process — so cloud-side state (instances) is not
+shared across replicas here. Against a real TPU/GCE cloud the instances
+ARE shared (they live in the cloud), and the failover semantics are the
+ones tests/test_ha.py::TestTwoReplicaExternalStore proves in-process
+with a genuinely shared cloud: leader killed mid-provisioning, no pods
+lost.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    workdir = sys.argv[1] if len(sys.argv) > 1 else tempfile.mkdtemp(
+        prefix="kt_ha_")
+    os.makedirs(workdir, exist_ok=True)
+    store_sock = os.path.join(workdir, "store.sock")
+    lease = os.path.join(workdir, "lease.json")
+    env_base = dict(os.environ,
+                    PYTHONPATH=REPO,
+                    KARPENTER_TPU_PLATFORM=os.environ.get(
+                        "KARPENTER_TPU_PLATFORM", "cpu"))
+
+    procs = {}
+    procs["store"] = subprocess.Popen(
+        [sys.executable, "-m", "karpenter_tpu.store", store_sock],
+        env=env_base, cwd=REPO)
+    deadline = time.time() + 10
+    while not os.path.exists(store_sock) and time.time() < deadline:
+        time.sleep(0.05)
+    for i, (mport, hport) in enumerate([(8000, 8081), (8002, 8083)], 1):
+        procs[f"rep-{i}"] = subprocess.Popen(
+            [sys.executable, "-m", "karpenter_tpu"],
+            env=dict(env_base,
+                     KARPENTER_TPU_STORE_SOCKET=store_sock,
+                     KARPENTER_TPU_LEASE_FILE=lease,
+                     KARPENTER_TPU_REPLICA_ID=f"rep-{i}",
+                     KARPENTER_TPU_METRICS_PORT=str(mport),
+                     KARPENTER_TPU_HEALTH_PORT=str(hport)),
+            cwd=REPO)
+    print(f"HA pair up (workdir={workdir}): store pid "
+          f"{procs['store'].pid}, replicas "
+          f"{procs['rep-1'].pid}/{procs['rep-2'].pid}. "
+          "Kill the leader to watch failover; Ctrl-C to stop.", flush=True)
+
+    def shutdown(*_):
+        for p in procs.values():
+            p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        sys.exit(0)
+
+    signal.signal(signal.SIGINT, shutdown)
+    signal.signal(signal.SIGTERM, shutdown)
+    while True:
+        for name, p in list(procs.items()):
+            if p.poll() is not None and name.startswith("rep"):
+                print(f"{name} exited rc={p.returncode}; the peer holds "
+                      "(or takes) the lease", flush=True)
+                del procs[name]
+        if not any(n.startswith("rep") for n in procs):
+            print("both replicas gone; shutting down", flush=True)
+            shutdown()
+        time.sleep(0.5)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -87,7 +87,15 @@ class Store:
             raise ValueError(f"already exists: {name}")
         obj.meta.creation_time = self._cluster.clock.now()
         self._items[name] = obj
-        self._cluster.backend.put(self.kind, name, obj, verb="added")
+        if self._cluster.backend.put(self.kind, name, obj,
+                                     verb="added") is False:
+            # the authoritative store already holds this name (a peer
+            # created it in the failover dual-writer window before its
+            # write synced into our cache): roll the local create back and
+            # surface AlreadyExists so the caller retries under a fresh
+            # name — exactly the apiserver-409 flow
+            del self._items[name]
+            raise ValueError(f"already exists: {name} (peer replica)")
         self._cluster.mutated(self.kind, "added", name)
         return obj
 

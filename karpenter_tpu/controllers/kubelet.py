@@ -69,7 +69,13 @@ class FakeKubelet:
                     + [Taint(wellknown.UNREGISTERED_TAINT_KEY)]),
             ready=False,
         )
-        self.cluster.nodes.create(node)
+        try:
+            self.cluster.nodes.create(node)
+        except ValueError:
+            # AlreadyExists: a replica losing leadership can race its
+            # successor inside the brief dual-writer window (k8s absorbs
+            # this as an apiserver 409) — the node is joined either way
+            pass
 
     def _shed_startup_taints(self, claim, node) -> None:
         """One reconcile round after readiness, the 'CNI-style' agents the

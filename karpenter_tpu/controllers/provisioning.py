@@ -123,9 +123,25 @@ class Provisioner:
                 "Pod", pod_name, "FailedScheduling", reason)
 
     def _create_claim(self, spec: NewNodeClaim) -> NodeClaim:
+        # generateName semantics: the sequence keeps names readable and
+        # roughly ordered, but uniqueness must hold across REPLICAS — two
+        # operators each start their counter at zero, and a failover's
+        # dual-writer window would collide on bare sequence names (k8s
+        # solves this with a random generateName suffix)
         self._claim_seq += 1
-        return create_claim_from_spec(
-            self.cluster, self.cp, spec, f"{spec.nodepool}-{self._claim_seq}")
+        name = f"{spec.nodepool}-{self._claim_seq}"
+        import uuid
+        if name in self.cluster.nodeclaims:
+            name = f"{spec.nodepool}-{uuid.uuid4().hex[:8]}"
+        try:
+            return create_claim_from_spec(self.cluster, self.cp, spec, name)
+        except ValueError:
+            # the authoritative store held the name even though our cache
+            # didn't (peer's create not yet synced): retry under a random
+            # name — the window where this recurses twice is negligible
+            return create_claim_from_spec(
+                self.cluster, self.cp, spec,
+                f"{spec.nodepool}-{uuid.uuid4().hex[:8]}")
 
 
 def create_claim_from_spec(cluster: Cluster, cp: TPUCloudProvider,

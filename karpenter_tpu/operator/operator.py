@@ -129,7 +129,12 @@ class Operator:
         handler = self._make_handler()
         ports = []
         for port in (self.metrics_port, self.health_port):
-            srv = ThreadingHTTPServer(("127.0.0.1", port), handler)
+            # loopback by default; a containerized replica sets
+            # KARPENTER_TPU_BIND_HOST=0.0.0.0 so published ports and
+            # healthchecks actually reach the server (deploy/)
+            import os as _os
+            host = _os.environ.get("KARPENTER_TPU_BIND_HOST", "127.0.0.1")
+            srv = ThreadingHTTPServer((host, port), handler)
             ports.append(srv.server_address[1])  # resolves port 0 → actual
             t = threading.Thread(target=srv.serve_forever, daemon=True,
                                  name=f"http-{srv.server_address[1]}")
@@ -197,6 +202,12 @@ class Operator:
                     left = deadline - time.monotonic()
                     if left <= 0 or watch.wait(timeout=min(left, 0.25)):
                         break
+                    # peer replicas' writes arrive via the store backend,
+                    # not the local watch — apply them on the wait tick so
+                    # a pod created through another replica wakes this
+                    # loop with informer latency (applying publishes to
+                    # the local watch, which the next wait() observes)
+                    self.env.cluster.sync_backend()
         finally:
             self.env.cluster.unwatch(watch)
             if self.elector is not None:
@@ -223,13 +234,38 @@ def main() -> int:
     from karpenter_tpu.utils.platform import configure
     configure()
 
+    # HA deployment plumbing (deploy/: 2 replicas, one store daemon, one
+    # shared lease — charts/karpenter/values.yaml:35's layout):
+    #   KARPENTER_TPU_STORE_SOCKET  unix socket of a StoreDaemon; this
+    #                               replica's cluster becomes an informer
+    #                               cache over it (docs/store-backends.md)
+    #   KARPENTER_TPU_LEASE_FILE    shared file lease → leader election
+    #   KARPENTER_TPU_REPLICA_ID    identity in the lease (default pid)
+    env = None
+    store_sock = os.environ.get("KARPENTER_TPU_STORE_SOCKET")
+    if store_sock:
+        from karpenter_tpu.env import Environment
+        from karpenter_tpu.store import RemoteBackend
+        from karpenter_tpu.utils.clock import RealClock
+        env = Environment(clock=RealClock(), options=Options.from_env(),
+                          store_backend=RemoteBackend(store_sock))
+    lease = None
+    identity = None
+    lease_file = os.environ.get("KARPENTER_TPU_LEASE_FILE")
+    if lease_file:
+        from karpenter_tpu.operator.leaderelection import FileLease
+        lease = FileLease(lease_file)
+        identity = os.environ.get(
+            "KARPENTER_TPU_REPLICA_ID", f"replica-{os.getpid()}")
     op = Operator(
         metrics_port=int(os.environ.get("KARPENTER_TPU_METRICS_PORT", 8000)),
-        health_port=int(os.environ.get("KARPENTER_TPU_HEALTH_PORT", 8081)))
+        health_port=int(os.environ.get("KARPENTER_TPU_HEALTH_PORT", 8081)),
+        env=env, lease=lease, identity=identity)
     op.install_signal_handlers()
     op.serve()  # bind before the banner so the printed ports are real
     print(f"karpenter-tpu operator: cluster={op.options.cluster_name} "
-          f"metrics=:{op.metrics_port} health=:{op.health_port}",
+          f"metrics=:{op.metrics_port} health=:{op.health_port}"
+          + (f" replica={identity}" if identity else ""),
           flush=True)
     op.run()
     return 0

@@ -5,8 +5,10 @@ reference's controllers actually use
 (/root/reference/cmd/controller/main.go:46-54):
 
 - `load(kind)` — authoritative name→object snapshot (relist/recovery).
-- `put(kind, name, obj)` — upsert the authoritative copy. Called by the
-  cluster AFTER the local cache mutation; the object may be the same
+- `put(kind, name, obj)` — upsert the authoritative copy; returns False
+  when the store rejected the write as a conflict (create of an existing
+  name, modify of a deleted one — the apiserver-409 analogue). Called by
+  the cluster AFTER the local cache mutation; the object may be the same
   mutable instance the cache holds, so implementations must serialize
   (or copy) before returning.
 - `delete(kind, name)` — remove the authoritative copy.
@@ -28,7 +30,7 @@ class StoreBackend:
         raise NotImplementedError
 
     def put(self, kind: str, name: str, obj: object,
-            verb: str = "modified") -> None:
+            verb: str = "modified") -> bool:
         raise NotImplementedError
 
     def delete(self, kind: str, name: str) -> None:
@@ -51,8 +53,8 @@ class InMemoryBackend(StoreBackend):
         return {}
 
     def put(self, kind: str, name: str, obj: object,
-            verb: str = "modified") -> None:
-        pass
+            verb: str = "modified") -> bool:
+        return True
 
     def delete(self, kind: str, name: str) -> None:
         pass

@@ -110,6 +110,15 @@ class StoreDaemon:
                             # (kube-apiserver rejects this with a conflict);
                             # the writer's cache converges on its next sync.
                             conflict = True
+                        elif verb == "added" and msg["name"] in kind_map:
+                            # create of a name a peer already created (the
+                            # failover dual-writer window with colliding
+                            # generated names): last-write-wins would
+                            # silently destroy the peer's object and leak
+                            # whatever cloud resource it tracked — reject,
+                            # like an apiserver 409; the writer rolls back
+                            # its cache and retries under a fresh name.
+                            conflict = True
                         else:
                             conflict = False
                             kind_map[msg["name"]] = msg["data"]
@@ -216,13 +225,17 @@ class RemoteBackend:
         return {name: pickle.loads(data) for name, data in items.items()}
 
     def put(self, kind: str, name: str, obj: object,
-            verb: str = "modified") -> None:
-        # a conflict reply (modify of a peer-deleted object) is silently
-        # dropped: the watch stream delivers the delete and the local
-        # cache converges — same shape as an informer absorbing a 409
-        self._call({"op": "put", "kind": kind, "name": name, "verb": verb,
-                    "data": pickle.dumps(
-                        obj, protocol=pickle.HIGHEST_PROTOCOL)})
+            verb: str = "modified") -> bool:
+        # False = the daemon rejected the write as a conflict (create of
+        # an existing name, modify of a peer-deleted one). Modify
+        # conflicts are absorbable (the watch stream delivers the delete
+        # and the cache converges); CREATE conflicts must bubble so the
+        # writer can roll back its cache and pick a fresh name.
+        out = self._call({"op": "put", "kind": kind, "name": name,
+                          "verb": verb,
+                          "data": pickle.dumps(
+                              obj, protocol=pickle.HIGHEST_PROTOCOL)})
+        return bool(out.get("ok", True))
 
     def delete(self, kind: str, name: str) -> None:
         self._call({"op": "delete", "kind": kind, "name": name})

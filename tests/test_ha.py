@@ -147,3 +147,91 @@ class TestReplicaPairE2E:
                 op.stop()
             for th in threads:
                 th.join(timeout=5)
+
+
+class TestTwoReplicaExternalStore:
+    def test_leader_killed_mid_provisioning_loses_no_pods(self, tmp_path):
+        """The production layout (VERDICT r3 #8): two operator replicas,
+        each with its OWN informer cache, sharing one external store
+        daemon (the apiserver analogue), one cloud, and one file lease —
+        the deploy/ manifest's shape in-process. The leader is killed
+        mid-provisioning without releasing its lease; the standby must
+        take over and finish: every pod scheduled, none lost."""
+        from karpenter_tpu.providers.fake_cloud import FakeCloud
+        from karpenter_tpu.store import RemoteBackend, StoreDaemon
+        from karpenter_tpu.utils.clock import RealClock
+
+        opts = Options(batch_idle_duration=0)
+        daemon = StoreDaemon(str(tmp_path / "store.sock"))
+        cloud = FakeCloud(clock=RealClock())
+        envs = []
+        for _ in range(2):
+            envs.append(Environment(
+                clock=RealClock(), options=opts, cloud=cloud,
+                store_backend=RemoteBackend(daemon.path)))
+        env_a, env_b = envs
+        env_a.add_default_nodeclass()
+        env_a.cluster.nodepools.create(
+            NodePool(meta=ObjectMeta(name="default")))
+
+        lease = FileLease(str(tmp_path / "lease.json"))
+        ops = []
+        for ident, env in (("rep-1", env_a), ("rep-2", env_b)):
+            op = Operator(options=opts, env=env, lease=lease,
+                          identity=ident, metrics_port=0, health_port=0,
+                          reconcile_interval=0.05)
+            op.elector.lease_duration = 1.2
+            op.elector.renew_interval = 0.3
+            op.elector.retry_period = 0.1
+            ops.append(op)
+        threads = [threading.Thread(target=op.run, daemon=True)
+                   for op in ops]
+        for th in threads:
+            th.start()
+        try:
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                leaders = [op for op in ops if op.elector.is_leader]
+                if len(leaders) == 1:
+                    break
+                time.sleep(0.05)
+            assert len(leaders) == 1
+            leader = leaders[0]
+            standby = next(op for op in ops if op is not leader)
+
+            # pods created through the STANDBY's cache: the leader must
+            # see them via the store daemon (cross-replica visibility)
+            for i in range(6):
+                standby.env.cluster.pods.create(mkpod(f"p{i}"))
+            # wait until provisioning has STARTED (claims exist) but not
+            # necessarily finished, then kill the leader without release
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                if leader.env.cluster.nodeclaims.list():
+                    break
+                time.sleep(0.02)
+            assert leader.env.cluster.nodeclaims.list(), \
+                "leader never began provisioning"
+            leader.elector.release = lambda: None  # sudden death
+            leader.stop()
+
+            # standby takes over and finishes the job on ITS OWN cache
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                pods = standby.env.cluster.pods.list()
+                if len(pods) == 6 and all(p.scheduled for p in pods):
+                    break
+                time.sleep(0.05)
+            pods = standby.env.cluster.pods.list()
+            assert len(pods) == 6, "pods were lost across failover"
+            assert all(p.scheduled for p in pods), \
+                "standby never finished provisioning"
+            assert standby.elector.is_leader
+        finally:
+            for op in ops:
+                op.stop()
+            for th in threads:
+                th.join(timeout=5)
+            for env in envs:
+                env.cluster.backend.close()
+            daemon.close()
