@@ -1,4 +1,4 @@
-"""Run all 5 BASELINE config benchmarks; one JSON line each on stdout.
+"""Run all 6 config benchmarks; one JSON line each on stdout.
 
     python benchmarks/run_all.py            # real device if available
     JAX_PLATFORMS=cpu python benchmarks/run_all.py
@@ -16,7 +16,8 @@ import sys
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 CONFIGS = ["config1_inflate.py", "config2_mixed.py", "config3_topology.py",
-           "config4_consolidation.py", "config5_burst.py"]
+           "config4_consolidation.py", "config5_burst.py",
+           "config6_interruption.py"]
 TIMEOUT = float(os.environ.get("KARPENTER_TPU_BENCH_TIMEOUT", "600"))
 
 if __name__ == "__main__":
