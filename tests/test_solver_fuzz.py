@@ -561,3 +561,74 @@ class TestFuzzMixed:
             assert node_gap <= 2, (
                 f"MIXED_SEED={seed}: solver {res.node_count()} nodes vs "
                 f"oracle {oracle.node_count()} (gap {node_gap} > 2)")
+
+
+class TestFuzzSweep:
+    """Randomized leave-k-out sweeps: the device fast path must match the
+    generic batched path exactly on arbitrary cluster snapshots, pod
+    mixes, exclusion widths, and price caps."""
+
+    @pytest.mark.parametrize("seed", range(12))
+    def test_seeded_sweep_matches_generic(self, seed):
+        import dataclasses
+
+        from karpenter_tpu.scheduling import ExistingNode, ScheduleInput
+        from karpenter_tpu.solver import TPUSolver
+
+        rng = np.random.RandomState(1000 + seed)
+        catalog = CATALOG
+        n_nodes = int(rng.randint(6, 20))
+        zones = ["tpu-west-1a", "tpu-west-1b", "tpu-west-1c"]
+        nodes = []
+        for i in range(n_nodes):
+            alloc = Resources.of(
+                cpu=float(rng.choice([4000, 8000, 16000])),
+                memory=float(rng.choice([8192, 16384, 32768])), pods=58)
+            node = Node(meta=ObjectMeta(name=f"fz{i}", labels={
+                wellknown.ZONE_LABEL: zones[int(rng.randint(3))],
+                wellknown.CAPACITY_TYPE_LABEL:
+                    ["spot", "on-demand"][int(rng.randint(2))],
+                wellknown.NODEPOOL_LABEL: "default",
+                wellknown.ARCH_LABEL: "amd64",
+                wellknown.OS_LABEL: "linux",
+                wellknown.HOSTNAME_LABEL: f"fz{i}"}),
+                allocatable=alloc, ready=bool(rng.rand() > 0.1))
+            pods = []
+            for j in range(int(rng.randint(1, 4))):
+                p = Pod(meta=ObjectMeta(name=f"fz{i}-p{j}"),
+                        requests=Resources.of(
+                            cpu=float(rng.choice([250, 500, 1000, 2000])),
+                            memory=float(rng.choice([512, 1024, 4096])),
+                            pods=1),
+                        node_name=f"fz{i}")
+                pods.append(p)
+            used = Resources()
+            for p in pods:
+                used = used + p.requests
+            nodes.append(ExistingNode(node=node,
+                                      available=node.allocatable - used,
+                                      pods=pods))
+        pool = NodePool(meta=ObjectMeta(name="default"))
+        inps = []
+        k = int(rng.randint(1, 3))  # leave-one-out and leave-two-out mixes
+        for start in range(0, n_nodes - k + 1, k):
+            excl = tuple(range(start, start + k))
+            pods = [p for e in excl for p in nodes[e].pods]
+            cap = float(rng.choice([0.05, 0.2, 1.0, np.inf]))
+            inps.append(ScheduleInput(
+                pods=pods, nodepools=[pool],
+                instance_types={"default": catalog},
+                existing_nodes=[en for i, en in enumerate(nodes)
+                                if i not in excl],
+                price_cap=None if np.isinf(cap) else cap,
+                exist_base=nodes, exist_excluded=excl))
+        fast = TPUSolver(mesh="off").solve_batch(inps, max_nodes=8)
+        generic = TPUSolver(mesh="off").solve_batch(
+            [dataclasses.replace(i_, exist_base=None, exist_excluded=None)
+             for i_ in inps], max_nodes=8)
+        for i, (f, g) in enumerate(zip(fast, generic)):
+            assert dict(f.existing_assignments) == dict(
+                g.existing_assignments), (seed, i)
+            assert set(f.unschedulable) == set(g.unschedulable), (seed, i)
+            assert f.node_count() == g.node_count(), (seed, i)
+            assert abs(f.total_price() - g.total_price()) < 1e-6, (seed, i)

@@ -156,6 +156,45 @@ class TestSolverService:
             local = Scheduler(inp).solve()
             assert res.node_count() == local.node_count()
 
+    def test_sweep_batch_through_daemon(self, client):
+        """The leave-one-out provenance (ScheduleInput.exist_base) must
+        survive the pickle boundary: inputs serialized in ONE request keep
+        their shared snapshot identity after unpickling, so the daemon's
+        backend takes the sweep fast path — and the results must match a
+        local in-process solve exactly."""
+        from karpenter_tpu.models import Node, wellknown
+        from karpenter_tpu.scheduling import ExistingNode
+        from karpenter_tpu.solver import TPUSolver
+        nodes = []
+        for i in range(8):
+            n = Node(meta=ObjectMeta(name=f"sw{i}", labels={
+                wellknown.ZONE_LABEL: f"tpu-west-1{'abc'[i % 3]}",
+                wellknown.CAPACITY_TYPE_LABEL: "spot",
+                wellknown.NODEPOOL_LABEL: "default",
+                wellknown.ARCH_LABEL: "amd64",
+                wellknown.OS_LABEL: "linux",
+                wellknown.HOSTNAME_LABEL: f"sw{i}"}),
+                allocatable=Resources.of(cpu=16000, memory=32768, pods=58),
+                ready=True)
+            p = Pod(meta=ObjectMeta(name=f"swr{i}"),
+                    requests=Resources.parse({"cpu": "500m",
+                                              "memory": "1Gi"}),
+                    node_name=f"sw{i}")
+            nodes.append(ExistingNode(
+                node=n, available=n.allocatable - p.requests, pods=[p]))
+        inps = [ScheduleInput(
+            pods=list(nodes[i].pods), nodepools=[POOL],
+            instance_types={"default": CATALOG},
+            existing_nodes=nodes[:i] + nodes[i + 1:], price_cap=0.5,
+            exist_base=nodes, exist_excluded=(i,)) for i in range(8)]
+        remote = client.solve_batch(inps, max_nodes=8)
+        local = TPUSolver(mesh="off").solve_batch(inps, max_nodes=8)
+        for i, (r, l) in enumerate(zip(remote, local)):
+            assert dict(r.existing_assignments) == dict(
+                l.existing_assignments), i
+            assert set(r.unschedulable) == set(l.unschedulable), i
+            assert r.node_count() == l.node_count(), i
+
     def test_error_response_on_garbage(self, daemon):
         s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         s.connect(daemon)

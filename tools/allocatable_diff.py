@@ -1,0 +1,91 @@
+#!/usr/bin/env python3
+"""Compare the catalog's COMPUTED allocatable against what a live node
+actually reports — the reference's tools/allocatable-diff
+(/root/reference/tools/allocatable-diff): drift between the scheduler's
+capacity model and kubelet reality silently over- or under-packs nodes.
+
+Here "live" = a node provisioned through the full controller stack in the
+fake cloud (the same claim → launch → register path a real node takes),
+optionally under a NodeClass with kubelet config / device mappings so the
+allocatable math (providers/instancetype.apply_node_class) is exercised
+end to end.
+
+Usage:
+    python tools/allocatable_diff.py [--types m6.large,c6.xlarge] [--max-pods N]
+Exit code 1 if any type diverges.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--types", default="",
+                    help="comma-separated type names (default: a sample)")
+    ap.add_argument("--max-pods", type=int, default=None,
+                    help="kubelet maxPods override to exercise")
+    args = ap.parse_args()
+
+    os.environ.setdefault("KARPENTER_TPU_PLATFORM", "cpu")
+    # persistent compile cache + platform pin: without configure() the
+    # first solve pays a full cold XLA compile
+    from karpenter_tpu.utils.platform import configure
+    configure()
+    from karpenter_tpu.env import Environment
+    from karpenter_tpu.models import (
+        KubeletConfiguration, NodePool, ObjectMeta, Pod, Requirement,
+        Requirements, Resources, wellknown)
+    from karpenter_tpu.operator.options import Options
+    from karpenter_tpu.models.resources import RESOURCE_AXIS
+
+    env = Environment(options=Options(batch_idle_duration=0))
+    nc = env.add_default_nodeclass()
+    if args.max_pods is not None:
+        nc.kubelet = KubeletConfiguration(max_pods=args.max_pods)
+        env.cluster.nodeclasses.update(nc)
+    env.cluster.nodepools.create(NodePool(meta=ObjectMeta(name="default")))
+
+    names = ([t for t in args.types.split(",") if t]
+             or ["m6.large", "c6.2xlarge", "r7.4xlarge", "m6d.2xlarge"])
+    computed = {it.name: it
+                for it in env.instance_types.list(nc) if it.name in names}
+    missing = set(names) - set(computed)
+    if missing:
+        print(f"unknown types: {sorted(missing)}", file=sys.stderr)
+        return 1
+
+    rc = 0
+    for name in names:
+        it = computed[name]
+        # provision one node of exactly this type
+        pod = Pod(meta=ObjectMeta(name=f"probe-{name.replace('.', '-')}"),
+                  requests=Resources.parse({"cpu": "100m", "memory": "128Mi"}))
+        pod.requirements = Requirements(Requirement.make(
+            wellknown.INSTANCE_TYPE_LABEL, "In", name))
+        env.cluster.pods.create(pod)
+        env.settle()
+        live = env.cluster.nodes.get(pod.node_name)
+        if live is None:
+            print(f"{name}: FAILED to provision", file=sys.stderr)
+            rc = 1
+            continue
+        want = it.allocatable()
+        diffs = []
+        for axis, w, g in zip(RESOURCE_AXIS, want.v, live.allocatable.v):
+            if abs(w - g) > 1e-6:
+                diffs.append(f"{axis}: computed={w:.1f} live={g:.1f}")
+        status = "OK" if not diffs else "DIVERGED " + "; ".join(diffs)
+        if diffs:
+            rc = 1
+        print(f"{name:16s} {status}")
+        env.cluster.pods.delete(pod.meta.name)
+        env.settle()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,53 @@
+#!/usr/bin/env python3
+"""Compatibility matrix — the reference's tools/kompat
+(/root/reference/tools/kompat): which cluster (k8s) minor versions each
+framework release supports, rendered for docs or queried in CI.
+
+Usage:
+    python tools/kompat.py                  # render the matrix
+    python tools/kompat.py --check 1.29     # exit 1 if unsupported by HEAD
+"""
+
+import argparse
+import sys
+
+# release → (min minor, max minor). HEAD rides the newest row. The fake
+# cloud's version provider reports within this window
+# (karpenter_tpu/providers/version.py).
+MATRIX = {
+    "0.1": ("1.26", "1.28"),
+    "0.2": ("1.27", "1.29"),
+    "0.3": ("1.27", "1.30"),
+    "0.4": ("1.28", "1.31"),
+}
+
+
+def _minor(v: str) -> int:
+    return int(v.split(".")[1])
+
+
+def supported(release: str, k8s: str) -> bool:
+    lo, hi = MATRIX[release]
+    return _minor(lo) <= _minor(k8s) <= _minor(hi)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--check", metavar="K8S_VERSION",
+                    help="verify HEAD supports this cluster version")
+    args = ap.parse_args()
+    head = max(MATRIX)
+    if args.check:
+        ok = supported(head, args.check)
+        print(f"karpenter-tpu {head} + k8s {args.check}: "
+              f"{'supported' if ok else 'UNSUPPORTED'}")
+        return 0 if ok else 1
+    print(f"{'release':10s} {'k8s minors':>12s}")
+    for rel, (lo, hi) in MATRIX.items():
+        marker = "  (HEAD)" if rel == head else ""
+        print(f"{rel:10s} {lo:>5s} - {hi}{marker}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
