@@ -152,6 +152,12 @@ def _solve_ffd_impl(
     exist_ct: jnp.ndarray,        # [E] i32
     max_nodes: int = 1024,
     zc: int = 1,                  # grid stride: columns per (pool,type)
+    with_topology: bool = True,   # static: False skips TRACING the heavy
+                                  # domain branch entirely (sweep path —
+                                  # lax.cond compiles both sides, and the
+                                  # vmapped consolidation kernel must not
+                                  # pay TPU compile time for a branch its
+                                  # caller guarantees unreachable)
 ):
     G, RDIM = group_req.shape
     E = exist_remaining.shape[0]
@@ -539,6 +545,8 @@ def _solve_ffd_impl(
                        dom_placed=dom_placed)
             return out_carry, out
 
+        if not with_topology:
+            return light(carry)
         return jax.lax.cond(dsel > 0, heavy, light, carry)
 
     xs = (group_req, group_count, group_mask, exist_cap, group_ncap,
@@ -564,7 +572,8 @@ def _solve_ffd_impl(
     return packed
 
 
-solve_ffd = partial(jax.jit, static_argnames=("max_nodes", "zc"))(_solve_ffd_impl)
+solve_ffd = partial(jax.jit, static_argnames=(
+    "max_nodes", "zc", "with_topology"))(_solve_ffd_impl)
 
 # The consolidation simulator's batch axis (SURVEY §7 step 6): many
 # candidate-removal simulations against one cluster state share the catalog
@@ -643,7 +652,7 @@ def solve_ffd_sweep(
             zG,                                 # mindom
             jnp.zeros((G, 1), bool),            # delig
             col_zone, col_ct, exist_zone, exist_ct,
-            max_nodes=max_nodes, zc=zc)
+            max_nodes=max_nodes, zc=zc, with_topology=False)
 
     return jax.vmap(one)(group_req, group_count, group_class,
                          exclude_idx, price_cap, pool_limit)

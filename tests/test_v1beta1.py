@@ -1,0 +1,165 @@
+"""v1beta1 → v1 conversion (the webhook machinery reduced to the
+in-process admission seam; reference: pkg/apis/v1beta1 +
+pkg/webhooks/webhooks.go + ec2nodeclass_conversion.go)."""
+
+from karpenter_tpu.env import Environment
+from karpenter_tpu.models import (
+    KubeletConfiguration,
+    NodePool,
+    ObjectMeta,
+    Pod,
+    Resources,
+)
+from karpenter_tpu.models.objects import SelectorTerm
+from karpenter_tpu.models.objects import (
+    CONSOLIDATE_WHEN_EMPTY_OR_UNDERUTILIZED,
+    CONSOLIDATE_WHEN_UNDERUTILIZED,
+    Budget,
+)
+from karpenter_tpu.models.v1beta1 import (
+    V1Beta1Disruption,
+    V1Beta1NodeClass,
+    V1Beta1NodePool,
+    admit,
+    nodeclass_from_v1,
+    nodeclass_to_v1,
+    nodepool_from_v1,
+    nodepool_to_v1,
+)
+from karpenter_tpu.operator.options import Options
+
+
+class TestNodePoolConversion:
+    def test_expire_after_moves_and_policy_renames(self):
+        b = V1Beta1NodePool(
+            meta=ObjectMeta(name="old"),
+            disruption=V1Beta1Disruption(
+                consolidation_policy=CONSOLIDATE_WHEN_UNDERUTILIZED,
+                consolidate_after=120.0,
+                expire_after=3600.0,
+                budgets=[Budget(nodes="20%")]),
+            weight=5)
+        v1 = nodepool_to_v1(b)
+        assert v1.expire_after == 3600.0  # disruption → template-level
+        assert (v1.disruption.consolidation_policy
+                == CONSOLIDATE_WHEN_EMPTY_OR_UNDERUTILIZED)
+        assert v1.disruption.consolidate_after == 120.0
+        assert v1.weight == 5
+        # round trip is lossless
+        back = nodepool_from_v1(v1)
+        assert back.disruption.expire_after == 3600.0
+        assert (back.disruption.consolidation_policy
+                == CONSOLIDATE_WHEN_UNDERUTILIZED)
+        assert back.disruption.budgets[0].nodes == "20%"
+
+    def test_kubelet_rides_compat_annotation(self):
+        from karpenter_tpu.models.v1beta1 import KUBELET_COMPAT_ANNOTATION
+        b = V1Beta1NodePool(
+            meta=ObjectMeta(name="k"),
+            kubelet=KubeletConfiguration(max_pods=42))
+        v1 = nodepool_to_v1(b)
+        assert KUBELET_COMPAT_ANNOTATION in v1.meta.annotations
+
+
+class TestNodeClassConversion:
+    def test_ami_spellings_and_metadata_default(self):
+        b = V1Beta1NodeClass(
+            meta=ObjectMeta(name="old"),
+            ami_family="ubuntu",
+            ami_selector_terms=[SelectorTerm(tags={"team": "ml"})],
+            metadata_http_tokens="optional")
+        v1 = nodeclass_to_v1(b)
+        assert v1.image_family == "ubuntu"
+        assert v1.image_selector_terms[0].tags == {"team": "ml"}
+        # the old optional-tokens behavior is pinned explicitly — the v1
+        # default hardened to required, and conversion must not silently
+        # change launches
+        assert v1.metadata_options.http_tokens == "optional"
+        back = nodeclass_from_v1(v1)
+        assert back.ami_family == "ubuntu"
+        assert back.metadata_http_tokens == "optional"
+
+    def test_kubelet_attaches_at_conversion(self):
+        b = V1Beta1NodeClass(meta=ObjectMeta(name="k"))
+        v1 = nodeclass_to_v1(b, kubelet=KubeletConfiguration(max_pods=9))
+        assert v1.kubelet.max_pods == 9
+
+
+class TestAdmissionSeam:
+    def test_v1beta1_objects_provision_end_to_end(self):
+        """A user with pre-v1 manifests switches over without edits: the
+        admission seam converts, the kubelet template lands on the
+        NodeClass, and pods schedule under the converted pool."""
+        env = Environment(options=Options(batch_idle_duration=0))
+        admit(env.cluster, V1Beta1NodeClass(meta=ObjectMeta(name="default")))
+        admit(env.cluster, V1Beta1NodePool(
+            meta=ObjectMeta(name="default"),
+            kubelet=KubeletConfiguration(max_pods=3),
+            disruption=V1Beta1Disruption(expire_after=86400.0)))
+        pool = env.cluster.nodepools.get("default")
+        assert pool is not None and pool.expire_after == 86400.0
+        nc = env.cluster.nodeclasses.get("default")
+        assert nc.kubelet is not None and nc.kubelet.max_pods == 3
+        for i in range(7):
+            env.cluster.pods.create(Pod(
+                meta=ObjectMeta(name=f"p{i}"),
+                requests=Resources.parse({"cpu": "10m", "memory": "16Mi"})))
+        env.settle()
+        pods = env.cluster.pods.list()
+        assert pods and all(p.scheduled for p in pods)
+        # max_pods=3 from the v1beta1 template actually binds
+        assert len(env.cluster.nodeclaims.list()) >= 3
+
+    def test_v1_objects_pass_through(self):
+        env = Environment(options=Options(batch_idle_duration=0))
+        env.add_default_nodeclass()
+        admit(env.cluster, NodePool(meta=ObjectMeta(name="plain")))
+        assert env.cluster.nodepools.get("plain") is not None
+
+
+class TestConversionFidelity:
+    def test_meta_annotations_preserved_and_unaliased(self):
+        b = V1Beta1NodePool(
+            meta=ObjectMeta(name="m", annotations={"owner": "ml-team"}),
+            annotations={"tmpl": "1"},
+            kubelet=KubeletConfiguration(max_pods=5))
+        v1 = nodepool_to_v1(b)
+        assert v1.meta.annotations["owner"] == "ml-team"
+        assert v1.annotations == {"tmpl": "1"}
+        assert v1.meta.annotations is not v1.annotations
+        v1.annotations["x"] = "y"
+        assert "x" not in v1.meta.annotations
+
+    def test_kubelet_round_trip_is_lossless(self):
+        kub = KubeletConfiguration(
+            max_pods=5, pods_per_core=2,
+            kube_reserved={"cpu": "100m"},
+            eviction_hard={"memory.available": "5%"})
+        b = V1Beta1NodePool(meta=ObjectMeta(name="rt"), kubelet=kub)
+        back = nodepool_from_v1(nodepool_to_v1(b))
+        assert back.kubelet == kub
+        # and the compat annotation does not leak into the round-tripped
+        # object metadata
+        from karpenter_tpu.models.v1beta1 import KUBELET_COMPAT_ANNOTATION
+        assert KUBELET_COMPAT_ANNOTATION not in back.meta.annotations
+
+    def test_pool_before_class_admission_order(self):
+        """kubectl-apply ordering is unordered: admitting the pool first
+        must still land its template kubelet on the class."""
+        env = Environment(options=Options(batch_idle_duration=0))
+        admit(env.cluster, V1Beta1NodePool(
+            meta=ObjectMeta(name="default"),
+            kubelet=KubeletConfiguration(max_pods=7)))
+        admit(env.cluster, V1Beta1NodeClass(meta=ObjectMeta(name="default")))
+        nc = env.cluster.nodeclasses.get("default")
+        assert nc.kubelet is not None and nc.kubelet.max_pods == 7
+
+    def test_explicit_v1_kubelet_wins(self):
+        env = Environment(options=Options(batch_idle_duration=0))
+        nc = env.add_default_nodeclass()
+        nc.kubelet = KubeletConfiguration(max_pods=99)
+        env.cluster.nodeclasses.update(nc)
+        admit(env.cluster, V1Beta1NodePool(
+            meta=ObjectMeta(name="default"),
+            kubelet=KubeletConfiguration(max_pods=7)))
+        assert env.cluster.nodeclasses.get("default").kubelet.max_pods == 99

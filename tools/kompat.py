@@ -22,13 +22,16 @@ MATRIX = {
 }
 
 
-def _minor(v: str) -> int:
-    return int(v.split(".")[1])
+def _vt(v: str) -> tuple:
+    """Numeric (major, minor) — string comparison breaks at two-digit
+    components ('0.10' < '0.4' lexicographically)."""
+    parts = v.split(".")
+    return (int(parts[0]), int(parts[1]))
 
 
 def supported(release: str, k8s: str) -> bool:
     lo, hi = MATRIX[release]
-    return _minor(lo) <= _minor(k8s) <= _minor(hi)
+    return _vt(lo) <= _vt(k8s) <= _vt(hi)
 
 
 def main() -> int:
@@ -36,7 +39,7 @@ def main() -> int:
     ap.add_argument("--check", metavar="K8S_VERSION",
                     help="verify HEAD supports this cluster version")
     args = ap.parse_args()
-    head = max(MATRIX)
+    head = max(MATRIX, key=_vt)
     if args.check:
         ok = supported(head, args.check)
         print(f"karpenter-tpu {head} + k8s {args.check}: "
