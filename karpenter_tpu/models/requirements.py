@@ -212,11 +212,16 @@ class Requirements:
     shared keys), Intersects.
     """
 
-    __slots__ = ("_reqs", "_hash")
+    __slots__ = ("_reqs", "_hash", "_sat")
 
     def __init__(self, *reqs: Requirement):
         self._reqs: Dict[str, Requirement] = {}
         self._hash: Optional[int] = None
+        # memoized "no key is unsatisfiable" verdict: compatible() re-scans
+        # every own requirement per call, and instance-type requirement sets
+        # are immutable in practice — the oracle's per-(pod×type) checks
+        # were ~1M is_unsatisfiable calls per 5k-pod solve without this
+        self._sat: Optional[bool] = None
         for r in reqs:
             self.add(r)
 
@@ -250,6 +255,7 @@ class Requirements:
         cur = self._reqs.get(req.key)
         self._reqs[req.key] = cur.intersect(req) if cur is not None else req
         self._hash = None
+        self._sat = None
 
     def update(self, other: "Requirements") -> None:
         for r in other:
@@ -259,6 +265,7 @@ class Requirements:
         out = Requirements()
         out._reqs = dict(self._reqs)
         out._hash = self._hash
+        out._sat = self._sat
         return out
 
     # -- algebra ---------------------------------------------------------
@@ -282,7 +289,10 @@ class Requirements:
                 continue
             if not cur.intersects(req):
                 return False
-        return not any(r.is_unsatisfiable() for r in self._reqs.values())
+        if self._sat is None:
+            self._sat = not any(
+                r.is_unsatisfiable() for r in self._reqs.values())
+        return self._sat
 
     def conflict_key(self, other: "Requirements") -> Optional[str]:
         """First key whose intersection is empty, for error messages."""

@@ -168,8 +168,14 @@ class Resources:
         return Resources([a * k for a in self.v])
 
     def fits(self, capacity: "Resources", eps: float = 1e-9) -> bool:
-        """True if self ≤ capacity elementwise (with float slack)."""
-        return all(a <= b + eps for a, b in zip(self.v, capacity.v))
+        """True if self ≤ capacity elementwise (with float slack). Plain
+        indexed loop: this is the oracle's innermost check (~1M calls per
+        5k-pod solve) and the generator+zip form cost ~2x."""
+        a, b = self.v, capacity.v
+        for i in range(len(a)):
+            if a[i] > b[i] + eps:
+                return False
+        return True
 
     def any_negative(self) -> bool:
         return any(a < -1e-9 for a in self.v)

@@ -217,9 +217,15 @@ def _attach_pending_kubelet(cluster, nc: NodeClass) -> None:
     """Apply any admitted pool's compat-annotation kubelet to this class
     — admission order (pool-then-class or class-then-pool) must not
     matter, exactly as kubectl-apply ordering doesn't. An explicit v1
-    kubelet on the class wins over the converted template config."""
-    if nc.kubelet is not None:
-        return
+    kubelet on the class wins over the converted template config.
+
+    LIMITATION (intentional, observable): v1 hangs kubelet config on the
+    NodeClass, so several v1beta1 pools sharing one class flatten to ONE
+    config — the first attached wins, and any DIFFERING later config
+    raises a `KubeletConversionConflict` event telling the operator to
+    split the class (the reference's v1 migration guide gives the same
+    instruction for per-pool kubelet divergence)."""
+    pending = []
     for pool in cluster.nodepools.list():
         if pool.node_class_ref != nc.name:
             continue
@@ -228,9 +234,17 @@ def _attach_pending_kubelet(cluster, nc: NodeClass) -> None:
             continue
         kub = _load_kubelet(raw)
         if kub is not None:
+            pending.append((pool.name, kub))
+    for pool_name, kub in pending:
+        if nc.kubelet is None:
             nc.kubelet = kub
             cluster.nodeclasses.update(nc)
-            return
+        elif nc.kubelet != kub:
+            cluster.record_event(
+                "NodeClass", nc.name, "KubeletConversionConflict",
+                f"pool {pool_name}'s v1beta1 kubelet config differs from "
+                f"the one already on this class; split the NodeClass to "
+                f"keep per-pool kubelet settings")
 
 
 def admit(cluster, obj) -> object:
@@ -240,19 +254,21 @@ def admit(cluster, obj) -> object:
     referenced NodeClass regardless of which object is admitted first
     (the reference's conversion carries it the same direction)."""
     if isinstance(obj, V1Beta1NodePool):
-        v1 = nodepool_to_v1(obj)
-        out = cluster.nodepools.create(v1)
-        if obj.kubelet is not None:
-            nc = cluster.nodeclasses.get(obj.node_class_ref)
-            if nc is not None:
-                _attach_pending_kubelet(cluster, nc)
-        return out
+        obj = nodepool_to_v1(obj)
+        # falls through to the NodePool branch: a converted pool and a
+        # pre-converted v1 pool carrying the compat annotation behave
+        # identically regardless of admission order
     if isinstance(obj, V1Beta1NodeClass):
         nc = cluster.nodeclasses.create(nodeclass_to_v1(obj))
         _attach_pending_kubelet(cluster, nc)
         return nc
     if isinstance(obj, NodePool):
-        return cluster.nodepools.create(obj)
+        out = cluster.nodepools.create(obj)
+        if KUBELET_COMPAT_ANNOTATION in obj.meta.annotations:
+            nc = cluster.nodeclasses.get(obj.node_class_ref)
+            if nc is not None:
+                _attach_pending_kubelet(cluster, nc)
+        return out
     if isinstance(obj, NodeClass):
         nc = cluster.nodeclasses.create(obj)
         _attach_pending_kubelet(cluster, nc)

@@ -163,3 +163,31 @@ class TestConversionFidelity:
             meta=ObjectMeta(name="default"),
             kubelet=KubeletConfiguration(max_pods=7)))
         assert env.cluster.nodeclasses.get("default").kubelet.max_pods == 99
+
+    def test_annotated_v1_pool_attaches_after_class(self):
+        """nodepool_to_v1 output admitted as a plain v1 object (re-applied
+        converted manifests) must attach its kubelet annotation too."""
+        env = Environment(options=Options(batch_idle_duration=0))
+        admit(env.cluster, V1Beta1NodeClass(meta=ObjectMeta(name="default")))
+        v1pool = nodepool_to_v1(V1Beta1NodePool(
+            meta=ObjectMeta(name="default"),
+            kubelet=KubeletConfiguration(max_pods=7)))
+        admit(env.cluster, v1pool)
+        assert env.cluster.nodeclasses.get("default").kubelet.max_pods == 7
+
+    def test_divergent_pool_kubelets_raise_conflict_event(self):
+        """Two v1beta1 pools with DIFFERENT template kubelets sharing one
+        class: the first wins, the second raises an observable conflict
+        event (v1 hangs kubelet on the class — the operator must split
+        the class to keep per-pool settings)."""
+        env = Environment(options=Options(batch_idle_duration=0))
+        admit(env.cluster, V1Beta1NodeClass(meta=ObjectMeta(name="default")))
+        admit(env.cluster, V1Beta1NodePool(
+            meta=ObjectMeta(name="a"),
+            kubelet=KubeletConfiguration(max_pods=10)))
+        admit(env.cluster, V1Beta1NodePool(
+            meta=ObjectMeta(name="b"),
+            kubelet=KubeletConfiguration(max_pods=200)))
+        assert env.cluster.nodeclasses.get("default").kubelet.max_pods == 10
+        reasons = {r for _, _, _, r, _ in env.cluster.events}
+        assert "KubeletConversionConflict" in reasons
