@@ -235,3 +235,97 @@ class TestTwoReplicaExternalStore:
             for env in envs:
                 env.cluster.backend.close()
             daemon.close()
+
+
+class TestFullProductionTopology:
+    def test_store_daemon_solverd_and_two_replicas(self, tmp_path):
+        """The deploy/ manifest's complete shape, in-process: one store
+        daemon (apiserver analogue), one NATIVE solverd owning the solver
+        (shared by both replicas over its coalescing socket), two
+        operator replicas with separate informer caches racing one file
+        lease. Pods created through the standby provision via
+        leader → solverd → shared cloud, and failover keeps the stack
+        working without re-paying solver state."""
+        from karpenter_tpu.providers.fake_cloud import FakeCloud
+        from karpenter_tpu.store import RemoteBackend, StoreDaemon
+        from karpenter_tpu.utils.clock import RealClock
+        from tests.test_solver_service import build_daemon, spawn_daemon
+
+        build_daemon()  # skips the test if the toolchain can't
+        solver_sock = str(tmp_path / "kt.sock")
+        proc, dump = spawn_daemon(solver_sock)
+        store = StoreDaemon(str(tmp_path / "store.sock"))
+        lease = FileLease(str(tmp_path / "lease.json"))
+        cloud = FakeCloud(clock=RealClock())
+        opts = Options(batch_idle_duration=0, solver_endpoint=solver_sock)
+        envs = [Environment(clock=RealClock(), options=opts, cloud=cloud,
+                            store_backend=RemoteBackend(store.path))
+                for _ in range(2)]
+        envs[0].add_default_nodeclass()
+        envs[0].cluster.nodepools.create(
+            NodePool(meta=ObjectMeta(name="default")))
+        ops = []
+        for ident, env in zip(("rep-1", "rep-2"), envs):
+            op = Operator(options=opts, env=env, lease=lease,
+                          identity=ident, metrics_port=0, health_port=0,
+                          reconcile_interval=0.05)
+            op.elector.lease_duration = 1.5
+            op.elector.renew_interval = 0.3
+            op.elector.retry_period = 0.1
+            ops.append(op)
+        threads = [threading.Thread(target=op.run, daemon=True)
+                   for op in ops]
+        for th in threads:
+            th.start()
+        try:
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                leaders = [op for op in ops if op.elector.is_leader]
+                if len(leaders) == 1:
+                    break
+                time.sleep(0.05)
+            assert len(leaders) == 1
+            leader = leaders[0]
+            standby = next(op for op in ops if op is not leader)
+            # pods through the standby; the leader schedules them via the
+            # NATIVE solver daemon (a cold compile cache makes the first
+            # solve pay the full XLA compile — budget for it)
+            for i in range(4):
+                standby.env.cluster.pods.create(mkpod(f"s{i}"))
+            deadline = time.time() + 300
+            while time.time() < deadline:
+                pods = leader.env.cluster.pods.list()
+                if len(pods) == 4 and all(p.scheduled for p in pods):
+                    break
+                time.sleep(0.1)
+            pods = leader.env.cluster.pods.list()
+            assert len(pods) == 4 and all(p.scheduled for p in pods), \
+                f"--- solverd stderr ---\n{dump()}"
+            # kill the leader without release; standby finishes new work
+            # over the SAME solver daemon (no device re-init)
+            leader.elector.release = lambda: None
+            leader.stop()
+            standby.env.cluster.pods.create(mkpod("after"))
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                p = standby.env.cluster.pods.get("after")
+                if p is not None and p.scheduled:
+                    break
+                time.sleep(0.1)
+            p = standby.env.cluster.pods.get("after")
+            assert p is not None and p.scheduled, \
+                f"--- solverd stderr ---\n{dump()}"
+            assert standby.elector.is_leader
+        finally:
+            for op in ops:
+                op.stop()
+            for th in threads:
+                th.join(timeout=10)
+            for env in envs:
+                env.cluster.backend.close()
+            store.close()
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                proc.kill()
