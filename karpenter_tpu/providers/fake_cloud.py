@@ -97,7 +97,12 @@ class LaunchTemplate:
     image_id: str
     user_data: str
     security_group_ids: List[str]
-    block_device_gib: int
+    block_device_gib: int  # root volume (kept for quick assertions)
+    # the FULL device list the instance boots with (family defaults or
+    # explicit spec) + metadata exposure — the cloud stores what the
+    # reference's CreateLaunchTemplate request carries
+    block_device_mappings: Optional[list] = None
+    metadata_options: Optional[object] = None
     tags: Dict[str, str] = field(default_factory=dict)
 
 
@@ -162,7 +167,8 @@ class FakeCloud:
             tags=dict(cluster_tag))
         t = self.clock.now()
         for family, variants in (("cos", ("", "-accelerator")),
-                                 ("ubuntu", ("",))):
+                                 ("ubuntu", ("",)),
+                                 ("accel", ("",))):
             for gen, age in (("v118", 2_000_000.0), ("v121", 1_000.0)):
                 for variant in variants:
                     iid = f"img-{family}-{gen}{variant}"

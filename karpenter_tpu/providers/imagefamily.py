@@ -19,6 +19,8 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from karpenter_tpu.models.objects import (
+    BlockDevice,
+    BlockDeviceMapping,
     InstanceType,
     NodeClass,
     match_selector_terms,
@@ -48,14 +50,27 @@ class ResolvedLaunchConfig:
 
 
 class ImageFamily:
-    """Family interface (resolver.go:79-86): alias for discovery plus the
-    bootstrap script the node runs to join the cluster."""
+    """Family interface (resolver.go:79-86): alias for discovery, the
+    bootstrap script the node runs to join the cluster, and the family's
+    default block devices (resolver.go:94-100 — each reference family
+    ships its own DefaultBlockDeviceMappings; an explicit spec always
+    wins)."""
 
     name = "base"
 
     def user_data(self, cluster_name: str, k8s_version: str,
                   nc: NodeClass) -> str:
         raise NotImplementedError
+
+    def default_block_device_mappings(self, nc: NodeClass):
+        """The mappings a node boots with when the class doesn't pin any
+        (reference: amifamily defaults; e.g. Bottlerocket's two-volume
+        layout vs AL2's single root). Base: one root at the class's
+        legacy scalar size."""
+        return [BlockDeviceMapping(
+            device_name="/dev/sda1",
+            ebs=BlockDevice(volume_size_gib=nc.block_device_gib),
+            root_volume=True)]
 
 
 class COSFamily(ImageFamily):
@@ -76,6 +91,35 @@ class UbuntuFamily(ImageFamily):
         return base + nc.user_data
 
 
+class AccelFamily(ImageFamily):
+    """Accelerator-optimized family: a small OS root plus a separate
+    scratch volume for model/images — the two-volume layout of the
+    reference's Bottlerocket family (bottlerocket.go DefaultBlockDevice-
+    Mappings: 4Gi root + data volume), reshaped for accelerator nodes."""
+    name = "accel"
+    ROOT_GIB = 8
+    MIN_DATA_GIB = 200
+
+    def user_data(self, cluster_name, k8s_version, nc):
+        base = (f"#cloud-config\n# accel node join {cluster_name} "
+                f"(k8s {k8s_version})\nruncmd:\n"
+                f"- kubelet --bootstrap --cluster {cluster_name} "
+                f"--accelerator-runtime\n")
+        return base + nc.user_data
+
+    def default_block_device_mappings(self, nc: NodeClass):
+        return [
+            BlockDeviceMapping(device_name="/dev/sda1",
+                               ebs=BlockDevice(volume_size_gib=self.ROOT_GIB),
+                               root_volume=True),
+            # the DATA volume takes the class's size knob: accel nodes
+            # grow scratch, not OS root
+            BlockDeviceMapping(device_name="/dev/sdb", ebs=BlockDevice(
+                volume_size_gib=max(nc.block_device_gib,
+                                    self.MIN_DATA_GIB))),
+        ]
+
+
 class CustomFamily(ImageFamily):
     """Selector-terms-only: the user supplies the full user-data
     (amifamily/custom.go)."""
@@ -86,7 +130,8 @@ class CustomFamily(ImageFamily):
 
 
 FAMILIES: Dict[str, ImageFamily] = {
-    f.name: f for f in (COSFamily(), UbuntuFamily(), CustomFamily())
+    f.name: f for f in (COSFamily(), UbuntuFamily(), AccelFamily(),
+                        CustomFamily())
 }
 
 
@@ -94,6 +139,31 @@ def get_family(name: str) -> ImageFamily:
     """Dispatch by family name, defaulting like GetAMIFamily
     (resolver.go:163-180)."""
     return FAMILIES.get(name, FAMILIES["cos"])
+
+
+def effective_block_device_mappings(nc: NodeClass):
+    """The device list a node of this class actually boots with: an
+    explicit spec wins, else the family's defaults — ONE definition
+    shared by launch (resolve → launch template) and allocatable math
+    (providers/instancetype.apply_node_class), so the scheduler's
+    ephemeral-storage view can never diverge from the disk the node gets
+    (the reference resolves both from the same amifamily defaults,
+    resolver.go:94-100 + types.go ephemeral math)."""
+    if nc.block_device_mappings is not None:
+        return nc.block_device_mappings
+    return get_family(nc.image_family).default_block_device_mappings(nc)
+
+
+def root_volume_gib_of(mappings, fallback: int) -> int:
+    """Root size of a device list (mapping flagged root, else first, else
+    the legacy scalar) — NodeClass.root_volume_gib over an arbitrary
+    list."""
+    for m in mappings or []:
+        if m.root_volume and m.ebs.volume_size_gib:
+            return m.ebs.volume_size_gib
+    if mappings and mappings[0].ebs.volume_size_gib:
+        return mappings[0].ebs.volume_size_gib
+    return fallback
 
 
 class ImageProvider:
@@ -146,6 +216,7 @@ class ImageProvider:
             return []
         family = get_family(nc.image_family)
         ud = family.user_data(self.cluster_name, self.versions.get(), nc)
+        mappings = effective_block_device_mappings(nc)
         # specific variants (accelerator builds) outrank plain images of the
         # same generation; then newest wins
         images = sorted(images, key=lambda i: (-len(i.requirements),
@@ -160,9 +231,12 @@ class ImageProvider:
         return [
             ResolvedLaunchConfig(
                 image=by_id[iid], instance_type_names=names, user_data=ud,
-                block_device_gib=nc.root_volume_gib(),
+                # one source of truth: the scalar is the ROOT of the
+                # effective device list, never an independent knob
+                block_device_gib=root_volume_gib_of(
+                    mappings, nc.block_device_gib),
                 security_group_ids=list(security_group_ids or []),
-                block_device_mappings=nc.block_device_mappings,
+                block_device_mappings=mappings,
                 metadata_options=nc.metadata_options,
                 instance_store_policy=nc.instance_store_policy)
             for iid, names in assigned.items()

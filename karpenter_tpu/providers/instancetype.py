@@ -65,8 +65,20 @@ def apply_node_class(shape: InstanceType, nc: NodeClass) -> InstanceType:
     already carries the default ladder, and returning the SAME object
     preserves the provider's list-identity cache contract."""
     kub = nc.kubelet
+    # family-default devices count as spec: an accel-family class boots
+    # an 8 GiB root even with no explicit mappings, and advertising the
+    # catalog's generic ephemeral there would pack pods onto disk that
+    # doesn't exist (the reference computes ephemeral from the same
+    # amifamily defaults its launch templates use)
+    from karpenter_tpu.providers.imagefamily import (
+        ImageFamily, effective_block_device_mappings, get_family,
+        root_volume_gib_of)
+    family_has_defaults = (
+        type(get_family(nc.image_family)).default_block_device_mappings
+        is not ImageFamily.default_block_device_mappings)
     if (kub is None and nc.block_device_mappings is None
-            and nc.instance_store_policy is None):
+            and nc.instance_store_policy is None
+            and not family_has_defaults):
         return shape
 
     caps = dict(zip(RESOURCE_AXIS, shape.capacity.v))
@@ -91,8 +103,10 @@ def apply_node_class(shape: InstanceType, nc: NodeClass) -> InstanceType:
         # RAID0 over the local disks IS the node's ephemeral storage
         # (ec2nodeclass.go:384-394)
         ephemeral_mib = nvme_gib * 1024.0
-    elif nc.block_device_mappings is not None:
-        ephemeral_mib = nc.root_volume_gib() * 1024.0
+    elif nc.block_device_mappings is not None or family_has_defaults:
+        eff = effective_block_device_mappings(nc)
+        ephemeral_mib = root_volume_gib_of(
+            eff, nc.block_device_gib) * 1024.0
 
     # -- reserved + eviction overhead ------------------------------------
     mem_mib = caps.get("memory", 0.0)

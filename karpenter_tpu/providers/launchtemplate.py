@@ -79,6 +79,8 @@ class LaunchTemplateProvider:
                         user_data=cfg.user_data,
                         security_group_ids=cfg.security_group_ids,
                         block_device_gib=cfg.block_device_gib,
+                        block_device_mappings=cfg.block_device_mappings,
+                        metadata_options=cfg.metadata_options,
                         tags={TAG_NODECLASS: nc.name,
                               "karpenter.sh/cluster": self.cluster_name},
                     ))

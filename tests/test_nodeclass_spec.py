@@ -219,3 +219,69 @@ class TestLaunchRoundTrip:
         assert len(claims) >= 3
         pods = env.cluster.pods.list()
         assert all(p.scheduled for p in pods)
+
+
+class TestFamilyDefaultDevices:
+    """Per-family default block devices (resolver.go:94-100): an explicit
+    spec always wins; the accel family boots a two-volume layout like the
+    reference's Bottlerocket."""
+
+    def test_default_family_single_root(self, env):
+        nc = env.add_default_nodeclass()
+        cfgs = env.images.resolve(nc, env.instance_types.list(nc)[:5])
+        assert cfgs
+        maps = cfgs[0].block_device_mappings
+        assert len(maps) == 1 and maps[0].root_volume
+        assert maps[0].ebs.volume_size_gib == nc.block_device_gib
+
+    def test_accel_family_two_volumes(self, env):
+        nc = env.add_default_nodeclass(name="accel-class",
+                                       image_family="accel",
+                                       block_device_gib=500)
+        cfgs = env.images.resolve(nc, env.instance_types.list(nc)[:5])
+        assert cfgs, "accel family must resolve images from the cloud"
+        maps = cfgs[0].block_device_mappings
+        assert len(maps) == 2
+        root = next(m for m in maps if m.root_volume)
+        data = next(m for m in maps if not m.root_volume)
+        assert root.ebs.volume_size_gib == 8  # small OS root
+        assert data.ebs.volume_size_gib == 500  # class knob grows scratch
+
+    def test_explicit_mappings_beat_family_defaults(self, env):
+        from karpenter_tpu.models import BlockDevice, BlockDeviceMapping
+        nc = env.add_default_nodeclass(
+            name="pinned", image_family="accel",
+            block_device_mappings=[BlockDeviceMapping(
+                device_name="/dev/xvda",
+                ebs=BlockDevice(volume_size_gib=42), root_volume=True)])
+        cfgs = env.images.resolve(nc, env.instance_types.list(nc)[:5])
+        maps = cfgs[0].block_device_mappings
+        assert len(maps) == 1 and maps[0].ebs.volume_size_gib == 42
+
+    def test_accel_defaults_feed_allocatable_math(self, env):
+        """The scheduler must see the disk the node actually boots with:
+        an accel class with no explicit mappings advertises its 8 GiB
+        family-default root as ephemeral capacity, not the catalog's
+        generic value."""
+        shape = _shape(env)
+        nc = NodeClass(meta=ObjectMeta(name="a"), image_family="accel")
+        it = apply_node_class(shape, nc)
+        assert it.capacity.get("ephemeral-storage") == 8 * 1024
+        # and the launch template carries the same two-volume layout
+        env.cluster.nodeclasses.create(nc)
+        cfgs = env.images.resolve(nc, env.instance_types.list(nc)[:3])
+        lt_maps = cfgs[0].block_device_mappings
+        assert cfgs[0].block_device_gib == 8  # scalar == root of the list
+        assert len(lt_maps) == 2
+
+    def test_cloud_template_stores_device_list(self, env):
+        from karpenter_tpu.models import BlockDevice, BlockDeviceMapping
+        nc = env.add_default_nodeclass(block_device_mappings=[
+            BlockDeviceMapping(device_name="/dev/xvda",
+                               ebs=BlockDevice(volume_size_gib=77),
+                               root_volume=True)])
+        env.launch_templates.ensure_all(nc, env.instance_types.list(nc)[:3])
+        lts = env.cloud.list_launch_templates()
+        assert lts and lts[0].block_device_mappings is not None
+        assert lts[0].block_device_mappings[0].ebs.volume_size_gib == 77
+        assert lts[0].block_device_gib == 77
