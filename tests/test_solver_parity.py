@@ -690,3 +690,73 @@ class TestSweepFastPath:
         # and the public entry still solves it correctly via the generic path
         res = solver.solve_batch([inp], max_nodes=8)[0]
         assert not res.unschedulable
+
+    def test_partial_sweep_mixed_batch(self):
+        """A batch mixing single-candidate sims (sweep-eligible) with an
+        over-wide multi-node subset and a topology-active sim: the
+        eligible majority rides the device sweep, the holes solve
+        generically, and every result matches the all-generic answer."""
+        import dataclasses
+
+        from karpenter_tpu.models import TopologySpreadConstraint
+        nodes = self._cluster(16)
+        pool = NodePool(meta=ObjectMeta(name="default"))
+        inps = []
+        for i in range(10):
+            inps.append(ScheduleInput(
+                pods=list(nodes[i].pods), nodepools=[pool],
+                instance_types={"default": CATALOG},
+                existing_nodes=nodes[:i] + nodes[i + 1:], price_cap=0.5,
+                exist_base=nodes, exist_excluded=(i,)))
+        # over-wide subset: 12 exclusions > X_BUCKETS max
+        wide_excl = tuple(range(12))
+        inps.append(ScheduleInput(
+            pods=[p for e in wide_excl for p in nodes[e].pods],
+            nodepools=[pool], instance_types={"default": CATALOG},
+            existing_nodes=nodes[12:], price_cap=None,
+            exist_base=nodes, exist_excluded=wide_excl))
+        # topology-active sim
+        sp = mkpod("sp", labels={"app": "w"}, topology_spread=[
+            TopologySpreadConstraint(topology_key=wellknown.ZONE_LABEL,
+                                     label_selector={"app": "w"})])
+        inps.append(ScheduleInput(
+            pods=[sp], nodepools=[pool],
+            instance_types={"default": CATALOG},
+            existing_nodes=nodes[1:], exist_base=nodes, exist_excluded=(0,)))
+        fast = TPUSolver(mesh="off").solve_batch(inps, max_nodes=16)
+        generic = TPUSolver(mesh="off").solve_batch(
+            [dataclasses.replace(i_, exist_base=None, exist_excluded=None)
+             for i_ in inps], max_nodes=16)
+        assert len(fast) == len(inps)
+        for i, (f, g) in enumerate(zip(fast, generic)):
+            assert f is not None, i
+            assert set(f.unschedulable) == set(g.unschedulable), i
+            assert f.node_count() == g.node_count(), i
+
+    def test_baseless_first_input_does_not_demote_batch(self):
+        """A fused batch whose FIRST input carries no snapshot (a
+        provisioning request interleaved by the solverd window) must not
+        demote the eligible sweep majority."""
+        import dataclasses
+        nodes = self._cluster(8)
+        pool = NodePool(meta=ObjectMeta(name="default"))
+        plain = ScheduleInput(
+            pods=[mkpod("prov-a"), mkpod("prov-b")], nodepools=[pool],
+            instance_types={"default": CATALOG})
+        inps = [plain] + [ScheduleInput(
+            pods=list(nodes[i].pods), nodepools=[pool],
+            instance_types={"default": CATALOG},
+            existing_nodes=nodes[:i] + nodes[i + 1:], price_cap=0.5,
+            exist_base=nodes, exist_excluded=(i,)) for i in range(8)]
+        solver = TPUSolver(mesh="off")
+        cat = solver._catalog_encoding(inps[0])
+        sweep = solver._try_sweep(inps, cat, 8, explicit_cap=True)
+        assert sweep is not None, "base-less first input demoted the batch"
+        assert sweep[0] is None and all(r is not None for r in sweep[1:])
+        full = solver.solve_batch(inps, max_nodes=8)
+        generic = TPUSolver(mesh="off").solve_batch(
+            [dataclasses.replace(i_, exist_base=None, exist_excluded=None)
+             for i_ in inps], max_nodes=8)
+        for i, (f, g) in enumerate(zip(full, generic)):
+            assert set(f.unschedulable) == set(g.unschedulable), i
+            assert f.node_count() == g.node_count(), i
