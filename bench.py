@@ -33,17 +33,9 @@ import threading
 import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
-ATTEMPTS_LOG = os.path.join(HERE, "BENCH_ATTEMPTS.jsonl")
+sys.path.insert(0, HERE)
 
-
-def log_attempt(record: dict) -> None:
-    """Append-only per-attempt evidence (ADVICE r2: the n=1 rc=1 record was
-    overwritten and unverifiable; JSONL preserves it)."""
-    try:
-        with open(ATTEMPTS_LOG, "a") as f:
-            f.write(json.dumps(record) + "\n")
-    except OSError:
-        pass
+from karpenter_tpu.utils.platform import log_attempt  # noqa: E402
 
 
 def build_input(n_pods: int):

@@ -20,13 +20,11 @@ def run(metric: str, target_ms: float, make_input, solve=None, repeats: int = 5,
     # bootstrap the platform BEFORE any jax dispatch: honor
     # JAX_PLATFORMS/KARPENTER_TPU_PLATFORM (CPU smoke), else site default
     # (TPU) with UNAVAILABLE retry + CPU fallback — never die with rc=1
-    from karpenter_tpu.utils.platform import initialize
-
     # failed-probe evidence lands in the repo-root attempts log even when
     # the parent bench only captures this config's stdout JSON (VERDICT
     # r3 #1: record the actual probe error, not just the fallback); one
-    # writer shared with the headline bench
-    from bench import log_attempt
+    # writer shared with the headline bench and the relay watchdog
+    from karpenter_tpu.utils.platform import initialize, log_attempt
     platform = initialize(attempt_log=log_attempt)
     from karpenter_tpu.solver import TPUSolver
 

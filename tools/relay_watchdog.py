@@ -1,0 +1,273 @@
+"""Relay watchdog — opportunistic TPU bench trigger.
+
+Round 3 and 4 both ended with every bench attempt degraded to CPU because
+the axon device relay was absent from the VM for the whole session
+(`BENCH_ATTEMPTS.jsonl`, probe `outcome: "hang"` with only the VM control
+API + the harness API pump listening).  Bench runs were manual one-shots,
+so even a brief relay-restoration window would have been missed.  This
+watchdog closes that hole: it runs for the whole round, detects a live
+relay within minutes of restoration, and immediately fires the full
+benchmark suite so the round cannot end without a TPU attempt at every
+opportunity.  Mirrors the boot-probe discipline of the reference's EC2
+connectivity gate (/root/reference/pkg/operator/operator.go:209-218) —
+but as a *standing* watch, because here the dependency can come back.
+
+Two-tier check, cheap by design:
+
+- Tier 0 (milliseconds, every cycle): the TCP listener set from
+  /proc/net/tcp.  The relay's claim leg listens on loopback
+  (sitecustomize: AXON_POOL_SVC_OVERRIDE=127.0.0.1), so a NEW listening
+  port vs the known-dead baseline {2024 VM control, 48271 API pump} is
+  the earliest possible signal — probe immediately.
+- Tier 1 (bounded seconds, on tier-0 signal or every --probe-every):
+  the real backend probe in a throwaway subprocess with a SHORT timeout.
+  When the relay is up the probe completes in seconds; when it is down
+  the probe hangs and the timeout bounds the cost.  Listening-but-dead
+  ports (the round-4 signature) are handled by this tier: tier 0 alone
+  can never prove liveness.
+
+Every check appends one record to BENCH_ATTEMPTS.jsonl
+(stage=watchdog-probe / watchdog-bench), so the round's artifact either
+contains a TPU bench or an attempts log proving the relay never answered.
+
+On a live probe: runs `python bench.py` (headline + all six configs),
+writes stdout's JSON line to BENCH_r05.json, and exits 0.  The bench run
+also warms the persistent XLA compile cache for TPU shapes, so the
+driver's own round-end run compiles warm.
+
+Usage:
+    python tools/relay_watchdog.py [--probe-every 900] [--probe-timeout 45]
+        [--max-hours 12] [--round 5] [--once]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+from karpenter_tpu.utils.platform import (  # noqa: E402
+    listening_ports, log_attempt, probe_backend, scrub_cpu_overrides)
+
+# Loopback listeners that are provably NOT the relay (observed all of
+# rounds 3-4 while every probe hung): the VM control API and the harness
+# API pump ("stdio pump" 403s on every path).  A port OUTSIDE this set
+# appearing is the tier-0 wake-up signal.
+KNOWN_DEAD_PORTS = frozenset({2024, 48271})
+
+
+def _ephemeral_floor() -> int:
+    """Lower bound of the kernel's ephemeral port range (default 32768):
+    test daemons bind listeners there constantly, and none of them is the
+    relay — excluding the whole range keeps tier-0 quiet."""
+    try:
+        with open("/proc/sys/net/ipv4/ip_local_port_range") as f:
+            return int(f.read().split()[0])
+    except (OSError, ValueError, IndexError):
+        return 32768
+
+
+def new_ports(ports: list | None) -> frozenset:
+    """Listening ports outside the known-dead baseline and below the
+    ephemeral range — the candidate-relay set for tier-0 comparison."""
+    if not ports:
+        return frozenset()
+    floor = _ephemeral_floor()
+    return frozenset(p for p in ports
+                     if p not in KNOWN_DEAD_PORTS and p < floor)
+
+
+def _sweep_orphan_configs() -> int:
+    """Terminate any benchmarks/config*.py process GROUPS that outlived a
+    killed bench.py.  Configs are session leaders (bench.py spawns them
+    with start_new_session=True), so they don't die with bench.py — and
+    their own platform-probe grandchildren don't die with THEM, so the
+    sweep must killpg the group, or a wedged probe subprocess keeps the
+    chip claim and starves every later watchdog probe.  Returns the
+    number of groups reaped so callers can re-probe immediately after a
+    reap freed the chip."""
+    from karpenter_tpu.utils.platform import scan_processes, terminate_group
+    # orphaned_from: a cmdline match alone would also hit a CONCURRENT
+    # bench.py's live configs (e.g. the round driver's) — only configs
+    # whose owning bench.py is dead are ours to reap
+    reaped = 0
+    for pid, cmdline in scan_processes(
+            lambda args: "benchmarks/config" in args
+            and sys.executable in args, orphaned_from="bench.py"):
+        log_attempt({"stage": "watchdog-bench", "event": "orphan-config",
+                     "pid": pid, "args": cmdline[:120], "ts": time.time()})
+        # the config is its session's leader, so pid == pgid
+        terminate_group(pid)
+        reaped += 1
+    return reaped
+
+
+def probe_device(timeout_s: float) -> dict:
+    """One bounded subprocess probe of the site-default (axon) backend,
+    via the shared platform probe (single copy of the probe protocol).
+    Returns a record with outcome ok|hang|error; 'platform' on ok."""
+    rec = probe_backend(None, timeout_s, log=lambda m: None)
+    rec["stage"] = "watchdog-probe"
+    return rec
+
+
+def fire_bench(round_no: int, bench_timeout_s: float) -> bool:
+    """Run the full bench suite; write BENCH_r{round}.json on success.
+    Returns True when the artifact was produced with a non-CPU headline.
+
+    On timeout the whole tree must die, not just bench.py: bench.py runs
+    each config in its OWN session (so per-config timeouts can killpg),
+    which means killing bench.py orphans a mid-solve config that would
+    hold the chip and starve every later probe.  After the kill, sweep
+    for surviving config processes by cmdline and TERM them gracefully
+    (SIGTERM first so PJRT teardown releases the device claim)."""
+    out_path = os.path.join(REPO, f"BENCH_r{round_no:02d}.json")
+    env = scrub_cpu_overrides(dict(os.environ))
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    log_attempt({"stage": "watchdog-bench", "event": "start",
+                 "ts": time.time()})
+    proc = subprocess.Popen([sys.executable, os.path.join(REPO, "bench.py")],
+                            env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True, cwd=REPO,
+                            start_new_session=True)
+    try:
+        stdout, stderr = proc.communicate(timeout=bench_timeout_s)
+    except subprocess.TimeoutExpired:
+        import signal
+        # TERM bench.py (no handler installed — it dies immediately; its
+        # in-flight config sessions are cleaned up by the group sweep
+        # below, which is the actual recovery path)
+        try:
+            proc.send_signal(signal.SIGTERM)
+        except OSError:
+            pass
+        try:
+            stdout, stderr = proc.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            stdout, stderr = proc.communicate()
+        _sweep_orphan_configs()
+        log_attempt({"stage": "watchdog-bench", "event": "timeout",
+                     "timeout_s": bench_timeout_s,
+                     "stderr_tail": (stderr or "").strip()[-300:],
+                     "ts": time.time()})
+        return False
+    line = next((ln for ln in stdout.splitlines()
+                 if ln.startswith("{")), None)
+    rec = {"stage": "watchdog-bench", "event": "done", "rc": proc.returncode,
+           "ts": time.time()}
+    if not line:
+        rec["stderr_tail"] = (stderr or "").strip()[-300:]
+        log_attempt(rec)
+        return False
+    try:
+        result = json.loads(line)
+    except ValueError:
+        rec["unparsed"] = line[:300]
+        log_attempt(rec)
+        return False
+    rec["platform"] = result.get("platform")
+    rec["p50_ms"] = result.get("p50_ms")
+    log_attempt(rec)
+    # a CPU-degraded run must not clobber a better same-name artifact
+    # (e.g. from the round driver or an earlier live window); the full
+    # result is preserved in the attempts log either way
+    live = result.get("platform") not in (None, "cpu")
+    if live or not os.path.exists(out_path):
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+    return live
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--probe-every", type=float, default=900.0,
+                    help="seconds between unconditional tier-1 probes")
+    ap.add_argument("--poll-every", type=float, default=20.0,
+                    help="seconds between tier-0 listener checks")
+    ap.add_argument("--probe-timeout", type=float, default=45.0,
+                    help="tier-1 probe subprocess timeout (relay-up probes "
+                         "finish in seconds; this bounds the hang cost)")
+    ap.add_argument("--bench-timeout", type=float, default=3600.0)
+    ap.add_argument("--max-hours", type=float, default=12.0)
+    ap.add_argument("--round", type=int, default=5)
+    ap.add_argument("--once", action="store_true",
+                    help="one probe (and bench if live), then exit")
+    args = ap.parse_args()
+
+    deadline = time.monotonic() + args.max_hours * 3600.0
+    last_probe = None  # None = probe immediately (monotonic() can be
+    # small near boot, so 0.0 would silently defer the first probe)
+    # previous-cycle snapshot: only ports ADDED since the last cycle
+    # signal, so steady-state listeners stay quiet but a relay RESTART on
+    # its previous fixed port (disappear → reappear) still fires tier 0
+    prev_candidates = new_ports(listening_ports())
+    checks = probes = 0
+    log_attempt({"stage": "watchdog", "event": "start", "pid": os.getpid(),
+                 "probe_every_s": args.probe_every,
+                 "probe_timeout_s": args.probe_timeout,
+                 "baseline_candidates": sorted(prev_candidates),
+                 "ts": time.time()})
+    # a config orphaned by a PREVIOUS killed bench may already hold the
+    # chip — every probe would hang and the in-bench sweep could never
+    # run; reap at startup so the watchdog starts from a clean device
+    _sweep_orphan_configs()
+    while time.monotonic() < deadline:
+        checks += 1
+        candidates = new_ports(listening_ports())
+        added = candidates - prev_candidates
+        port_signal = bool(added)
+        if port_signal:
+            log_attempt({"stage": "watchdog", "event": "new-listener",
+                         "new": sorted(added), "ts": time.time()})
+        prev_candidates = candidates
+        due = (last_probe is None
+               or time.monotonic() - last_probe >= args.probe_every)
+        if args.once or port_signal or due:
+            last_probe = time.monotonic()
+            probes += 1
+            rec = probe_device(args.probe_timeout)
+            rec["trigger"] = ("once" if args.once
+                              else "new-listener" if port_signal else "timer")
+            log_attempt(rec)
+            if rec.get("outcome") == "hang":
+                # a hang can be a wedged orphan holding the chip, not a
+                # dead relay — reap any (orphans-only, so a concurrent
+                # driver bench's live configs are untouched), and if a
+                # reap freed the chip, re-probe next cycle instead of
+                # waiting out the timer: the relay may be live NOW
+                if _sweep_orphan_configs():
+                    last_probe = None
+            if rec.get("outcome") == "ok" and rec.get("platform") != "cpu":
+                print(f"[watchdog] relay LIVE (platform={rec['platform']}); "
+                      "firing full bench", file=sys.stderr, flush=True)
+                if fire_bench(args.round, args.bench_timeout):
+                    log_attempt({"stage": "watchdog", "event": "success",
+                                 "checks": checks, "probes": probes,
+                                 "ts": time.time()})
+                    return 0
+                # bench failed despite a live probe (chip contended?):
+                # keep watching — the next window may succeed
+            if args.once:
+                # same liveness criterion as the main loop: ok-but-CPU
+                # (no site accelerator) is NOT a live relay
+                return 0 if (rec.get("outcome") == "ok"
+                             and rec.get("platform") != "cpu") else 1
+        time.sleep(args.poll_every)
+    log_attempt({"stage": "watchdog", "event": "deadline", "checks": checks,
+                 "probes": probes, "ts": time.time()})
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
