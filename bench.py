@@ -1,7 +1,8 @@
 """Headline benchmark — BASELINE.json config #5 class:
 
 50k-pod burst (8 heterogeneous size classes incl. GPU extended resources)
-against the full ~700-type catalog (~4.2k zonal spot/on-demand offerings),
+against the full transcribed real-machine catalog (605 types, ~3.2k zonal
+spot/on-demand offerings — providers/ec2_catalog.py),
 one NodePool, price-optimal packing on one TPU chip.
 
 North star (BASELINE.md): <200 ms on v5e-1, node count ≤ the FFD oracle.
@@ -306,7 +307,7 @@ def main() -> None:
         inp, budget_50k)
 
     result = {
-        "metric": "schedule 50k pods x 700 instance types (end-to-end, 1 chip)",
+        "metric": "schedule 50k pods x 605 instance types (end-to-end, 1 chip)",
         "value": round(p50, 1),
         "unit": "ms",
         "vs_baseline": round(200.0 / p50, 3),

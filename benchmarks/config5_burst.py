@@ -17,7 +17,7 @@ import bench  # repo root: build_input only — never bench.main()
 
 if __name__ == "__main__":
     results = run(
-        "config#5 burst: 50k pods x 700 types, 1 pool (headline class)",
+        "config#5 burst: 50k pods x 605 types, 1 pool (headline class)",
         200.0, lambda: bench.build_input(50_000), repeats=5,
         extra=lambda r: {"nodes": r.node_count(),
                          "unschedulable": len(r.unschedulable)})

@@ -40,7 +40,7 @@ def build_env():
         zone = zones[i % len(zones)]
         ct = ["spot", "on-demand"][i % 2]
         inst, _ = env.cloud.create_fleet(
-            [FleetCandidate(f"m6.large", zone, ct, 0.05)],
+            [FleetCandidate(f"m5.large", zone, ct, 0.05)],
             tags={"karpenter.sh/managed-by": "default-cluster"})
         claim = NodeClaim(
             meta=ObjectMeta(name=f"c{i}",

@@ -4,9 +4,12 @@
 bandwidth_gen,prices_gen} against live AWS APIs,
 /root/reference/Makefile:160-162).
 
-The data source here is the fake cloud's describe API (whose internals
-are the synthesis formulas in providers/catalog.py — max-pods ladder,
-bandwidth ladder, deterministic prices). Against a real TPU cloud this
+The default table's data source is the TRANSCRIBED real-machine catalog
+(providers/ec2_catalog.py): public EC2 shapes — real per-size ENI/IP
+limits via max_pods = eni×(ip−1)+2, bandwidth ladders, family-linear
+prices with real anchors and real inversions, sparse zonal/spot
+offerings.  The synthesis formulas in providers/catalog.py remain the
+generator for non-default test fleets.  Against a real TPU cloud this
 script would hit the provider's describe/pricing endpoints instead; the
 table format and loader stay identical.
 
@@ -24,12 +27,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from karpenter_tpu.providers.catalog import (  # noqa: E402
     GENERATED_CATALOG_PATH,
     dump_catalog,
-    synthesize_catalog,
 )
+from karpenter_tpu.providers.ec2_catalog import transcribe_catalog  # noqa: E402
 
 
 def main() -> int:
-    table = dump_catalog(synthesize_catalog())
+    table = dump_catalog(transcribe_catalog())
     payload = json.dumps(table, indent=None, sort_keys=True,
                          separators=(",", ":")) + "\n"
     if "--check" in sys.argv:

@@ -296,8 +296,45 @@ class TPUSolver:
             # and cost-blind, and a spurious verdict here would silently
             # stop consolidation under price caps
             res = self._rescue_stranded(inp, res)
+        res = self._oracle_backstop_on_limits(inp, res)
         metrics.SOLVER_SOLVES.inc(
             path="split" if self._used_split else "device")
+        return res
+
+    # pods beyond this, the backstop oracle's O(pods) wall-clock isn't
+    # worth a limits-edge improvement — shedding already bounds real
+    # bursts, and the split/rescue results stand
+    _ORACLE_BACKSTOP_MAX_PODS = 2000
+
+    def _oracle_backstop_on_limits(self, inp: ScheduleInput,
+                                   res: ScheduleResult) -> ScheduleResult:
+        """Full-oracle fallback when pods strand on a BINDING pool limit.
+
+        The decomposed paths (device-then-residue split, rescue) spend a
+        shared pool budget sequentially, so whichever sub-solve runs
+        first can starve the later one even when a joint solve fits
+        everyone — e.g. a co-location residue that the one-shot oracle
+        puts on already-paid existing-node capacity while the device pass
+        burns the limit on new nodes (surfaced by real-catalog fuzzing).
+        Budget interplay is global, so the honest backstop is the
+        reference's own shape: ONE engine solving the whole input.  Runs
+        only when pods actually stranded on a limit, bounded by pod
+        count; keeps whichever result strands fewer pods."""
+        if not res.unschedulable or len(inp.pods) > \
+                self._ORACLE_BACKSTOP_MAX_PODS:
+            return res
+        if not any(lim is not None
+                   for lim in (inp.remaining_limits or {}).values()):
+            return res
+        if not any("limit" in reason for reason in res.unschedulable.values()):
+            return res
+        from karpenter_tpu.scheduling import Scheduler
+        from karpenter_tpu.utils import metrics
+        orc = Scheduler(inp).solve()
+        if len(orc.unschedulable) < len(res.unschedulable):
+            metrics.SOLVER_ORACLE_BACKSTOP.inc()
+            self._used_split = True  # host help happened
+            return orc
         return res
 
     def _count_residue(self, pods: List[Pod]) -> None:
@@ -556,6 +593,37 @@ class TPUSolver:
         aug = self._augment_with_claims(inp, residue_pods, supported_pods,
                                         dev_res)
         orc_res = Scheduler(aug).solve()
+
+        # Budget starvation retry: under a BINDING pool limit the device
+        # pass (solved first) can spend budget the residue needed — the
+        # one-shot oracle shares the limit across all pods, so it would
+        # have scheduled everything (surfaced by real-catalog fuzzing:
+        # co-location groups stranded with "limits exceeded" while the
+        # oracle strands none).  Reserve the residue's aggregate requests
+        # out of the device pass's budget and retry once; keep whichever
+        # split strands fewer pods overall.
+        residue_names = {p.meta.name for p in residue_pods}
+        has_limit = any(lim is not None
+                        for lim in (inp.remaining_limits or {}).values())
+        if supported_pods and has_limit and any(
+                n in residue_names and "limit" in r
+                for n, r in orc_res.unschedulable.items()):
+            reserve = Resources()
+            for p in residue_pods:
+                reserve = reserve + effective_request(p)
+            reduced = {pool: (lim - reserve if lim is not None else None)
+                       for pool, lim in inp.remaining_limits.items()}
+            dev2 = self._solve_relaxed(
+                dataclasses.replace(inp, pods=supported_pods,
+                                    remaining_limits=reduced),
+                max_nodes=max_nodes)
+            aug2 = self._augment_with_claims(inp, residue_pods,
+                                             supported_pods, dev2)
+            orc2 = Scheduler(aug2).solve()
+            if (len(dev2.unschedulable) + len(orc2.unschedulable)
+                    < len(dev_res.unschedulable) + len(orc_res.unschedulable)):
+                dev_res, orc_res = dev2, orc2
+
         # UNION after internal sub-solves: a nested split (a relaxation
         # variant of the supported pods was itself inexpressible) already
         # recorded its oracle's verdicts — overwriting would re-rescue

@@ -282,6 +282,10 @@ SOLVER_SOLVES = _c(
 SOLVER_RESIDUE_PODS = _c(
     "karpenter_tpu_solver_residue_pods_total",
     "Pods solved host-side as split-solve residue.")
+SOLVER_ORACLE_BACKSTOP = _c(
+    "karpenter_tpu_solver_oracle_backstop_total",
+    "Solves where the full-oracle backstop beat the decomposed paths "
+    "under a binding pool limit.")
 # per-instance-type catalog gauges (reference:
 # pkg/providers/instancetype/instancetype.go:156-161,302-311 + metrics.go)
 INSTANCE_TYPE_CPU = _g(

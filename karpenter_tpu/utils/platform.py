@@ -59,9 +59,19 @@ def log_attempt(record: dict) -> None:
     root.  Shared by bench.py and the relay watchdog — append-only so
     per-attempt evidence survives artifact overwrites (ADVICE r2), and a
     write failure never takes down the attempt itself."""
+    root = repo_root()
+    if os.path.basename(root) in ("site-packages", "dist-packages"):
+        # pip install: the package parent is not writable evidence
+        # territory — keep the trail in the user cache dir instead of
+        # silently swallowing every record (same guard as the compile
+        # cache below)
+        root = os.path.join(
+            os.environ.get("XDG_CACHE_HOME")
+            or os.path.join(os.path.expanduser("~"), ".cache"),
+            "karpenter_tpu")
     try:
-        with open(os.path.join(repo_root(), "BENCH_ATTEMPTS.jsonl"),
-                  "a") as f:
+        os.makedirs(root, exist_ok=True)
+        with open(os.path.join(root, "BENCH_ATTEMPTS.jsonl"), "a") as f:
             f.write(json.dumps(record) + "\n")
     except OSError:
         pass

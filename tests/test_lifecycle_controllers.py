@@ -127,7 +127,7 @@ class TestInterruption:
         n = 300
         for i in range(n):
             inst, _ = env.cloud.create_fleet(
-                [FleetCandidate("m6.large", env.cloud.zones[i % 3],
+                [FleetCandidate("m5.large", env.cloud.zones[i % 3],
                                 "spot", 0.05)], tags={})
             claim = NodeClaim(
                 meta=ObjectMeta(name=f"bulk{i}", labels={
@@ -143,14 +143,14 @@ class TestInterruption:
         assert not env.cluster.nodeclaims.list(
             lambda c: c.meta.name.startswith("bulk") and not c.meta.deleting)
         assert env.unavailable.is_unavailable(
-            "spot", "m6.large", env.cloud.zones[0])
+            "spot", "m5.large", env.cloud.zones[0])
 
 
 class TestGC:
     def test_leaked_instance_reclaimed(self, env):
         from karpenter_tpu.providers.fake_cloud import FleetCandidate
         leaked, _ = env.cloud.create_fleet(
-            [FleetCandidate("m6.large", "tpu-west-1a", "on-demand", 0.1)],
+            [FleetCandidate("m5.large", "tpu-west-1a", "on-demand", 0.1)],
             tags={"karpenter.sh/discovery": env.options.cluster_name})
         env.settle()
         assert env.cloud.instances[leaked.instance_id].state == "terminated"

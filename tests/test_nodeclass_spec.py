@@ -30,7 +30,7 @@ def env():
     return e
 
 
-def _shape(env, name="m6.2xlarge"):
+def _shape(env, name="m5.2xlarge"):
     shapes = env.cloud.describe_instance_types()
     return next(s for s in shapes if s.name == name)
 
@@ -125,7 +125,7 @@ class TestBlockDevicesAndInstanceStore:
         assert nc.root_volume_gib() == 77
 
     def test_raid0_uses_local_nvme(self, env):
-        shape = _shape(env, "m6d.2xlarge")  # local-NVMe variant
+        shape = _shape(env, "m5d.2xlarge")  # local-NVMe variant
         nc = NodeClass(meta=ObjectMeta(name="b"),
                        instance_store_policy="RAID0")
         it = apply_node_class(shape, nc)

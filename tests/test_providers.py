@@ -44,7 +44,7 @@ class TestCatalog:
 
     def test_shapes(self):
         cat = {it.name: it for it in generate_catalog()}
-        m = cat["m6.2xlarge"]
+        m = cat["m5.2xlarge"]
         assert m.capacity.cpu == 8000
         assert 8 * 4 * 1024 * 0.9 < m.capacity.memory < 8 * 4 * 1024  # vm overhead applied
         assert m.capacity.pods == 58
@@ -63,7 +63,7 @@ class TestCatalog:
         assert g.requirements.get(wellknown.INSTANCE_GPU_NAME_LABEL).values() == {"a10g"}
         arm = cat["m6g.large"]
         assert arm.requirements.get(wellknown.ARCH_LABEL).values() == {"arm64"}
-        assert cat["m6.large"].requirements.get(wellknown.ZONE_LABEL).values() == {
+        assert cat["m5.large"].requirements.get(wellknown.ZONE_LABEL).values() == {
             "tpu-west-1a", "tpu-west-1b", "tpu-west-1c"}
 
     def test_shrunk_catalog(self):
@@ -90,10 +90,10 @@ class TestInstanceTypeProvider:
             assert {o.zone for o in it.offerings} == {"tpu-west-1b"}
 
     def test_family_filtering(self, provider):
-        nc = NodeClass(meta=ObjectMeta(name="fam"), instance_families=["m6", "c6"])
+        nc = NodeClass(meta=ObjectMeta(name="fam"), instance_families=["m5", "c5"])
         types = provider.list(nc)
         assert types
-        assert {it.name.split(".")[0] for it in types} == {"m6", "c6"}
+        assert {it.name.split(".")[0] for it in types} == {"m5", "c5"}
 
     def test_capacity_type_filtering(self, provider):
         nc = NodeClass(meta=ObjectMeta(name="od"), capacity_types=["on-demand"])
@@ -109,24 +109,24 @@ class TestInstanceTypeProvider:
 
 class TestFakeCloud:
     def test_create_fleet_honors_ice_pools(self, cloud):
-        cloud.insufficient_capacity_pools.add(("spot", "m6.large", "tpu-west-1a"))
+        cloud.insufficient_capacity_pools.add(("spot", "m5.large", "tpu-west-1a"))
         inst, ice = cloud.create_fleet(
-            [FleetCandidate("m6.large", "tpu-west-1a", "spot", 0.02),
-             FleetCandidate("m6.large", "tpu-west-1b", "spot", 0.021)],
+            [FleetCandidate("m5.large", "tpu-west-1a", "spot", 0.02),
+             FleetCandidate("m5.large", "tpu-west-1b", "spot", 0.021)],
             tags={"karpenter.sh/nodeclaim": "nc-1"},
         )
         assert inst is not None and inst.zone == "tpu-west-1b"
-        assert ice == [("spot", "m6.large", "tpu-west-1a")]
+        assert ice == [("spot", "m5.large", "tpu-west-1a")]
 
     def test_create_fleet_all_ice(self, cloud):
-        cloud.insufficient_capacity_pools.add(("spot", "m6.large", "tpu-west-1a"))
+        cloud.insufficient_capacity_pools.add(("spot", "m5.large", "tpu-west-1a"))
         inst, ice = cloud.create_fleet(
-            [FleetCandidate("m6.large", "tpu-west-1a", "spot", 0.02)], tags={})
+            [FleetCandidate("m5.large", "tpu-west-1a", "spot", 0.02)], tags={})
         assert inst is None and len(ice) == 1
 
     def test_describe_by_tag_and_terminate(self, cloud):
         inst, _ = cloud.create_fleet(
-            [FleetCandidate("m6.large", "tpu-west-1a", "on-demand", 0.1)],
+            [FleetCandidate("m5.large", "tpu-west-1a", "on-demand", 0.1)],
             tags={"karpenter.sh/nodepool": "np"},
         )
         assert [i.instance_id for i in cloud.describe_instances(
@@ -144,7 +144,7 @@ class TestFakeCloud:
 
     def test_interruption_queue(self, cloud):
         inst, _ = cloud.create_fleet(
-            [FleetCandidate("m6.large", "tpu-west-1a", "spot", 0.02)], tags={})
+            [FleetCandidate("m5.large", "tpu-west-1a", "spot", 0.02)], tags={})
         cloud.interrupt_spot(inst.instance_id)
         msgs = cloud.receive_messages()
         assert msgs[0]["kind"] == "spot_interruption"
@@ -156,8 +156,8 @@ class TestPricing:
     def test_prices_and_seqnum(self, cloud):
         pricing = PricingProvider(cloud)
         assert pricing.live()
-        p = pricing.on_demand_price("m6.large", "tpu-west-1a")
-        s = pricing.spot_price("m6.large", "tpu-west-1a")
+        p = pricing.on_demand_price("m5.large", "tpu-west-1a")
+        s = pricing.spot_price("m5.large", "tpu-west-1a")
         assert p and s and s < p
         seq = pricing.seqnum
         assert not pricing.update()  # no change
@@ -168,13 +168,13 @@ def test_ice_expiry_restores_availability(provider, clock):
     """Regression: ICE entries aging out must invalidate the instance-type
     cache (seqnum bump on eviction), restoring offering availability."""
     nc = NodeClass(meta=ObjectMeta(name="default"))
-    provider.unavailable.mark_unavailable("spot", "c7.large", "tpu-west-1a")
+    provider.unavailable.mark_unavailable("spot", "c7i.large", "tpu-west-1a")
     types = provider.list(nc)
-    c7 = next(it for it in types if it.name == "c7.large")
+    c7 = next(it for it in types if it.name == "c7i.large")
     assert any(not o.available for o in c7.offerings)
     clock.step(181)  # past the 3-min ICE TTL
     types = provider.list(nc)
-    c7 = next(it for it in types if it.name == "c7.large")
+    c7 = next(it for it in types if it.name == "c7i.large")
     assert all(o.available for o in c7.offerings)
 
 

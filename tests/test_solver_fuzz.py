@@ -48,7 +48,18 @@ ZONE = wellknown.ZONE_LABEL
 HOST = wellknown.HOSTNAME_LABEL
 CT = wellknown.CAPACITY_TYPE_LABEL
 CATALOG = generate_catalog(CatalogSpec(max_types=24, include_gpu=False))
-TYPES = {it.name: it for it in CATALOG}
+# the transcribed real-shaped default fleet (metal 737-pod types, sparse
+# spot pools, price inversions): half the seeds fuzz against random
+# slices of it so the lumpy real structure is property-tested too
+REAL_CATALOG = generate_catalog()
+
+
+def _pick_catalog(rng):
+    if rng.rand() < 0.5:
+        return CATALOG
+    n = int(rng.randint(16, 80))
+    idx = rng.choice(len(REAL_CATALOG), size=n, replace=False)
+    return [REAL_CATALOG[i] for i in sorted(idx)]
 
 N_SEEDS = int(os.environ.get("FUZZ_SEEDS", "200"))
 # fresh-seed sweeps: FUZZ_SEED_BASE=10000 runs seeds [10000, 10000+N) —
@@ -60,6 +71,7 @@ ORACLE_CMP_MAX_PODS = 700  # oracle is O(pods); compare counts below this
 
 def _gen_problem(seed: int, scale: str = "default") -> ScheduleInput:
     rng = np.random.RandomState(seed)
+    catalog = _pick_catalog(rng)
     if scale == "slow":
         total_target = rng.randint(1000, 5001)
     else:
@@ -147,7 +159,7 @@ def _gen_problem(seed: int, scale: str = "default") -> ScheduleInput:
 
     return ScheduleInput(
         pods=pods, nodepools=pools,
-        instance_types={"default": CATALOG},
+        instance_types={"default": catalog},
         existing_nodes=existing,
         remaining_limits=limits or {"default": None},
     )
@@ -184,10 +196,14 @@ def check_validity(seed: int, inp: ScheduleInput, res) -> None:
         f"extra={seen - set(pod_by_name)}")
     assert not (set(placed) & set(res.unschedulable)), ctx
 
-    # capacity validity on new claims
+    # capacity validity on new claims (resolve names against the INPUT's
+    # own catalog: seeds mix the synthetic mini-fleet with real slices)
+    types_by_name = {it.name: it
+                     for types in inp.instance_types.values()
+                     for it in types}
     for claim in res.new_claims:
         assert claim.instance_type_names, f"{ctx} claim without types"
-        top = TYPES[claim.instance_type_names[0]]
+        top = types_by_name[claim.instance_type_names[0]]
         assert claim.requests.fits(top.allocatable()), (
             f"{ctx} claim {claim.hostname} overflows {top.name}")
 
@@ -400,6 +416,7 @@ def _gen_problem_mixed(seed: int) -> ScheduleInput:
     from karpenter_tpu.models import VolumeClaim
 
     rng = np.random.RandomState(100_000 + seed)
+    catalog = _pick_catalog(rng)
     total_target = rng.randint(40, 600)
     n_groups = rng.randint(2, 8)
 
@@ -501,7 +518,7 @@ def _gen_problem_mixed(seed: int) -> ScheduleInput:
 
     return ScheduleInput(
         pods=pods, nodepools=pools,
-        instance_types={p.name: CATALOG for p in pools},
+        instance_types={p.name: catalog for p in pools},
         existing_nodes=existing,
         remaining_limits={**{p.name: None for p in pools}, **limits},
     )
@@ -580,7 +597,7 @@ class TestFuzzSweep:
         from karpenter_tpu.solver import TPUSolver
 
         rng = np.random.RandomState(1000 + seed)
-        catalog = CATALOG
+        catalog = _pick_catalog(rng)
         n_nodes = int(rng.randint(6, 20))
         zones = ["tpu-west-1a", "tpu-west-1b", "tpu-west-1c"]
         nodes = []

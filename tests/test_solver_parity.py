@@ -52,8 +52,11 @@ def assert_parity(inp, *, exact_nodes=True):
         assert solver.node_count() == oracle.node_count()
     else:
         assert solver.node_count() <= oracle.node_count()
-    # validity: every claim's pods fit the claim's cheapest type
-    by_name = {it.name: it for it in CATALOG}
+    # validity: every claim's pods fit the claim's cheapest type — resolve
+    # names against the INPUT's own catalog (tests mix the transcribed
+    # default fleet with synthesized small fleets; the universes differ)
+    by_name = {it.name: it
+               for types in inp.instance_types.values() for it in types}
     for claim in solver.new_claims:
         it = by_name[claim.instance_type_names[0]]
         assert claim.requests.fits(it.allocatable()), (
@@ -120,8 +123,9 @@ class TestParity:
         oracle, solver = assert_parity(inp)
         gpu_claims = [c for c in solver.new_claims
                       if any(p.meta.name.startswith("gp") for p in c.pods)]
+        by_name = {t.name: t for t in CATALOG}
         assert gpu_claims and all(
-            n.startswith(("g4", "g5", "p3", "p4"))
+            by_name[n].capacity.get("gpu") >= 2
             for c in gpu_claims for n in c.instance_type_names)
 
     def test_unschedulable_matches(self):
@@ -189,11 +193,11 @@ class TestParity:
     def test_min_values(self):
         pool = NodePool(meta=ObjectMeta(name="flex"), requirements=Requirements(
             Requirement.make(wellknown.INSTANCE_FAMILY_LABEL, "In",
-                             "m6", "c6", min_values=2)))
+                             "m5", "c5", min_values=2)))
         inp = mkinput([mkpod("p")], pools=[pool])
         oracle, solver = assert_parity(inp)
         fams = {n.split(".")[0] for n in solver.new_claims[0].instance_type_names}
-        assert fams == {"m6", "c6"}
+        assert fams == {"m5", "c5"}
 
     def test_split_handles_required_pod_affinity(self):
         # required pod *affinity* (non-anti) has no tensor encoding; the
@@ -502,7 +506,10 @@ class TestDenseLayoutFallback:
         # the standard catalog keeps the grid (full fill)
         enc2 = encode_catalog(mkinput([], types=CATALOG))
         assert enc2.layout == "grid"
-        assert enc2.fill_factor > 0.9
+        # the transcribed catalog has deliberate sparse zonal/spot holes
+        # (missing spot pools, single-zone accelerators), so grid fill is
+        # below the old synthetic 1.0 but still comfortably grid-worthy
+        assert enc2.fill_factor > 0.8
 
     def test_dense_layout_parity(self):
         types = self._disjoint_catalog()

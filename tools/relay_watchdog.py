@@ -208,6 +208,8 @@ def main() -> int:
     deadline = time.monotonic() + args.max_hours * 3600.0
     last_probe = None  # None = probe immediately (monotonic() can be
     # small near boot, so 0.0 would silently defer the first probe)
+    fast_until = 0.0   # end of the tight-cadence window after a
+    # new-listener probe hung (relay possibly mid-initialization)
     # previous-cycle snapshot: only ports ADDED since the last cycle
     # signal, so steady-state listeners stay quiet but a relay RESTART on
     # its previous fixed port (disappear → reappear) still fires tier 0
@@ -231,8 +233,15 @@ def main() -> int:
             log_attempt({"stage": "watchdog", "event": "new-listener",
                          "new": sorted(added), "ts": time.time()})
         prev_candidates = candidates
+        interval = args.probe_every
+        if fast_until and time.monotonic() < fast_until:
+            # a listener appeared but its claim leg hung: the relay may
+            # still be INITIALIZING — keep probing at a tight cadence for
+            # a few minutes instead of waiting out the full timer (a
+            # short live window must not slip through that gap)
+            interval = 60.0
         due = (last_probe is None
-               or time.monotonic() - last_probe >= args.probe_every)
+               or time.monotonic() - last_probe >= interval)
         if args.once or port_signal or due:
             last_probe = time.monotonic()
             probes += 1
@@ -241,6 +250,8 @@ def main() -> int:
                               else "new-listener" if port_signal else "timer")
             log_attempt(rec)
             if rec.get("outcome") == "hang":
+                if port_signal:
+                    fast_until = time.monotonic() + 300.0
                 # a hang can be a wedged orphan holding the chip, not a
                 # dead relay — reap any (orphans-only, so a concurrent
                 # driver bench's live configs are untouched), and if a
@@ -248,6 +259,8 @@ def main() -> int:
                 # waiting out the timer: the relay may be live NOW
                 if _sweep_orphan_configs():
                     last_probe = None
+            else:
+                fast_until = 0.0
             if rec.get("outcome") == "ok" and rec.get("platform") != "cpu":
                 print(f"[watchdog] relay LIVE (platform={rec['platform']}); "
                       "firing full bench", file=sys.stderr, flush=True)
