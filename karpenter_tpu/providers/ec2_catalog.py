@@ -35,7 +35,6 @@ from typing import Dict, List, Optional, Tuple
 
 from karpenter_tpu.providers.catalog import (
     CatalogSpec,
-    DEFAULT_ZONES,
     _det_unit,
     _overhead,
     _vm_overhead,
@@ -254,10 +253,13 @@ FAMILIES: List[Family] = [
                                                  "4xlarge", "8xlarge",
                                                  "16xlarge", "32xlarge"],
        nvme_gb_per_vcpu=30.0, zones=("a",)),
-    _f("d3", "d", 3, "amd64", 8.0, 0.998 / 2, ["xlarge", "2xlarge",
+    # anchors quoted per the public sheet: d3.xlarge $0.499 (4 vCPU),
+    # h1.2xlarge $0.468 (8 vCPU) — normalized to the .large-equivalent
+    # the _f helper expects
+    _f("d3", "d", 3, "amd64", 8.0, 0.499 / 2, ["xlarge", "2xlarge",
                                                "4xlarge", "8xlarge"],
        nvme_gb_per_vcpu=1485.0, zones=("a", "b")),
-    _f("h1", "h", 1, "amd64", 4.0, 0.468 / 2, ["2xlarge", "4xlarge",
+    _f("h1", "h", 1, "amd64", 4.0, 0.468 / 4, ["2xlarge", "4xlarge",
                                                "8xlarge", "16xlarge"],
        nvme_gb_per_vcpu=250.0, zones=("a", "b")),
     _f("a1", "a", 1, "arm64", 2.0, 0.051, ["medium", "large", "xlarge",

@@ -20,10 +20,12 @@ entire nodes×offerings fill on the MXU-friendly dense arrays built by
     vectorized fill.
 
 Topology spread constraints (hostname / zone / capacity-type, maxSkew,
-minDomains) and required pod anti-affinity are encoded as per-group domain
-tensors solved in-kernel (see `ffd.py`); constraint shapes the encoding
-can't express — required pod affinity, custom topology keys, selectors
-coupling pending groups — raise `UnsupportedPods` and the provisioner falls
+minDomains), required pod anti-affinity, and required pod affinity on
+zone/capacity-type (populated-domain restriction or seed pin) are encoded
+as per-group domain tensors solved in-kernel (see `ffd.py`); constraint
+shapes the encoding can't express — custom topology keys, hostname
+co-location seeding, selectors coupling pending groups — raise
+`UnsupportedPods` and the provisioner falls
 back to the CPU oracle (solver-unavailable ⇒ fall back, never fail —
 SURVEY §5).
 """

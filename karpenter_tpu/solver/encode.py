@@ -505,11 +505,14 @@ class SharedExistEncoding:
 class _TopologyEncoder:
     """Classifies each group's spread / (anti-)affinity constraints and
     produces the kernel's topology tensors; raises `Unsupported` for shapes
-    the tensor encoding can't express — required pod affinity, custom
-    topology keys, and selectors that couple pending groups (their counts
-    would change with other groups' placements mid-solve) — so the caller
-    falls back to the CPU oracle. Mirrors scheduling/topology.py; reference
-    surface: website/content/en/preview/concepts/scheduling.md:209-417.
+    the tensor encoding can't express — custom topology keys, hostname
+    co-location seeding, and selectors that couple pending groups (their
+    counts would change with other groups' placements mid-solve) — so the
+    caller falls back to the CPU oracle.  Required pod affinity on
+    zone/capacity-type encodes as static domain restrictions (populated
+    domains, or a host-side seed pin for the self-selector first-placement
+    case). Mirrors scheduling/topology.py; reference surface:
+    website/content/en/preview/concepts/scheduling.md:209-417.
     """
 
     def __init__(self, inp: ScheduleInput, cat: "CatalogEncoding",
@@ -524,6 +527,7 @@ class _TopologyEncoder:
         # registers the device placements before placing it, which
         # enforces the symmetry.
         self.split_mode = split_mode
+        self.cat = cat  # for the seed-domain pick (column prices)
         self.dense_layout = cat.layout == "dense"
         # seeding the tracker walks every resident pod — skip it entirely
         # when no pending pod carries a constraint and no resident pod
@@ -598,6 +602,40 @@ class _TopologyEncoder:
 
     def _dom_ids(self, key: str) -> Dict[str, int]:
         return self.zone_ids if key == wellknown.ZONE_LABEL else self.ct_ids
+
+    def _seed_domain(self, rep: Pod, key: str,
+                     already_allowed: Optional[set]) -> Optional[str]:
+        """The domain a self-matching required-affinity group seeds when
+        no matching pod exists anywhere.  The oracle seeds wherever its
+        first FFD placement lands — existing nodes first, then the
+        cheapest new node — so prefer the domain with the most free
+        existing CPU, tiebreak by cheapest compatible catalog column,
+        then lexicographic for determinism.  A wrong pick can strand the
+        group (capacity missing in the pinned domain); the solver's
+        rescue path re-seeds those pods through the oracle."""
+        ids = self._dom_ids(key)
+        cand = set(ids)
+        if already_allowed is not None:
+            cand &= {d for d, i in ids.items() if i in already_allowed}
+        elig = self.tracker.eligible_domains(rep, key)
+        if elig:
+            cand &= set(elig)
+        if not cand:
+            return None
+        cap_by = {d: 0.0 for d in cand}
+        for en in self.existing:
+            d = en.node.labels.get(key)
+            if d in cap_by:
+                cap_by[d] += max(float(en.available.get("cpu") or 0.0), 0.0)
+        price_by = {d: float("inf") for d in cand}
+        gmask, _ = group_column_mask(self.cat, rep)
+        for o_idx in np.nonzero(gmask)[0]:
+            col = self.cat.columns[o_idx]
+            d = (col.zone if key == wellknown.ZONE_LABEL
+                 else col.capacity_type)
+            if d in price_by and col.price < price_by[d]:
+                price_by[d] = col.price
+        return sorted(cand, key=lambda d: (-cap_by[d], price_by[d], d))[0]
 
     def _static_gmin(self, rep: Pod, key: str, counts, mindom) -> int:
         eligible = self.tracker.eligible_domains(rep, key)
@@ -687,14 +725,49 @@ class _TopologyEncoder:
             if not t.required:
                 continue  # preferred terms are not consumed (oracle parity)
             key = t.topology_key
-            if not t.anti:
-                raise Unsupported("required pod affinity")
             if key not in _TOPO_KEYS:
-                raise Unsupported(f"anti-affinity topology key {key}")
+                raise Unsupported(f"affinity topology key {key}")
             if self._matching_groups(t.label_selector) - {gi}:
-                raise Unsupported("anti-affinity selector couples pending groups")
+                raise Unsupported("affinity selector couples pending groups")
             self_match = _matches(t.label_selector, my)
             counts = self.tracker.counts_for(key, t.label_selector)
+            if not t.anti:
+                # required CO-LOCATION affinity (oracle:
+                # topology.affinity_allowed_domains) — three shapes:
+                #   populated domains exist → each member restricted to
+                #     them (static: counts can't shrink mid-solve);
+                #   none populated + self-matching → the group seeds ONE
+                #     domain; the oracle seeds wherever its first FFD
+                #     placement lands, the device path pre-pins the
+                #     domain host-side (most free existing capacity,
+                #     then cheapest compatible column);
+                #   none populated + not self-matching → nothing is
+                #     allowed (kube semantics), encoded as an empty
+                #     domain restriction.
+                populated = {d for d, n in counts.items() if n > 0}
+                if key == wellknown.HOSTNAME_LABEL:
+                    if populated:
+                        # members must share a host with a match; fresh
+                        # nodes have none, so new-node placement is off
+                        ncap = 0
+                        clamp_hosts(
+                            lambda h: BIG if h in populated else 0)
+                    else:
+                        # all members on ONE fresh node — "exactly one
+                        # new node" isn't expressible in the column model
+                        raise Unsupported(
+                            "hostname co-location seeding")
+                elif populated:
+                    restrict(key, populated)
+                    requires[key] = True
+                elif self_match:
+                    pin = self._seed_domain(rep, key, allowed[key])
+                    restrict(key, {pin} if pin is not None else set())
+                    requires[key] = True
+                else:
+                    restrict(key, set())
+                    requires[key] = True
+                continue
             if key == wellknown.HOSTNAME_LABEL:
                 if self_match:
                     ncap = min(ncap, 1)

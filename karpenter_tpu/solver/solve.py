@@ -296,7 +296,11 @@ class TPUSolver:
             # and cost-blind, and a spurious verdict here would silently
             # stop consolidation under price caps
             res = self._rescue_stranded(inp, res)
-        res = self._oracle_backstop_on_limits(inp, res)
+        if max_nodes is None:
+            # the backstop ignores node caps, so a capped solve (a
+            # consolidation sim) must never take it: a fewer-strands plan
+            # that uses more nodes than the cap is inadmissible there
+            res = self._oracle_backstop_on_limits(inp, res)
         metrics.SOLVER_SOLVES.inc(
             path="split" if self._used_split else "device")
         return res
@@ -326,7 +330,11 @@ class TPUSolver:
         if not any(lim is not None
                    for lim in (inp.remaining_limits or {}).values()):
             return res
-        if not any("limit" in reason for reason in res.unschedulable.values()):
+        # the ORACLE's binding-limit reason, specifically — the kernel's
+        # generic strand reason ("...exhausted or over limits") must not
+        # fire a full O(pods) oracle solve on plain capacity exhaustion
+        if not any("limits exceeded" in reason
+                   for reason in res.unschedulable.values()):
             return res
         from karpenter_tpu.scheduling import Scheduler
         from karpenter_tpu.utils import metrics

@@ -239,7 +239,7 @@ def main() -> int:
             # still be INITIALIZING — keep probing at a tight cadence for
             # a few minutes instead of waiting out the full timer (a
             # short live window must not slip through that gap)
-            interval = 60.0
+            interval = min(args.probe_every, 60.0)
         due = (last_probe is None
                or time.monotonic() - last_probe >= interval)
         if args.once or port_signal or due:
