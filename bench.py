@@ -147,6 +147,7 @@ def run_configs(timeout_s: float):
     out = []
     configs = ["config1_inflate.py", "config2_mixed.py",
                "config3_topology.py", "config4_consolidation.py",
+               "config4b_consolidation_spread.py",
                "config5_burst.py", "config6_interruption.py"]
     env = dict(os.environ)
     # configs share the persistent compile cache (platform bootstrap), so

@@ -502,6 +502,231 @@ class SharedExistEncoding:
         return ok
 
 
+class SweepTopologyTables:
+    """Per-class topology tables for the consolidation sweep's HEAVY lane.
+
+    The sweep's whole point is that per-simulation host work stays O(pods),
+    never O(cluster): the shared snapshot's per-node facts upload once.
+    Topology-constrained pods used to hole out to the generic batched path
+    (paying the per-sim [E,*] encode the sweep exists to kill); this class
+    precomputes, ONCE per sweep, everything their kernel tensors need —
+    per-(selector, key) per-node matching-resident counts, per-class
+    hostname clamps, eligible-domain masks — so a simulation's dynamic
+    tensors (dbase/dcap after ITS exclusions) are O(X) arithmetic.
+
+    Supported per-class shapes mirror the kernel's dynamic machinery
+    (_solve_ffd_impl's heavy branch): at most ONE dynamic self-matching
+    zone/capacity-type term (DoNotSchedule spread with maxSkew/minDomains,
+    or required anti-affinity), plus self-matching hostname spread/anti as
+    ncap + per-node clamps.  Everything else raises `Unsupported` and the
+    simulation stays a hole for the generic path: non-self-match selectors
+    (static allowed-set math), required co-location (seed pin needs
+    per-sim state), preferences (host relaxation ladder).
+    """
+
+    def __init__(self, base: Sequence, zone_arr: np.ndarray,
+                 ct_arr: np.ndarray, zone_ids: Dict[str, int],
+                 ct_ids: Dict[str, int]):
+        self.base = base
+        self.zone_arr = zone_arr          # [E] zone id per snapshot node
+        self.ct_arr = ct_arr              # [E] ct id per snapshot node
+        self.zone_ids = zone_ids
+        self.ct_ids = ct_ids
+        self.D = max(len(zone_ids), len(ct_ids), 1)
+        self.E = len(base)
+        self._counts: Dict[tuple, np.ndarray] = {}
+        self._class_topo: Dict[int, dict] = {}
+        # resident required-anti index (ONE scan): (key, selector) →
+        # [E] bool, node holds a resident whose required anti-affinity
+        # carries that (key, selector).  Classes matched by a selector
+        # get those nodes'/domains' placements blocked (the oracle's
+        # symmetric_anti_blocked_domains, sweep-shaped) — without this,
+        # one anti-affinity pod anywhere in the cluster would disable
+        # the whole sweep.
+        self._res_anti: Dict[tuple, np.ndarray] = {}
+        for ei, en in enumerate(base):
+            for p in en.pods:
+                for t in p.pod_affinities:
+                    if not (t.required and t.anti):
+                        continue
+                    k = (t.topology_key,
+                         tuple(sorted(t.label_selector.items())))
+                    flags = self._res_anti.get(k)
+                    if flags is None:
+                        flags = np.zeros(self.E, dtype=bool)
+                        self._res_anti[k] = flags
+                    flags[ei] = True
+
+    def counts_per_node(self, selector: Dict[str, str]) -> np.ndarray:
+        """Matching resident pods per snapshot node ([E] i32), cached per
+        selector — the one O(cluster) scan, paid once per distinct
+        selector per sweep."""
+        key = tuple(sorted(selector.items()))
+        out = self._counts.get(key)
+        if out is None:
+            out = np.zeros(self.E, dtype=np.int32)
+            for ei, en in enumerate(self.base):
+                out[ei] = sum(1 for p in en.pods
+                              if _matches(selector, p.meta.labels))
+            self._counts[key] = out
+        return out
+
+    def _dom_total(self, counts: np.ndarray, dom_arr: np.ndarray) -> np.ndarray:
+        total = np.zeros(self.D, dtype=np.int32)
+        valid = dom_arr >= 0
+        np.add.at(total, dom_arr[valid], counts[valid])
+        return total
+
+    def class_topo(self, rep: Pod) -> dict:
+        """Class-level topology info (cached): static parts of the kernel
+        tensors plus the per-node count arrays the per-sim math needs.
+        Raises Unsupported for shapes the sweep can't express."""
+        gid = rep.scheduling_group_id()
+        info = self._class_topo.get(gid)
+        if info is not None:
+            if isinstance(info, Unsupported):
+                raise info
+            return info
+        try:
+            info = self._build_class_topo(rep)
+        except Unsupported as e:
+            self._class_topo[gid] = e
+            raise
+        self._class_topo[gid] = info
+        return info
+
+    def _build_class_topo(self, rep: Pod) -> dict:
+        my = rep.meta.labels
+        ncap = BIG
+        hostcap = np.full(self.E, BIG, dtype=np.int32)
+        dyn = None  # (key, dsel, anti flag, selector, skew, mindom)
+
+        def set_dyn(key, anti, sel, skew=BIG, mindom=0):
+            nonlocal dyn
+            if dyn is not None:
+                raise Unsupported("multiple dynamic topology terms")
+            dsel = 1 if key == wellknown.ZONE_LABEL else 2
+            dyn = dict(key=key, dsel=dsel, anti=anti, selector=dict(sel),
+                       skew=skew, mindom=mindom)
+
+        for c in rep.topology_spread:
+            if c.when_unsatisfiable != "DoNotSchedule":
+                continue  # best-effort never blocks (encoder parity)
+            key = c.topology_key
+            if key not in _TOPO_KEYS:
+                raise Unsupported(f"spread topology key {key}")
+            if not _matches(c.label_selector, my):
+                raise Unsupported("non-self-match spread in sweep")
+            counts = self.counts_per_node(c.label_selector)
+            if key == wellknown.HOSTNAME_LABEL:
+                ncap = min(ncap, c.max_skew)
+                hostcap = np.minimum(hostcap,
+                                     np.maximum(c.max_skew - counts, 0))
+            else:
+                set_dyn(key, False, c.label_selector, skew=c.max_skew,
+                        mindom=c.min_domains or 0)
+        for t in rep.pod_affinities:
+            if not t.required:
+                continue
+            if not t.anti:
+                raise Unsupported("required co-location in sweep")
+            key = t.topology_key
+            if key not in _TOPO_KEYS:
+                raise Unsupported(f"affinity topology key {key}")
+            if not _matches(t.label_selector, my):
+                raise Unsupported("non-self-match anti in sweep")
+            counts = self.counts_per_node(t.label_selector)
+            if key == wellknown.HOSTNAME_LABEL:
+                ncap = min(ncap, 1)
+                hostcap = np.minimum(hostcap, np.maximum(1 - counts, 0))
+            else:
+                set_dyn(key, True, t.label_selector)
+
+        # symmetric anti: resident required-anti terms whose selector
+        # matches THIS class block the holding node (hostname key) or the
+        # holding node's domain (zone/ct key) — per-sim, because an
+        # excluded node's residents stop blocking
+        sym_key = None
+        sym_flags = None
+        for (key, sel_t), flags in self._res_anti.items():
+            if not _matches(dict(sel_t), my):
+                continue
+            if key == wellknown.HOSTNAME_LABEL:
+                hostcap = np.where(flags, 0, hostcap).astype(np.int32)
+            elif key in _DOM_KEYS:
+                if sym_key is not None and sym_key != key:
+                    raise Unsupported(
+                        "symmetric anti on two domain keys")
+                sym_key = key
+                sym_flags = (flags if sym_flags is None
+                             else (sym_flags | flags))
+            else:
+                raise Unsupported(f"symmetric anti-affinity on {key}")
+        if sym_key is not None:
+            if dyn is None:
+                # borrow the dynamic slot: dcap 0 on blocked domains,
+                # skew unbounded — pure domain blocking
+                set_dyn(sym_key, True, {})
+                dyn["counts"] = np.zeros(self.E, dtype=np.int32)
+                dyn["sym_only"] = True
+            elif dyn["key"] != sym_key:
+                raise Unsupported(
+                    "symmetric anti key differs from dynamic key")
+            dyn["sym_flags"] = sym_flags
+
+        delig = np.zeros(self.D, dtype=bool)
+        dsel = 0
+        if dyn is not None:
+            dsel = dyn["dsel"]
+            ids = (self.zone_ids if dyn["dsel"] == 1 else self.ct_ids)
+            req = rep.requirements.get(dyn["key"])
+            for d, i in ids.items():
+                if req is None or req.matches(d):
+                    delig[i] = True
+            dom_arr = self.zone_arr if dyn["dsel"] == 1 else self.ct_arr
+            if "counts" not in dyn:
+                dyn["counts"] = self.counts_per_node(dyn["selector"])
+            dyn["dom_total"] = self._dom_total(dyn["counts"], dom_arr)
+            dyn["dom_arr"] = dom_arr
+            if dyn.get("sym_flags") is not None:
+                dyn["sym_idx"] = np.nonzero(dyn["sym_flags"])[0]
+        return dict(ncap=ncap, hostcap=hostcap, dyn=dyn, dsel=dsel,
+                    delig=delig)
+
+    def sim_tensors(self, info: dict, excl: Sequence[int]):
+        """(dbase, dcap) for ONE simulation: the class totals minus the
+        excluded nodes' contributions, plus symmetric-anti domain
+        blocking over the KEPT flagged nodes — O(X + flagged), never
+        O(E)."""
+        dbase = np.zeros(self.D, dtype=np.int32)
+        dcap = np.full(self.D, BIG, dtype=np.int32)
+        dyn = info["dyn"]
+        if dyn is None:
+            return dbase, dcap
+        after = dyn["dom_total"].copy()
+        for e in excl:
+            if 0 <= e < self.E:
+                d = dyn["dom_arr"][e]
+                if d >= 0:
+                    after[d] -= dyn["counts"][e]
+        if dyn.get("sym_only"):
+            pass  # pure symmetric blocking: no own-term counts
+        elif dyn["anti"]:
+            # at most one matching pod per domain (encoder parity:
+            # dcap = 1 - counts, dbase untouched)
+            dcap = np.maximum(1 - after, 0).astype(np.int32)
+        else:
+            dbase = after
+        if dyn.get("sym_flags") is not None:
+            excl_set = set(int(e) for e in excl)
+            for e in dyn["sym_idx"]:
+                if int(e) not in excl_set:
+                    d = dyn["dom_arr"][e]
+                    if d >= 0:
+                        dcap[d] = 0
+        return dbase, dcap
+
+
 class _TopologyEncoder:
     """Classifies each group's spread / (anti-)affinity constraints and
     produces the kernel's topology tensors; raises `Unsupported` for shapes

@@ -658,6 +658,59 @@ def solve_ffd_sweep(
                          exclude_idx, price_cap, pool_limit)
 
 
+@partial(jax.jit, static_argnames=("max_nodes", "zc"))
+def solve_ffd_sweep_topo(
+    # per-simulation (vmapped axis 0)
+    group_req,      # [B, G, R]
+    group_count,    # [B, G]
+    group_class,    # [B, G] i32 — row into the class tables
+    exclude_idx,    # [B, X] i32 — union rows this sim removes (-1 = pad)
+    price_cap,      # [B] f32 — +inf when uncapped
+    pool_limit,     # [B, P, R]
+    group_ncap,     # [B, G] i32
+    group_dsel,     # [B, G] i32
+    group_dbase,    # [B, G, D] i32
+    group_dcap,     # [B, G, D] i32
+    group_skew,     # [B, G] i32
+    group_mindom,   # [B, G] i32
+    group_delig,    # [B, G, D] bool
+    # shared across the batch (replicated)
+    class_mask,     # [C, O] bool
+    class_cap,      # [C, E] i32 — hostname clamps folded in at build time
+    exist_remaining, exist_zone, exist_ct,
+    col_alloc, col_daemon, pt_alloc, col_pool, pool_daemon,
+    col_price, col_zone, col_ct,
+    max_nodes: int = 8, zc: int = 1,
+):
+    """The sweep kernel's HEAVY lane: same shared-snapshot batching as
+    solve_ffd_sweep, but with real per-simulation topology tensors
+    (dynamic zone/ct spread + anti, hostname caps via pre-clamped
+    class_cap) and the domain branch TRACED (with_topology=True).  A
+    separate jit entry so constraint-light sweeps never pay this
+    branch's compile time (the two lanes cache independently)."""
+    E = exist_remaining.shape[0]
+
+    def one(greq, gcount, gcls, excl, pcap, plim,
+            ncap, dsel, dbase, dcap, skew, mindom, delig):
+        keep = jnp.all(
+            jnp.arange(E, dtype=jnp.int32)[None, :] != excl[:, None],
+            axis=0)                                             # [E]
+        er = exist_remaining * keep[:, None]
+        ecap = class_cap[gcls] * keep[None, :].astype(class_cap.dtype)
+        gmask = class_mask[gcls] & (col_price < pcap)[None, :]
+        return _solve_ffd_impl(
+            greq, gcount, gmask, ecap, er,
+            col_alloc, col_daemon, pt_alloc, col_pool, pool_daemon, plim,
+            ncap, dsel, dbase, dcap, skew, mindom, delig,
+            col_zone, col_ct, exist_zone, exist_ct,
+            max_nodes=max_nodes, zc=zc, with_topology=True)
+
+    return jax.vmap(one)(group_req, group_count, group_class,
+                         exclude_idx, price_cap, pool_limit,
+                         group_ncap, group_dsel, group_dbase, group_dcap,
+                         group_skew, group_mindom, group_delig)
+
+
 def unpack(packed, G: int, E: int, N: int, RDIM: int, D: int):
     """Split the flat result buffer back into named host arrays."""
     import numpy as np

@@ -160,6 +160,58 @@ class TestShardedEqualsSingle:
         rb = sharded.solve_batch(inps)
         assert [canon(x) for x in ra] == [canon(x) for x in rb]
 
+    def test_sweep_fast_path_under_mesh(self, solvers):
+        # VERDICT r4 #4: the leave-k-out consolidation sweep no longer
+        # bails out when a mesh is active — the class/column tensors
+        # shard over the catalog axis and the batch is identical to the
+        # single-device sweep, including spread-constrained (heavy-lane)
+        # simulations
+        single, sharded = solvers
+        import dataclasses
+        zones = ["tpu-west-1a", "tpu-west-1b", "tpu-west-1c"]
+        nodes = []
+        for i in range(9):
+            alloc = Resources.parse(
+                {"cpu": "16", "memory": "32Gi", "pods": "58"})
+            node = Node(meta=ObjectMeta(name=f"sw{i}", labels={
+                wellknown.ZONE_LABEL: zones[i % 3],
+                wellknown.CAPACITY_TYPE_LABEL: "on-demand",
+                wellknown.NODEPOOL_LABEL: "default",
+                wellknown.ARCH_LABEL: "amd64",
+                wellknown.OS_LABEL: "linux",
+                wellknown.HOSTNAME_LABEL: f"sw{i}"}),
+                allocatable=alloc, ready=True)
+            pods = []
+            for j in range(2):
+                spread = ([TopologySpreadConstraint(
+                    topology_key=wellknown.ZONE_LABEL, max_skew=2,
+                    label_selector={"sg": "s0"})] if i % 2 else [])
+                pods.append(Pod(
+                    meta=ObjectMeta(name=f"sw{i}-p{j}",
+                                    labels={"sg": "s0"}),
+                    requests=Resources.parse(
+                        {"cpu": "1", "memory": "2Gi"}),
+                    node_name=f"sw{i}", topology_spread=spread))
+            used = Resources()
+            for p in pods:
+                used = used + p.requests
+            nodes.append(ExistingNode(node=node,
+                                      available=node.allocatable - used,
+                                      pods=pods))
+        pool = NodePool(meta=ObjectMeta(name="default"))
+        inps = []
+        for e in range(9):
+            inps.append(ScheduleInput(
+                pods=list(nodes[e].pods), nodepools=[pool],
+                instance_types={"default": CATALOG},
+                existing_nodes=[en for i, en in enumerate(nodes)
+                                if i != e],
+                exist_base=nodes, exist_excluded=(e,)))
+        ra = single.solve_batch(inps, max_nodes=8)
+        rb = sharded.solve_batch(
+            [dataclasses.replace(i_) for i_ in inps], max_nodes=8)
+        assert [canon(x) for x in ra] == [canon(x) for x in rb]
+
     def test_explicit_device_count(self):
         s2 = TPUSolver(mesh=2)
         assert s2.mesh is not None and s2.mesh.size == 2
