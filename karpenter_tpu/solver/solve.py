@@ -207,9 +207,9 @@ class TPUSolver:
         return np.pad(arr, widths, constant_values=value)
 
     def _encode_checked(self, inp: ScheduleInput, cat,
-                        exist_shared=None) -> EncodedProblem:
+                        exist_shared=None, groups=None) -> EncodedProblem:
         try:
-            enc = encode(inp, cat, exist_shared=exist_shared)
+            enc = encode(inp, cat, exist_shared=exist_shared, groups=groups)
         except Unsupported as e:
             raise UnsupportedPods(str(e)) from e
         if inp.price_cap is not None:
@@ -400,12 +400,14 @@ class TPUSolver:
         return self._merge_split(inp, dev_res, orc_res, stranded)
 
     def _attempt_or_split(self, inp: ScheduleInput,
-                          max_nodes: Optional[int] = None) -> ScheduleResult:
+                          max_nodes: Optional[int] = None,
+                          groups=None) -> ScheduleResult:
         """Device attempt; on inexpressible groups, the split path for
         THIS exact input. Raises UnsupportedPods only when splitting can't
         help either (the GatedSolver then falls back to the oracle)."""
         try:
-            return self._solve_attempt(inp, max_nodes=max_nodes)
+            return self._solve_attempt(inp, max_nodes=max_nodes,
+                                       groups=groups)
         except UnsupportedPods:
             res = self._solve_split(inp, max_nodes=max_nodes)
             self._used_split = True
@@ -421,16 +423,26 @@ class TPUSolver:
         the solver that must be bounded'). Re-solving whole keeps packing
         globally consistent. Soft terms therefore steer the kernel's
         domain choice when satisfiable and never block a pod."""
-        # cheap attribute pre-filter first: at 50k pods the method-call scan
-        # alone costs ~40 ms — a third of the TPU latency budget — while
-        # plain pods (the bulk) are three falsy attribute checks
-        if not any(p.preferences
-                   or ((p.pod_affinities or p.topology_spread)
-                       and p.has_soft_terms())
-                   for p in inp.pods):
-            return self._attempt_or_split(inp, max_nodes=max_nodes)
-        import dataclasses
+        # group FIRST, then check soft terms on one REP per class: soft
+        # terms are part of the scheduling key, so classes are uniform —
+        # a handful of rep checks replaces the 50k-pod attribute scan
+        # (~11 ms), and the groups feed straight into encode (which
+        # needed them anyway)
         import time as _time
+        from karpenter_tpu.solver.encode import group_pods
+        t0 = _time.perf_counter()
+        groups = group_pods(inp.pods)
+        # grouping belongs to the ENCODE phase even though it runs before
+        # _solve_attempt's timer — _solve_attempt folds this in, so the
+        # bench's host-share accounting stays honest
+        self._pregroup_ms = (_time.perf_counter() - t0) * 1e3
+        if not any(g[0].preferences
+                   or ((g[0].pod_affinities or g[0].topology_spread)
+                       and g[0].has_soft_terms())
+                   for g in groups):
+            return self._attempt_or_split(inp, max_nodes=max_nodes,
+                                          groups=groups)
+        import dataclasses
         from karpenter_tpu.utils import metrics
         by_name = {p.meta.name: p for p in inp.pods}
         relax: Dict[str, int] = {}
@@ -508,7 +520,8 @@ class TPUSolver:
         return self.max_nodes
 
     def _solve_attempt(self, inp: ScheduleInput,
-                       max_nodes: Optional[int] = None) -> ScheduleResult:
+                       max_nodes: Optional[int] = None,
+                       groups=None) -> ScheduleResult:
         mn = max_nodes or self._adaptive_max_nodes()
         import time as _time
         # a pure-device attempt carries no oracle verdicts; reaching the
@@ -517,9 +530,11 @@ class TPUSolver:
         self._last_slots_exhausted = False
         t0 = _time.perf_counter()
         cat = self._catalog_encoding(inp)
-        enc = self._encode_checked(inp, cat)
+        enc = self._encode_checked(inp, cat, groups=groups)
         t1 = _time.perf_counter()
-        self.last_phase_ms = {"encode": (t1 - t0) * 1e3}
+        self.last_phase_ms = {
+            "encode": (t1 - t0) * 1e3 + getattr(self, "_pregroup_ms", 0.0)}
+        self._pregroup_ms = 0.0
         if enc.n_groups == 0:
             return ScheduleResult()
         if enc.n_columns == 0:
@@ -1445,32 +1460,62 @@ class TPUSolver:
         col_alloc = enc.col_alloc
 
         # distribute each group's pods: existing nodes first (scan order),
-        # then new nodes, then unschedulable — matching kernel accounting
-        node_pods: Dict[int, List[Pod]] = {}
-        node_groups: Dict[int, List[int]] = {}
-        for gi, pods in enumerate(enc.groups):
-            cursor = 0
-            # iterate only the touched slots (np.nonzero ascending keeps
-            # the kernel's fill order): the dense range scan made decode
-            # O(G×E) per simulation — at a 2k-node consolidation sweep
-            # that was the largest post-kernel host cost
-            for ei in np.nonzero(take_exist[gi])[0]:
-                k = take_exist[gi, ei]
-                for pod in pods[cursor:cursor + k]:
-                    res.existing_assignments[pod.meta.name] = enc.existing[ei].name
-                cursor += k
-            for ni in np.nonzero(take_new[gi, :num_active])[0]:
-                k = take_new[gi, ni]
-                node_pods.setdefault(int(ni), []).extend(pods[cursor:cursor + k])
-                node_groups.setdefault(int(ni), []).append(gi)
-                cursor += k
-            for pod in pods[cursor:cursor + unsched[gi]]:
-                res.unschedulable[pod.meta.name] = self._unsched_reason(enc, gi)
+        # then new nodes, then unschedulable — matching kernel accounting.
+        # The C++ fast path (native/hostops.cc distribute) walks the same
+        # rows without per-pod Python frames; the loop below is the
+        # fallback and the differential-test oracle.
+        from karpenter_tpu.native import hostops
+        native = hostops()
+        if native is not None and isinstance(enc.groups, list):
+            exist_names = [en.name for en in enc.existing]
+            node_pods, node_groups, unsched_by_group = native.distribute(
+                enc.groups,
+                np.ascontiguousarray(take_exist, dtype=np.int64),
+                np.ascontiguousarray(take_new[:, :num_active],
+                                     dtype=np.int64),
+                np.ascontiguousarray(unsched, dtype=np.int64),
+                exist_names, num_active, res.existing_assignments)
+            for gi, pods in unsched_by_group.items():
+                reason = self._unsched_reason(enc, gi)
+                for pod in pods:
+                    res.unschedulable[pod.meta.name] = reason
+        else:
+            node_pods = {}
+            node_groups = {}
+            for gi, pods in enumerate(enc.groups):
+                cursor = 0
+                # iterate only the touched slots (np.nonzero ascending
+                # keeps the kernel's fill order): the dense range scan
+                # made decode O(G×E) per simulation — at a 2k-node
+                # consolidation sweep that was the largest post-kernel
+                # host cost
+                for ei in np.nonzero(take_exist[gi])[0]:
+                    k = take_exist[gi, ei]
+                    for pod in pods[cursor:cursor + k]:
+                        res.existing_assignments[pod.meta.name] = \
+                            enc.existing[ei].name
+                    cursor += k
+                for ni in np.nonzero(take_new[gi, :num_active])[0]:
+                    k = take_new[gi, ni]
+                    node_pods.setdefault(int(ni), []).extend(
+                        pods[cursor:cursor + k])
+                    node_groups.setdefault(int(ni), []).append(gi)
+                    cursor += k
+                for pod in pods[cursor:cursor + unsched[gi]]:
+                    res.unschedulable[pod.meta.name] = \
+                        self._unsched_reason(enc, gi)
 
         # claim metadata (requirements + ranked type list) depends only on
         # (pool, resident groups, used vector, pinned domains) — hundreds of
-        # nodes from the same fill collapse to a handful of computations
+        # nodes from the same fill collapse to a handful of computations.
+        # used-vector identity via one vectorized unique (the per-node
+        # tobytes hashing was ~1 ms of the 50k decode); float rows hoisted
+        # out of the loop likewise.
         claim_cache: Dict[tuple, tuple] = {}
+        if num_active > 0:
+            _, used_id = np.unique(used[:num_active], axis=0,
+                                   return_inverse=True)
+            used_f = used[:num_active, :R].astype(float)
         for ni in range(num_active):
             pods = node_pods.get(ni, [])
             if not pods:
@@ -1479,7 +1524,7 @@ class TPUSolver:
             pool = enc.pools[pidx]
             gis = tuple(node_groups.get(ni, []))
             zi, ci = int(node_zone[ni]), int(node_ct[ni])
-            ckey = (pidx, gis, zi, ci, used[ni].tobytes())
+            ckey = (pidx, gis, zi, ci, int(used_id[ni]))
             cached = claim_cache.get(ckey)
             if cached is None:
                 nmask = (col_pool == pidx) & np.all(
@@ -1542,7 +1587,7 @@ class TPUSolver:
                 node_class_ref=pool.node_class_ref,
                 requirements=reqs,
                 pods=pods,
-                requests=Resources(list(used[ni][:R].astype(float))),
+                requests=Resources(used_f[ni].tolist()),
                 instance_type_names=ranked,
                 price=best_price[ranked[0]],
                 taints=list(pool.taints),

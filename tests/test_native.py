@@ -73,3 +73,61 @@ class TestGroupPods:
                     requests=Resources.parse({"cpu": "1"}))
                 for n in ["b", "a", "ab", "a-1", "z", "ä", "a0"]]
         same(NATIVE.group_pods(pods), group_pods_py(list(pods)))
+
+
+@pytest.mark.skipif(NATIVE is None, reason="native toolchain unavailable")
+class TestDistribute:
+    def test_matches_python_distribution(self):
+        import numpy as np
+        # 3 groups with 5/3/4 pods over 2 existing nodes + 3 new slots
+        groups = []
+        for g, n in enumerate([5, 3, 4]):
+            groups.append([Pod(meta=ObjectMeta(name=f"g{g}p{j}"),
+                               requests=Resources.parse({"cpu": "1"}))
+                           for j in range(n)])
+        take_exist = np.array([[2, 1], [0, 0], [1, 0]], dtype=np.int64)
+        take_new = np.array([[1, 1, 0], [2, 0, 1], [0, 3, 0]],
+                            dtype=np.int64)
+        unsched = np.array([0, 0, 0], dtype=np.int64)
+        exist_names = ["e0", "e1"]
+        assignments = {}
+        node_pods, node_groups, unsched_by_group = NATIVE.distribute(
+            groups, take_exist, take_new, unsched, exist_names, 3,
+            assignments)
+        # python oracle
+        py_assign, py_pods, py_groups = {}, {}, {}
+        for gi, pods in enumerate(groups):
+            cursor = 0
+            for ei in np.nonzero(take_exist[gi])[0]:
+                k = take_exist[gi, ei]
+                for pod in pods[cursor:cursor + k]:
+                    py_assign[pod.meta.name] = exist_names[ei]
+                cursor += k
+            for ni in np.nonzero(take_new[gi, :3])[0]:
+                k = take_new[gi, ni]
+                py_pods.setdefault(int(ni), []).extend(
+                    pods[cursor:cursor + k])
+                py_groups.setdefault(int(ni), []).append(gi)
+                cursor += k
+        assert assignments == py_assign
+        assert {k: [id(p) for p in v] for k, v in node_pods.items()} == \
+            {k: [id(p) for p in v] for k, v in py_pods.items()}
+        assert dict(node_groups) == py_groups
+        assert unsched_by_group == {}
+
+    def test_unschedulable_and_truncation(self):
+        import numpy as np
+        groups = [[Pod(meta=ObjectMeta(name=f"u{j}"),
+                       requests=Resources.parse({"cpu": "1"}))
+                   for j in range(4)]]
+        take_exist = np.zeros((1, 0), dtype=np.int64)
+        take_new = np.array([[1]], dtype=np.int64)
+        unsched = np.array([3], dtype=np.int64)
+        assignments = {}
+        node_pods, node_groups, unsched_by_group = NATIVE.distribute(
+            groups, take_exist, take_new, unsched, [], 1, assignments)
+        assert assignments == {}
+        assert [p.meta.name for p in node_pods[0]] == ["u0"]
+        assert node_groups == {0: [0]}
+        assert [p.meta.name for p in unsched_by_group[0]] == \
+            ["u1", "u2", "u3"]

@@ -691,7 +691,8 @@ class TestFuzzSweep:
             pods = []
             for j in range(int(rng.randint(1, 4))):
                 grp = int(rng.randint(n_sel_groups))
-                kind = rng.choice(["zspread", "zspread", "zanti", "plain"])
+                kind = rng.choice(["zspread", "zspread", "zanti", "plain",
+                                   "hspread", "ctspread", "hanti"])
                 constraint = {}
                 if kind == "zspread":
                     constraint["topology_spread"] = [TopologySpreadConstraint(
@@ -702,10 +703,31 @@ class TestFuzzSweep:
                     constraint["pod_affinities"] = [PodAffinityTerm(
                         label_selector={"sg": f"s{grp}", "one": "1"},
                         topology_key=ZONE, anti=True, required=True)]
+                elif kind == "hspread":
+                    # hostname spread: ncap + per-node clamps in the tables
+                    constraint["topology_spread"] = [TopologySpreadConstraint(
+                        topology_key=wellknown.HOSTNAME_LABEL,
+                        max_skew=int(rng.randint(2, 5)),
+                        label_selector={"sg": f"s{grp}"})]
+                elif kind == "ctspread":
+                    # capacity-type dynamic domain (dsel=2)
+                    constraint["topology_spread"] = [TopologySpreadConstraint(
+                        topology_key=wellknown.CAPACITY_TYPE_LABEL,
+                        max_skew=int(rng.randint(1, 3)),
+                        label_selector={"sg": f"s{grp}"})]
+                elif kind == "hanti":
+                    constraint["pod_affinities"] = [PodAffinityTerm(
+                        label_selector={"sg": f"s{grp}", "hone": "1"},
+                        topology_key=wellknown.HOSTNAME_LABEL,
+                        anti=True, required=True)]
+                extra_lbl = {}
+                if kind == "zanti":
+                    extra_lbl["one"] = "1"
+                elif kind == "hanti":
+                    extra_lbl["hone"] = "1"
                 p = Pod(meta=ObjectMeta(
                     name=f"tz{i}-p{j}",
-                    labels={"sg": f"s{grp}",
-                            **({"one": "1"} if kind == "zanti" else {})}),
+                    labels={"sg": f"s{grp}", **extra_lbl}),
                     requests=Resources.of(
                         cpu=float(rng.choice([500, 1000, 2000])),
                         memory=float(rng.choice([1024, 4096])), pods=1),
