@@ -46,15 +46,16 @@ from karpenter_tpu.utils.cache import UnavailableOfferings
 from karpenter_tpu.utils.clock import Clock, FakeClock
 
 
-def _close_store(backend, daemon, sockdir: str) -> None:
+def _close_store(backend, daemon, sockdir) -> None:
     """Module-level so the Environment finalizer holds no self-reference
     (a bound method would keep the environment alive forever)."""
     try:
         backend.close()
     finally:
         daemon.close()
-    import shutil
-    shutil.rmtree(sockdir, ignore_errors=True)
+    if sockdir is not None:
+        import shutil
+        shutil.rmtree(sockdir, ignore_errors=True)
 
 
 class Environment:
@@ -91,6 +92,17 @@ class Environment:
                 self._store_finalizer = weakref.finalize(
                     self, _close_store, store_backend, self.store_daemon,
                     sockdir)
+            elif os.environ.get("KARPENTER_TPU_STORE_BACKEND") == "http":
+                # the kube-protocol backend against the in-repo fake
+                # apiserver — the whole suite then exercises REST
+                # list/watch JSON as its cluster store
+                import weakref
+                from karpenter_tpu.store import FakeApiServer, HttpBackend
+                self.store_daemon = FakeApiServer()
+                store_backend = HttpBackend(self.store_daemon.url)
+                self._store_finalizer = weakref.finalize(
+                    self, _close_store, store_backend, self.store_daemon,
+                    None)
         self.store_backend = store_backend
         # the cloud session is injectable (operator.go:105-116 resolves the
         # AWS session the same way); default is the in-memory fake, the only

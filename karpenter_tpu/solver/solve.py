@@ -409,6 +409,9 @@ class TPUSolver:
             return self._solve_attempt(inp, max_nodes=max_nodes,
                                        groups=groups)
         except UnsupportedPods:
+            # the failed attempt never consumed the pre-group timing; a
+            # stale value must not leak into a later solve's encode phase
+            self._pregroup_ms = 0.0
             res = self._solve_split(inp, max_nodes=max_nodes)
             self._used_split = True
             return res

@@ -8,23 +8,31 @@ package gives our `Cluster` the same split: the in-process object dicts
 become an INFORMER CACHE, and a `StoreBackend` decides where the
 authoritative copies live.
 
-Two backends:
+Three backends:
 
 - `InMemoryBackend` — the cache IS the store (the default; zero overhead,
   identical semantics to the pre-seam Cluster).
 - `RemoteBackend` (`remote.py`) — a process-external store daemon spoken
   to over a unix socket with a watch stream, the solverd pattern applied
   to state. Writes forward to the daemon; peers' writes stream back and
-  update the local cache. A kube-apiserver client would attach exactly
-  here: implement `StoreBackend` with list/put/delete bridged to a k8s
-  client and the watch loop bridged to informers (docs/store-backends.md).
+  update the local cache.
+- `HttpBackend` (`http.py`) — the kube list/watch REST protocol over
+  chunked HTTP against `FakeApiServer`, a minimal in-repo apiserver:
+  global resourceVersions, watch streams, 410-Gone relist recovery,
+  deletionTimestamp semantics. A REAL kube-apiserver attaches here by
+  swapping the payload codec for CRD JSON plus auth/TLS
+  (docs/store-backends.md).
 """
 
 from karpenter_tpu.store.backend import InMemoryBackend, StoreBackend
+from karpenter_tpu.store.http import FakeApiServer, HttpBackend, PickleCodec
 from karpenter_tpu.store.remote import RemoteBackend, StoreDaemon
 
 __all__ = [
+    "FakeApiServer",
+    "HttpBackend",
     "InMemoryBackend",
+    "PickleCodec",
     "RemoteBackend",
     "StoreBackend",
     "StoreDaemon",

@@ -311,6 +311,14 @@ PyObject* distribute(PyObject* /*self*/, PyObject* args) {
     PyErr_SetString(PyExc_ValueError, "distribute: group axis too short");
     return nullptr;
   }
+  if (PyList_GET_SIZE(exist_names) < E) {
+    // PyList_GET_ITEM is an unchecked macro; a short name list must be a
+    // Python error, not an out-of-bounds read
+    PyErr_SetString(PyExc_ValueError,
+                    "distribute: exist_names shorter than take_exist "
+                    "columns");
+    return nullptr;
+  }
   if (num_active > N) num_active = N;
 
   // buffer per-node members in C++ vectors (5 ns pushes) and materialize

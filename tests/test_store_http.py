@@ -1,0 +1,292 @@
+"""The kube-protocol store backend (VERDICT r4 #6): REST list/watch JSON
+over chunked HTTP against the in-repo fake apiserver — the reference's
+operating mode (informers + client.Client,
+/root/reference/cmd/controller/main.go:46-54) as a third `StoreBackend`.
+
+Two tiers: raw-protocol assertions (a kube client would recognize the
+wire shapes — list envelopes, watch event stream, 409/404/410 statuses),
+and the same cluster/e2e contract the remote-daemon backend passes.
+"""
+
+import http.client
+import json
+import time
+
+import pytest
+
+from karpenter_tpu.cluster import Cluster
+from karpenter_tpu.env import Environment
+from karpenter_tpu.models import NodePool, ObjectMeta, Pod, Resources
+from karpenter_tpu.store import FakeApiServer, HttpBackend
+from karpenter_tpu.store.http import GROUP_PATH
+from karpenter_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture()
+def server():
+    s = FakeApiServer()
+    yield s
+    s.close()
+
+
+def mkpod(name, cpu="500m", mem="1Gi"):
+    return Pod(meta=ObjectMeta(name=name),
+               requests=Resources.parse({"cpu": cpu, "memory": mem}))
+
+
+def _req(server, method, path, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
+    payload = json.dumps(body).encode() if body is not None else None
+    conn.request(method, path, body=payload,
+                 headers={"Content-Type": "application/json"} if payload
+                 else {})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, (json.loads(data) if data else {})
+
+
+def _item(name, data="payload"):
+    return {"apiVersion": "karpenter.tpu/v1", "kind": "Pod",
+            "metadata": {"name": name}, "data": data}
+
+
+class TestWireProtocol:
+    def test_list_envelope_shape(self, server):
+        _req(server, "POST", f"{GROUP_PATH}/pods", _item("a"))
+        status, doc = _req(server, "GET", f"{GROUP_PATH}/pods")
+        assert status == 200
+        # the kube list envelope: kind/apiVersion/metadata.resourceVersion
+        assert doc["kind"] == "PodsList"
+        assert doc["apiVersion"] == "karpenter.tpu/v1"
+        assert doc["metadata"]["resourceVersion"].isdigit()
+        assert [i["metadata"]["name"] for i in doc["items"]] == ["a"]
+        assert doc["items"][0]["metadata"]["resourceVersion"].isdigit()
+
+    def test_create_conflict_and_update_of_absent(self, server):
+        status, _ = _req(server, "POST", f"{GROUP_PATH}/pods", _item("a"))
+        assert status == 201
+        status, doc = _req(server, "POST", f"{GROUP_PATH}/pods", _item("a"))
+        assert status == 409 and doc["kind"] == "Status"
+        status, _ = _req(server, "PUT", f"{GROUP_PATH}/pods/ghost",
+                         _item("ghost"))
+        assert status == 404
+        status, _ = _req(server, "DELETE", f"{GROUP_PATH}/pods/ghost")
+        assert status == 404
+
+    def test_resource_versions_monotonic(self, server):
+        rvs = []
+        for n in ("a", "b", "c"):
+            _, doc = _req(server, "POST", f"{GROUP_PATH}/pods", _item(n))
+            rvs.append(int(doc["metadata"]["resourceVersion"]))
+        assert rvs == sorted(rvs) and len(set(rvs)) == 3
+
+    def test_watch_stream_is_chunked_json_events(self, server):
+        _, doc = _req(server, "POST", f"{GROUP_PATH}/pods", _item("a"))
+        rv0 = int(doc["metadata"]["resourceVersion"])
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=10)
+        conn.request("GET",
+                     f"{GROUP_PATH}/pods?watch=true&resourceVersion={rv0}")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.headers.get("Transfer-Encoding") == "chunked"
+        _req(server, "PUT", f"{GROUP_PATH}/pods/a", _item("a", "v2"))
+        _req(server, "DELETE", f"{GROUP_PATH}/pods/a")
+        ev1 = json.loads(resp.readline())
+        ev2 = json.loads(resp.readline())
+        conn.close()
+        assert ev1["type"] == "MODIFIED" and ev1["object"]["data"] == "v2"
+        assert ev2["type"] == "DELETED"
+        assert ev2["object"]["metadata"]["name"] == "a"
+
+    def test_watch_gone_when_log_trimmed(self):
+        server = FakeApiServer(retain_events=4)
+        try:
+            for i in range(10):
+                _req(server, "POST", f"{GROUP_PATH}/pods", _item(f"p{i}"))
+            conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                              timeout=10)
+            conn.request("GET",
+                         f"{GROUP_PATH}/pods?watch=true&resourceVersion=1")
+            resp = conn.getresponse()
+            assert resp.status == 410  # Gone → client must relist
+            conn.close()
+        finally:
+            server.close()
+
+
+class TestHttpBackendContract:
+    def test_put_list_delete_roundtrip(self, server):
+        be = HttpBackend(server.url)
+        pod = mkpod("p1")
+        assert be.put("pods", "p1", pod, verb="added")
+        loaded = be.load("pods")
+        assert set(loaded) == {"p1"}
+        assert loaded["p1"] is not pod
+        assert loaded["p1"].meta.name == "p1"
+        assert loaded["p1"].requests.v == pod.requests.v
+        be.delete("pods", "p1")
+        assert be.load("pods") == {}
+        be.close()
+
+    def test_conflict_semantics(self, server):
+        be = HttpBackend(server.url)
+        assert be.put("pods", "p1", mkpod("p1"), verb="added")
+        # create-of-existing rejected (apiserver 409)
+        assert not be.put("pods", "p1", mkpod("p1"), verb="added")
+        # modify-of-deleted rejected (apiserver 404)
+        be.delete("pods", "p1")
+        assert not be.put("pods", "p1", mkpod("p1"), verb="modified")
+        be.close()
+
+    def test_echo_suppression(self, server):
+        be = HttpBackend(server.url)
+        be.load("pods")  # starts the watch
+        be.put("pods", "p1", mkpod("p1"), verb="added")
+        time.sleep(0.3)
+        assert be.events() == []
+        be.close()
+
+    def test_peer_events_flow(self, server):
+        a = HttpBackend(server.url)
+        b = HttpBackend(server.url)
+        b.load("nodes")  # starts b's watch
+        a.put("nodes", "n1", mkpod("n1"), verb="added")
+        a.delete("nodes", "n1")
+        deadline = time.time() + 5
+        evs = []
+        while len(evs) < 2 and time.time() < deadline:
+            evs += b.events()
+            time.sleep(0.01)
+        assert [(k, v, n) for k, v, n, _ in evs] == [
+            ("nodes", "added", "n1"), ("nodes", "deleted", "n1")]
+        a.close()
+        b.close()
+
+    def test_deleting_verb_via_deletion_timestamp(self, server):
+        a = HttpBackend(server.url)
+        b = HttpBackend(server.url)
+        b.load("pods")
+        pod = mkpod("f1")
+        a.put("pods", "f1", pod, verb="added")
+        pod.meta.deletion_time = 1.0
+        a.put("pods", "f1", pod, verb="deleting")
+        deadline = time.time() + 5
+        evs = []
+        while len(evs) < 2 and time.time() < deadline:
+            evs += b.events()
+            time.sleep(0.01)
+        assert [(v, n) for _, v, n, _ in evs] == [
+            ("added", "f1"), ("deleting", "f1")]
+        assert evs[1][3].meta.deleting
+        a.close()
+        b.close()
+
+    def test_410_gap_recovery_synthesizes_deletes(self):
+        server = FakeApiServer(retain_events=4)
+        try:
+            a = HttpBackend(server.url)
+            b = HttpBackend(server.url)
+            a.put("pods", "keep", mkpod("keep"), verb="added")
+            a.put("pods", "gone", mkpod("gone"), verb="added")
+            assert set(b.load("pods")) == {"keep", "gone"}
+            # stall b's watch horizon off the log: burst past the retain
+            # window, deleting "gone" inside the gap
+            a.delete("pods", "gone")
+            for i in range(8):
+                a.put("pods", f"x{i}", mkpod(f"x{i}"), verb="added")
+            deadline = time.time() + 5
+            seen = {}
+            while time.time() < deadline:
+                for k, v, n, o in b.events():
+                    seen[n] = v
+                if "gone" in seen and seen.get("x7") is not None:
+                    break
+                time.sleep(0.02)
+            assert seen.get("gone") == "deleted"
+            assert all(seen.get(f"x{i}") in ("added", "modified")
+                       for i in range(8))
+            a.close()
+            b.close()
+        finally:
+            server.close()
+
+
+class TestClusterOnHttpBackend:
+    def test_relist_recovery(self, server):
+        c1 = Cluster(clock=FakeClock(), backend=HttpBackend(server.url))
+        c1.nodepools.create(NodePool(meta=ObjectMeta(name="default")))
+        c1.pods.create(mkpod("p1"))
+        c2 = Cluster(clock=FakeClock(), backend=HttpBackend(server.url))
+        assert c2.nodepools.get("default") is not None
+        assert c2.pods.get("p1") is not None
+        assert c2.pods.get("p1") is not c1.pods.get("p1")
+
+    def test_two_replicas_converge(self, server):
+        a = Cluster(clock=FakeClock(), backend=HttpBackend(server.url))
+        b = Cluster(clock=FakeClock(), backend=HttpBackend(server.url))
+        a.pods.create(mkpod("p1"))
+        deadline = time.time() + 5
+        while b.pods.get("p1") is None and time.time() < deadline:
+            b.sync_backend()
+            time.sleep(0.01)
+        assert b.pods.get("p1") is not None
+        pod_b = b.pods.get("p1")
+        pod_b.phase = "Running"
+        b.pods.update(pod_b)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            a.sync_backend()
+            if a.pods.get("p1").phase == "Running":
+                break
+            time.sleep(0.01)
+        assert a.pods.get("p1").phase == "Running"
+
+    def test_stale_update_cannot_resurrect(self, server):
+        a = Cluster(clock=FakeClock(), backend=HttpBackend(server.url))
+        b = Cluster(clock=FakeClock(), backend=HttpBackend(server.url))
+        a.pods.create(mkpod("z1"))
+        deadline = time.time() + 5
+        while b.pods.get("z1") is None and time.time() < deadline:
+            b.sync_backend()
+            time.sleep(0.01)
+        stale = b.pods.get("z1")
+        a.pods.delete("z1")
+        a.pods.remove_finalizer("z1", "none")  # fully delete
+        deadline = time.time() + 5
+        while b.pods.get("z1") is not None and time.time() < deadline:
+            b.sync_backend()
+            time.sleep(0.01)
+        stale.phase = "Running"
+        b.pods.update(stale)  # apiserver 404 → write rejected
+        b.sync_backend()
+        assert HttpBackend(server.url).load("pods").get("z1") is None
+
+
+class TestEnvironmentOnHttpBackend:
+    def test_e2e_provisioning_against_fake_apiserver(self, monkeypatch):
+        """The full controller stack runs unchanged with the kube-protocol
+        backend as its cluster store: pending pods → NodeClaims →
+        fake-cloud instances → bound pods, every mutation a REST write
+        and every peer observation a watch event."""
+        from karpenter_tpu.operator.options import Options
+        monkeypatch.setenv("KARPENTER_TPU_STORE_BACKEND", "http")
+        env = Environment(options=Options(batch_idle_duration=0))
+        assert env.store_daemon is not None  # the fake apiserver
+        env.add_default_nodeclass()
+        env.cluster.nodepools.create(
+            NodePool(meta=ObjectMeta(name="default")))
+        for i in range(10):
+            env.cluster.pods.create(mkpod(f"p{i}"))
+        env.settle()
+        pods = env.cluster.pods.list()
+        assert pods and all(p.scheduled for p in pods)
+        assert env.cluster.nodeclaims.list()
+        # the apiserver's authoritative copies match the informer cache
+        be = HttpBackend(env.store_daemon.url)
+        authoritative = be.load("nodeclaims")
+        assert set(authoritative) == {
+            c.name for c in env.cluster.nodeclaims.list()}
+        be.close()
+        env.close()
