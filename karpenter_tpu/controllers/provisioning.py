@@ -46,6 +46,11 @@ class Provisioner:
         self._batch_first: Optional[float] = None
         self._batch_sig: Optional[frozenset] = None
         self._batch_last_change: Optional[float] = None
+        # pod uid → first time seen pending, for the backlog-age gauge
+        # (degraded-mode liveness: shed pods re-enter later passes, and
+        # the oldest pending pod's age must shrink to zero as the
+        # backlog drains — designs/limits.md:23-25 liveness discipline)
+        self._first_pending: dict = {}
 
     # -- batching (settings.md BATCH_IDLE/MAX_DURATION) -------------------
     def _batch_ready(self, pending: List[Pod]) -> bool:
@@ -73,6 +78,16 @@ class Provisioner:
             if NOMINATED_ANNOTATION not in p.meta.annotations
         ]
         metrics.SCHEDULING_QUEUE_DEPTH.set(len(pending))
+        now = self.clock.now()
+        live = {p.meta.uid for p in pending}
+        for uid in live - self._first_pending.keys():
+            self._first_pending[uid] = now
+        for uid in list(self._first_pending):
+            if uid not in live:
+                del self._first_pending[uid]
+        metrics.PROVISIONER_BACKLOG_AGE.set(
+            max((now - t for t in self._first_pending.values()),
+                default=0.0))
         if not self._batch_ready(pending):
             return
         self._batch_first = self._batch_sig = self._batch_last_change = None

@@ -148,9 +148,11 @@ class FakeApiServer:
 
             def do_POST(self):
                 kind, _, _ = self._parts()
+                if kind is None:
+                    return self._status(404, "NotFound")
                 item = self._read_body()
-                if kind is None or item is None:
-                    return
+                if item is None:
+                    return  # _read_body already answered 400
                 name = item.get("metadata", {}).get("name")
                 if not name:
                     return self._status(422, "Invalid")
@@ -162,11 +164,13 @@ class FakeApiServer:
 
             def do_PUT(self):
                 kind, name, _ = self._parts()
-                item = self._read_body()
-                if kind is None or item is None:
-                    return
+                if kind is None:
+                    return self._status(404, "NotFound")
                 if name is None:
                     return self._status(405, "MethodNotAllowed")
+                item = self._read_body()
+                if item is None:
+                    return  # _read_body already answered 400
                 with server._lock:
                     if name not in server._data.setdefault(kind, {}):
                         # modify-of-deleted: the apiserver-404 analogue
@@ -242,6 +246,13 @@ class FakeApiServer:
         while not self._closed:
             batch = []
             with self._lock:
+                if self._log and last < self._log[0][0] - 1:
+                    # the stream fell behind the bounded log mid-watch:
+                    # events were trimmed unseen. Close the stream — the
+                    # client reconnects from its last rv, receives 410,
+                    # and relists (silently skipping the gap would lose
+                    # peer events forever)
+                    return
                 for erv, ekind, etype, item in self._log:
                     if erv > last and ekind == kind:
                         batch.append((erv, etype, item))

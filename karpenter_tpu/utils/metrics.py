@@ -224,6 +224,12 @@ SCHEDULING_DURATION = _h(
 SCHEDULING_SIMULATION_DURATION = _h(
     "karpenter_provisioner_scheduling_simulation_duration_seconds",
     "Duration of one disruption scheduling simulation.")
+PROVISIONER_BACKLOG_AGE = _g(
+    "karpenter_tpu_provisioner_backlog_age_seconds",
+    "Age of the oldest still-pending pod the provisioner has seen — the "
+    "degraded-mode liveness signal: under oracle fallback with load "
+    "shedding, a healthy backlog drains pass by pass and this converges "
+    "to zero; growth means the loop is not keeping up.")
 SCHEDULING_QUEUE_DEPTH = _g(
     "karpenter_provisioner_scheduling_queue_depth",
     "Pending pods awaiting a scheduling pass.")

@@ -1,0 +1,166 @@
+"""Process-level HA (VERDICT r4 #8): the deploy/ topology with REAL
+processes — one store daemon, one native kt_solverd, two operator
+replica processes racing a shared file lease.  The leader dies by
+SIGKILL (no lease release, no teardown); the standby must take the lease
+and keep provisioning over the SAME solver daemon.
+
+Complements tests/test_ha.py: the in-process twin proves
+mid-provisioning failover with a genuinely shared cloud (pods in flight
+on the leader finish on the standby); this test proves the PROCESS
+mechanics — kill -9 survival of the file lease protocol, store-daemon
+relist/watch across real process boundaries, and no solver re-init
+(the fake cloud is per-process, so cloud-side instance state does not
+survive the leader here — deploy/run_ha.py documents the same caveat).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from karpenter_tpu.models import NodeClass, NodePool, ObjectMeta, Pod, Resources
+from karpenter_tpu.store import RemoteBackend
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def mkpod(name, cpu="500m", mem="1Gi"):
+    return Pod(meta=ObjectMeta(name=name),
+               requests=Resources.parse({"cpu": cpu, "memory": mem}))
+
+
+def _proc_env(store_sock, lease, ident, solver_sock):
+    env = dict(os.environ,
+               PYTHONPATH=REPO,
+               KARPENTER_TPU_PLATFORM="cpu",
+               JAX_PLATFORMS="cpu",
+               KARPENTER_TPU_STORE_SOCKET=store_sock,
+               KARPENTER_TPU_LEASE_FILE=lease,
+               KARPENTER_TPU_REPLICA_ID=ident,
+               KARPENTER_TPU_METRICS_PORT="0",
+               KARPENTER_TPU_HEALTH_PORT="0",
+               SOLVER_ENDPOINT=solver_sock,
+               BATCH_IDLE_DURATION="0")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("KARPENTER_TPU_STORE_BACKEND", None)
+    return env
+
+
+def _wait_scheduled(store_sock, names, timeout):
+    be = RemoteBackend(store_sock)
+    try:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            pods = be.load("pods")
+            if names <= set(pods) and all(
+                    pods[n].scheduled for n in names):
+                return pods
+            time.sleep(0.25)
+        return be.load("pods")
+    finally:
+        be.close()
+
+
+class TestProcessTopologyHA:
+    def test_kill9_leader_standby_takes_over(self, tmp_path):
+        from tests.test_solver_service import build_daemon, spawn_daemon
+
+        build_daemon()
+        solver_sock = str(tmp_path / "kt.sock")
+        solver_proc, dump = spawn_daemon(solver_sock)
+        store_sock = str(tmp_path / "store.sock")
+        lease = str(tmp_path / "lease.json")
+        procs = {}
+        logs = {}
+        try:
+            procs["store"] = subprocess.Popen(
+                [sys.executable, "-m", "karpenter_tpu.store", store_sock],
+                env=dict(os.environ, PYTHONPATH=REPO,
+                         KARPENTER_TPU_PLATFORM="cpu"),
+                cwd=REPO)
+            deadline = time.time() + 15
+            while not os.path.exists(store_sock) and time.time() < deadline:
+                time.sleep(0.05)
+            assert os.path.exists(store_sock), "store daemon never bound"
+
+            for ident in ("rep-1", "rep-2"):
+                logs[ident] = open(tmp_path / f"{ident}.log", "wb")
+                procs[ident] = subprocess.Popen(
+                    [sys.executable, "-m", "karpenter_tpu"],
+                    env=_proc_env(store_sock, lease, ident, solver_sock),
+                    cwd=REPO, stdout=logs[ident],
+                    stderr=subprocess.STDOUT)
+
+            # seed the cluster through a plain store client (the
+            # kubectl-analogue): nodeclass, nodepool, wave-1 pods
+            be = RemoteBackend(store_sock)
+            be.put("nodeclasses", "default",
+                   NodeClass(meta=ObjectMeta(name="default")), verb="added")
+            be.put("nodepools", "default",
+                   NodePool(meta=ObjectMeta(name="default")), verb="added")
+            w1 = {f"w1-{i}" for i in range(5)}
+            for n in w1:
+                be.put("pods", n, mkpod(n), verb="added")
+            be.close()
+
+            pods = _wait_scheduled(store_sock, w1, timeout=180)
+            assert all(pods[n].scheduled for n in w1), (
+                f"wave-1 never scheduled: "
+                f"{ {n: pods.get(n) and pods[n].node_name for n in w1} }\n"
+                f"--- solverd ---\n{dump()}")
+
+            # find the leader in the shared lease and SIGKILL it — no
+            # release, no teardown; the lease must expire on its own
+            holder = json.load(open(lease))["holder"]
+            assert holder in ("rep-1", "rep-2")
+            standby_id = "rep-2" if holder == "rep-1" else "rep-1"
+            leader_proc = procs[holder]
+            os.kill(leader_proc.pid, 9)
+            leader_proc.wait(timeout=10)
+            assert solver_proc.poll() is None, "solverd died with leader"
+
+            # wave-2 lands during the leadership gap; the standby must
+            # acquire the expired lease and provision it
+            be = RemoteBackend(store_sock)
+            w2 = {f"w2-{i}" for i in range(5)}
+            for n in w2:
+                be.put("pods", n, mkpod(n), verb="added")
+            be.close()
+
+            pods = _wait_scheduled(store_sock, w2, timeout=120)
+            assert all(pods[n].scheduled for n in w2), (
+                f"standby never provisioned wave-2 "
+                f"(holder was {holder})\n--- solverd ---\n{dump()}")
+
+            # zero lost pods: every pod of both waves still exists and
+            # is bound in the authoritative store
+            assert w1 | w2 <= set(pods)
+            assert all(pods[n].scheduled for n in w1 | w2)
+            # the standby holds the lease now
+            assert json.load(open(lease))["holder"] == standby_id
+            # no device/solver re-init: the same kt_solverd process
+            # served both leaders
+            assert solver_proc.poll() is None
+        finally:
+            for p in procs.values():
+                try:
+                    p.terminate()
+                except OSError:
+                    pass
+            for p in procs.values():
+                try:
+                    p.wait(timeout=10)
+                except Exception:  # noqa: BLE001
+                    p.kill()
+            for f in logs.values():
+                f.close()
+            solver_proc.terminate()
+            try:
+                solver_proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                solver_proc.kill()
