@@ -158,6 +158,16 @@ def _solve_ffd_impl(
                                   # vmapped consolidation kernel must not
                                   # pay TPU compile time for a branch its
                                   # caller guarantees unreachable)
+    sparse_k: int = 0,            # static: >0 packs take_exist as top-K
+                                  # (count, index) pairs per group instead
+                                  # of the dense [G, E] row.  The device
+                                  # link here is a network tunnel, so the
+                                  # result download is the sweep's floor:
+                                  # dense take_exist is G*E (8*2048 f32 =
+                                  # 64 KiB/sim) while a group of c pods
+                                  # touches at most c existing nodes.
+                                  # Caller guarantees K >= max group count
+                                  # so the sparse form is lossless.
 ):
     G, RDIM = group_req.shape
     E = exist_remaining.shape[0]
@@ -558,8 +568,28 @@ def _solve_ffd_impl(
     # — one concatenated buffer costs one. colmask [N,O] stays on device
     # entirely; the host reconstructs it from (take_new, used, group_mask,
     # node_zone/node_ct).
-    packed = jnp.concatenate([
-        outs["take_exist"].astype(jnp.float32).reshape(-1),  # G*E
+    if sparse_k:
+        # compact the nonzero entries of each [E] row into K slots by
+        # prefix-sum rank + scatter (mode=drop swallows the impossible
+        # overflow) — NOT lax.top_k, whose sort costs more than the rest
+        # of the result pack combined at E=2048
+        te = outs["take_exist"]                              # [G, E] i32
+        nz = te > 0
+        rank = jnp.cumsum(nz.astype(jnp.int32), axis=1) - 1  # [G, E]
+        slot = jnp.where(nz, rank, sparse_k)                 # K = dropped
+        gi = jnp.broadcast_to(
+            jnp.arange(te.shape[0], dtype=jnp.int32)[:, None], te.shape)
+        ei = jnp.broadcast_to(
+            jnp.arange(te.shape[1], dtype=jnp.int32)[None, :], te.shape)
+        te_cnt = jnp.zeros((te.shape[0], sparse_k), te.dtype).at[
+            gi, slot].set(te, mode="drop")
+        te_idx = jnp.zeros((te.shape[0], sparse_k), jnp.int32).at[
+            gi, slot].set(ei, mode="drop")
+        head = [te_cnt.astype(jnp.float32).reshape(-1),      # G*K
+                te_idx.astype(jnp.float32).reshape(-1)]      # G*K
+    else:
+        head = [outs["take_exist"].astype(jnp.float32).reshape(-1)]  # G*E
+    packed = jnp.concatenate(head + [
         outs["take_new"].astype(jnp.float32).reshape(-1),    # G*N
         outs["unsched"].astype(jnp.float32).reshape(-1),     # G
         outs["dom_placed"].astype(jnp.float32).reshape(-1),  # G*D
@@ -573,7 +603,7 @@ def _solve_ffd_impl(
 
 
 solve_ffd = partial(jax.jit, static_argnames=(
-    "max_nodes", "zc", "with_topology"))(_solve_ffd_impl)
+    "max_nodes", "zc", "with_topology", "sparse_k"))(_solve_ffd_impl)
 
 # The consolidation simulator's batch axis (SURVEY §7 step 6): many
 # candidate-removal simulations against one cluster state share the catalog
@@ -587,16 +617,18 @@ _BATCH_AXES = (0, 0, 0, 0, 0,          # group_req..exist_remaining
                None, None,              # col_zone, col_ct (shared)
                0, 0)                    # exist_zone, exist_ct
 
-@partial(jax.jit, static_argnames=("max_nodes", "zc"))
-def solve_ffd_batch(*args, max_nodes: int = 1024, zc: int = 1):
-    return jax.vmap(partial(_solve_ffd_impl, max_nodes=max_nodes, zc=zc),
+@partial(jax.jit, static_argnames=("max_nodes", "zc", "sparse_k"))
+def solve_ffd_batch(*args, max_nodes: int = 1024, zc: int = 1,
+                    sparse_k: int = 0):
+    return jax.vmap(partial(_solve_ffd_impl, max_nodes=max_nodes, zc=zc,
+                            sparse_k=sparse_k),
                     in_axes=_BATCH_AXES)(*args)
 
 
 _BIG = 2 ** 29  # mirrors encode.BIG (no import: encode must stay jax-free)
 
 
-@partial(jax.jit, static_argnames=("max_nodes", "zc"))
+@partial(jax.jit, static_argnames=("max_nodes", "zc", "sparse_k"))
 def solve_ffd_sweep(
     # per-simulation (vmapped axis 0)
     group_req,      # [B, G, R]
@@ -614,7 +646,7 @@ def solve_ffd_sweep(
     col_alloc, col_daemon, pt_alloc, col_pool, pool_daemon,
     col_price,      # [O] f32
     col_zone, col_ct,
-    max_nodes: int = 8, zc: int = 1,
+    max_nodes: int = 8, zc: int = 1, sparse_k: int = 0,
 ):
     """The consolidation-sweep kernel: every simulation is 'the shared
     cluster snapshot minus a few candidate nodes' (SURVEY §3.3 hot loop
@@ -652,13 +684,14 @@ def solve_ffd_sweep(
             zG,                                 # mindom
             jnp.zeros((G, 1), bool),            # delig
             col_zone, col_ct, exist_zone, exist_ct,
-            max_nodes=max_nodes, zc=zc, with_topology=False)
+            max_nodes=max_nodes, zc=zc, with_topology=False,
+            sparse_k=sparse_k)
 
     return jax.vmap(one)(group_req, group_count, group_class,
                          exclude_idx, price_cap, pool_limit)
 
 
-@partial(jax.jit, static_argnames=("max_nodes", "zc"))
+@partial(jax.jit, static_argnames=("max_nodes", "zc", "sparse_k"))
 def solve_ffd_sweep_topo(
     # per-simulation (vmapped axis 0)
     group_req,      # [B, G, R]
@@ -680,7 +713,7 @@ def solve_ffd_sweep_topo(
     exist_remaining, exist_zone, exist_ct,
     col_alloc, col_daemon, pt_alloc, col_pool, pool_daemon,
     col_price, col_zone, col_ct,
-    max_nodes: int = 8, zc: int = 1,
+    max_nodes: int = 8, zc: int = 1, sparse_k: int = 0,
 ):
     """The sweep kernel's HEAVY lane: same shared-snapshot batching as
     solve_ffd_sweep, but with real per-simulation topology tensors
@@ -703,7 +736,8 @@ def solve_ffd_sweep_topo(
             col_alloc, col_daemon, pt_alloc, col_pool, pool_daemon, plim,
             ncap, dsel, dbase, dcap, skew, mindom, delig,
             col_zone, col_ct, exist_zone, exist_ct,
-            max_nodes=max_nodes, zc=zc, with_topology=True)
+            max_nodes=max_nodes, zc=zc, with_topology=True,
+            sparse_k=sparse_k)
 
     return jax.vmap(one)(group_req, group_count, group_class,
                          exclude_idx, price_cap, pool_limit,
@@ -711,16 +745,33 @@ def solve_ffd_sweep_topo(
                          group_skew, group_mindom, group_delig)
 
 
-def unpack(packed, G: int, E: int, N: int, RDIM: int, D: int):
-    """Split the flat result buffer back into named host arrays."""
+def unpack(packed, G: int, E: int, N: int, RDIM: int, D: int,
+           sparse_k: int = 0):
+    """Split the flat result buffer back into named host arrays.  With
+    sparse_k > 0 the buffer's head carries top-K (count, index) pairs per
+    group (see _solve_ffd_impl) and the dense [G, E] take_exist row is
+    rebuilt here by scatter — top_k indices are distinct per row, so the
+    scatter is collision-free and lossless when K bounds the group size."""
     import numpy as np
     # copy: device buffers surface as read-only views, and the topology
     # repair pass (solve.py) mutates these arrays in place
     flat = np.array(packed)
-    sizes = [G * E, G * N, G, G * D, N * RDIM, N, N, N, 1]
+    K = sparse_k
+    head = 2 * G * K if K else G * E
+    sizes = [head, G * N, G, G * D, N * RDIM, N, N, N, 1]
     offs = np.cumsum([0] + sizes)
+    if K:
+        cnt = flat[offs[0]:offs[0] + G * K].reshape(G, K)
+        idx = flat[offs[0] + G * K:offs[1]].reshape(G, K).astype(np.int64)
+        take_exist = np.zeros((G, E), dtype=flat.dtype)
+        # mask the empty slots: they carry (cnt=0, idx=0) and an
+        # unmasked scatter would zero a genuine entry at column 0
+        m = cnt > 0
+        take_exist[np.nonzero(m)[0], idx[m]] = cnt[m]
+    else:
+        take_exist = flat[offs[0]:offs[1]].reshape(G, E)
     return dict(
-        take_exist=flat[offs[0]:offs[1]].reshape(G, E),
+        take_exist=take_exist,
         take_new=flat[offs[1]:offs[2]].reshape(G, N),
         unsched=flat[offs[2]:offs[3]],
         dom_placed=flat[offs[3]:offs[4]].reshape(G, D),
