@@ -156,15 +156,26 @@ def run_configs(timeout_s: float):
     operator_set = "KARPENTER_TPU_PROBE_TIMEOUT" in env
     env.setdefault("KARPENTER_TPU_PROBE_TIMEOUT", "90")
     degraded = False
-    for cfg in configs:
+    chip_seen = False
+    retried = set()
+    first_attempt = {}
+    queue = list(configs)
+    while queue:
+        cfg = queue.pop(0)
         if not operator_set:
             # once an earlier config burned its probe budget and fell
             # back to CPU (wedged/held chip), later configs keep trying
             # the device but briefly — rediscovering the same dead chip
             # at full budget per config would cost ~5 extra minutes each.
+            # EXCEPT when an earlier config in this run already reached
+            # the chip: the relay provably exists, so a later hang is a
+            # transient (claim-release lag, a dying holder) worth the
+            # full budget — the first live window lost its two final
+            # configs to exactly this 20 s shortcut.
             # A config that reaches the device resets the budget, and an
             # operator-exported probe timeout is respected as-is.
-            env["KARPENTER_TPU_PROBE_TIMEOUT"] = "20" if degraded else "90"
+            env["KARPENTER_TPU_PROBE_TIMEOUT"] = (
+                "20" if degraded and not chip_seen else "90")
         path = os.path.join(HERE, "benchmarks", cfg)
         rec = {"config": cfg}
         try:
@@ -234,8 +245,33 @@ def run_configs(timeout_s: float):
             # timeout/crash before printing JSON is degradation evidence
             # too (a wedged chip can hang a config past its wall-clock)
             degraded = True
-        log_attempt({"stage": "config", **rec, "ts": time.time()})
-        out.append(rec)
+        if isinstance(parsed, dict) and parsed.get("platform") not in (
+                None, "cpu"):
+            chip_seen = True
+        # one deferred retry for a config that degraded to CPU inside a
+        # PROVEN-live window (an earlier config reached the chip): the
+        # fallback was almost certainly claim contention, and re-running
+        # after the rest of the queue gives the wedge maximal time to
+        # clear.  Only the final attempt lands in the artifact; every
+        # attempt lands in the log.
+        retry = (chip_seen and isinstance(parsed, dict)
+                 and parsed.get("platform") == "cpu"
+                 and cfg not in retried)
+        log_attempt({"stage": "config", **rec, "ts": time.time(),
+                     **({"retrying": True} if retry else {})})
+        if retry:
+            retried.add(cfg)
+            first_attempt[cfg] = rec
+            queue.append(cfg)
+        else:
+            prev = first_attempt.pop(cfg, None)
+            if prev is not None and not isinstance(
+                    rec.get("parsed"), dict):
+                # the retry produced nothing (window closed, timeout):
+                # the first attempt's complete CPU measurement beats an
+                # error record in the artifact
+                rec = prev
+            out.append(rec)
     return out
 
 

@@ -827,6 +827,25 @@ class TPUSolver:
     # smallest tier almost always holds
     K_BUCKETS = (8, 32, 128)
 
+    def _pick_sparse_k(self, max_cnt: int, E_pad: int) -> int:
+        """K for the top-K take_exist result compression (0 = dense):
+        bucket the max group count so the compaction is lossless, engage
+        only when it actually shrinks the row past the padded existing
+        axis, and honor the dense-rollback knob.  Shared by the sweep and
+        the generic batched path — the two must never drift."""
+        import os as _os
+        Ks = bucket(min(max_cnt, max(E_pad, 1)), self.K_BUCKETS)
+        sparse_k = Ks if (E_pad > 0 and 2 * Ks < E_pad) else 0
+        # ops knob: KARPENTER_TPU_SWEEP_TOPK=0 forces the dense result
+        # row (debug/rollback); malformed values degrade to the default,
+        # never crash (same discipline as the relaxation-budget knob)
+        try:
+            if int(_os.environ.get("KARPENTER_TPU_SWEEP_TOPK", "1")) == 0:
+                sparse_k = 0
+        except ValueError:
+            pass
+        return sparse_k
+
     def _try_sweep(self, inps: List[ScheduleInput], cat, mn: int,
                    explicit_cap: bool) -> Optional[List[ScheduleResult]]:
         """The leave-k-out fast path for the consolidation sweep: every
@@ -1045,17 +1064,7 @@ class TPUSolver:
             gcount_i = sims[i][3]
             if gcount_i.size:
                 max_cnt = max(max_cnt, int(gcount_i.max()))
-        Ks = bucket(min(max_cnt, max(Eb, 1)), self.K_BUCKETS)
-        sparse_k = Ks if (E > 0 and 2 * Ks < Eb) else 0
-        # ops knob: KARPENTER_TPU_SWEEP_TOPK=0 forces the dense result
-        # row (debug/rollback); malformed values degrade to the default,
-        # never crash (same discipline as the relaxation-budget knob)
-        import os as _os
-        try:
-            if int(_os.environ.get("KARPENTER_TPU_SWEEP_TOPK", "1")) == 0:
-                sparse_k = 0
-        except ValueError:
-            pass
+        sparse_k = self._pick_sparse_k(max_cnt, Eb)
 
         def decode_chunk(idxs, packed, pcap, plims, heavy, topo_rows):
             nonlocal decode_ms
@@ -1368,6 +1377,17 @@ class TPUSolver:
             dev = cat.device_args
             O = dev["O"]
 
+            # same top-K result compression as the sweep path: the
+            # generic batch serves consolidation sims the sweep holes
+            # out, and its dense [G,E] take_exist rows pay the same
+            # tunnel-download floor (K bounds the max group count, so
+            # compaction is lossless; see _solve_ffd_impl sparse_k)
+            max_cnt = 1
+            for _, e in encs:
+                for pods in e.groups:
+                    max_cnt = max(max_cnt, len(pods))
+            sparse_k = self._pick_sparse_k(max_cnt, E)
+
             chunk_size = B_BUCKETS[-1]
             for start in range(0, len(encs), chunk_size):
                 chunk = encs[start:start + chunk_size]
@@ -1382,10 +1402,11 @@ class TPUSolver:
                     batched=True)
                 packed = ffd.solve_ffd_batch(
                     *self._assemble(dev, stacked), max_nodes=mn,
-                    zc=dev["ZC"])
+                    zc=dev["ZC"], sparse_k=sparse_k)
                 packed = np.array(packed)
                 for bi, (i, enc) in enumerate(chunk):
-                    out = ffd.unpack(packed[bi], G, E, mn, R, Db)
+                    out = ffd.unpack(packed[bi], G, E, mn, R, Db,
+                                     sparse_k=sparse_k)
                     # judged BEFORE topology repair: repair-stranded pods
                     # are exactly the estimate-miss class the rescue is
                     # for (solve() computes its flag pre-repair too)

@@ -797,6 +797,59 @@ class TestSweepFastPath:
             assert set(f.unschedulable) == set(g.unschedulable), i
             assert f.node_count() == g.node_count(), i
 
+    def test_sparse_result_rows_match_dense(self, monkeypatch):
+        """The top-K take_exist compression (ffd sparse_k) is an encoding
+        of the result buffer, not a semantics change: the sweep must
+        produce identical assignments with the knob forced dense."""
+        nodes = self._cluster(16)
+        inps = self._sweep_inputs(nodes)
+        sparse = TPUSolver(mesh="off").solve_batch(inps, max_nodes=8)
+        monkeypatch.setenv("KARPENTER_TPU_SWEEP_TOPK", "0")
+        dense = TPUSolver(mesh="off").solve_batch(inps, max_nodes=8)
+        for i, (s, d) in enumerate(zip(sparse, dense)):
+            assert dict(s.existing_assignments) == dict(
+                d.existing_assignments), i
+            assert set(s.unschedulable) == set(d.unschedulable), i
+            assert s.node_count() == d.node_count(), i
+            assert abs(s.total_price() - d.total_price()) < 1e-6, i
+
+    def test_unpack_sparse_reconstruction_tiers(self):
+        """unpack(sparse_k=K) must rebuild the dense [G, E] take_exist
+        row for every K tier, including the empty-slot/index-0 collision
+        (pad slots carry (0, 0); an unmasked scatter would erase a real
+        count at column 0)."""
+        import numpy as np
+
+        from karpenter_tpu.solver import ffd
+        rng = np.random.default_rng(7)
+        G, E, N, R, D = 5, 40, 3, 4, 2
+        for K in (8, 32, 128):
+            dense = np.zeros((G, E), dtype=np.float32)
+            for g in range(G):
+                # k nonzero entries, always including column 0 (the
+                # masked-scatter edge) and at most min(K, E) of them
+                k = int(rng.integers(1, min(K, E)))
+                cols = np.concatenate(
+                    [[0], rng.choice(np.arange(1, E), k - 1, replace=False)]
+                ) if k > 1 else np.array([0])
+                dense[g, cols] = rng.integers(1, 9, size=len(cols))
+            # pack the way _solve_ffd_impl does: rank-compacted
+            # (count, index) pairs, pad slots zero
+            cnt = np.zeros((G, K), dtype=np.float32)
+            idx = np.zeros((G, K), dtype=np.float32)
+            for g in range(G):
+                nz = np.nonzero(dense[g])[0]
+                cnt[g, :len(nz)] = dense[g, nz]
+                idx[g, :len(nz)] = nz
+            tail = [np.zeros(G * N, np.float32), np.zeros(G, np.float32),
+                    np.zeros(G * D, np.float32), np.zeros(N * R, np.float32),
+                    np.zeros(N, np.float32), np.zeros(N, np.float32),
+                    np.zeros(N, np.float32), np.zeros(1, np.float32)]
+            packed = np.concatenate([cnt.reshape(-1), idx.reshape(-1)]
+                                    + tail)
+            out = ffd.unpack(packed, G, E, N, R, D, sparse_k=K)
+            assert np.array_equal(out["take_exist"], dense), K
+
     def test_baseless_first_input_does_not_demote_batch(self):
         """A fused batch whose FIRST input carries no snapshot (a
         provisioning request interleaved by the solverd window) must not

@@ -139,7 +139,9 @@ def fire_bench(round_no: int, bench_timeout_s: float) -> bool:
                  "ts": time.time()})
     # sentinel: concurrent heavy host work (test suites, rehearsals)
     # polluted the first live-window bench — anything sharing the box
-    # can poll this file and stand down while the chip run is in flight
+    # can poll this file and stand down while the chip run is in flight.
+    # It holds the firing timestamp: readers must treat it as STALE once
+    # older than the bench timeout (a kill -9 skips the finally below)
     sentinel = os.path.join(REPO, ".bench_running")
     with open(sentinel, "w") as f:
         f.write(str(time.time()))
@@ -201,15 +203,18 @@ def fire_bench(round_no: int, bench_timeout_s: float) -> bool:
         if not write:
             try:
                 with open(out_path) as f:
-                    old = json.loads(f.readline())
+                    old = json.load(f)
                 old_live = old.get("platform") not in (None, "cpu")
                 if live and not old_live:
                     write = True
                 elif live and old_live:
                     write = (result.get("p50_ms") or 1e18) <= (
                         old.get("p50_ms") or 1e18)
-            except (OSError, ValueError):
-                write = True
+            except (OSError, ValueError, AttributeError, TypeError):
+                # unreadable/odd-shaped artifact: only a LIVE run may
+                # replace it — a CPU-degraded run clobbering an artifact
+                # we failed to parse would violate the invariant above
+                write = live
         if write:
             with open(out_path, "w") as f:
                 f.write(line + "\n")

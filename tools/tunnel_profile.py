@@ -1,0 +1,101 @@
+"""Device-tunnel microbenchmark — run inside a live relay window.
+
+The first live window (round 5) showed per-config TPU numbers dominated
+by the LINK, not the kernel: config4's dense result download made the
+sweep 7x slower than CPU, and config2 carried ~170 ms of overhead that
+CPU runs don't.  This tool separates the three link costs so kernel work
+and transfer work stop being conflated in bench analysis:
+
+- RTT: round-trip a 4-byte array (dispatch + pull), median of 20
+- upload bandwidth: 8 MiB host->device, blocked
+- download bandwidth: 8 MiB device->host
+- config2-shaped solve: 5 timed runs with the solver's own phase split
+- 256-sim sweep: per-sim cost at bench shapes with the sparse result path
+
+Prints ONE JSON line (same convention as bench.py) and appends the full
+record to BENCH_ATTEMPTS.jsonl.  Bounded: first compiles aside, the
+measurement body is a few seconds.
+
+Usage: python tools/tunnel_profile.py   (falls back to CPU when the relay
+is down — the record then documents the CPU link as a baseline)
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+
+def main() -> None:
+    from karpenter_tpu.utils.platform import initialize, log_attempt
+    platform = initialize(attempt_log=log_attempt)
+    import jax
+    import numpy as np
+
+    dev = jax.devices()[0]
+    rec = {"stage": "tunnel-profile", "platform": platform,
+           "ts": time.time()}
+
+    # RTT: smallest possible payload, full dispatch+pull round trip
+    tiny = np.zeros(1, np.float32)
+    f = jax.jit(lambda x: x + 1)
+    _ = np.asarray(f(tiny))  # compile
+    rtts = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        _ = np.asarray(f(tiny))
+        rtts.append((time.perf_counter() - t0) * 1000.0)
+    rec["rtt_ms_p50"] = round(statistics.median(rtts), 2)
+
+    # bandwidth, 8 MiB each way
+    big = np.ones((1024, 2048), np.float32)  # 8 MiB
+    jax.device_put(big, dev).block_until_ready()  # warm path
+    t0 = time.perf_counter()
+    buf = jax.device_put(big, dev)
+    buf.block_until_ready()
+    up_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    _ = np.asarray(buf)
+    down_s = time.perf_counter() - t0
+    rec["upload_MiB_s"] = round(8.0 / up_s, 1)
+    rec["download_MiB_s"] = round(8.0 / down_s, 1)
+
+    # config2-shaped solve (5k mixed pods, 3 pools)
+    import benchmarks.config2_mixed as c2
+    from karpenter_tpu.solver import TPUSolver
+    inp = c2.make_input()
+    solver = TPUSolver(max_nodes=2048)
+    solver.solve(inp)
+    solver.solve(inp)  # adaptive-bucket steady state
+    runs = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        solver.solve(inp)
+        runs.append((time.perf_counter() - t0) * 1000.0)
+    rec["config2_ms_p50"] = round(statistics.median(runs), 1)
+    rec["config2_phases_ms"] = {k: round(v, 1)
+                                for k, v in solver.last_phase_ms.items()}
+
+    # 256-sim sweep at bench shapes (sparse result path); max_nodes=8
+    # mirrors the consolidation benchmark — a replacement sim buys a
+    # handful of nodes, and the kernel cost scales with the N axis
+    import benchmarks.config4_consolidation as c4
+    sweep_inps = c4.make_input()[:256]
+    solver.solve_batch(sweep_inps, max_nodes=8)
+    t0 = time.perf_counter()
+    solver.solve_batch(sweep_inps, max_nodes=8)
+    rec["sweep256_ms"] = round((time.perf_counter() - t0) * 1000.0, 1)
+    rec["sweep_phases_ms"] = {k: round(v, 1)
+                              for k, v in solver.last_phase_ms.items()}
+
+    log_attempt(rec)
+    print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()
