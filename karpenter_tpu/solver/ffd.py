@@ -127,6 +127,17 @@ def _water_fill(cnt, base, xmax, elig, skew, mindom):
     return jnp.minimum(x, cnt)
 
 
+def _expand_packed_mask(m, O: int):
+    """[R, ceil(O/8)] uint8 -> [R, O] bool: byte-gather along the column
+    axis + bit shift (host side packs with np.packbits
+    bitorder="little").  The shape assert is trace-time-free and turns a
+    mask packed at the wrong column count (JAX would silently CLAMP the
+    out-of-bounds byte gather) into an immediate error."""
+    assert m.shape[-1] == (O + 7) // 8, (m.shape, O)
+    o = jnp.arange(O, dtype=jnp.int32)
+    return ((m[:, o // 8] >> (o % 8).astype(jnp.uint8)) & 1).astype(bool)
+
+
 def _solve_ffd_impl(
     group_req: jnp.ndarray,       # [G, R]
     group_count: jnp.ndarray,     # [G]
@@ -168,12 +179,22 @@ def _solve_ffd_impl(
                                   # touches at most c existing nodes.
                                   # Caller guarantees K >= max group count
                                   # so the sparse form is lossless.
+    mask_packed: bool = False,    # static: group_mask arrives bit-packed
+                                  # as [G, ceil(O/8)] uint8 (little bit
+                                  # order) and is expanded on device —
+                                  # the [G, O] bool row is the dominant
+                                  # UPLOAD the same way take_exist is the
+                                  # dominant download (O runs to ~11k
+                                  # columns at full catalog), and the
+                                  # tunnel makes bytes the cost.
 ):
     G, RDIM = group_req.shape
     E = exist_remaining.shape[0]
     O = col_alloc.shape[0]
     PT = pt_alloc.shape[0]
     assert O == PT * zc, (O, PT, zc)
+    if mask_packed:
+        group_mask = _expand_packed_mask(group_mask, O)
 
     def pt_expand(a_pt):
         # [N,PT] → [N,O]: the grid layout makes the (pool,type) axis a
@@ -603,7 +624,8 @@ def _solve_ffd_impl(
 
 
 solve_ffd = partial(jax.jit, static_argnames=(
-    "max_nodes", "zc", "with_topology", "sparse_k"))(_solve_ffd_impl)
+    "max_nodes", "zc", "with_topology", "sparse_k",
+    "mask_packed"))(_solve_ffd_impl)
 
 # The consolidation simulator's batch axis (SURVEY §7 step 6): many
 # candidate-removal simulations against one cluster state share the catalog
@@ -617,18 +639,20 @@ _BATCH_AXES = (0, 0, 0, 0, 0,          # group_req..exist_remaining
                None, None,              # col_zone, col_ct (shared)
                0, 0)                    # exist_zone, exist_ct
 
-@partial(jax.jit, static_argnames=("max_nodes", "zc", "sparse_k"))
+@partial(jax.jit, static_argnames=("max_nodes", "zc", "sparse_k",
+                                   "mask_packed"))
 def solve_ffd_batch(*args, max_nodes: int = 1024, zc: int = 1,
-                    sparse_k: int = 0):
+                    sparse_k: int = 0, mask_packed: bool = False):
     return jax.vmap(partial(_solve_ffd_impl, max_nodes=max_nodes, zc=zc,
-                            sparse_k=sparse_k),
+                            sparse_k=sparse_k, mask_packed=mask_packed),
                     in_axes=_BATCH_AXES)(*args)
 
 
 _BIG = 2 ** 29  # mirrors encode.BIG (no import: encode must stay jax-free)
 
 
-@partial(jax.jit, static_argnames=("max_nodes", "zc", "sparse_k"))
+@partial(jax.jit, static_argnames=("max_nodes", "zc", "sparse_k",
+                                   "mask_packed"))
 def solve_ffd_sweep(
     # per-simulation (vmapped axis 0)
     group_req,      # [B, G, R]
@@ -647,6 +671,7 @@ def solve_ffd_sweep(
     col_price,      # [O] f32
     col_zone, col_ct,
     max_nodes: int = 8, zc: int = 1, sparse_k: int = 0,
+    mask_packed: bool = False,
 ):
     """The consolidation-sweep kernel: every simulation is 'the shared
     cluster snapshot minus a few candidate nodes' (SURVEY §3.3 hot loop
@@ -662,6 +687,10 @@ def solve_ffd_sweep(
     branch.
     """
     E = exist_remaining.shape[0]
+    if mask_packed:
+        # shared [C, ceil(O/8)] -> [C, O] once per call (the per-sim
+        # masks are class_mask rows, so one expansion serves the batch)
+        class_mask = _expand_packed_mask(class_mask, col_price.shape[0])
 
     def one(greq, gcount, gcls, excl, pcap, plim):
         keep = jnp.all(
@@ -691,7 +720,8 @@ def solve_ffd_sweep(
                          exclude_idx, price_cap, pool_limit)
 
 
-@partial(jax.jit, static_argnames=("max_nodes", "zc", "sparse_k"))
+@partial(jax.jit, static_argnames=("max_nodes", "zc", "sparse_k",
+                                   "mask_packed"))
 def solve_ffd_sweep_topo(
     # per-simulation (vmapped axis 0)
     group_req,      # [B, G, R]
@@ -714,6 +744,7 @@ def solve_ffd_sweep_topo(
     col_alloc, col_daemon, pt_alloc, col_pool, pool_daemon,
     col_price, col_zone, col_ct,
     max_nodes: int = 8, zc: int = 1, sparse_k: int = 0,
+    mask_packed: bool = False,
 ):
     """The sweep kernel's HEAVY lane: same shared-snapshot batching as
     solve_ffd_sweep, but with real per-simulation topology tensors
@@ -722,6 +753,8 @@ def solve_ffd_sweep_topo(
     separate jit entry so constraint-light sweeps never pay this
     branch's compile time (the two lanes cache independently)."""
     E = exist_remaining.shape[0]
+    if mask_packed:
+        class_mask = _expand_packed_mask(class_mask, col_price.shape[0])
 
     def one(greq, gcount, gcls, excl, pcap, plim,
             ncap, dsel, dbase, dcap, skew, mindom, delig):

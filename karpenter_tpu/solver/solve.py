@@ -218,12 +218,37 @@ class TPUSolver:
             enc.group_mask &= (cat.col_price < inp.price_cap)[None, :]
         return enc
 
-    def _problem_args(self, enc: EncodedProblem, G: int, E: int, Db: int, O: int):
+    def _mask_packed(self) -> bool:
+        """Bit-pack the [G, O] group mask for upload (8x fewer bytes over
+        the device tunnel; expanded on device — ffd mask_packed).  Off
+        under a mesh: the packed byte axis would need its own sharding
+        story, and the mesh path's win is compute, not link bytes.  Knob
+        KARPENTER_TPU_MASK_BITS=0 forces dense (debug/rollback; malformed
+        values degrade to the default, never crash).  CPU backend keeps
+        dense masks: there is no link to save, and the byte-gather
+        expansion costs ~10 ms at the 50k shape (it breaks the mask
+        consumer's fusion on XLA:CPU)."""
+        if self._resolve_mesh() is not None:
+            return False
+        import jax
+        if jax.default_backend() == "cpu":
+            return False
+        import os as _os
+        try:
+            return int(_os.environ.get("KARPENTER_TPU_MASK_BITS", "1")) != 0
+        except ValueError:
+            return True
+
+    def _problem_args(self, enc: EncodedProblem, G: int, E: int, Db: int,
+                      O: int, pack_mask: bool = False):
         """The per-problem (non-catalog) kernel arguments, padded."""
+        gmask = self._pad(self._pad(enc.group_mask, 1, O), 0, G)
+        if pack_mask:
+            gmask = np.packbits(gmask, axis=-1, bitorder="little")
         return (
             self._pad(enc.group_req, 0, G),
             self._pad(enc.group_count, 0, G),
-            self._pad(self._pad(enc.group_mask, 1, O), 0, G),
+            gmask,
             self._pad(self._pad(enc.exist_cap, 1, E), 0, G),
             self._pad(enc.exist_remaining, 0, E),
             enc.pool_limit,
@@ -556,12 +581,15 @@ class TPUSolver:
         E = bucket(len(enc.existing), E_BUCKETS)
         Db = bucket(enc.n_domains, D_BUCKETS)
         dev = cat.device_args
-        prob = self._put_problem(self._problem_args(enc, G, E, Db, dev["O"]))
+        mbits = self._mask_packed()
+        prob = self._put_problem(self._problem_args(
+            enc, G, E, Db, dev["O"], pack_mask=mbits))
         args = self._assemble(dev, prob)
         t2 = _time.perf_counter()
         from karpenter_tpu.utils.profiling import trace_solve
         with trace_solve("ffd-solve"):
-            packed = ffd.solve_ffd(*args, max_nodes=mn, zc=dev["ZC"])
+            packed = ffd.solve_ffd(*args, max_nodes=mn, zc=dev["ZC"],
+                                   mask_packed=mbits)
             out = ffd.unpack(packed, G, E, mn, R, Db)
             if (max_nodes is None and mn < self.max_nodes
                     and out["unsched"].sum() > 0
@@ -570,7 +598,8 @@ class TPUSolver:
                 # configured ceiling (one-time cost; the next solve's
                 # warm-start adapts to the real active count)
                 mn = self.max_nodes
-                packed = ffd.solve_ffd(*args, max_nodes=mn, zc=dev["ZC"])
+                packed = ffd.solve_ffd(*args, max_nodes=mn, zc=dev["ZC"],
+                                       mask_packed=mbits)
                 out = ffd.unpack(packed, G, E, mn, R, Db)
         self._last_slots_exhausted = bool(
             out["unsched"].sum() > 0 and out["num_active"] >= mn)
@@ -1020,6 +1049,13 @@ class TPUSolver:
         if class_masks:
             class_mask[:len(class_masks), :O_real] = np.stack(class_masks)
             class_cap[:len(class_caps), :E] = np.stack(class_caps)
+        # pack only the device COPY: the host class_mask also feeds the
+        # per-sim EncodedProblem reconstruction in decode, which needs
+        # the dense rows
+        mbits = self._mask_packed()
+        class_mask_dev = (np.packbits(class_mask, axis=-1,
+                                      bitorder="little")
+                          if mbits else class_mask)
         exist_remaining = np.zeros((Eb, R), dtype=np.float32)
         exist_remaining[:E] = shared._avail
         exist_zone = np.full(Eb, -1, dtype=np.int32)
@@ -1038,7 +1074,7 @@ class TPUSolver:
         col_price = put_price(self._pad(
             cat.col_price.astype(np.float32), 0, O, value=np.inf))
         dev = cat.device_args
-        shared_dev = (put_cmask(class_mask), put_rep(class_cap),
+        shared_dev = (put_cmask(class_mask_dev), put_rep(class_cap),
                       put_rep(exist_remaining), put_rep(exist_zone),
                       put_rep(exist_ct))
         encode_ms = (_time.perf_counter() - t0) * 1000.0
@@ -1227,7 +1263,8 @@ class TPUSolver:
                         dev["pt_alloc"], dev["col_pool"],
                         dev["pool_daemon"], col_price,
                         dev["col_zone"], dev["col_ct"],
-                        max_nodes=mn, zc=dev["ZC"], sparse_k=sparse_k)
+                        max_nodes=mn, zc=dev["ZC"], sparse_k=sparse_k,
+                        mask_packed=mbits)
                 else:
                     packed = ffd.solve_ffd_sweep_topo(
                         greq, gcount, gcls, excl, pcap, plim,
@@ -1240,7 +1277,8 @@ class TPUSolver:
                         dev["pt_alloc"], dev["col_pool"],
                         dev["pool_daemon"], col_price,
                         dev["col_zone"], dev["col_ct"],
-                        max_nodes=mn, zc=dev["ZC"], sparse_k=sparse_k)
+                        max_nodes=mn, zc=dev["ZC"], sparse_k=sparse_k,
+                        mask_packed=mbits)
                 if pipelined:
                     # enqueue only — jax dispatch is async, so every
                     # chunk is in flight before the first result is
@@ -1388,11 +1426,13 @@ class TPUSolver:
                     max_cnt = max(max_cnt, len(pods))
             sparse_k = self._pick_sparse_k(max_cnt, E)
 
+            mbits = self._mask_packed()
             chunk_size = B_BUCKETS[-1]
             for start in range(0, len(encs), chunk_size):
                 chunk = encs[start:start + chunk_size]
                 B = bucket(len(chunk), B_BUCKETS)
-                probs = [self._problem_args(e, G, E, Db, O) for _, e in chunk]
+                probs = [self._problem_args(e, G, E, Db, O, pack_mask=mbits)
+                         for _, e in chunk]
                 # pad the batch axis with empty problems (zero groups = no
                 # work) so repeat calls hit the jit cache at bucketed shapes
                 while len(probs) < B:
@@ -1402,7 +1442,7 @@ class TPUSolver:
                     batched=True)
                 packed = ffd.solve_ffd_batch(
                     *self._assemble(dev, stacked), max_nodes=mn,
-                    zc=dev["ZC"], sparse_k=sparse_k)
+                    zc=dev["ZC"], sparse_k=sparse_k, mask_packed=mbits)
                 packed = np.array(packed)
                 for bi, (i, enc) in enumerate(chunk):
                     out = ffd.unpack(packed[bi], G, E, mn, R, Db,

@@ -813,6 +813,36 @@ class TestSweepFastPath:
             assert s.node_count() == d.node_count(), i
             assert abs(s.total_price() - d.total_price()) < 1e-6, i
 
+    def test_packed_mask_matches_dense(self, monkeypatch):
+        """Bit-packed group-mask upload (ffd mask_packed) is an encoding,
+        not a semantics change — forced on (it defaults off on the CPU
+        backend, where there is no link to save), results must match the
+        dense mask exactly, existing-node fill included."""
+        nodes = self._cluster(8)
+        pool = NodePool(meta=ObjectMeta(name="default"))
+        pods = [mkpod(f"pk{i}", cpu="500m", mem="1Gi") for i in range(40)]
+        for i in range(0, 40, 3):  # zonal selectors vary the masks
+            pods[i].requirements = Requirements(Requirement.make(
+                wellknown.ZONE_LABEL, "In", f"tpu-west-1{'abc'[i % 3]}"))
+        inp = ScheduleInput(pods=pods, nodepools=[pool],
+                            instance_types={"default": CATALOG},
+                            existing_nodes=nodes)
+        sweep_inps = self._sweep_inputs(self._cluster(8))
+        dense = TPUSolver(mesh="off").solve(inp)
+        dense_b = TPUSolver(mesh="off").solve_batch([inp] * 3, max_nodes=8)
+        dense_s = TPUSolver(mesh="off").solve_batch(sweep_inps, max_nodes=8)
+        monkeypatch.setattr(TPUSolver, "_mask_packed", lambda self: True)
+        packed = TPUSolver(mesh="off").solve(inp)
+        packed_b = TPUSolver(mesh="off").solve_batch([inp] * 3, max_nodes=8)
+        packed_s = TPUSolver(mesh="off").solve_batch(sweep_inps, max_nodes=8)
+        for d, p in ([(dense, packed)] + list(zip(dense_b, packed_b))
+                     + list(zip(dense_s, packed_s))):
+            assert dict(p.existing_assignments) == dict(
+                d.existing_assignments)
+            assert set(p.unschedulable) == set(d.unschedulable)
+            assert p.node_count() == d.node_count()
+            assert abs(p.total_price() - d.total_price()) < 1e-6
+
     def test_unpack_sparse_reconstruction_tiers(self):
         """unpack(sparse_k=K) must rebuild the dense [G, E] take_exist
         row for every K tier, including the empty-slot/index-0 collision
