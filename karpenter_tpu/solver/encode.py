@@ -85,6 +85,11 @@ class EncodedProblem:
     group_skew: np.ndarray = None    # [G] i32
     group_mindom: np.ndarray = None  # [G] i32
     group_delig: np.ndarray = None   # [G, D] bool
+    # [G] bool — hostname co-location seeding: ALL members must land on
+    # one node.  Encode-time column/row fit enforces it against original
+    # capacity; the post-solve whole-node repair (solve.py) strands the
+    # group atomically if the dynamic fill still split it
+    group_whole_node: np.ndarray = None
     col_zone: np.ndarray = None      # [O] i32
     col_ct: np.ndarray = None        # [O] i32
     exist_zone: np.ndarray = None    # [E] i32
@@ -884,9 +889,11 @@ class _TopologyEncoder:
                 dcap=np.full(self.D, BIG, dtype=np.int32), skew=BIG, mindom=0,
                 delig=np.zeros(self.D, dtype=bool),
                 allowed={k: None for k in _DOM_KEYS},
-                requires={k: False for k in _DOM_KEYS})
+                requires={k: False for k in _DOM_KEYS},
+                whole_node=False)
         ncap = BIG
         ecap = np.full(E, BIG, dtype=np.int32)
+        whole_node = False
         allowed: Dict[str, Optional[set]] = {k: None for k in _DOM_KEYS}
         requires: Dict[str, bool] = {k: False for k in _DOM_KEYS}
         dyn_key: Optional[str] = None
@@ -979,11 +986,28 @@ class _TopologyEncoder:
                         ncap = 0
                         clamp_hosts(
                             lambda h: BIG if h in populated else 0)
+                    elif self_match:
+                        # all members on ONE node, fresh or existing:
+                        # "exactly one node" is not a column-model
+                        # concept, but "every candidate must hold the
+                        # WHOLE group" is — flag it for the caller,
+                        # which owns the column/row capacity math (the
+                        # group count lives there).  Encode-time
+                        # eligibility is against ORIGINAL capacity, so
+                        # the fill can still split the group when an
+                        # earlier group consumed an eligible node —
+                        # the post-solve whole-node repair strands such
+                        # groups atomically and the rescue hands them
+                        # to the oracle (its seed-then-strand is the
+                        # reference semantics).
+                        whole_node = True
                     else:
-                        # all members on ONE fresh node — "exactly one
-                        # new node" isn't expressible in the column model
-                        raise Unsupported(
-                            "hostname co-location seeding")
+                        # no populated host and the selector does NOT
+                        # match the group itself: nothing satisfies the
+                        # required term (kube semantics — same verdict
+                        # as the zone/ct branch's restrict(key, set()))
+                        ncap = 0
+                        clamp_hosts(lambda h: 0)
                 elif populated:
                     restrict(key, populated)
                     requires[key] = True
@@ -1062,7 +1086,19 @@ class _TopologyEncoder:
                 allowed[dyn_key] = None
         return dict(ncap=ncap, ecap=ecap, dsel=dsel, dbase=dbase, dcap=dcap,
                     skew=skew, mindom=mindom, delig=delig,
-                    allowed=allowed, requires=requires)
+                    allowed=allowed, requires=requires,
+                    whole_node=whole_node)
+
+
+def _np_fit_count(avail: np.ndarray, req: np.ndarray) -> np.ndarray:
+    """Host mirror of the kernel's _fit_count (ffd.py:60): how many pods
+    of per-pod request `req` [R] fit in `avail` [..., R].  Same EPS so a
+    host-side whole-group-fit verdict never disagrees with the device
+    fill."""
+    safe = np.where(req > 0, req, 1.0)
+    counts = np.floor((avail + 1e-3) / safe)
+    counts = np.where(req > 0, counts, float(2 ** 30))
+    return np.clip(counts.min(axis=-1), 0, 2 ** 30).astype(np.int64)
 
 
 def group_column_mask(cat: "CatalogEncoding", rep: Pod):
@@ -1168,8 +1204,25 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None,
     group_skew = np.zeros(G, dtype=np.int32)
     group_mindom = np.zeros(G, dtype=np.int32)
     group_delig = np.zeros((G, D), dtype=bool)
+    group_whole_node = np.zeros(G, dtype=bool)
     static_allowed: List[Dict[str, Optional[set]]] = []
     merged_reqs: List[List[Optional[Requirements]]] = []
+
+    _avail_rows = [None]
+
+    def exist_avail() -> np.ndarray:
+        """[E, R] remaining capacity, built once on first use — the same
+        rows the kernel's exist fill sees (shared snapshot when present),
+        so the whole-node verdicts can't disagree with the fill."""
+        if _avail_rows[0] is None:
+            if exist_shared is not None:
+                _avail_rows[0] = exist_shared.exist_remaining(
+                    inp.existing_nodes, shared_rows)
+            else:
+                _avail_rows[0] = np.array(
+                    [en.available.v for en in inp.existing_nodes],
+                    dtype=np.float32).reshape(E, R)
+        return _avail_rows[0]
 
     pool_col = cat.col_pool
     dom_arrays = {wellknown.ZONE_LABEL: (cat.col_zone, topo.exist_zone),
@@ -1196,6 +1249,7 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None,
         group_skew[gi] = t["skew"]
         group_mindom[gi] = t["mindom"]
         group_delig[gi] = t["delig"]
+        group_whole_node[gi] = t["whole_node"]
 
         gmask, merged_per_pool = group_column_mask(cat, rep)
         # static topology domain restrictions → column mask
@@ -1203,6 +1257,12 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None,
             al = t["allowed"][key]
             if al is not None:
                 gmask = gmask & np.isin(col_ids, list(al))
+        if t["whole_node"]:
+            # hostname co-location seeding: every candidate column must
+            # hold the WHOLE group (greedy fill then never splits it)
+            gmask = gmask & (_np_fit_count(
+                cat.col_alloc - cat.col_daemon,
+                group_req[gi]) >= len(g))
         static_allowed.append(t["allowed"])
         group_mask[gi] = gmask
         merged_reqs.append(merged_per_pool)
@@ -1231,6 +1291,12 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None,
                     if not t["requires"][key]:
                         ok_dom |= ex_ids < 0  # label-absent passes (symmetry)
                     cap_row = np.where(ok_dom, cap_row, 0)
+            if t["whole_node"]:
+                # all-or-nothing rows: only nodes whose remaining
+                # capacity absorbs the full group stay eligible
+                cap_row = np.where(
+                    _np_fit_count(exist_avail(), group_req[gi]) >= len(g),
+                    cap_row, 0)
             exist_cap[gi] = cap_row
 
     if dropped:
@@ -1247,16 +1313,11 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None,
         group_skew = group_skew[keep]
         group_mindom = group_mindom[keep]
         group_delig = group_delig[keep]
+        group_whole_node = group_whole_node[keep]
         groups = [g for gi, g in enumerate(groups) if keep[gi]]
         # static_allowed / merged_reqs were only appended for kept groups
 
-    if exist_shared is not None:
-        exist_remaining = exist_shared.exist_remaining(
-            inp.existing_nodes, shared_rows)
-    else:
-        exist_remaining = np.array(
-            [en.available.v for en in inp.existing_nodes], dtype=np.float32
-        ).reshape(E, R)
+    exist_remaining = exist_avail()
 
     pool_limit = np.full((max(len(pools), 1), R), np.inf, dtype=np.float32)
     for pidx, pool in enumerate(pools):
@@ -1289,6 +1350,7 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None,
         group_skew=group_skew,
         group_mindom=group_mindom,
         group_delig=group_delig,
+        group_whole_node=group_whole_node,
         col_zone=cat.col_zone,
         col_ct=cat.col_ct,
         exist_zone=topo.exist_zone,

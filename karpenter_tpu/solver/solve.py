@@ -607,6 +607,7 @@ class TPUSolver:
             # capped sims (tiny explicit N) must not poison the warm-start
             self._last_active = int(out["num_active"])
         t3 = _time.perf_counter()
+        self._repair_whole_node(enc, out)
         self._repair_topology(enc, out)
         t4 = _time.perf_counter()
         res = self._decode(enc, out)
@@ -1452,6 +1453,7 @@ class TPUSolver:
                     # for (solve() computes its flag pre-repair too)
                     exhausted = bool(out["unsched"].sum() > 0
                                      and out["num_active"] >= mn)
+                    self._repair_whole_node(enc, out)
                     self._repair_topology(enc, out)
                     res = self._decode(enc, out)
                     if res.unschedulable and not (
@@ -1494,6 +1496,32 @@ class TPUSolver:
         return res
 
     # -- topology repair --------------------------------------------------
+    def _repair_whole_node(self, enc: EncodedProblem,
+                           out: Dict[str, np.ndarray]) -> None:
+        """Whole-node (hostname co-location seeding) enforcement: the
+        encoder's column/row fit is computed against ORIGINAL capacity,
+        but the kernel fills groups in order — an earlier group can
+        consume an eligible node and leave this group's members SPLIT
+        across nodes, which silently violates the required affinity.
+        Strand such a group atomically here (take rows zeroed, all
+        members unschedulable): the caller's rescue then hands the whole
+        group to the oracle, whose seed-then-strand is the reference
+        semantics.  Decode skips pod-less nodes, so zeroed take rows
+        never emit empty claims."""
+        gw = enc.group_whole_node
+        if gw is None or not gw.any():
+            return
+        Er = len(enc.existing)
+        num_active = int(out["num_active"])
+        for gi in np.nonzero(gw[:enc.n_groups])[0]:
+            te = out["take_exist"][gi, :Er]
+            tn = out["take_new"][gi, :num_active]
+            if int((te > 0).sum()) + int((tn > 0).sum()) <= 1:
+                continue
+            out["unsched"][gi] += te.sum() + tn.sum()
+            te[:] = 0
+            tn[:] = 0
+
     def _repair_topology(self, enc: EncodedProblem, out: Dict[str, np.ndarray]) -> None:
         """The kernel's per-domain quotas are planned against a capacity
         *estimate* (new-node slots and pool budgets are shared across

@@ -236,15 +236,13 @@ class TestParity:
             it = by_name[claim.instance_type_names[0]]
             assert claim.requests.fits(it.allocatable())
 
-    def test_split_handles_hostname_coloc_seeding(self):
+    def test_hostname_coloc_seeding_encodes_on_device(self):
         # hostname co-location seeding ("all members on one fresh node")
-        # is not expressible in the column model — still rides the split
-        # path to the host oracle
+        # encodes as a whole-node column fit (encode.py whole_node) —
+        # previously an Unsupported that rode the split path; the group
+        # must now solve on device with NO residue and stay co-located
         from karpenter_tpu.models import PodAffinityTerm
         from karpenter_tpu.utils import metrics
-        # sized so the group can't dribble onto the device pass's leftover
-        # capacity: greedy seeding on a nearly-full node is a known
-        # corner of the (reference-shaped) sequential engine
         pods = [mkpod(f"h{i}", cpu="2", labels={"app": "db"},
                       pod_affinities=[PodAffinityTerm(
                           label_selector={"app": "db"},
@@ -254,7 +252,12 @@ class TestParity:
         residue_before = metrics.SOLVER_RESIDUE_PODS.value()
         res = TPUSolver().solve(mkinput(pods + filler))
         assert not res.unschedulable
-        assert metrics.SOLVER_RESIDUE_PODS.value() == residue_before + 3
+        assert metrics.SOLVER_RESIDUE_PODS.value() == residue_before
+        coloc_claims = [c for c in res.new_claims
+                        if any(p.meta.name.startswith("h") for p in c.pods)]
+        assert len(coloc_claims) == 1
+        assert sum(1 for p in coloc_claims[0].pods
+                   if p.meta.name.startswith("h")) == 3
 
     def test_split_cross_group_coupling(self):
         # a spread selector matching another pending group couples their

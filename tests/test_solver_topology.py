@@ -254,6 +254,91 @@ class TestHostnameConstraints:
             assert len(claim.pods) <= 2
         assert solver.node_count() == oracle.node_count() == 5
 
+    def test_hostname_colocation_seeds_one_new_node(self):
+        # self-matching required hostname affinity with NO populated
+        # hosts: the whole group lands on ONE node, encoded on device
+        # (whole-node column fit — previously an Unsupported split)
+        coloc = PodAffinityTerm(label_selector={"app": "web"},
+                                topology_key=HOST, required=True)
+        pods = [mkpod(f"p{i}", pod_affinities=[coloc]) for i in range(4)]
+        inp = mkinput(pods)
+        s = TPUSolver()
+        res = s.solve(inp)
+        assert not res.unschedulable
+        assert not s._used_split, "must encode on device, not split"
+        assert res.node_count() == 1
+        assert len(res.new_claims[0].pods) == 4
+        assert Scheduler(inp).solve().node_count() >= res.node_count()
+
+    def test_hostname_colocation_fills_existing_node(self):
+        coloc = PodAffinityTerm(label_selector={"app": "web"},
+                                topology_key=HOST, required=True)
+        n1 = mknode("n1", cpu=1000, mem=2048)    # too small for the group
+        n2 = mknode("n2")                        # fits all
+        pods = [mkpod(f"p{i}", pod_affinities=[coloc]) for i in range(3)]
+        inp = mkinput(pods, existing_nodes=[n1, n2])
+        res = TPUSolver().solve(inp)
+        assert not res.unschedulable
+        assert set(res.existing_assignments.values()) == {"n2"}
+        assert len(res.existing_assignments) == 3
+        assert res.node_count() == 0
+
+    def test_hostname_colocation_survives_partial_fill(self):
+        # encode-time eligibility is against ORIGINAL capacity; a larger
+        # group filled first can consume the eligible node.  The group
+        # must NEVER split across hosts — the whole-node repair strands
+        # it atomically and the rescue re-solves it coherently.
+        coloc = PodAffinityTerm(label_selector={"app": "db"},
+                                topology_key=HOST, required=True)
+        n1 = mknode("n1")  # 16 cpu: fits the trio (6) OR the filler (12)
+        filler = mkpod("big", cpu="12", mem="4Gi", labels={"app": "other"})
+        group = [mkpod(f"c{i}", cpu="2", labels={"app": "db"},
+                       pod_affinities=[coloc]) for i in range(3)]
+        res = TPUSolver().solve(mkinput([filler] + group,
+                                        existing_nodes=[n1]))
+        # invariant: placed members of the co-location group share a host
+        hosts = set()
+        for p in group:
+            n = res.existing_assignments.get(p.meta.name)
+            if n is None:
+                n = next((id(c) for c in res.new_claims
+                          if any(q.meta.name == p.meta.name
+                                 for q in c.pods)), None)
+            if n is not None:
+                hosts.add(n)
+        assert len(hosts) <= 1, "required co-location split across hosts"
+        # partial placement is legitimate (the rescue oracle seeds where
+        # its first placement lands and strands the tail — reference
+        # semantics); what must match is the oracle's verdict
+        oracle = Scheduler(mkinput([filler] + group,
+                                   existing_nodes=[mknode("n1")])).solve()
+        assert set(res.unschedulable) == set(oracle.unschedulable)
+
+    def test_hostname_colocation_non_self_match_unschedulable(self):
+        # selector matches nothing (not the group, no residents): kube
+        # semantics say nothing satisfies the required term — parity
+        # with the oracle's unschedulable verdict, not a free seed
+        coloc = PodAffinityTerm(label_selector={"app": "db"},
+                                topology_key=HOST, required=True)
+        pods = [mkpod(f"p{i}", pod_affinities=[coloc])  # app=web pods
+                for i in range(3)]
+        inp = mkinput(pods)
+        oracle, solver = both(inp)
+        assert set(solver.unschedulable) == set(oracle.unschedulable) \
+            == {f"p{i}" for i in range(3)}
+
+    def test_hostname_colocation_oversized_matches_oracle(self):
+        # a group no single node can hold: the device path strands it
+        # whole and the rescue reproduces the oracle's seed-then-strand
+        coloc = PodAffinityTerm(label_selector={"app": "web"},
+                                topology_key=HOST, required=True)
+        pods = [mkpod(f"p{i}", cpu="8", mem="16Gi",
+                      pod_affinities=[coloc]) for i in range(40)]
+        inp = mkinput(pods)
+        oracle, solver = both(inp)
+        assert set(solver.unschedulable) == set(oracle.unschedulable)
+        assert solver.node_count() <= oracle.node_count()
+
     def test_hostname_anti_affinity_one_per_node(self):
         pods = [mkpod(f"p{i}", pod_affinities=[anti()]) for i in range(6)]
         inp = mkinput(pods)
