@@ -228,6 +228,14 @@ class TPUSolver:
         dense masks: there is no link to save, and the byte-gather
         expansion costs ~10 ms at the 50k shape (it breaks the mask
         consumer's fusion on XLA:CPU)."""
+        return self._link_knob("KARPENTER_TPU_MASK_BITS")
+
+    def _link_knob(self, env_name: str) -> bool:
+        """Shared gate for the device-link transforms (mask packing,
+        coalesced upload): on only when there IS a link to save (not the
+        CPU backend) and no mesh (the transforms have no sharding
+        story); <env_name>=0 rolls back; malformed values degrade to the
+        default, never crash."""
         if self._resolve_mesh() is not None:
             return False
         import jax
@@ -235,9 +243,18 @@ class TPUSolver:
             return False
         import os as _os
         try:
-            return int(_os.environ.get("KARPENTER_TPU_MASK_BITS", "1")) != 0
+            return int(_os.environ.get(env_name, "1")) != 0
         except ValueError:
             return True
+
+    def _coalesce_upload(self) -> bool:
+        """Ship the per-problem arrays as ONE buffer (ffd.pack_problem):
+        fifteen small transfers pay fifteen fixed link costs over the
+        device tunnel, one buffer pays one.  Same gating as the mask
+        packing (CPU has no link; the mesh shards the mask by column and
+        a coalesced buffer has no sharding story); knob
+        KARPENTER_TPU_COALESCE=0 rolls back to per-array transfers."""
+        return self._link_knob("KARPENTER_TPU_COALESCE")
 
     def _problem_args(self, enc: EncodedProblem, G: int, E: int, Db: int,
                       O: int, pack_mask: bool = False):
@@ -582,14 +599,27 @@ class TPUSolver:
         Db = bucket(enc.n_domains, D_BUCKETS)
         dev = cat.device_args
         mbits = self._mask_packed()
-        prob = self._put_problem(self._problem_args(
-            enc, G, E, Db, dev["O"], pack_mask=mbits))
-        args = self._assemble(dev, prob)
+        prob = self._problem_args(enc, G, E, Db, dev["O"], pack_mask=mbits)
+        coalesce = self._coalesce_upload()
+        if coalesce:
+            buf, layout = ffd.pack_problem(prob)
+
+            def run(n):
+                return ffd.solve_ffd_coalesced(
+                    buf, dev["col_alloc"], dev["col_daemon"],
+                    dev["pt_alloc"], dev["col_pool"], dev["pool_daemon"],
+                    dev["col_zone"], dev["col_ct"], layout=layout,
+                    max_nodes=n, zc=dev["ZC"], mask_packed=mbits)
+        else:
+            args = self._assemble(dev, self._put_problem(prob))
+
+            def run(n):
+                return ffd.solve_ffd(*args, max_nodes=n, zc=dev["ZC"],
+                                     mask_packed=mbits)
         t2 = _time.perf_counter()
         from karpenter_tpu.utils.profiling import trace_solve
         with trace_solve("ffd-solve"):
-            packed = ffd.solve_ffd(*args, max_nodes=mn, zc=dev["ZC"],
-                                   mask_packed=mbits)
+            packed = run(mn)
             out = ffd.unpack(packed, G, E, mn, R, Db)
             if (max_nodes is None and mn < self.max_nodes
                     and out["unsched"].sum() > 0
@@ -598,8 +628,7 @@ class TPUSolver:
                 # configured ceiling (one-time cost; the next solve's
                 # warm-start adapts to the real active count)
                 mn = self.max_nodes
-                packed = ffd.solve_ffd(*args, max_nodes=mn, zc=dev["ZC"],
-                                       mask_packed=mbits)
+                packed = run(mn)
                 out = ffd.unpack(packed, G, E, mn, R, Db)
         self._last_slots_exhausted = bool(
             out["unsched"].sum() > 0 and out["num_active"] >= mn)
@@ -1519,6 +1548,13 @@ class TPUSolver:
             if int((te > 0).sum()) + int((tn > 0).sum()) <= 1:
                 continue
             out["unsched"][gi] += te.sum() + tn.sum()
+            # release the phantom consumption on shared new nodes (same
+            # accounting as _repair_topology): decode rebuilds each
+            # node's surviving-column mask from used[ni], which must
+            # reflect only the pods actually staying on the node
+            req = enc.group_req[gi]
+            for ni in np.nonzero(tn > 0)[0]:
+                out["used"][ni] -= int(tn[ni]) * req
             te[:] = 0
             tn[:] = 0
 

@@ -838,7 +838,11 @@ class TestSweepFastPath:
         packed = TPUSolver(mesh="off").solve(inp)
         packed_b = TPUSolver(mesh="off").solve_batch([inp] * 3, max_nodes=8)
         packed_s = TPUSolver(mesh="off").solve_batch(sweep_inps, max_nodes=8)
-        for d, p in ([(dense, packed)] + list(zip(dense_b, packed_b))
+        # and the coalesced single-buffer upload on top of the packed mask
+        monkeypatch.setattr(TPUSolver, "_coalesce_upload", lambda self: True)
+        coal = TPUSolver(mesh="off").solve(inp)
+        for d, p in ([(dense, packed), (dense, coal)]
+                     + list(zip(dense_b, packed_b))
                      + list(zip(dense_s, packed_s))):
             assert dict(p.existing_assignments) == dict(
                 d.existing_assignments)

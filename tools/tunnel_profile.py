@@ -81,6 +81,29 @@ def main() -> None:
     rec["config2_phases_ms"] = {k: round(v, 1)
                                 for k, v in solver.last_phase_ms.items()}
 
+    # A/B the link transforms (knobs read per-solve): dense per-array
+    # transfers vs the default packed-mask + coalesced buffer — the
+    # difference IS the per-solve link overhead the transforms remove
+    knobs = ("KARPENTER_TPU_COALESCE", "KARPENTER_TPU_MASK_BITS")
+    saved = {k: os.environ.get(k) for k in knobs}
+    try:
+        for k in knobs:
+            os.environ[k] = "0"
+        solver.solve(inp)  # compile/warm the dense variant
+        runs_d = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            solver.solve(inp)
+            runs_d.append((time.perf_counter() - t0) * 1000.0)
+        rec["config2_ms_p50_dense_link"] = round(
+            statistics.median(runs_d), 1)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
     # 256-sim sweep at bench shapes (sparse result path); max_nodes=8
     # mirrors the consolidation benchmark — a replacement sim buys a
     # handful of nodes, and the kernel cost scales with the N axis
