@@ -36,7 +36,8 @@ def sharded_solve_ffd(
     col_alloc, col_daemon, pt_alloc, col_pool, pool_daemon,
     pool_limit,
     group_ncap, group_dsel, group_dbase, group_dcap, group_skew,
-    group_mindom, group_delig, col_zone, col_ct, exist_zone, exist_ct,
+    group_mindom, group_delig, group_whole,
+    col_zone, col_ct, exist_zone, exist_ct,
     max_nodes: int = 1024,
     zc: int = 1,
     axis: str = "cat",
@@ -71,6 +72,7 @@ def sharded_solve_ffd(
         jax.device_put(group_skew, rep),
         jax.device_put(group_mindom, rep),
         jax.device_put(group_delig, rep),
+        jax.device_put(group_whole, rep),
         jax.device_put(col_zone, col),
         jax.device_put(col_ct, col),
         jax.device_put(exist_zone, rep),

@@ -75,6 +75,19 @@ def _prefix_fill(cap: jnp.ndarray, want: jnp.ndarray) -> jnp.ndarray:
     return jnp.clip(jnp.minimum(cap, want - before), 0, None)
 
 
+def _atomic_fill(cap: jnp.ndarray, want: jnp.ndarray) -> jnp.ndarray:
+    """ALL-or-nothing fill: the FIRST slot holding the entire `want`
+    takes it; every other slot takes zero.  Whole-node co-location
+    groups use this instead of _prefix_fill — a greedy partial take
+    against fill-time capacity is exactly the silent split the required
+    affinity forbids."""
+    elig = cap >= want
+    first = jnp.argmax(elig)
+    idx = jnp.arange(cap.shape[0]) if cap.shape[0] else jnp.zeros(0, int)
+    return jnp.where((idx == first) & elig.any() & (want > 0),
+                     want, 0).astype(cap.dtype)
+
+
 def _water_fill(cnt, base, xmax, elig, skew, mindom):
     """Split `cnt` pods into per-domain quotas [D].
 
@@ -157,6 +170,11 @@ def _solve_ffd_impl(
     group_skew: jnp.ndarray,      # [G] i32
     group_mindom: jnp.ndarray,    # [G] i32 (0 = unset)
     group_delig: jnp.ndarray,     # [G, D] bool eligible domains for skew min
+    group_whole: jnp.ndarray,     # [G] bool — whole-node co-location: fills
+                                  # are ALL-or-nothing (encode restricts the
+                                  # columns/rows to whole-group fits, but
+                                  # fill-time capacity is dynamic — a
+                                  # partial take would split the group)
     col_zone: jnp.ndarray,        # [O] i32
     col_ct: jnp.ndarray,          # [O] i32
     exist_zone: jnp.ndarray,      # [E] i32
@@ -249,7 +267,7 @@ def _solve_ffd_impl(
 
     def step(carry, xs):
         (req, cnt, gmask, ecap, ncap, dsel,
-         dbase, dcap, skew, mindom, delig) = xs
+         dbase, dcap, skew, mindom, delig, whole) = xs
 
         def light(carry):
             exist_rem = carry["exist_rem"]
@@ -263,7 +281,9 @@ def _solve_ffd_impl(
             # -- 1. existing nodes --------------------------------------
             cap_e = (jnp.minimum(_fit_count(exist_rem, req), ecap)
                      if E else jnp.zeros((0,), jnp.int32))
-            take_e = _prefix_fill(cap_e, cnt) if E else cap_e
+            take_e = (jnp.where(whole, _atomic_fill(cap_e, cnt),
+                                _prefix_fill(cap_e, cnt))
+                      if E else cap_e)
             exist_rem = exist_rem - take_e[:, None] * req if E else exist_rem
             c1 = cnt - (take_e.sum() if E else 0)
 
@@ -281,8 +301,17 @@ def _solve_ffd_impl(
                 jnp.minimum(
                     jnp.where(elig_pt, cap_npt, 0).max(axis=1), ncap),
                 0)
-            cap_n = _clamp_pool_limits(cap_n, node_pool, limits, req)
-            take_n = _prefix_fill(cap_n, c1)
+            # pool-limit clamp: the prefix-residual form charges earlier
+            # same-pool nodes that an ALL-or-nothing fill will never
+            # touch, spuriously disqualifying the one node that could
+            # hold the whole group — whole groups clamp each node
+            # against the FULL pool budget instead (sound: exactly one
+            # node takes, and its take stays within that budget)
+            cap_n_pfx = _clamp_pool_limits(cap_n, node_pool, limits, req)
+            cap_n_full = jnp.minimum(cap_n, _fit_count(limits, req)[node_pool])
+            cap_n = jnp.where(whole, cap_n_full, cap_n_pfx)
+            take_n = jnp.where(whole, _atomic_fill(cap_n, c1),
+                               _prefix_fill(cap_n, c1))
             used = used + take_n[:, None] * req
             touched = take_n > 0
             colmask = jnp.where(touched[:, None], colmask & gmask[None, :], colmask)
@@ -309,6 +338,16 @@ def _solve_ffd_impl(
                 k_full = jnp.max(jnp.where(cols_p, per_col, 0))
                 pool_room = jnp.all(limits[p] - pool_daemon[p] - req >= -EPS)
                 can = cols_p.any() & pool_room & (c_rem > 0) & (k_full > 0)
+                # whole-node groups must land the ENTIRE remainder on one
+                # node of one pool — a pool that can only take part of it
+                # (column capacity, or budget after the one-node daemon
+                # charge) would split the group across the pool cascade
+                can = can & jnp.where(
+                    whole,
+                    (k_full >= c_rem) & (_fit_count(
+                        (limits[p] - pool_daemon[p])[None, :],
+                        req)[0] >= c_rem),
+                    True)
                 kf = jnp.maximum(k_full, 1)
                 # budget-exact node count: affordable PODS first, then the
                 # per-node daemon charge for the implied node count (two
@@ -582,7 +621,7 @@ def _solve_ffd_impl(
 
     xs = (group_req, group_count, group_mask, exist_cap, group_ncap,
           group_dsel, group_dbase, group_dcap, group_skew, group_mindom,
-          group_delig)
+          group_delig, group_whole)
     final, outs = jax.lax.scan(step, init, xs)
     # Results are packed into ONE flat f32 buffer: each host pull pays a
     # full round trip on the device link, so small arrays cost one RTT each
@@ -643,6 +682,11 @@ def pack_problem(prob):
     chunks, layout = [], []
     for i in order:
         a = np.ascontiguousarray(prob[i])
+        # _unpack_problem knows exactly these dtypes; anything else (a
+        # stray float64 from numpy defaults) would silently shift every
+        # later offset and corrupt the solve — fail loudly instead
+        assert a.dtype.name in ("float32", "int32", "uint8", "bool"), \
+            (i, a.dtype)
         layout.append((i, a.shape, a.dtype.name))
         chunks.append(a.view(np.uint8).reshape(-1))
     return np.concatenate(chunks), tuple(
@@ -685,13 +729,13 @@ def solve_ffd_coalesced(buf, col_alloc, col_daemon, pt_alloc, col_pool,
     device-resident across solves and never travel."""
     (group_req, group_count, group_mask, exist_cap, exist_remaining,
      pool_limit, group_ncap, group_dsel, group_dbase, group_dcap,
-     group_skew, group_mindom, group_delig, exist_zone, exist_ct) = \
-        _unpack_problem(buf, layout)
+     group_skew, group_mindom, group_delig, group_whole,
+     exist_zone, exist_ct) = _unpack_problem(buf, layout)
     return _solve_ffd_impl(
         group_req, group_count, group_mask, exist_cap, exist_remaining,
         col_alloc, col_daemon, pt_alloc, col_pool, pool_daemon,
         pool_limit, group_ncap, group_dsel, group_dbase, group_dcap,
-        group_skew, group_mindom, group_delig,
+        group_skew, group_mindom, group_delig, group_whole,
         col_zone, col_ct, exist_zone, exist_ct,
         max_nodes=max_nodes, zc=zc, with_topology=with_topology,
         sparse_k=sparse_k, mask_packed=mask_packed)
@@ -704,7 +748,7 @@ _BATCH_AXES = (0, 0, 0, 0, 0,          # group_req..exist_remaining
                None, None, None,        # col_alloc, col_daemon, pt_alloc
                None, None,              # col_pool, pool_daemon (shared)
                0,                       # pool_limit
-               0, 0, 0, 0, 0, 0, 0,     # topology group arrays
+               0, 0, 0, 0, 0, 0, 0, 0,  # topology group arrays (+whole)
                None, None,              # col_zone, col_ct (shared)
                0, 0)                    # exist_zone, exist_ct
 
@@ -781,6 +825,7 @@ def solve_ffd_sweep(
             jnp.full((G,), _BIG, jnp.int32),    # skew (unbounded)
             zG,                                 # mindom
             jnp.zeros((G, 1), bool),            # delig
+            jnp.zeros((G,), bool),              # whole (sweep holes coloc)
             col_zone, col_ct, exist_zone, exist_ct,
             max_nodes=max_nodes, zc=zc, with_topology=False,
             sparse_k=sparse_k)
@@ -837,6 +882,7 @@ def solve_ffd_sweep_topo(
             greq, gcount, gmask, ecap, er,
             col_alloc, col_daemon, pt_alloc, col_pool, pool_daemon, plim,
             ncap, dsel, dbase, dcap, skew, mindom, delig,
+            jnp.zeros(greq.shape[:1], bool),    # whole (sweep holes coloc)
             col_zone, col_ct, exist_zone, exist_ct,
             max_nodes=max_nodes, zc=zc, with_topology=True,
             sparse_k=sparse_k)

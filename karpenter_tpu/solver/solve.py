@@ -277,6 +277,7 @@ class TPUSolver:
             self._pad(enc.group_skew, 0, G),
             self._pad(enc.group_mindom, 0, G),
             self._pad(self._pad(enc.group_delig, 1, Db), 0, G),
+            self._pad(enc.group_whole_node, 0, G),
             self._pad(enc.exist_zone, 0, E, value=-1),
             self._pad(enc.exist_ct, 0, E, value=-1),
         )
@@ -303,13 +304,14 @@ class TPUSolver:
         """Interleave per-problem and shared catalog args in kernel order."""
         (group_req, group_count, group_mask, exist_cap, exist_remaining,
          pool_limit, group_ncap, group_dsel, group_dbase, group_dcap,
-         group_skew, group_mindom, group_delig, exist_zone, exist_ct) = prob
+         group_skew, group_mindom, group_delig, group_whole,
+         exist_zone, exist_ct) = prob
         return (group_req, group_count, group_mask, exist_cap, exist_remaining,
                 dev["col_alloc"], dev["col_daemon"],
                 dev["pt_alloc"], dev["col_pool"],
                 dev["pool_daemon"], pool_limit,
                 group_ncap, group_dsel, group_dbase, group_dcap,
-                group_skew, group_mindom, group_delig,
+                group_skew, group_mindom, group_delig, group_whole,
                 dev["col_zone"], dev["col_ct"], exist_zone, exist_ct)
 
     def solve(self, inp: ScheduleInput,
