@@ -1084,6 +1084,13 @@ class _TopologyEncoder:
                     if i not in allowed[dyn_key]:
                         dcap[i] = 0
                 allowed[dyn_key] = None
+        if whole_node and dsel > 0:
+            # the kernel's ALL-or-nothing fill lives in the light branch;
+            # the heavy (domain-partitioned) branch's per-domain fills
+            # would split the group and strand it wholesale — the host
+            # oracle handles both constraints coherently instead
+            raise Unsupported(
+                "whole-node co-location combined with dynamic spread")
         return dict(ncap=ncap, ecap=ecap, dsel=dsel, dbase=dbase, dcap=dcap,
                     skew=skew, mindom=mindom, delig=delig,
                     allowed=allowed, requires=requires,

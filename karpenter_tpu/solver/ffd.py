@@ -83,7 +83,7 @@ def _atomic_fill(cap: jnp.ndarray, want: jnp.ndarray) -> jnp.ndarray:
     affinity forbids."""
     elig = cap >= want
     first = jnp.argmax(elig)
-    idx = jnp.arange(cap.shape[0]) if cap.shape[0] else jnp.zeros(0, int)
+    idx = jnp.arange(cap.shape[0])
     return jnp.where((idx == first) & elig.any() & (want > 0),
                      want, 0).astype(cap.dtype)
 

@@ -1330,6 +1330,10 @@ class TPUSolver:
             packed = np.asarray(packed)
             device_ms += (_time.perf_counter() - t1) * 1000.0
             decode_chunk(idxs, packed, pcap, plim, heavy, topo_rows)
+        # the exist-names cache exists for THIS sweep's shared list; keep
+        # it past the return and it pins the whole node+pod snapshot in a
+        # long-lived controller's memory
+        self._exist_names_cache = None
         self.last_phase_ms = {
             "encode": encode_ms, "device": device_ms, "decode": decode_ms,
             "per_sim": ((encode_ms + device_ms + decode_ms) / len(eligible)
@@ -1648,7 +1652,15 @@ class TPUSolver:
         from karpenter_tpu.native import hostops
         native = hostops()
         if native is not None and isinstance(enc.groups, list):
-            exist_names = [en.name for en in enc.existing]
+            # the sweep decodes 2k sims against the SAME shared existing
+            # list — rebuilding the name list per sim was 4M property
+            # calls (~1.5 s of the config4 decode); cache by identity
+            cached = getattr(self, "_exist_names_cache", None)
+            if cached is not None and cached[0] is enc.existing:
+                exist_names = cached[1]
+            else:
+                exist_names = [en.name for en in enc.existing]
+                self._exist_names_cache = (enc.existing, exist_names)
             node_pods, node_groups, unsched_by_group = native.distribute(
                 enc.groups,
                 np.ascontiguousarray(take_exist, dtype=np.int64),
@@ -1691,11 +1703,19 @@ class TPUSolver:
         # nodes from the same fill collapse to a handful of computations.
         # used-vector identity via one vectorized unique (the per-node
         # tobytes hashing was ~1 ms of the 50k decode); float rows hoisted
-        # out of the loop likewise.
+        # out of the loop likewise.  The crossover runs the other way at
+        # sweep scale: np.unique's sort setup costs ~0.15 ms per CALL,
+        # which across 2k small sims was ~0.3 s of config4 — tiny node
+        # counts hash bytes instead.
         claim_cache: Dict[tuple, tuple] = {}
-        if num_active > 0:
+        if 0 < num_active <= 16:
+            seen: Dict[bytes, int] = {}
+            used_id = [seen.setdefault(used[ni].tobytes(), len(seen))
+                       for ni in range(num_active)]
+        elif num_active > 0:
             _, used_id = np.unique(used[:num_active], axis=0,
                                    return_inverse=True)
+        if num_active > 0:
             used_f = used[:num_active, :R].astype(float)
         for ni in range(num_active):
             pods = node_pods.get(ni, [])

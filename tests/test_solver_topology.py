@@ -328,6 +328,27 @@ class TestHostnameConstraints:
         assert set(solver.unschedulable) == set(oracle.unschedulable) \
             == {f"p{i}" for i in range(3)}
 
+    def test_hostname_colocation_with_zone_spread_splits_to_oracle(self):
+        # whole-node seeding + dynamic zone spread on ONE group: the
+        # kernel's atomic fill lives in the light branch only, so this
+        # combination rides the split path — placements must still honor
+        # the co-location (one host)
+        coloc = PodAffinityTerm(label_selector={"app": "web"},
+                                topology_key=HOST, required=True)
+        pods = [mkpod(f"p{i}", pod_affinities=[coloc],
+                      topology_spread=[spread(key=ZONE, skew=3)])
+                for i in range(3)]
+        s = TPUSolver()
+        res = s.solve(mkinput(pods))
+        placed_hosts = set()
+        for c in res.new_claims:
+            if any(p.meta.name.startswith("p") for p in c.pods):
+                placed_hosts.add(id(c))
+        for name, node in res.existing_assignments.items():
+            placed_hosts.add(node)
+        assert len(placed_hosts) <= 1
+        assert s._used_split, "combo must ride the split path"
+
     def test_hostname_colocation_oversized_matches_oracle(self):
         # a group no single node can hold: the device path strands it
         # whole and the rescue reproduces the oracle's seed-then-strand
