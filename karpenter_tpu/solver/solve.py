@@ -35,7 +35,7 @@ from karpenter_tpu.solver.encode import (
 
 R = len(RESOURCE_AXIS)
 
-G_BUCKETS = (8, 32, 128, 512, 2048)
+G_BUCKETS = (1, 4, 8, 32, 128, 512, 2048)
 E_BUCKETS = (0, 64, 512, 2048, 4096)
 B_BUCKETS = (4, 16, 64)  # simulate-batch axis (SURVEY §7 step 6)
 PT_ALIGN = 64  # (pool,type) axis padding; column axis O = PT_pad × ZC
@@ -1137,89 +1137,96 @@ class TPUSolver:
         def decode_chunk(idxs, packed, pcap, plims, heavy, topo_rows):
             nonlocal decode_ms
             t2 = _time.perf_counter()
-            for bi, i in enumerate(idxs):
-                groups, cls_i, greq_i, gcount_i = sims[i]
-                out = ffd.unpack(packed[bi], G, Eb, mn, R,
-                                 Db if heavy else 1, sparse_k=sparse_k)
-                exhausted = bool(out["unsched"].sum() > 0
-                                 and out["num_active"] >= mn)
-                g = len(groups)
-                keep = np.ones(E, dtype=bool)
-                ex = [e for e in inps[i].exist_excluded if e < E]
-                keep[ex] = False
-                if heavy:
-                    tr = topo_rows
-                    dn = Db
-                    ncap_i = tr["ncap"][bi, :g]
-                    dsel_i = tr["dsel"][bi, :g]
-                    dbase_i = tr["dbase"][bi, :g]
-                    dcap_i = tr["dcap"][bi, :g]
-                    skew_i = tr["skew"][bi, :g]
-                    mindom_i = tr["mindom"][bi, :g]
-                    delig_i = tr["delig"][bi, :g]
-                else:
-                    dn = 1
-                    ncap_i = np.full(g, BIG, dtype=np.int32)
-                    dsel_i = np.zeros(g, dtype=np.int32)
-                    dbase_i = np.zeros((g, 1), dtype=np.int32)
-                    dcap_i = np.full((g, 1), BIG, dtype=np.int32)
-                    skew_i = np.full(g, BIG, dtype=np.int32)
-                    mindom_i = np.zeros(g, dtype=np.int32)
-                    delig_i = np.zeros((g, 1), dtype=bool)
-                enc = EncodedProblem(
-                    group_req=greq_i,
-                    group_count=gcount_i,
-                    group_mask=(class_mask[cls_i, :O_real]
-                                & (cat.col_price < pcap[bi])[None, :]
-                                if g else np.zeros((0, O_real), bool)),
-                    exist_cap=(class_cap[cls_i, :E] * keep[None, :]
-                               if g else np.zeros((0, E), np.int32)),
-                    exist_remaining=shared._avail * keep[:, None],
-                    col_alloc=cat.col_alloc,
-                    col_daemon=cat.col_daemon,
-                    col_price=cat.col_price,
-                    col_pool=cat.col_pool,
-                    pool_limit=plims[bi],
-                    group_ncap=ncap_i,
-                    group_dsel=dsel_i,
-                    group_dbase=dbase_i,
-                    group_dcap=dcap_i,
-                    group_skew=skew_i,
-                    group_mindom=mindom_i,
-                    group_delig=delig_i,
-                    col_zone=cat.col_zone,
-                    col_ct=cat.col_ct,
-                    exist_zone=shared.zone,
-                    exist_ct=shared.ct,
-                    zone_values=zone_values,
-                    ct_values=ct_values,
-                    n_domains=dn,
-                    static_allowed=[
-                        {wellknown.ZONE_LABEL: None,
-                         wellknown.CAPACITY_TYPE_LABEL: None}
-                        for _ in range(g)],
-                    groups=groups,
-                    columns=cat.columns,
-                    existing=base,
-                    pools=cat.pools,
-                    merged_reqs=[class_merged[c] for c in cls_i],
-                )
-                if heavy:
-                    # same estimate-miss repair as the generic batched
-                    # path: per-domain quotas are planned against a
-                    # capacity estimate, so a starved domain hands pods
-                    # to another
-                    self._repair_topology(enc, out)
-                res = self._decode(enc, out)
-                if res.unschedulable and not (explicit_cap and exhausted):
-                    # same verdict discipline as solve()/solve_batch: a
-                    # stranding WITHOUT slot pressure earns the oracle
-                    # rescue; only an explicit caller cap earns the cheap
-                    # slot-exhaustion reject
-                    self._residue_counted = set()
-                    self._last_oracle_judged = set()
-                    res = self._rescue_stranded(inps[i], res)
-                out_results[i] = res
+            # every sim decodes against the SAME shared list — let
+            # _decode cache its name list while this chunk decodes
+            # (the cache itself is released when the sweep returns)
+            self._in_sweep_decode = True
+            try:
+                for bi, i in enumerate(idxs):
+                    groups, cls_i, greq_i, gcount_i = sims[i]
+                    out = ffd.unpack(packed[bi], G, Eb, mn, R,
+                                     Db if heavy else 1, sparse_k=sparse_k)
+                    exhausted = bool(out["unsched"].sum() > 0
+                                     and out["num_active"] >= mn)
+                    g = len(groups)
+                    keep = np.ones(E, dtype=bool)
+                    ex = [e for e in inps[i].exist_excluded if e < E]
+                    keep[ex] = False
+                    if heavy:
+                        tr = topo_rows
+                        dn = Db
+                        ncap_i = tr["ncap"][bi, :g]
+                        dsel_i = tr["dsel"][bi, :g]
+                        dbase_i = tr["dbase"][bi, :g]
+                        dcap_i = tr["dcap"][bi, :g]
+                        skew_i = tr["skew"][bi, :g]
+                        mindom_i = tr["mindom"][bi, :g]
+                        delig_i = tr["delig"][bi, :g]
+                    else:
+                        dn = 1
+                        ncap_i = np.full(g, BIG, dtype=np.int32)
+                        dsel_i = np.zeros(g, dtype=np.int32)
+                        dbase_i = np.zeros((g, 1), dtype=np.int32)
+                        dcap_i = np.full((g, 1), BIG, dtype=np.int32)
+                        skew_i = np.full(g, BIG, dtype=np.int32)
+                        mindom_i = np.zeros(g, dtype=np.int32)
+                        delig_i = np.zeros((g, 1), dtype=bool)
+                    enc = EncodedProblem(
+                        group_req=greq_i,
+                        group_count=gcount_i,
+                        group_mask=(class_mask[cls_i, :O_real]
+                                    & (cat.col_price < pcap[bi])[None, :]
+                                    if g else np.zeros((0, O_real), bool)),
+                        exist_cap=(class_cap[cls_i, :E] * keep[None, :]
+                                   if g else np.zeros((0, E), np.int32)),
+                        exist_remaining=shared._avail * keep[:, None],
+                        col_alloc=cat.col_alloc,
+                        col_daemon=cat.col_daemon,
+                        col_price=cat.col_price,
+                        col_pool=cat.col_pool,
+                        pool_limit=plims[bi],
+                        group_ncap=ncap_i,
+                        group_dsel=dsel_i,
+                        group_dbase=dbase_i,
+                        group_dcap=dcap_i,
+                        group_skew=skew_i,
+                        group_mindom=mindom_i,
+                        group_delig=delig_i,
+                        col_zone=cat.col_zone,
+                        col_ct=cat.col_ct,
+                        exist_zone=shared.zone,
+                        exist_ct=shared.ct,
+                        zone_values=zone_values,
+                        ct_values=ct_values,
+                        n_domains=dn,
+                        static_allowed=[
+                            {wellknown.ZONE_LABEL: None,
+                             wellknown.CAPACITY_TYPE_LABEL: None}
+                            for _ in range(g)],
+                        groups=groups,
+                        columns=cat.columns,
+                        existing=base,
+                        pools=cat.pools,
+                        merged_reqs=[class_merged[c] for c in cls_i],
+                    )
+                    if heavy:
+                        # same estimate-miss repair as the generic batched
+                        # path: per-domain quotas are planned against a
+                        # capacity estimate, so a starved domain hands pods
+                        # to another
+                        self._repair_topology(enc, out)
+                    res = self._decode(enc, out)
+                    if res.unschedulable and not (explicit_cap and exhausted):
+                        # same verdict discipline as solve()/solve_batch: a
+                        # stranding WITHOUT slot pressure earns the oracle
+                        # rescue; only an explicit caller cap earns the cheap
+                        # slot-exhaustion reject
+                        self._residue_counted = set()
+                        self._last_oracle_judged = set()
+                        res = self._rescue_stranded(inps[i], res)
+                    out_results[i] = res
+            finally:
+                self._in_sweep_decode = False
             decode_ms += (_time.perf_counter() - t2) * 1000.0
 
         chunk_size = B_BUCKETS[-1]
@@ -1334,6 +1341,7 @@ class TPUSolver:
         # it past the return and it pins the whole node+pod snapshot in a
         # long-lived controller's memory
         self._exist_names_cache = None
+        self._in_sweep_decode = False
         self.last_phase_ms = {
             "encode": encode_ms, "device": device_ms, "decode": decode_ms,
             "per_sim": ((encode_ms + device_ms + decode_ms) / len(eligible)
@@ -1660,7 +1668,13 @@ class TPUSolver:
                 exist_names = cached[1]
             else:
                 exist_names = [en.name for en in enc.existing]
-                self._exist_names_cache = (enc.existing, exist_names)
+                # populate only when another decode of the SAME list may
+                # follow (the sweep; it clears on return).  solve()'s
+                # per-reconcile lists never repeat, and pinning one past
+                # the return would retain the whole node+pod snapshot on
+                # a long-lived controller's solver
+                if getattr(self, "_in_sweep_decode", False):
+                    self._exist_names_cache = (enc.existing, exist_names)
             node_pods, node_groups, unsched_by_group = native.distribute(
                 enc.groups,
                 np.ascontiguousarray(take_exist, dtype=np.int64),

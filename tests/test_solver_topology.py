@@ -340,13 +340,14 @@ class TestHostnameConstraints:
                 for i in range(3)]
         s = TPUSolver()
         res = s.solve(mkinput(pods))
+        assert not res.unschedulable, "a fresh cluster fits the trio"
         placed_hosts = set()
         for c in res.new_claims:
             if any(p.meta.name.startswith("p") for p in c.pods):
                 placed_hosts.add(id(c))
         for name, node in res.existing_assignments.items():
             placed_hosts.add(node)
-        assert len(placed_hosts) <= 1
+        assert len(placed_hosts) == 1
         assert s._used_split, "combo must ride the split path"
 
     def test_hostname_colocation_oversized_matches_oracle(self):

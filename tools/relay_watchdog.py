@@ -323,6 +323,19 @@ def main() -> int:
                                      "event": "success",
                                      "checks": checks, "probes": probes,
                                      "ts": time.time()})
+                        # same window, while it lasts: capture the link
+                        # microbenchmarks (RTT/bandwidth/knob A/B) that
+                        # ground the tunnel optimizations — the profile
+                        # logs its own record to the attempts log
+                        try:
+                            subprocess.run(
+                                [sys.executable,
+                                 os.path.join(HERE, "tunnel_profile.py")],
+                                timeout=900, cwd=REPO,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+                        except (subprocess.TimeoutExpired, OSError):
+                            pass
                         # do NOT exit: the relay comes in WINDOWS, and a
                         # later window (warmer caches, quieter host) can
                         # beat this run — fire_bench only overwrites the
