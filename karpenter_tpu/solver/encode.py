@@ -40,7 +40,7 @@ from karpenter_tpu.scheduling.types import (
 R = len(RESOURCE_AXIS)
 _ABSENT = -1
 BIG = 2 ** 29  # "unbounded" cap that still fits i32 arithmetic on device
-D_BUCKETS = (8, 16, 32, 64, 128)
+D_BUCKETS = (2, 4, 8, 16, 32, 64, 128)
 _DOM_KEYS = (wellknown.ZONE_LABEL, wellknown.CAPACITY_TYPE_LABEL)
 _TOPO_KEYS = (wellknown.HOSTNAME_LABEL,) + _DOM_KEYS
 

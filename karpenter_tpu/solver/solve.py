@@ -36,7 +36,14 @@ from karpenter_tpu.solver.encode import (
 R = len(RESOURCE_AXIS)
 
 G_BUCKETS = (1, 4, 8, 32, 128, 512, 2048)
-E_BUCKETS = (0, 64, 512, 2048, 4096)
+# tier granularity is a padding-waste vs recompile-cliff trade: the
+# kernel scan's per-step cost is linear in the padded axes, and the
+# round-5 profile showed 1-group sims paying an 8-step scan (G) and
+# mid-size clusters up to 4x E padding.  Each boundary crossing compiles
+# once per deployment — the persistent compilation cache (shared across
+# processes and restarts, operator + solverd + bench) absorbs repeats,
+# so steady-state clusters see each cliff exactly once.
+E_BUCKETS = (0, 16, 64, 128, 256, 512, 1024, 2048, 4096)
 B_BUCKETS = (4, 16, 64)  # simulate-batch axis (SURVEY §7 step 6)
 PT_ALIGN = 64  # (pool,type) axis padding; column axis O = PT_pad × ZC
 
