@@ -463,6 +463,43 @@ class TestFuzzColoc:
                 f"oracle {oracle.node_count()} (gap {node_gap} > 3)")
 
 
+@pytest.fixture(scope="module")
+def link_solvers():
+    """(baseline, forced-link-transforms) pair, both single-device: the
+    transforms are explicitly gated OFF under a mesh (no sharding story
+    for the packed/coalesced buffers), so forcing them on must bypass
+    only the backend gate, never the mesh gate — and a module-scoped
+    pair reuses the per-solver catalog-encoding cache across seeds."""
+    base = TPUSolver(mesh="off")
+    forced = TPUSolver(mesh="off")
+    forced._mask_packed = lambda: True
+    forced._coalesce_upload = lambda: True
+    return base, forced
+
+
+class TestFuzzLinkTransforms:
+    @pytest.mark.parametrize("seed", range(20))
+    def test_seeded_link_transforms(self, link_solvers, seed):
+        """The device-link encodings (bit-packed masks + coalesced
+        problem buffer) forced ON against the same seeds the default
+        solver answers — the transforms are encodings, not semantics,
+        so results must match EXACTLY.  On real TPU the gates default
+        on, and this is the only broad exercise they get before a
+        live-window bench."""
+        base_solver, forced = link_solvers
+        inp = _gen_problem(seed)
+        base = base_solver.solve(inp)
+        res = forced.solve(inp)
+        check_validity(seed, inp, res)
+        assert dict(res.existing_assignments) == dict(
+            base.existing_assignments), f"SEED={seed}"
+        assert set(res.unschedulable) == set(base.unschedulable), \
+            f"SEED={seed}"
+        assert res.node_count() == base.node_count(), f"SEED={seed}"
+        assert abs(res.total_price() - base.total_price()) < 1e-6, \
+            f"SEED={seed}"
+
+
 @pytest.mark.slow
 class TestFuzzLarge:
     @pytest.mark.parametrize("seed", range(20))
