@@ -58,7 +58,8 @@ class _ExistingSim:
         self.remaining = en.available.copy()
         self.hostname = en.node.name
         self.domains = node_domains_for(en.node.labels, en.node.name)
-        # pod equivalence classes that failed against this node since its
+        # interned group ids (objects.py scheduling_group_id) of pod
+        # equivalence classes that failed against this node since its
         # last mutation — identical pods skip the full re-check (the same
         # memoization the reference gets from batching identical pods)
         self.failed_keys: set = set()
@@ -82,7 +83,7 @@ class _NewSim:
         self.requests = daemon_overhead.copy()
         self.pods: List[Pod] = []
         self.failed_keys: set = set()
-        self.last_key = None  # scheduling key of the last pod added
+        self.last_key = None  # group id (interned int) of the last pod added
         self.hostname = f"new-node-{next(_sim_counter)}"
         # topology domains already determined for this node
         self.domains: Dict[str, str] = {
@@ -182,7 +183,12 @@ class Scheduler:
         self.result.unschedulable[pod.meta.name] = reason
 
     def _place(self, pod: Pod, req: Resources) -> Optional[str]:
-        key = pod.scheduling_key()
+        # interned int, not the deep tuple: the failed-key memo is probed
+        # per (pod, sim) and deep-tuple hashing (Resources + Requirements
+        # members) was ~60% of the oracle's 50k wall-clock; the int id
+        # follows the same immutable-spec/intern-epoch discipline the
+        # grouped solver already relies on (objects.py:249)
+        key = pod.scheduling_group_id()
         # topology-sensitive pods can't reuse failure memos: the tracker
         # state they were checked against changes with every placement
         stateful = bool(pod.topology_spread or pod.pod_affinities
@@ -290,7 +296,7 @@ class Scheduler:
 
     def _try_add_to_new(self, pod: Pod, req: Resources, sim: _NewSim,
                         commit: bool) -> bool:
-        key = pod.scheduling_key()
+        key = pod.scheduling_group_id()  # interned int — see _place
         stateful = bool(pod.topology_spread or pod.pod_affinities
                         or self.tracker.anti_topology_keys())
         total = sim.requests + req
