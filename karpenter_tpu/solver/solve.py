@@ -35,7 +35,7 @@ from karpenter_tpu.solver.encode import (
 
 R = len(RESOURCE_AXIS)
 
-G_BUCKETS = (1, 4, 8, 32, 128, 512, 2048)
+G_BUCKETS = (1, 4, 8, 16, 32, 128, 512, 2048)
 # tier granularity is a padding-waste vs recompile-cliff trade: the
 # kernel scan's per-step cost is linear in the padded axes, and the
 # round-5 profile showed 1-group sims paying an 8-step scan (G) and

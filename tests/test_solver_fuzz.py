@@ -420,7 +420,10 @@ class TestFuzzParity:
           * node count — worst +2 on 7/200 synthetic-catalog seeds;
             the round-5 real-catalog slices (lumpy sizes) widen the tail
             to +3 on ~1/400 fresh seeds with price within 1% (seed 60196
-            class: more smaller nodes at nearly equal cost).
+            class: more smaller nodes at nearly equal cost), and a
+            rarer class (~1/2000, seed 120132) buys +4 smaller nodes at
+            STRICTLY LOWER total price — cost is the objective, so a
+            cheaper plan is never a failure regardless of node count.
         """
         inp = _gen_problem(seed)
         res = solver.solve(inp)
@@ -432,9 +435,18 @@ class TestFuzzParity:
                 f"SEED={seed}: solver strands {len(res.unschedulable)} vs "
                 f"oracle {len(oracle.unschedulable)} — beyond the known bound")
             node_gap = res.node_count() - oracle.node_count()
-            assert node_gap <= 3, (
+            # the price escape is only sound when coverage is at least
+            # the oracle's (stranded pods cost nothing) and the plan is
+            # strictly cheaper — a same-price fragmentation regression
+            # must still fail the node bound
+            cheaper_full_cover = (uns_gap <= 0
+                                  and res.total_price()
+                                  < oracle.total_price())
+            assert node_gap <= 3 or cheaper_full_cover, (
                 f"SEED={seed}: solver {res.node_count()} nodes vs oracle "
-                f"{oracle.node_count()} (gap {node_gap} > 3)")
+                f"{oracle.node_count()} (gap {node_gap} > 3) at "
+                f"price {res.total_price():.3f} vs "
+                f"{oracle.total_price():.3f}, uns_gap {uns_gap}")
 
 
 class TestFuzzColoc:
