@@ -25,6 +25,7 @@ from karpenter_tpu.models.objects import (
     NodePool,
     Pod,
 )
+from karpenter_tpu.utils import tracing
 from karpenter_tpu.utils.clock import Clock, RealClock
 
 T = TypeVar("T")
@@ -176,6 +177,11 @@ class Cluster:
         for kind, store in self._stores.items():
             store._items.update(self.backend.load(kind))
         self.events: List[tuple] = []  # (time, kind, object, reason, message)
+        # active trace id per event, in lockstep with `events` (a parallel
+        # list, not a 6th tuple element: consumers unpack 5-tuples) — lets
+        # an operator jump from a FailedScheduling event to the exact
+        # provisioning pass's trace in /debug/traces
+        self.event_trace_ids: List[Optional[str]] = []
         # rolling dedup window over the last 512 event keys, maintained
         # incrementally (ADVICE r3: re-slicing events[-512:] per call made
         # a 2k-candidate sweep's per-candidate events quadratic)
@@ -247,8 +253,10 @@ class Cluster:
         self._recent_event_keys.append(key)
         self._recent_event_set.add(key)
         self.events.append((self.clock.now(), *key))
+        self.event_trace_ids.append(tracing.current_trace_id())
         if len(self.events) > 5000:
             del self.events[:2500]
+            del self.event_trace_ids[:2500]
 
     # -- convenience views ------------------------------------------------
     def pending_pods(self) -> List[Pod]:

@@ -45,7 +45,7 @@ from karpenter_tpu.operator.options import Options
 from karpenter_tpu.scheduling import ScheduleResult
 from karpenter_tpu.scheduling.types import ScheduleInput
 from karpenter_tpu.solver.solve import B_BUCKETS as SOLVER_B_BUCKETS
-from karpenter_tpu.utils import cron, errors, metrics
+from karpenter_tpu.utils import cron, errors, metrics, tracing
 from karpenter_tpu.utils.clock import Clock
 
 SPOT_TO_SPOT_MIN_TYPES = 15  # disruption.md:123-132
@@ -118,14 +118,23 @@ class Disruption:
         self._publish_eligibility(candidates)
         if not candidates:
             return
-        for method in (self._drift, self._emptiness,
-                       self._multi_node, self._single_node):
-            mname = method.__name__.lstrip("_")
-            with metrics.DISRUPTION_EVALUATION_DURATION.time(method=mname):
-                acted = method(candidates)
-            if acted:
-                metrics.DISRUPTION_ACTIONS.inc(method=mname)
-                return
+        # one trace per disruption pass: each method's evaluation (and the
+        # batched simulations under it) nests here, mirroring the
+        # provisioning pass's root span
+        with tracing.span("disruption.pass",
+                          candidates=len(candidates)) as _sp:
+            for method in (self._drift, self._emptiness,
+                           self._multi_node, self._single_node):
+                mname = method.__name__.lstrip("_")
+                with metrics.DISRUPTION_EVALUATION_DURATION.time(
+                        method=mname):
+                    with tracing.span(f"disruption.{mname}"):
+                        acted = method(candidates)
+                if acted:
+                    metrics.DISRUPTION_ACTIONS.inc(method=mname)
+                    if _sp is not None:
+                        _sp.attrs["acted"] = mname
+                    return
 
     def _publish_eligibility(self, candidates: List[Candidate]) -> None:
         """Refresh every method's eligible-nodes gauge each pass (including
@@ -427,8 +436,9 @@ class Disruption:
         one new (price-capped) node? None = infeasible."""
         inp = self._build_sim_input(cands, price_cap)
         with metrics.SCHEDULING_SIMULATION_DURATION.time():
-            return self._admissible(self.solver.solve(
-                inp, source="disruption", max_nodes=8))
+            with tracing.span("disruption.simulate", pods=len(inp.pods)):
+                return self._admissible(self.solver.solve(
+                    inp, source="disruption", max_nodes=8))
 
     def _simulate_batch(self, cand_sets: List[List[Candidate]],
                         price_caps: List[Optional[float]]):
@@ -439,14 +449,17 @@ class Disruption:
         # one node snapshot shared by every simulation: wrappers are
         # reused, so the controller-side build is O(nodes + sims) and the
         # solver's per-batch union cache keys work by object identity
-        prebuilt = build_existing_nodes(self.cluster)
-        inps = [self._build_sim_input(cs, cap, prebuilt=prebuilt)
-                for cs, cap in zip(cand_sets, price_caps)]
-        # admissibility allows at most ONE replacement node (_admissible),
-        # so a tiny new-node axis is exact: slot exhaustion reports
-        # unschedulable, rejected the same as a >1-claim result
-        results = self.solver.solve_batch(inps, source="disruption",
-                                          max_nodes=8)
+        with tracing.span("disruption.simulate_batch",
+                          sims=len(cand_sets)):
+            prebuilt = build_existing_nodes(self.cluster)
+            inps = [self._build_sim_input(cs, cap, prebuilt=prebuilt)
+                    for cs, cap in zip(cand_sets, price_caps)]
+            # admissibility allows at most ONE replacement node
+            # (_admissible), so a tiny new-node axis is exact: slot
+            # exhaustion reports unschedulable, rejected the same as a
+            # >1-claim result
+            results = self.solver.solve_batch(inps, source="disruption",
+                                              max_nodes=8)
         return (self._admissible(r) for r in results)
 
     def _acceptable(self, cands: List[Candidate],

@@ -20,7 +20,7 @@ from karpenter_tpu.models.objects import NodeClaim, ObjectMeta, Pod
 from karpenter_tpu.operator.options import Options
 from karpenter_tpu.scheduling import ScheduleInput
 from karpenter_tpu.scheduling.types import NewNodeClaim, ScheduleResult
-from karpenter_tpu.utils import errors, metrics
+from karpenter_tpu.utils import errors, metrics, tracing
 from karpenter_tpu.utils.clock import Clock
 
 NOMINATED_ANNOTATION = "karpenter.sh/nominated-claim"
@@ -92,20 +92,32 @@ class Provisioner:
             return
         self._batch_first = self._batch_sig = self._batch_last_change = None
 
-        try:
-            inp = self._build_input(pending)
-        except Exception as e:  # noqa: BLE001
-            # catalog discovery hit a cloud outage with a cold cache — keep
-            # the pods pending and retry next round (provisioning must never
-            # crash the loop, SURVEY §5)
-            if not errors.is_retryable(e):
-                raise
-            self.cluster.record_event(
-                "Provisioner", "provisioning", "SchedulingRetryable", str(e))
-            return
-        with metrics.SCHEDULING_DURATION.time():
-            result = self._solve(inp)
-        self._apply(result)
+        # ONE trace per provisioning pass, rooted here: every child span
+        # (input assembly, solve phases, remote-solver RPC, store I/O,
+        # apply) hangs off this id, and record_event stamps it so events
+        # and traces cross-reference
+        with tracing.span("provisioning.pass", pods=len(pending)) as _sp:
+            try:
+                with tracing.span("provisioning.build_input"):
+                    inp = self._build_input(pending)
+            except Exception as e:  # noqa: BLE001
+                # catalog discovery hit a cloud outage with a cold cache —
+                # keep the pods pending and retry next round (provisioning
+                # must never crash the loop, SURVEY §5)
+                if not errors.is_retryable(e):
+                    raise
+                self.cluster.record_event(
+                    "Provisioner", "provisioning", "SchedulingRetryable",
+                    str(e))
+                return
+            with metrics.SCHEDULING_DURATION.time():
+                with tracing.span("provisioning.solve"):
+                    result = self._solve(inp)
+            with tracing.span("provisioning.apply"):
+                self._apply(result)
+            if _sp is not None:
+                _sp.attrs["new_claims"] = len(result.new_claims)
+                _sp.attrs["unschedulable"] = len(result.unschedulable)
 
     # -- input assembly ---------------------------------------------------
     def _build_input(self, pending: List[Pod]) -> ScheduleInput:

@@ -52,7 +52,7 @@ class GatedSolver:
               max_nodes: Optional[int] = None):
         from karpenter_tpu.scheduling import Scheduler
         from karpenter_tpu.solver import UnsupportedPods
-        from karpenter_tpu.utils import metrics
+        from karpenter_tpu.utils import metrics, tracing
         if self.options.feature_gates.tpu_solver:
             try:
                 return self.tpu.solve(inp, max_nodes=max_nodes)
@@ -79,7 +79,9 @@ class GatedSolver:
                 f"oracle fallback: deferring {shed} pods to the next pass")
             inp = dataclasses.replace(
                 inp, pods=inp.pods[:self.ORACLE_SHED_LIMIT])
-        return Scheduler(inp).solve()
+        with tracing.span("solver.oracle", pods=len(inp.pods),
+                          source=source):
+            return Scheduler(inp).solve()
 
     def solve_batch(self, inps: List[ScheduleInput],
                     source: str = "disruption",

@@ -93,7 +93,9 @@ class Operator:
                 self.wfile.write(data)
 
             def do_GET(self):  # noqa: N802 - stdlib API
-                path = self.path.split("?")[0]
+                from urllib.parse import parse_qs, urlparse
+                url = urlparse(self.path)
+                path = url.path
                 if path == "/metrics":
                     self._respond(200, metrics.REGISTRY.render(),
                                   "text/plain; version=0.0.4")
@@ -110,6 +112,16 @@ class Operator:
                     ready = op.env.cloud_provider.live()
                     self._respond(200 if ready else 503,
                                   "ok\n" if ready else "not ready\n")
+                elif path == "/debug/traces":
+                    # recent completed traces as Chrome trace-event JSON
+                    # (Perfetto / chrome://tracing loadable); ?trace_id=
+                    # narrows to one — the id an event or log line stamped
+                    from karpenter_tpu.utils import tracing
+                    tid = (parse_qs(url.query).get("trace_id")
+                           or [None])[0]
+                    self._respond(200,
+                                  json.dumps(tracing.chrome_trace(tid)) +
+                                  "\n", "application/json")
                 elif path == "/debug/state":
                     c = op.env.cluster
                     self._respond(200, json.dumps({

@@ -55,6 +55,11 @@ def _solve_group(inps: List, max_nodes: Optional[int] = None) -> List:
     from karpenter_tpu.scheduling import Scheduler
     from karpenter_tpu.solver import UnsupportedPods
     try:
+        # singleton groups stay on solve_batch: routing them through
+        # solve() would compile the single-problem kernel shapes inside
+        # the daemon on top of the batch shapes — an extra compile cliff
+        # per deployment for no throughput win (phase observability rides
+        # the batch path's own spans/histograms instead)
         return _get_solver().solve_batch(inps, max_nodes=max_nodes)
     except UnsupportedPods:
         out = []
@@ -135,10 +140,32 @@ def handle_batch(payloads: List[bytes]) -> List[bytes]:
                 remaining_limits=body.get("remaining_limits") or {},
                 price_cap=body.get("price_cap"),
             ))
+        # stitch the fused solve into the CALLER's trace: extract the
+        # first traceparent in the group (a fused batch normally comes
+        # from one operator client), run the solve as its child, and ship
+        # the recorded spans back on each matching response — the spans
+        # belong to the caller's ring buffer, not this daemon's
+        from karpenter_tpu.utils import tracing
+        tp = next((requests[i][1].get("traceparent") for i in idxs
+                   if requests[i][1].get("traceparent")), None)
+        ctx = tracing.extract(tp)
         try:
-            results = _solve_group(inps, max_nodes=max_nodes)
+            with ctx:
+                with tracing.span("solverd.solve_batch", requests=len(idxs)):
+                    results = _solve_group(inps, max_nodes=max_nodes)
+            spans = [s.to_dict() for s in ctx.spans]
             for i, res in zip(idxs, results):
                 responses[i] = ("result", res)
+                if spans and requests[i][1].get("traceparent") == tp:
+                    try:
+                        # exactly ONE response carries the group's spans: a
+                        # fused 60-sim batch attaching (and the client
+                        # adopting) the same list per result would
+                        # duplicate every span ~60x in the caller's trace
+                        res._remote_spans = spans
+                        spans = []
+                    except AttributeError:
+                        pass  # a slotted result type: spans are best-effort
         except Exception as e:  # noqa: BLE001
             for i in idxs:
                 responses[i] = ("error", f"solve failed: {e}")

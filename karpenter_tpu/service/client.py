@@ -24,6 +24,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from karpenter_tpu.scheduling.types import ScheduleInput, ScheduleResult
+from karpenter_tpu.utils import tracing
 
 
 class SolverServiceError(RuntimeError):
@@ -227,8 +228,18 @@ class SolverServiceClient:
         shared-TPU shape the cap matters most for."""
         if not inps:
             return []
+        with tracing.span("service.solve_batch", requests=len(inps)):
+            return self._solve_batch_rpc(inps, max_nodes, _retry)
+
+    def _solve_batch_rpc(self, inps: List[ScheduleInput],
+                         max_nodes: Optional[int],
+                         _retry: bool) -> List[ScheduleResult]:
         fp, payload = self._fingerprint(inps[0])
         self._ensure_catalog(fp, payload)
+        # the traceparent-style context field: the daemon extracts it, runs
+        # the solve under the caller's trace, and ships its spans back on
+        # the result so remote-solver phases stitch into this pass's trace
+        tp = tracing.inject()
         rids = []
         for inp in inps:
             f, p = self._fingerprint(inp)
@@ -241,6 +252,7 @@ class SolverServiceClient:
                 "remaining_limits": inp.remaining_limits,
                 "price_cap": inp.price_cap,
                 "max_nodes": max_nodes,
+                "traceparent": tp,
             }))
         out: List[ScheduleResult] = []
         lost_catalog = False
@@ -248,6 +260,13 @@ class SolverServiceClient:
             for rid in rids:
                 kind, body = self._wait(rid)
                 if kind == "result":
+                    remote_spans = getattr(body, "_remote_spans", None)
+                    if remote_spans:
+                        tracing.adopt(remote_spans)
+                        try:
+                            del body._remote_spans
+                        except AttributeError:
+                            pass
                     out.append(body)
                 elif kind == "need_catalog":
                     lost_catalog = True

@@ -32,6 +32,7 @@ from karpenter_tpu.solver.encode import (
     bucket,
     encode,
 )
+from karpenter_tpu.utils import metrics, tracing
 
 R = len(RESOURCE_AXIS)
 
@@ -332,28 +333,31 @@ class TPUSolver:
         (inside _solve_relaxed via _attempt_or_split): a promoted soft
         term can make a variant inexpressible while the fully-relaxed pod
         is plain, and vice versa."""
-        from karpenter_tpu.utils import metrics
-        self._used_split = False
-        self._residue_counted = set()
-        res = self._solve_relaxed(inp, max_nodes=max_nodes)
-        if res.unschedulable and not (
-                max_nodes is not None
-                and getattr(self, "_last_slots_exhausted", False)):
-            # rescue unless the caller's explicit node cap was itself the
-            # binding constraint: a slot-exhausted consolidation sim WANTS
-            # the cheap reject (a >cap result is inadmissible either way),
-            # but a capped sim stranded for capacity/topology reasons may
-            # be feasible — the kernel's quota planning is estimate-based
-            # and cost-blind, and a spurious verdict here would silently
-            # stop consolidation under price caps
-            res = self._rescue_stranded(inp, res)
-        if max_nodes is None:
-            # the backstop ignores node caps, so a capped solve (a
-            # consolidation sim) must never take it: a fewer-strands plan
-            # that uses more nodes than the cap is inadmissible there
-            res = self._oracle_backstop_on_limits(inp, res)
-        metrics.SOLVER_SOLVES.inc(
-            path="split" if self._used_split else "device")
+        with tracing.span("solver.solve", pods=len(inp.pods)) as _sp:
+            self._used_split = False
+            self._residue_counted = set()
+            res = self._solve_relaxed(inp, max_nodes=max_nodes)
+            if res.unschedulable and not (
+                    max_nodes is not None
+                    and getattr(self, "_last_slots_exhausted", False)):
+                # rescue unless the caller's explicit node cap was itself the
+                # binding constraint: a slot-exhausted consolidation sim WANTS
+                # the cheap reject (a >cap result is inadmissible either way),
+                # but a capped sim stranded for capacity/topology reasons may
+                # be feasible — the kernel's quota planning is estimate-based
+                # and cost-blind, and a spurious verdict here would silently
+                # stop consolidation under price caps
+                res = self._rescue_stranded(inp, res)
+            if max_nodes is None:
+                # the backstop ignores node caps, so a capped solve (a
+                # consolidation sim) must never take it: a fewer-strands plan
+                # that uses more nodes than the cap is inadmissible there
+                res = self._oracle_backstop_on_limits(inp, res)
+            path = "split" if self._used_split else "device"
+            metrics.SOLVER_SOLVES.inc(path=path)
+            if _sp is not None:
+                _sp.attrs["path"] = path
+                _sp.attrs["unschedulable"] = len(res.unschedulable)
         return res
 
     # pods beyond this, the backstop oracle's O(pods) wall-clock isn't
@@ -388,7 +392,6 @@ class TPUSolver:
                    for reason in res.unschedulable.values()):
             return res
         from karpenter_tpu.scheduling import Scheduler
-        from karpenter_tpu.utils import metrics
         orc = Scheduler(inp).solve()
         if len(orc.unschedulable) < len(res.unschedulable):
             metrics.SOLVER_ORACLE_BACKSTOP.inc()
@@ -400,7 +403,6 @@ class TPUSolver:
         """Residue-pod metric, deduplicated per solve(): the relaxation
         loop can hit the split path once per round for the same pods —
         counting each round would inflate the metric ~65x."""
-        from karpenter_tpu.utils import metrics
         counted = getattr(self, "_residue_counted", None)
         if counted is None:
             metrics.SOLVER_RESIDUE_PODS.inc(len(pods))
@@ -484,12 +486,17 @@ class TPUSolver:
         # needed them anyway)
         import time as _time
         from karpenter_tpu.solver.encode import group_pods
+        wall0 = _time.time()
         t0 = _time.perf_counter()
         groups = group_pods(inp.pods)
         # grouping belongs to the ENCODE phase even though it runs before
         # _solve_attempt's timer — _solve_attempt folds this in, so the
         # bench's host-share accounting stays honest
         self._pregroup_ms = (_time.perf_counter() - t0) * 1e3
+        metrics.SOLVER_PHASE_DURATION.observe(
+            self._pregroup_ms / 1e3, phase="pregroup", path="solve")
+        tracing.record_span("solver.phase.pregroup", wall0,
+                            self._pregroup_ms / 1e3, pods=len(inp.pods))
         if not any(g[0].preferences
                    or ((g[0].pod_affinities or g[0].topology_spread)
                        and g[0].has_soft_terms())
@@ -497,7 +504,6 @@ class TPUSolver:
             return self._attempt_or_split(inp, max_nodes=max_nodes,
                                           groups=groups)
         import dataclasses
-        from karpenter_tpu.utils import metrics
         by_name = {p.meta.name: p for p in inp.pods}
         relax: Dict[str, int] = {}
         # bound by TOTAL soft terms (capped), not the deepest list: one
@@ -582,6 +588,7 @@ class TPUSolver:
         # end of this method overwrites any sub-solve's leftovers
         self._last_oracle_judged = set()
         self._last_slots_exhausted = False
+        wall0 = _time.time()
         t0 = _time.perf_counter()
         cat = self._catalog_encoding(inp)
         enc = self._encode_checked(inp, cat, groups=groups)
@@ -653,6 +660,16 @@ class TPUSolver:
         self.last_phase_ms.update(
             pad=(t2 - t1) * 1e3, device=(t3 - t2) * 1e3,
             repair=(t4 - t3) * 1e3, decode=(t5 - t4) * 1e3)
+        # per-phase histograms + spans; the histogram's `encode` is the
+        # pure encode interval — pregroup is its own phase (last_phase_ms
+        # keeps folding it into encode for the bench's host-share line)
+        for phase, lo, hi in (("encode", t0, t1), ("pad", t1, t2),
+                              ("device", t2, t3), ("repair", t3, t4),
+                              ("decode", t4, t5)):
+            metrics.SOLVER_PHASE_DURATION.observe(
+                hi - lo, phase=phase, path="solve")
+            tracing.record_span(f"solver.phase.{phase}",
+                                wall0 + (lo - t0), hi - lo)
         return res
 
     # -- split solve: device for the supported majority, host oracle for
@@ -662,7 +679,6 @@ class TPUSolver:
         import dataclasses
 
         from karpenter_tpu.solver.encode import encode
-        from karpenter_tpu.utils import metrics
 
         cat = self._catalog_encoding(inp)
         try:
@@ -1353,6 +1369,10 @@ class TPUSolver:
             "encode": encode_ms, "device": device_ms, "decode": decode_ms,
             "per_sim": ((encode_ms + device_ms + decode_ms) / len(eligible)
                         if eligible else 0.0)}
+        for phase, ms in (("encode", encode_ms), ("device", device_ms),
+                          ("decode", decode_ms)):
+            metrics.SOLVER_PHASE_DURATION.observe(
+                ms / 1e3, phase=phase, path="sweep")
         return out_results
 
     def solve_batch(self, inps: List[ScheduleInput],
@@ -1376,6 +1396,12 @@ class TPUSolver:
         """
         if not inps:
             return []
+        with tracing.span("solver.solve_batch", sims=len(inps)):
+            return self._solve_batch_inner(inps, max_nodes=max_nodes)
+
+    def _solve_batch_inner(self, inps: List[ScheduleInput],
+                           max_nodes: Optional[int] = None
+                           ) -> List[ScheduleResult]:
         mn = max_nodes or self.max_nodes
         # soft-term pods: batch the common no-relaxation first round —
         # every soft term ENFORCED as hard (relaxed(0), round 0 of the
@@ -1436,6 +1462,9 @@ class TPUSolver:
         # and name-keyed trust would be unsound — there the union would
         # just balloon to ~Σ|nodes|, so when sharing doesn't materialize
         # we drop the cache and keep the classic per-sim encode
+        import time as _time
+        wall0 = _time.time()
+        t_enc0 = _time.perf_counter()
         shared = SharedExistEncoding(cat)
         for inp in inps:
             shared.add_input(inp)
@@ -1452,6 +1481,7 @@ class TPUSolver:
                     inp, cat, exist_shared=shared)))
             except UnsupportedPods:
                 singles.append(i)
+        encode_s = _time.perf_counter() - t_enc0
         if len(cat.columns) == 0:
             return [self.solve(inp, max_nodes=max_nodes)
                     for inp in inps]
@@ -1479,9 +1509,11 @@ class TPUSolver:
 
             mbits = self._mask_packed()
             chunk_size = B_BUCKETS[-1]
+            pad_s = device_s = repair_s = decode_s = 0.0
             for start in range(0, len(encs), chunk_size):
                 chunk = encs[start:start + chunk_size]
                 B = bucket(len(chunk), B_BUCKETS)
+                t_pad0 = _time.perf_counter()
                 probs = [self._problem_args(e, G, E, Db, O, pack_mask=mbits)
                          for _, e in chunk]
                 # pad the batch axis with empty problems (zero groups = no
@@ -1491,11 +1523,15 @@ class TPUSolver:
                 stacked = self._put_problem(
                     tuple(np.stack(parts) for parts in zip(*probs)),
                     batched=True)
+                t_dev0 = _time.perf_counter()
+                pad_s += t_dev0 - t_pad0
                 packed = ffd.solve_ffd_batch(
                     *self._assemble(dev, stacked), max_nodes=mn,
                     zc=dev["ZC"], sparse_k=sparse_k, mask_packed=mbits)
                 packed = np.array(packed)
+                device_s += _time.perf_counter() - t_dev0
                 for bi, (i, enc) in enumerate(chunk):
+                    t_dec0 = _time.perf_counter()
                     out = ffd.unpack(packed[bi], G, E, mn, R, Db,
                                      sparse_k=sparse_k)
                     # judged BEFORE topology repair: repair-stranded pods
@@ -1505,6 +1541,8 @@ class TPUSolver:
                                      and out["num_active"] >= mn)
                     self._repair_whole_node(enc, out)
                     self._repair_topology(enc, out)
+                    t_dec1 = _time.perf_counter()
+                    repair_s += t_dec1 - t_dec0
                     res = self._decode(enc, out)
                     if res.unschedulable and not (
                             max_nodes is not None and exhausted):
@@ -1518,7 +1556,25 @@ class TPUSolver:
                         self._residue_counted = set()
                         self._last_oracle_judged = set()
                         res = self._rescue_stranded(inps[i], res)
+                    decode_s += _time.perf_counter() - t_dec1
                     out_results[i] = res
+            # generic-batch phase observability (path="batch"): the fused
+            # solverd lane and sweep holes run here, so their latency must
+            # be attributable too. unpack+repair time as `repair`, pregroup
+            # is folded into `encode` (grouping happens inside encode());
+            # spans land under the active solver.solve_batch span, which
+            # is what a remote caller's stitched trace shows. Spans lay
+            # out sequentially from the batch start — exact for the
+            # single-chunk common case, aggregate across chunks otherwise
+            t_cursor = wall0
+            for phase, secs in (("encode", encode_s), ("pad", pad_s),
+                                ("device", device_s), ("repair", repair_s),
+                                ("decode", decode_s)):
+                metrics.SOLVER_PHASE_DURATION.observe(
+                    secs, phase=phase, path="batch")
+                tracing.record_span(f"solver.phase.{phase}",
+                                    t_cursor, secs, path="batch")
+                t_cursor += secs
         return out_results
 
     def _existing_only(self, enc: EncodedProblem) -> ScheduleResult:

@@ -313,26 +313,34 @@ class HttpBackend:
         # one persistent keep-alive connection for RPCs (the server is
         # HTTP/1.1): per-call connect/teardown would pay TCP setup on
         # every cluster mutation. Reconnect-once on a broken socket.
+        # child_span: store I/O annotates whatever trace is in flight (a
+        # provisioning pass applying claims) but never starts one of its
+        # own — the watch thread's polling would flood the ring buffer
+        from karpenter_tpu.utils import tracing
         payload = json.dumps(body).encode() if body is not None else None
         headers = {"Content-Type": "application/json"} if payload else {}
-        with self._rpc_lock:
-            for attempt in (0, 1):
-                if self._rpc_conn is None:
-                    self._rpc_conn = self._conn()
-                try:
-                    self._rpc_conn.request(method, path, body=payload,
-                                           headers=headers)
-                    resp = self._rpc_conn.getresponse()
-                    data = resp.read()
-                    break
-                except (OSError, http.client.HTTPException):
+        with tracing.child_span("store.http.request", method=method,
+                                path=path) as _sp:
+            with self._rpc_lock:
+                for attempt in (0, 1):
+                    if self._rpc_conn is None:
+                        self._rpc_conn = self._conn()
                     try:
-                        self._rpc_conn.close()
-                    except OSError:
-                        pass
-                    self._rpc_conn = None
-                    if attempt:
-                        raise
+                        self._rpc_conn.request(method, path, body=payload,
+                                               headers=headers)
+                        resp = self._rpc_conn.getresponse()
+                        data = resp.read()
+                        break
+                    except (OSError, http.client.HTTPException):
+                        try:
+                            self._rpc_conn.close()
+                        except OSError:
+                            pass
+                        self._rpc_conn = None
+                        if attempt:
+                            raise
+            if _sp is not None:
+                _sp.attrs["status"] = resp.status
         try:
             doc = json.loads(data) if data else {}
         except ValueError:

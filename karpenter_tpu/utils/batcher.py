@@ -26,7 +26,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Generic, Hashable, List, Optional, TypeVar
 
-from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils import metrics, tracing
 
 T = TypeVar("T")  # request item
 U = TypeVar("U")  # per-item result
@@ -43,6 +43,10 @@ class _Pending(Generic[T, U]):
     done: threading.Event = field(default_factory=threading.Event)
     result: Optional[U] = None
     error: Optional[BaseException] = None
+    # submitter's trace context, captured at submit() time: the window
+    # executes on a worker thread with no thread-local trace of its own,
+    # so the execute span stitches under the first traced submitter
+    trace_ctx: Optional[tuple] = None
 
 
 class _Bucket(Generic[T, U]):
@@ -97,7 +101,8 @@ class Batcher(Generic[T, U]):
     def submit(self, request: T) -> "_Pending[T, U]":
         """Enqueue without blocking — lets one caller put many items into the
         same window before waiting (terminate_instances takes a list)."""
-        pending: _Pending[T, U] = _Pending(request)
+        pending: _Pending[T, U] = _Pending(request,
+                                           trace_ctx=tracing.current())
         key = self.hasher(request)
         now = time.monotonic()
         with self._lock:
@@ -157,8 +162,14 @@ class Batcher(Generic[T, U]):
 
     def _execute(self, items: List[_Pending[T, U]]) -> None:
         requests = [p.request for p in items]
+        ctx = next((p.trace_ctx for p in items if p.trace_ctx), None)
         try:
-            results = self.executor(requests)
+            if ctx is not None:
+                with tracing.span("batcher.execute", parent=ctx,
+                                  batcher=self.name, items=len(items)):
+                    results = self.executor(requests)
+            else:
+                results = self.executor(requests)
             if len(results) != len(requests):
                 raise RuntimeError(
                     f"{self.name}: executor returned {len(results)} results "

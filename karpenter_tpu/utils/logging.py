@@ -45,8 +45,13 @@ class Logger:
     def _emit(self, level: str, msg: str, kv: dict) -> None:
         if _LEVELS[level] < _configured_level():
             return
+        # UTC with millisecond precision: span start/end times are wall
+        # clock (utils/tracing), so log lines must carry enough timestamp
+        # to line up against them — local-time whole seconds can't
+        now = time.time()
         parts = [
-            f"ts={time.strftime('%Y-%m-%dT%H:%M:%S')}",
+            f"ts={time.strftime('%Y-%m-%dT%H:%M:%S', time.gmtime(now))}"
+            f".{int(now * 1000) % 1000:03d}Z",
             f"level={level}",
             f"logger={self.name}",
             f"msg={_fmt_value(msg)}",
@@ -90,10 +95,19 @@ class ChangeMonitor:
         self._now = now
         self._seen: dict = {}
         self._lock = threading.Lock()
+        self._next_sweep = now() + ttl
 
     def has_changed(self, key: str, value: object) -> bool:
         now = self._now()
         with self._lock:
+            if now >= self._next_sweep:
+                # opportunistic expiry sweep: per-KEY polling loops (one
+                # entry per node name, pod uid, ...) otherwise grow _seen
+                # forever in a long-running operator — expired entries
+                # would re-log anyway, so dropping them changes nothing
+                self._seen = {k: e for k, e in self._seen.items()
+                              if now - e[1] < self.ttl}
+                self._next_sweep = now + self.ttl
             entry = self._seen.get(key)
             if entry is not None:
                 last_value, stamp = entry

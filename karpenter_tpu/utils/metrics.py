@@ -47,10 +47,19 @@ class _Metric:
         return tuple(labels[k] for k in self.label_names)
 
     @staticmethod
-    def _fmt_labels(names, values) -> str:
+    def _esc(value) -> str:
+        """Label-value escaping per the Prometheus text exposition spec:
+        backslash, double-quote, and line-feed must be escaped or the
+        rendered line is invalid text format (a selector value like
+        `zone="us-east\\1"` would otherwise break every scraper)."""
+        return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+                .replace("\n", "\\n"))
+
+    @classmethod
+    def _fmt_labels(cls, names, values) -> str:
         if not names:
             return ""
-        inner = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+        inner = ",".join(f'{n}="{cls._esc(v)}"' for n, v in zip(names, values))
         return "{" + inner + "}"
 
 
@@ -285,6 +294,16 @@ BATCHER_BATCH_SIZE = REGISTRY.histogram(
 SOLVER_SOLVES = _c(
     "karpenter_tpu_solver_solves_total",
     "Scheduling solves by execution path.", ("path",))
+# last_phase_ms promoted to a first-class family: the per-solve phase
+# breakdown (pregroup/encode/pad/device/repair/decode) was visible only
+# in bench stdout, invisible to /metrics — the opaque segments now
+# dominating the 200 ms budget (BENCH_r05: device 50.7 ms, decode
+# 13.8 ms) must be attributable from the operator's scrape
+SOLVER_PHASE_DURATION = _h(
+    "karpenter_tpu_solver_phase_duration_seconds",
+    "Per-phase wall-clock of one device solve, by execution path "
+    "(solve = single-problem attempt, sweep = batched consolidation "
+    "sweep).", ("phase", "path"))
 SOLVER_RESIDUE_PODS = _c(
     "karpenter_tpu_solver_residue_pods_total",
     "Pods solved host-side as split-solve residue.")

@@ -337,3 +337,139 @@ class TestInstanceTypeGauges:
         text = metrics.REGISTRY.render()
         assert f'zone="{z1}"' not in text
         assert f'zone="{z2}"' in text  # A's view unaffected
+
+
+class TestExpositionEscaping:
+    """Label values containing `"` `\\` or newlines must escape per the
+    Prometheus text exposition spec — a zone like `us\\east` or a reason
+    carrying a quoted fragment otherwise renders invalid text format."""
+
+    # exposition escaping rules for label values, inverted
+    _UNESCAPE = {"\\\\": "\\", '\\"': '"', "\\n": "\n"}
+
+    @classmethod
+    def _parse_labels(cls, line):
+        """Strict parse of one sample line's label block; raises on any
+        malformed escape or unescaped quote."""
+        start = line.index("{")
+        end = line.rindex("}")
+        inner = line[start + 1:end]
+        out = {}
+        i = 0
+        while i < len(inner):
+            eq = inner.index("=", i)
+            name = inner[i:eq]
+            assert inner[eq + 1] == '"', f"unquoted value in {line!r}"
+            j = eq + 2
+            val = []
+            while True:
+                c = inner[j]
+                if c == "\\":
+                    pair = inner[j:j + 2]
+                    assert pair in cls._UNESCAPE, \
+                        f"bad escape {pair!r} in {line!r}"
+                    val.append(cls._UNESCAPE[pair])
+                    j += 2
+                elif c == '"':
+                    break
+                else:
+                    assert c != "\n", f"raw newline in {line!r}"
+                    val.append(c)
+                    j += 1
+            out[name] = "".join(val)
+            i = j + 1
+            if i < len(inner) and inner[i] == ",":
+                i += 1
+        return out
+
+    def test_hostile_values_round_trip(self):
+        from karpenter_tpu.utils.metrics import Counter
+        hostile = ['plain', 'with "quotes"', 'back\\slash',
+                   'new\nline', '"\\both\\"', 'trailing\\']
+        c = Counter("esc_total", "h", ("v",))
+        for v in hostile:
+            c.inc(v=v)
+        lines = [ln for ln in c.render() if not ln.startswith("#")]
+        parsed = [self._parse_labels(ln)["v"] for ln in lines]
+        assert sorted(parsed) == sorted(hostile)
+        # every rendered line is a single line (no raw newlines leaked)
+        for ln in lines:
+            assert "\n" not in ln
+
+    def test_histogram_labels_escaped(self):
+        from karpenter_tpu.utils.metrics import Histogram
+        h = Histogram("esc_seconds", "h", ("k",), buckets=(1.0,))
+        h.observe(0.5, k='a"b\\c')
+        text = "\n".join(h.render())
+        assert 'k="a\\"b\\\\c"' in text
+
+
+class TestDecoratedCloudProvider:
+    """metrics.Decorate analogue: every wrapped method observes a duration
+    sample; errors additionally bump the error counter and re-raise."""
+
+    class _Inner:
+        def __init__(self):
+            self.calls = []
+
+        def create(self, claim):
+            self.calls.append(("create", claim))
+            return "created"
+
+        def delete(self, name):
+            raise RuntimeError("cloud said no")
+
+        def get(self, name):
+            return None
+
+        def list_instances(self):
+            return []
+
+        def get_instance_types(self, ref):
+            return []
+
+        def is_drifted(self, claim):
+            return None
+
+        def live(self):
+            return True
+
+        def custom_helper(self):
+            return "passthrough"
+
+    def test_success_observes_duration_not_errors(self):
+        inner = self._Inner()
+        dec = metrics.DecoratedCloudProvider(inner)
+        d0 = metrics.CLOUDPROVIDER_DURATION.count(method="create")
+        e0 = metrics.CLOUDPROVIDER_ERRORS.value(method="create")
+        assert dec.create("claim-1") == "created"
+        assert inner.calls == [("create", "claim-1")]
+        assert metrics.CLOUDPROVIDER_DURATION.count(method="create") == d0 + 1
+        assert metrics.CLOUDPROVIDER_ERRORS.value(method="create") == e0
+
+    def test_error_observes_duration_and_error_and_reraises(self):
+        dec = metrics.DecoratedCloudProvider(self._Inner())
+        d0 = metrics.CLOUDPROVIDER_DURATION.count(method="delete")
+        e0 = metrics.CLOUDPROVIDER_ERRORS.value(method="delete")
+        with pytest.raises(RuntimeError, match="cloud said no"):
+            dec.delete("x")
+        assert metrics.CLOUDPROVIDER_DURATION.count(method="delete") == d0 + 1
+        assert metrics.CLOUDPROVIDER_ERRORS.value(method="delete") == e0 + 1
+
+    def test_duration_sum_advances(self):
+        dec = metrics.DecoratedCloudProvider(self._Inner())
+        s0 = metrics.CLOUDPROVIDER_DURATION.sum(method="live")
+        dec.live()
+        assert metrics.CLOUDPROVIDER_DURATION.sum(method="live") > s0
+
+    def test_unwrapped_attributes_pass_through(self):
+        dec = metrics.DecoratedCloudProvider(self._Inner())
+        assert dec.custom_helper() == "passthrough"
+        # undecorated methods observe nothing
+        assert metrics.REGISTRY.get(
+            "karpenter_cloudprovider_duration_seconds").count(
+                method="custom_helper") == 0
+
+    def test_wrapping_is_stable(self):
+        dec = metrics.DecoratedCloudProvider(self._Inner())
+        assert dec.create is dec.create  # wrapped once at construction

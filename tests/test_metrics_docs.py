@@ -1,0 +1,33 @@
+"""Tier-1 wiring for hack/check_metrics_docs.py: every family registered
+in utils/metrics.py must appear in docs/observability.md — new metrics
+can't ship undocumented (ISSUE 2 satellite)."""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_checker():
+    path = os.path.join(REPO, "hack", "check_metrics_docs.py")
+    spec = importlib.util.spec_from_file_location("check_metrics_docs", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_every_registered_family_is_documented():
+    checker = _load_checker()
+    assert checker.missing_families() == []
+
+
+def test_checker_detects_a_missing_family(tmp_path, monkeypatch):
+    # the guard itself must fail loudly when a family vanishes from the
+    # doc — otherwise a truncated doc passes forever
+    checker = _load_checker()
+    doc = tmp_path / "observability.md"
+    doc.write_text("# empty catalogue\n")
+    monkeypatch.setattr(checker, "DOC", str(doc))
+    missing = checker.missing_families()
+    assert "karpenter_tpu_solver_phase_duration_seconds" in missing
+    assert checker.main() == 1

@@ -1,0 +1,405 @@
+"""End-to-end span tracing (ISSUE 2 tentpole): the span primitives, the
+bounded ring buffer, Chrome-trace-event export, the provisioning pass's
+root-to-phase nesting, traceparent stitching across the solverd RPC
+boundary, and the operator's /debug/traces endpoint.
+
+The acceptance test drives a config5-style burst (many pods, several size
+classes) through the real provisioner and walks the exported trace's
+parent/child links: provisioning.pass → provisioning.solve → solver.solve
+→ all six phases (pregroup/encode/pad/device/repair/decode), and in
+service mode the stitched solverd.solve_batch span in between.
+"""
+
+import json
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.env import Environment
+from karpenter_tpu.models import NodePool, ObjectMeta, Pod, Resources
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.utils import metrics, tracing
+
+PHASES = ("pregroup", "encode", "pad", "device", "repair", "decode")
+
+
+@pytest.fixture(autouse=True)
+def clean_tracing():
+    tracing.reset()
+    tracing.set_enabled(None)
+    yield
+    tracing.reset()
+    tracing.set_enabled(None)
+
+
+def span_index(chrome: dict):
+    """span_id → event for every complete event in a Chrome export."""
+    return {e["args"]["span_id"]: e
+            for e in chrome["traceEvents"] if e.get("ph") == "X"}
+
+
+def walk_to_root(idx: dict, event: dict):
+    """Follow parent links; returns the chain of names root-last."""
+    chain = [event["name"]]
+    seen = set()
+    cur = event
+    while cur["args"]["parent_id"] is not None:
+        pid = cur["args"]["parent_id"]
+        assert pid not in seen, "parent cycle"
+        seen.add(pid)
+        assert pid in idx, f"dangling parent link from {cur['name']}"
+        cur = idx[pid]
+        chain.append(cur["name"])
+    return chain
+
+
+class TestSpans:
+    def test_nesting_and_ring_buffer(self):
+        tracing.set_enabled(True)
+        with tracing.span("root", a=1):
+            with tracing.span("child"):
+                tracing.record_span("leaf", 1.0, 0.25, k="v")
+        traces = tracing.finished_traces()
+        assert len(traces) == 1
+        by_name = {s.name: s for s in traces[0][1]}
+        assert by_name["root"].parent_id is None
+        assert by_name["child"].parent_id == by_name["root"].span_id
+        assert by_name["leaf"].parent_id == by_name["child"].span_id
+        assert by_name["leaf"].attrs == {"k": "v"}
+
+    def test_ring_buffer_bounded(self, monkeypatch):
+        tracing.set_enabled(True)
+        monkeypatch.setenv("KARPENTER_TPU_TRACE_BUFFER", "4")
+        tracing.reset()  # re-reads the bound
+        for i in range(10):
+            with tracing.span(f"t{i}"):
+                pass
+        traces = tracing.finished_traces()
+        assert len(traces) == 4
+        assert [t[1][0].name for t in traces] == ["t6", "t7", "t8", "t9"]
+
+    def test_disabled_is_noop(self, monkeypatch):
+        monkeypatch.delenv("KARPENTER_TPU_TRACE", raising=False)
+        with tracing.span("x") as sp:
+            assert sp is None
+            tracing.record_span("y", 0.0, 0.0)
+            assert tracing.current_trace_id() is None
+        assert tracing.finished_traces() == []
+        assert tracing.inject() is None
+
+    def test_env_gate(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_TRACE", "true")
+        with tracing.span("gated"):
+            pass
+        assert len(tracing.finished_traces()) == 1
+
+    def test_child_span_never_roots(self):
+        tracing.set_enabled(True)
+        with tracing.child_span("orphan") as sp:
+            assert sp is None  # no active trace: annotation-only spans skip
+        with tracing.span("root"):
+            with tracing.child_span("io") as sp:
+                assert sp is not None
+        (tid, spans), = tracing.finished_traces()
+        assert {s.name for s in spans} == {"root", "io"}
+
+    def test_traceparent_round_trip(self):
+        tracing.set_enabled(True)
+        with tracing.span("r") as sp:
+            tp = tracing.inject()
+            assert tracing.parse_traceparent(tp) == (sp.trace_id, sp.span_id)
+        assert tracing.parse_traceparent(None) is None
+        assert tracing.parse_traceparent("garbage") is None
+        assert tracing.parse_traceparent("00-zz-yy-01") is None
+
+    def test_extract_records_without_local_gate(self):
+        # the remote side records under an extracted context even with its
+        # own gate off — the caller made the gating decision
+        tracing.set_enabled(True)
+        with tracing.span("caller") as caller:
+            tp = tracing.inject()
+        tracing.reset()  # the remote process has its own empty collector
+        tracing.set_enabled(False)
+        ctx = tracing.extract(tp)
+        with ctx:
+            with tracing.span("remote"):
+                pass
+        assert len(ctx.spans) == 1
+        assert ctx.spans[0].parent_id == caller.span_id
+        assert ctx.spans[0].trace_id == caller.trace_id
+
+    def test_adopt_stitches_remote_spans(self):
+        tracing.set_enabled(True)
+        with tracing.span("local-root"):
+            tp = tracing.inject()
+            ctx = tracing.extract(tp)
+            with ctx:
+                with tracing.span("remote-child"):
+                    pass
+            tracing.adopt([s.to_dict() for s in ctx.spans])
+        (tid, spans), = tracing.finished_traces()
+        assert {s.name for s in spans} == {"local-root", "remote-child"}
+
+    def test_chrome_export_shape(self):
+        tracing.set_enabled(True)
+        with tracing.span("a"):
+            with tracing.span("b"):
+                pass
+        chrome = tracing.chrome_trace()
+        json.dumps(chrome)  # valid JSON
+        xs = [e for e in chrome["traceEvents"] if e.get("ph") == "X"]
+        assert len(xs) == 2
+        for e in xs:
+            assert isinstance(e["ts"], float) and isinstance(e["dur"], float)
+            assert e["pid"] >= 1 and e["tid"] >= 1
+        idx = span_index(chrome)
+        b = next(e for e in xs if e["name"] == "b")
+        assert walk_to_root(idx, b) == ["b", "a"]
+
+    def test_cross_thread_parent(self):
+        tracing.set_enabled(True)
+        with tracing.span("root"):
+            ctx = tracing.current()
+
+            def work():
+                with tracing.span("worker", parent=ctx):
+                    pass
+            t = threading.Thread(target=work)
+            t.start()
+            t.join()
+        (tid, spans), = tracing.finished_traces()
+        by_name = {s.name: s for s in spans}
+        assert by_name["worker"].parent_id == by_name["root"].span_id
+
+
+def mkpods(n):
+    sizes = [{"cpu": "250m", "memory": "512Mi"},
+             {"cpu": "500m", "memory": "1Gi"},
+             {"cpu": "1", "memory": "2Gi"},
+             {"cpu": "2", "memory": "4Gi"}]
+    return [Pod(meta=ObjectMeta(name=f"p{i}"),
+                requests=Resources.parse(sizes[i % len(sizes)]))
+            for i in range(n)]
+
+
+def provision_burst(env, n=40):
+    for pod in mkpods(n):
+        env.cluster.pods.create(pod)
+    env.provisioner.reconcile()
+
+
+class TestProvisioningTrace:
+    def test_burst_solve_trace_has_all_phases(self):
+        """A config5-style burst through the real provisioner: the trace
+        nests provisioning.pass → provisioning.solve → solver.solve → all
+        six phases, verified by walking the exported parent links."""
+        tracing.set_enabled(True)
+        env = Environment(options=Options(batch_idle_duration=0))
+        env.add_default_nodeclass()
+        env.cluster.nodepools.create(NodePool(meta=ObjectMeta(name="default")))
+        provision_burst(env)
+
+        chrome = tracing.chrome_trace()
+        idx = span_index(chrome)
+        events = [e for e in chrome["traceEvents"] if e.get("ph") == "X"]
+        roots = [e for e in events if e["name"] == "provisioning.pass"]
+        assert roots, [e["name"] for e in events]
+        root = roots[0]
+        assert root["args"]["parent_id"] is None
+        for phase in PHASES:
+            phase_events = [e for e in events
+                            if e["name"] == f"solver.phase.{phase}"]
+            assert phase_events, f"missing phase span {phase}"
+            chain = walk_to_root(idx, phase_events[0])
+            assert chain[-1] == "provisioning.pass"
+            assert "solver.solve" in chain
+            assert "provisioning.solve" in chain
+        # phase spans sit inside their parent's interval
+        solve = next(e for e in events if e["name"] == "solver.solve")
+        for e in events:
+            if e["name"].startswith("solver.phase."):
+                assert e["ts"] >= solve["ts"] - 1e3  # 1ms slack
+                assert (e["ts"] + e["dur"]
+                        <= solve["ts"] + solve["dur"] + 1e3)
+
+    def test_phase_histograms_promoted(self):
+        before = {p: metrics.SOLVER_PHASE_DURATION.count(phase=p,
+                                                         path="solve")
+                  for p in PHASES}
+        env = Environment(options=Options(batch_idle_duration=0))
+        env.add_default_nodeclass()
+        env.cluster.nodepools.create(NodePool(meta=ObjectMeta(name="default")))
+        provision_burst(env, n=12)
+        for p in PHASES:
+            assert metrics.SOLVER_PHASE_DURATION.count(
+                phase=p, path="solve") > before[p], f"no observation for {p}"
+        # and the family renders on the exposition endpoint
+        text = metrics.REGISTRY.render()
+        assert "karpenter_tpu_solver_phase_duration_seconds_bucket" in text
+
+    def test_record_event_stamps_trace_id(self):
+        tracing.set_enabled(True)
+        env = Environment(options=Options(batch_idle_duration=0))
+        env.add_default_nodeclass()
+        env.cluster.nodepools.create(NodePool(meta=ObjectMeta(name="default")))
+        # an unschedulable pod produces a FailedScheduling event inside the
+        # provisioning pass — its entry must carry the pass's trace id
+        env.cluster.pods.create(Pod(
+            meta=ObjectMeta(name="huge"),
+            requests=Resources.parse({"cpu": "10000", "memory": "1Ti"})))
+        env.provisioner.reconcile()
+        assert len(env.cluster.event_trace_ids) == len(env.cluster.events)
+        stamped = [tid for (_, _, _, reason, _), tid
+                   in zip(env.cluster.events, env.cluster.event_trace_ids)
+                   if reason == "FailedScheduling"]
+        assert stamped and stamped[0] is not None
+        assert any(t[0] == stamped[0] for t in tracing.finished_traces())
+
+    def test_disabled_tracing_records_nothing(self, monkeypatch):
+        monkeypatch.delenv("KARPENTER_TPU_TRACE", raising=False)
+        env = Environment(options=Options(batch_idle_duration=0))
+        env.add_default_nodeclass()
+        env.cluster.nodepools.create(NodePool(meta=ObjectMeta(name="default")))
+        provision_burst(env, n=8)
+        assert tracing.finished_traces() == []
+        assert env.cluster.event_trace_ids[-1:] in ([], [None])
+
+
+class _FramedBackendServer:
+    """In-process solverd stand-in: the daemon's u32|u64 framing over a
+    unix socket, requests answered by service.backend.handle_batch — the
+    RPC boundary without the native toolchain."""
+
+    def __init__(self, sock_path: str):
+        from karpenter_tpu.service import backend
+        self.path = sock_path
+        self._backend = backend
+        self._srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._srv.bind(sock_path)
+        self._srv.listen(4)
+        self._stop = False
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                header = b""
+                while len(header) < 12:
+                    chunk = conn.recv(12 - len(header))
+                    if not chunk:
+                        return
+                    header += chunk
+                plen, rid = struct.unpack("<IQ", header)
+                payload = b""
+                while len(payload) < plen:
+                    chunk = conn.recv(plen - len(payload))
+                    if not chunk:
+                        return
+                    payload += chunk
+                resp, = self._backend.handle_batch([payload])
+                conn.sendall(struct.pack("<IQ", len(resp), rid) + resp)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def close(self):
+        self._stop = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+class TestServiceModeStitching:
+    def test_remote_solver_spans_stitch_into_caller_trace(
+            self, tmp_path, monkeypatch):
+        from karpenter_tpu.service import backend
+        from karpenter_tpu.solver import TPUSolver
+        # small node axis: the backend's default 2048 would be a huge
+        # first compile on CPU
+        monkeypatch.setattr(backend, "_solver", TPUSolver(max_nodes=64))
+        sock = str(tmp_path / "solverd.sock")
+        srv = _FramedBackendServer(sock)
+        try:
+            tracing.set_enabled(True)
+            env = Environment(options=Options(batch_idle_duration=0,
+                                              solver_endpoint=sock))
+            env.add_default_nodeclass()
+            env.cluster.nodepools.create(
+                NodePool(meta=ObjectMeta(name="default")))
+            provision_burst(env, n=16)
+
+            chrome = tracing.chrome_trace()
+            idx = span_index(chrome)
+            events = [e for e in chrome["traceEvents"] if e.get("ph") == "X"]
+            names = {e["name"] for e in events}
+            assert "solverd.solve_batch" in names, names
+            # the stitched chain: remote phases → solverd.solve_batch →
+            # service.solve_batch → provisioning.solve → provisioning.pass
+            remote = next(e for e in events
+                          if e["name"] == "solverd.solve_batch")
+            chain = walk_to_root(idx, remote)
+            assert chain == ["solverd.solve_batch", "service.solve_batch",
+                             "provisioning.solve", "provisioning.pass"]
+            # the daemon fuses requests onto the generic batch path, whose
+            # phase spans (no pregroup: grouping happens inside encode())
+            # stitch under the remote solve_batch span
+            for phase in ("encode", "pad", "device", "repair", "decode"):
+                pe = [e for e in events
+                      if e["name"] == f"solver.phase.{phase}"]
+                assert pe, f"remote phase {phase} missing"
+                pchain = walk_to_root(idx, pe[0])
+                assert "solverd.solve_batch" in pchain
+                assert "solver.solve_batch" in pchain
+        finally:
+            srv.close()
+            env.solver.tpu.close()
+
+
+class TestDebugTracesEndpoint:
+    def test_endpoint_serves_chrome_json(self):
+        from karpenter_tpu.operator.operator import Operator
+        tracing.set_enabled(True)
+        env = Environment(options=Options(batch_idle_duration=0))
+        env.add_default_nodeclass()
+        env.cluster.nodepools.create(NodePool(meta=ObjectMeta(name="default")))
+        provision_burst(env, n=8)
+        op = Operator(options=env.options, metrics_port=0, health_port=0,
+                      env=env)
+        op.serve()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{op.metrics_port}/debug/traces",
+                    timeout=5) as r:
+                assert r.status == 200
+                doc = json.loads(r.read().decode())
+            events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+            assert any(e["name"] == "provisioning.pass" for e in events)
+            tid = next(e["args"]["trace_id"] for e in events
+                       if e["name"] == "provisioning.pass")
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{op.metrics_port}"
+                    f"/debug/traces?trace_id={tid}", timeout=5) as r:
+                one = json.loads(r.read().decode())
+            xs = [e for e in one["traceEvents"] if e.get("ph") == "X"]
+            assert xs and all(e["args"]["trace_id"] == tid for e in xs)
+        finally:
+            op.stop()
