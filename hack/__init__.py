@@ -1,0 +1,1 @@
+# makes `python -m hack.analyze` resolvable from the repo root

@@ -1,0 +1,18 @@
+"""kt-lint: repo-native static analysis (`python -m hack.analyze`).
+
+Four rule families tuned to this codebase's failure modes — jit-purity,
+lock-discipline, exception-hygiene, observability-conformance — plus the
+metrics-docs conformance check migrated from `hack/check_metrics_docs.py`.
+See docs/static-analysis.md for the rule catalogue, suppression syntax
+(`# kt-lint: disable=<rule>`), and the baseline workflow.
+"""
+
+from hack.analyze.core import (  # noqa: F401
+    BASELINE_PATH,
+    FileContext,
+    Finding,
+    Report,
+    baseline_matches,
+    load_baseline,
+    run,
+)

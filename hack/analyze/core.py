@@ -1,0 +1,235 @@
+"""kt-lint core: file walking, suppression, baselining, reporting.
+
+The framework half of `python -m hack.analyze` (ISSUE 3 tentpole). Rules
+live in `hack/analyze/rules/`; each exports RULE_NAME plus a
+`check(ctx) -> Iterator[Finding]` over one parsed file. This module owns
+everything rule-agnostic:
+
+  * `FileContext`  — source + AST + parent links + qualnames for one file
+  * suppression    — `# kt-lint: disable=<rule>[,<rule>...]` on the
+                     flagged line, on a statement header (suppresses the
+                     statement's whole span — a `def` line suppresses the
+                     function), or on a standalone comment line (applies
+                     to the next statement)
+  * baseline       — `hack/analyze/baseline.json`: grandfathered findings
+                     keyed by (rule, path, symbol, snippet-substring), so
+                     entries survive line drift but go stale when the code
+                     they describe disappears (tests/test_lint.py enforces
+                     that staleness is an error)
+  * `run()`        — walk paths, apply rules, partition findings into
+                     live / suppressed / baselined, report stale baseline
+                     entries
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "baseline.json")
+
+_SUPPRESS_RE = re.compile(r"#\s*kt-lint:\s*disable=([a-z0-9_,\- ]+)")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str        # repo-relative, posix separators
+    line: int
+    symbol: str      # enclosing function qualname, or "<module>"
+    message: str
+    snippet: str     # stripped source of the flagged line
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "symbol": self.symbol, "message": self.message,
+                "snippet": self.snippet}
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.rule}] {self.message}\n"
+                f"    {self.snippet}  (in {self.symbol})")
+
+
+class FileContext:
+    """One parsed source file plus the lookups every rule needs."""
+
+    def __init__(self, path: str, root: str = REPO):
+        self.path = os.path.abspath(path)
+        self.root = root
+        self.rel = os.path.relpath(self.path, root).replace(os.sep, "/")
+        with open(self.path, encoding="utf-8") as f:
+            self.source = f.read()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=self.rel)
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self._parents[child] = node
+        self._suppressions = self._parse_suppressions()
+
+    # -- structure ---------------------------------------------------------
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def qualname(self, node: ast.AST) -> str:
+        """Dotted name of the enclosing function/class scope."""
+        parts: List[str] = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(cur.name)
+            cur = self._parents.get(cur)
+        return ".".join(reversed(parts)) or "<module>"
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    # -- suppression -------------------------------------------------------
+    def _parse_suppressions(self) -> List[Tuple[int, int, Set[str]]]:
+        """(start_line, end_line, rules) intervals, inclusive."""
+        per_line: Dict[int, Set[str]] = {}
+        for i, text in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            per_line.setdefault(i, set()).update(rules)
+            if text.strip().startswith("#"):
+                # standalone comment: applies to the statement it precedes
+                per_line.setdefault(i + 1, set()).update(rules)
+        intervals: List[Tuple[int, int, Set[str]]] = [
+            (ln, ln, rules) for ln, rules in per_line.items()]
+        # a suppression on a statement header covers the statement's span
+        # (def line -> whole function, with line -> whole block)
+        for node in ast.walk(self.tree):
+            lineno = getattr(node, "lineno", None)
+            if lineno is None or not isinstance(node, ast.stmt):
+                continue
+            rules = per_line.get(lineno)
+            if rules:
+                end = getattr(node, "end_lineno", lineno) or lineno
+                intervals.append((lineno, end, rules))
+        return intervals
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        return any(start <= line <= end and rule in rules
+                   for start, end, rules in self._suppressions)
+
+    # -- finding factory ---------------------------------------------------
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        """Snippet is the flagged node's full source flattened to one
+        line (capped) — a multi-line call must still be matchable by a
+        baseline `contains` key, and two findings on the same first
+        physical line must stay distinguishable."""
+        line = getattr(node, "lineno", 1)
+        seg = None
+        try:
+            seg = ast.get_source_segment(self.source, node)
+        except (TypeError, ValueError):
+            pass
+        text = " ".join(seg.split()) if seg else self.snippet(line)
+        return Finding(rule=rule, path=self.rel, line=line,
+                       symbol=self.qualname(node), message=message,
+                       snippet=text[:200])
+
+
+# -- baseline ---------------------------------------------------------------
+def load_baseline(path: str = BASELINE_PATH) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    return doc.get("findings", [])
+
+
+def baseline_matches(entry: dict, finding: Finding) -> bool:
+    return (entry.get("rule") == finding.rule
+            and entry.get("path") == finding.path
+            and entry.get("symbol") == finding.symbol
+            and entry.get("contains", "") in finding.snippet)
+
+
+@dataclass
+class Report:
+    findings: List[Finding] = field(default_factory=list)     # live
+    suppressed: List[Finding] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    stale_baseline: List[dict] = field(default_factory=list)
+    files: int = 0
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings and not self.stale_baseline
+
+    def to_dict(self) -> dict:
+        return {
+            "clean": self.clean,
+            "files": self.files,
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": len(self.suppressed),
+            "baselined": len(self.baselined),
+            "stale_baseline": self.stale_baseline,
+        }
+
+
+def iter_py_files(paths: Iterable[str], root: str = REPO) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        p = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if not d.startswith(".")
+                                 and d not in ("__pycache__", "build"))
+            out.extend(os.path.join(dirpath, f) for f in sorted(filenames)
+                       if f.endswith(".py"))
+    return out
+
+
+def run(paths: Iterable[str], root: str = REPO,
+        baseline: Optional[List[dict]] = None,
+        rules: Optional[list] = None) -> Report:
+    """Analyze every .py under `paths`; partition findings against the
+    suppressions and the baseline. `rules` overrides the registry (tests
+    exercise one family at a time)."""
+    from hack.analyze.rules import ALL_RULES
+    active = ALL_RULES if rules is None else rules
+    baseline = load_baseline() if baseline is None else baseline
+    report = Report()
+    matched_entries: Set[int] = set()
+    for path in iter_py_files(paths, root=root):
+        try:
+            ctx = FileContext(path, root=root)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            report.findings.append(Finding(
+                rule="parse-error", path=os.path.relpath(path, root),
+                line=getattr(e, "lineno", 1) or 1, symbol="<module>",
+                message=f"file does not parse: {e}", snippet=""))
+            continue
+        report.files += 1
+        for rule in active:
+            for f in rule.check(ctx):
+                if ctx.is_suppressed(f.rule, f.line):
+                    report.suppressed.append(f)
+                    continue
+                hit = [i for i, e in enumerate(baseline)
+                       if baseline_matches(e, f)]
+                if hit:
+                    matched_entries.update(hit)
+                    report.baselined.append(f)
+                else:
+                    report.findings.append(f)
+    report.stale_baseline = [e for i, e in enumerate(baseline)
+                             if i not in matched_entries]
+    return report

@@ -1,0 +1,13 @@
+"""kt-lint rule registry. Each rule module exports RULE_NAME and
+`check(ctx: FileContext) -> Iterator[Finding]`."""
+
+from hack.analyze.rules import (
+    exception_hygiene,
+    jit_purity,
+    lock_discipline,
+    observability,
+)
+
+ALL_RULES = (jit_purity, lock_discipline, exception_hygiene, observability)
+
+RULE_NAMES = tuple(r.RULE_NAME for r in ALL_RULES)

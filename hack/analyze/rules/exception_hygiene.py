@@ -1,0 +1,79 @@
+"""exception-hygiene: no silent swallows in controller reconcile code.
+
+Every controller's `reconcile()` wraps its body in a broad handler so a
+cloud outage can't crash the manager loop — the right shape. The failure
+mode is the SILENT variant: `except Exception: pass` (or `return`) hides
+a persistent outage until someone notices nodes aren't launching. The
+reference records every reconcile error through controller-runtime's
+error metrics; here the contract is: a broad handler in
+`karpenter_tpu/controllers/` must either record the error somewhere an
+operator can see (cluster event, metric increment, log line) or re-raise
+on every path.
+
+A handler passes if its body contains any of:
+  * a `record_event(...)` call (cluster events surface in /debug/state)
+  * a metrics call: `.inc(` / `.observe(` / `.set(`
+  * a logging call: `.debug/.info/.warn/.error(` or `get_logger(...)`
+  * an unconditional trailing `raise` (the handler only filters —
+    a conditional `raise` with a silent fall-through still fails)
+
+Scope: `except:`, `except Exception`, `except BaseException` in files
+under `karpenter_tpu/controllers/`. Typed handlers (`except ValueError`)
+are policy decisions, not blind spots — out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from hack.analyze.core import FileContext, Finding
+
+RULE_NAME = "exception-hygiene"
+
+_SCOPE = "karpenter_tpu/controllers/"
+_LOG_METHODS = {"debug", "info", "warn", "warning", "error", "exception"}
+_METRIC_METHODS = {"inc", "observe", "set"}
+
+
+def _is_blind(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    return isinstance(t, ast.Name) and t.id in ("Exception", "BaseException")
+
+
+def _records(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            if fn.attr == "record_event":
+                return True
+            if fn.attr in _METRIC_METHODS | _LOG_METHODS:
+                return True
+        elif isinstance(fn, ast.Name) and fn.id == "get_logger":
+            return True
+    return False
+
+
+def _always_raises(handler: ast.ExceptHandler) -> bool:
+    """True when the handler's top-level body ends in an unconditional
+    raise (a pure filter/re-raise handler)."""
+    body = handler.body
+    return bool(body) and isinstance(body[-1], ast.Raise)
+
+
+def check(ctx: FileContext) -> Iterator[Finding]:
+    if not ctx.rel.startswith(_SCOPE):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler) or not _is_blind(node):
+            continue
+        if _records(node) or _always_raises(node):
+            continue
+        yield ctx.finding(
+            RULE_NAME, node,
+            "blind except swallows the error silently — record it "
+            "(record_event / metric / log) or re-raise on every path")

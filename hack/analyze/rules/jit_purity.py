@@ -1,0 +1,241 @@
+"""jit-purity: host effects and recompile hazards inside jitted code.
+
+The solver's SLO ("50k pods × 700 types in <200 ms") dies by a thousand
+cuts: one `.item()` inside a jitted function blocks on the device, one
+`np.asarray` silently round-trips through host memory, one Python branch
+on a traced value throws `TracerBoolConversionError` only on the code
+path that takes it, and one jit wrapper built per call recompiles on
+every invocation. All four are invisible to tests that run the fallback
+path — they must be caught statically.
+
+Flags, inside any function jitted via `@jax.jit`, `@jit`,
+`@partial(jax.jit, ...)` or the `f = partial(jax.jit, ...)(impl)` /
+`f = jax.jit(impl)` assignment forms (nested defs included — they trace
+with the parent):
+
+  * `.item()` calls                       — device→host sync
+  * `float()/int()/bool()` on a traced parameter — forces concretization
+  * any `np.*` / `numpy.*` call           — host array op under trace
+  * `print(...)`                          — host side effect per trace
+  * `time.*` / `_time.*` calls            — host clock reads don't trace
+  * `os.environ` / `os.getenv` reads      — env is a trace-time constant
+  * `if`/`while` on a traced parameter    — TracerBoolConversionError
+    (static_argnames/argnums parameters are exempt; `is None` checks are
+    exempt — they branch on structure, not value)
+  * `static_argnames` naming a parameter the function doesn't have
+  * building a jit wrapper inside a function body — a fresh jit cache
+    per call forces a recompile every invocation
+
+Plus, for the hot-path modules (`solver/solve.py`, `solver/encode.py`,
+`solver/ffd.py`): `print(...)` anywhere — stdout inside the solve path
+is both a latency tax and a tracing side effect.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from hack.analyze.core import FileContext, Finding
+
+RULE_NAME = "jit-purity"
+
+_HOT_PATH = ("karpenter_tpu/solver/solve.py",
+             "karpenter_tpu/solver/encode.py",
+             "karpenter_tpu/solver/ffd.py")
+_NUMPY_ALIASES = {"np", "numpy", "onp"}
+_TIME_ALIASES = {"time", "_time"}
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    """`jax.jit` or a bare `jit` name (from jax import jit)."""
+    if isinstance(node, ast.Attribute) and node.attr == "jit" \
+            and isinstance(node.value, ast.Name) and node.value.id == "jax":
+        return True
+    return isinstance(node, ast.Name) and node.id == "jit"
+
+
+def _jit_partial(node: ast.AST) -> Optional[ast.Call]:
+    """The Call node for `partial(jax.jit, ...)` / `functools.partial(...)`,
+    else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    is_partial = (isinstance(fn, ast.Name) and fn.id == "partial") or (
+        isinstance(fn, ast.Attribute) and fn.attr == "partial")
+    if is_partial and node.args and _is_jax_jit(node.args[0]):
+        return node
+    return None
+
+
+def _jit_call(node: ast.AST) -> Optional[ast.Call]:
+    """The Call node for `jax.jit(...)`, else None."""
+    if isinstance(node, ast.Call) and _is_jax_jit(node.func):
+        return node
+    return None
+
+
+def _static_names(call: Optional[ast.Call], fn: ast.FunctionDef) -> Set[str]:
+    """Parameter names pinned static by static_argnames/static_argnums."""
+    if call is None:
+        return set()
+    params = _param_names(fn)
+    out: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for c in ast.walk(kw.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    out.add(c.value)
+        elif kw.arg == "static_argnums":
+            for c in ast.walk(kw.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, int) \
+                        and 0 <= c.value < len(params):
+                    out.add(params[c.value])
+    return out
+
+
+def _param_names(fn: ast.FunctionDef) -> List[str]:
+    a = fn.args
+    return [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+
+
+def _jitted_functions(ctx: FileContext):
+    """Yield (FunctionDef, jit Call-or-None) for every jitted function:
+    decorator forms plus the module-level `name = jit(...)(impl)` and
+    `name = jax.jit(impl)` assignment forms."""
+    by_name = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.FunctionDef):
+            by_name.setdefault(node.name, node)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                if _is_jax_jit(dec):
+                    yield node, None
+                elif _jit_partial(dec) is not None:
+                    yield node, _jit_partial(dec)
+                elif _jit_call(dec) is not None:
+                    yield node, _jit_call(dec)
+        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = node.value
+            # f = jax.jit(impl, ...)  /  f = partial(jax.jit, ...)(impl)
+            target = None
+            spec: Optional[ast.Call] = None
+            if _jit_call(call) is not None and call.args:
+                target, spec = call.args[0], call
+            elif _jit_partial(call.func) is not None and call.args:
+                target, spec = call.args[0], _jit_partial(call.func)
+            if isinstance(target, ast.Name) and target.id in by_name:
+                yield by_name[target.id], spec
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _is_none_check(test: ast.AST) -> bool:
+    return (isinstance(test, ast.Compare)
+            and all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops))
+
+
+def check(ctx: FileContext) -> Iterator[Finding]:
+    # hot-path stdout guard (module scope included)
+    if ctx.rel in _HOT_PATH:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                    and node.func.id == "print":
+                yield ctx.finding(RULE_NAME, node,
+                                  "print() in the solver hot path")
+
+    seen: Set[int] = set()
+    for fn, spec in _jitted_functions(ctx):
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        params = set(_param_names(fn))
+        static = _static_names(spec, fn)
+        traced = params - static
+        for name in static - params:
+            yield ctx.finding(
+                RULE_NAME, spec or fn,
+                f"static_argnames names '{name}' which is not a parameter "
+                f"of {fn.name}() — jax raises at first call")
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute):
+                    if f.attr == "item":
+                        yield ctx.finding(RULE_NAME, node,
+                                          ".item() forces a device→host "
+                                          "sync inside a jitted function")
+                    elif isinstance(f.value, ast.Name):
+                        if f.value.id in _NUMPY_ALIASES:
+                            yield ctx.finding(
+                                RULE_NAME, node,
+                                f"numpy call ({f.value.id}.{f.attr}) inside "
+                                "a jitted function — host round-trip; use "
+                                "jnp")
+                        elif f.value.id in _TIME_ALIASES:
+                            yield ctx.finding(
+                                RULE_NAME, node,
+                                f"{f.value.id}.{f.attr}() inside a jitted "
+                                "function — host clock reads don't trace")
+                        elif f.value.id == "os" and f.attr == "getenv":
+                            yield ctx.finding(
+                                RULE_NAME, node,
+                                "os.getenv inside a jitted function — env "
+                                "reads bake into the trace")
+                elif isinstance(f, ast.Name):
+                    if f.id == "print":
+                        yield ctx.finding(RULE_NAME, node,
+                                          "print() inside a jitted function")
+                    elif f.id in ("float", "int", "bool") and node.args:
+                        used = _names_in(node.args[0]) & traced
+                        if used:
+                            yield ctx.finding(
+                                RULE_NAME, node,
+                                f"{f.id}() on traced value "
+                                f"({', '.join(sorted(used))}) forces "
+                                "concretization under trace")
+            elif isinstance(node, ast.Attribute) and node.attr == "environ" \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "os":
+                yield ctx.finding(RULE_NAME, node,
+                                  "os.environ read inside a jitted function")
+            elif isinstance(node, (ast.If, ast.While)):
+                if _is_none_check(node.test):
+                    continue
+                used = _names_in(node.test) & traced
+                if used:
+                    yield ctx.finding(
+                        RULE_NAME, node,
+                        f"Python branch on traced value "
+                        f"({', '.join(sorted(used))}) — "
+                        "TracerBoolConversionError at trace time; use "
+                        "lax.cond/jnp.where or mark it static")
+
+    # recompile hazard: a jit wrapper built inside a function body gets a
+    # fresh compilation cache per call. Decorator expressions are not
+    # "inside" the function — they run once at def time.
+    decorator_nodes: Set[int] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            for dec in node.decorator_list:
+                decorator_nodes.update(id(n) for n in ast.walk(dec))
+    flagged: Set[int] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for inner in ast.walk(node):
+            if inner is node or id(inner) in flagged \
+                    or id(inner) in decorator_nodes:
+                continue
+            wrapper = _jit_call(inner) or _jit_partial(inner)
+            if wrapper is not None:
+                flagged.add(id(inner))
+                yield ctx.finding(
+                    RULE_NAME, wrapper,
+                    "jit wrapper constructed inside a function — a fresh "
+                    "jit cache per call recompiles on every invocation; "
+                    "hoist to module scope or cache it")

@@ -1,0 +1,164 @@
+"""lock-discipline: locks held across blocking calls, double-acquires,
+and unbounded flock waits.
+
+The reconcile loops share a handful of process-wide locks (`Cluster`'s
+watch lock, the store backends' RPC/write locks, the batcher's window
+condition, the solver-service client's socket locks). A lock held across
+an HTTP round trip or a socket send turns one wedged peer into a stalled
+control plane; a nested acquire of one non-reentrant `threading.Lock`
+deadlocks outright; a bare `flock(LOCK_EX)` in a run loop blocks the
+replica forever behind a wedged peer process.
+
+Sub-checks (all reported under the one rule name, per-finding
+suppressible):
+
+  * lock-held-across-io — inside `with <lock>:` (any name whose last
+    underscore-part is `lock`/`wlock`/`rlock`/`mutex`; `clock` is not a
+    lock), a call that blocks:
+      - `time.sleep`
+      - anything under `subprocess.`
+      - socket/HTTP verbs: request, getresponse, urlopen, sendall, recv,
+        recvfrom, accept, connect, readline
+      - any method on a receiver that names a connection/stream:
+        *sock*/*conn*/resp/response/rfile/wfile
+      - repo-native I/O helpers: `_request`, `_send`, `_recv`,
+        `_read_exact`, `_status`, `_json`, `send_response`,
+        `send_header`, `end_headers` (store/http.py, store/remote.py,
+        service/client.py wrap their wire I/O in these)
+      - JAX dispatch: block_until_ready, device_put, device_get
+    Condition-variable `.wait(...)` is exempt — waiting releases the
+    lock; that is the mechanism working as designed.
+  * double-acquire — `with <lock>:` nested inside a `with` on the
+    textually identical lock expression in the same function
+    (non-reentrant `threading.Lock` self-deadlocks).
+  * blocking-flock — `fcntl.flock(fd, LOCK_EX)` without `LOCK_NB`: an
+    unbounded wait on a cross-process lock; run loops need a bounded
+    non-blocking retry so a wedged holder demotes the replica instead of
+    freezing it.
+
+Nested `def`/`lambda` bodies under a `with` are skipped — they run
+later, not under the lock.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Optional
+
+from hack.analyze.core import FileContext, Finding
+
+RULE_NAME = "lock-discipline"
+
+_LOCK_PARTS = {"lock", "wlock", "rlock", "mutex"}
+_BLOCKING_METHODS = {"request", "getresponse", "urlopen", "sendall", "recv",
+                     "recvfrom", "accept", "connect", "readline", "sleep",
+                     "block_until_ready", "device_put", "device_get"}
+_REPO_IO_HELPERS = {"_request", "_send", "_recv", "_read_exact", "_status",
+                    "_json", "send_response", "send_header", "end_headers"}
+_CONN_RECEIVER = re.compile(
+    r"(sock|socket|conn|connection|resp|response|rfile|wfile)$")
+
+
+def _last_name(expr: ast.AST) -> str:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return ""
+
+
+def _is_lock_expr(expr: ast.AST) -> bool:
+    name = _last_name(expr).lstrip("_")
+    if not name:
+        return False
+    return any(part in _LOCK_PARTS for part in name.split("_"))
+
+
+def _receiver_name(func: ast.Attribute) -> str:
+    return _last_name(func.value).lstrip("_")
+
+
+def _blocking_reason(call: ast.Call) -> Optional[str]:
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        base = fn.value
+        if isinstance(base, ast.Name) and base.id == "subprocess":
+            return f"subprocess.{fn.attr}"
+        if isinstance(base, ast.Name) and base.id in ("time", "_time") \
+                and fn.attr == "sleep":
+            return "time.sleep"
+        if fn.attr in _BLOCKING_METHODS:
+            return f".{fn.attr}()"
+        if fn.attr in _REPO_IO_HELPERS:
+            return f".{fn.attr}() (wire I/O helper)"
+        if fn.attr not in ("wait", "notify", "notify_all", "acquire",
+                           "release", "close", "socket", "settimeout",
+                           "setsockopt") \
+                and _CONN_RECEIVER.search(_receiver_name(fn)):
+            # close/settimeout/constructor are teardown/setup, not blocking
+            # round trips — only data-path calls count
+            return f"{_receiver_name(fn)}.{fn.attr}()"
+    elif isinstance(fn, ast.Name):
+        if fn.id in _REPO_IO_HELPERS:
+            return f"{fn.id}() (wire I/O helper)"
+        if fn.id == "urlopen":
+            return "urlopen()"
+    return None
+
+
+def _walk_under_lock(stmts: List[ast.stmt]) -> Iterator[ast.AST]:
+    """Walk statements executed while the lock is held: skip nested
+    function/lambda bodies (deferred execution)."""
+    stack: List[ast.AST] = list(stmts)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def check(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                lock_expr = item.context_expr
+                if not _is_lock_expr(lock_expr):
+                    continue
+                lock_text = ast.dump(lock_expr)
+                for inner in _walk_under_lock(node.body):
+                    if isinstance(inner, ast.Call):
+                        reason = _blocking_reason(inner)
+                        if reason is not None:
+                            yield ctx.finding(
+                                RULE_NAME, inner,
+                                f"blocking call {reason} while holding "
+                                f"`{ast.unparse(lock_expr)}` — narrow the "
+                                "critical section so I/O happens outside "
+                                "the lock")
+                    elif isinstance(inner, ast.With):
+                        for ii in inner.items:
+                            if _is_lock_expr(ii.context_expr) and \
+                                    ast.dump(ii.context_expr) == lock_text:
+                                yield ctx.finding(
+                                    RULE_NAME, inner,
+                                    f"`{ast.unparse(lock_expr)}` acquired "
+                                    "while already held — non-reentrant "
+                                    "Lock self-deadlocks")
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr == "flock" \
+                    and len(node.args) >= 2:
+                mode = node.args[1]
+                names = {n.attr for n in ast.walk(mode)
+                         if isinstance(n, ast.Attribute)}
+                names |= {n.id for n in ast.walk(mode)
+                          if isinstance(n, ast.Name)}
+                if "LOCK_EX" in names and "LOCK_NB" not in names:
+                    yield ctx.finding(
+                        RULE_NAME, node,
+                        "fcntl.flock(LOCK_EX) without LOCK_NB blocks "
+                        "unboundedly behind a wedged holder — use a "
+                        "bounded LOCK_NB retry loop")

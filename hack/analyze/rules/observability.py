@@ -1,0 +1,130 @@
+"""observability-conformance: metric and span names must fit the contract.
+
+The metric names are the compatibility surface with the reference's
+dashboards (SURVEY §5: "these metric names are the contract"), and the
+span names feed the Perfetto export where `component.operation` dotted
+grouping is what makes a 50-span provisioning pass readable. Shape
+drift — a counter missing `_total`, a histogram missing its unit, a
+camelCase span — is invisible at runtime and permanent once a dashboard
+depends on it. This rule subsumes the static half of
+`hack/check_metrics_docs.py` (the import-based doc-presence check runs
+from the same `python -m hack.analyze` entry point).
+
+Checks, over string-literal registrations anywhere in the tree:
+
+  * metric families (`_h(...)`/`_c(...)`/`_g(...)` helpers and
+    `REGISTRY.counter/gauge/histogram(...)`):
+      - name matches `[a-z][a-z0-9_]*` and starts with `karpenter_`
+      - counters end `_total`; gauges do NOT end `_total`
+      - histograms end in a unit suffix (_seconds/_bytes/_size/_count/
+        _ratio)
+      - label names match `[a-z][a-z0-9_]*`
+  * span names (`tracing.span(...)`, `tracing.child_span(...)`,
+    `tracing.record_span(...)` and the bare imported forms): lowercase
+    dotted segments `seg(.seg)*`, each `[a-z0-9_]+`
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Optional, Tuple
+
+from hack.analyze.core import FileContext, Finding
+
+RULE_NAME = "observability-conformance"
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_SPAN_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*$")
+_HISTO_SUFFIXES = ("_seconds", "_bytes", "_size", "_count", "_ratio")
+_HELPER_KINDS = {"_h": "histogram", "_c": "counter", "_g": "gauge"}
+_REGISTRY_KINDS = {"histogram": "histogram", "counter": "counter",
+                   "gauge": "gauge"}
+_SPAN_FUNCS = {"span", "child_span", "record_span"}
+
+
+def _registration(call: ast.Call) -> Optional[Tuple[str, ast.Call]]:
+    """(kind, call) when `call` registers a metric family."""
+    fn = call.func
+    if isinstance(fn, ast.Name) and fn.id in _HELPER_KINDS:
+        return _HELPER_KINDS[fn.id], call
+    if isinstance(fn, ast.Attribute) and fn.attr in _REGISTRY_KINDS:
+        base = fn.value
+        if isinstance(base, ast.Name) and "registry" in base.id.lower():
+            return _REGISTRY_KINDS[fn.attr], call
+    return None
+
+
+def _span_name_arg(call: ast.Call) -> Optional[ast.Constant]:
+    fn = call.func
+    named = (isinstance(fn, ast.Attribute) and fn.attr in _SPAN_FUNCS
+             and isinstance(fn.value, ast.Name)
+             and fn.value.id == "tracing") or (
+        isinstance(fn, ast.Name) and fn.id in _SPAN_FUNCS)
+    if not named:
+        return None
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0]
+    return None
+
+
+def check(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        reg = _registration(node)
+        if reg is not None:
+            kind, call = reg
+            if not call.args or not isinstance(call.args[0], ast.Constant) \
+                    or not isinstance(call.args[0].value, str):
+                continue  # dynamic name: can't check statically
+            name = call.args[0].value
+            if not _NAME_RE.match(name):
+                yield ctx.finding(
+                    RULE_NAME, call,
+                    f"metric name '{name}' is not lower_snake_case")
+            if not name.startswith("karpenter_"):
+                yield ctx.finding(
+                    RULE_NAME, call,
+                    f"metric name '{name}' must carry the karpenter_ "
+                    "namespace prefix")
+            if kind == "counter" and not name.endswith("_total"):
+                yield ctx.finding(
+                    RULE_NAME, call,
+                    f"counter '{name}' must end in _total "
+                    "(Prometheus counter convention)")
+            if kind == "gauge" and name.endswith("_total"):
+                yield ctx.finding(
+                    RULE_NAME, call,
+                    f"gauge '{name}' must not end in _total — that suffix "
+                    "marks counters")
+            if kind == "histogram" \
+                    and not name.endswith(_HISTO_SUFFIXES):
+                yield ctx.finding(
+                    RULE_NAME, call,
+                    f"histogram '{name}' needs a unit suffix "
+                    f"({'/'.join(_HISTO_SUFFIXES)})")
+            # label names ride arg 3 (helpers) / kwarg labels
+            label_expr = None
+            if len(call.args) >= 3:
+                label_expr = call.args[2]
+            for kw in call.keywords:
+                if kw.arg in ("labels", "label_names"):
+                    label_expr = kw.value
+            if label_expr is not None:
+                for c in ast.walk(label_expr):
+                    if isinstance(c, ast.Constant) \
+                            and isinstance(c.value, str) \
+                            and not _NAME_RE.match(c.value):
+                        yield ctx.finding(
+                            RULE_NAME, call,
+                            f"label '{c.value}' on '{name}' is not "
+                            "lower_snake_case")
+            continue
+        span_arg = _span_name_arg(node)
+        if span_arg is not None and not _SPAN_RE.match(span_arg.value):
+            yield ctx.finding(
+                RULE_NAME, node,
+                f"span name '{span_arg.value}' is not dotted "
+                "lower_snake_case (component.operation)")

@@ -110,6 +110,11 @@ class Disruption:
         except Exception as e:  # noqa: BLE001 — cloud outage: skip the pass
             if not errors.is_retryable(e):
                 raise
+            from karpenter_tpu.utils.logging import get_logger
+            get_logger(self.name).warn(
+                "disruption pass skipped on retryable error",
+                error=str(e)[:200])
+            metrics.RECONCILE_ERRORS.inc(controller=self.name)
 
     def _reconcile(self) -> None:
         if self._process_commands():

@@ -14,7 +14,8 @@ from __future__ import annotations
 from karpenter_tpu.cloudprovider import TPUCloudProvider
 from karpenter_tpu.cluster import Cluster
 from karpenter_tpu.providers.fake_cloud import INSTANCE_RUNNING
-from karpenter_tpu.utils import errors
+from karpenter_tpu.utils import errors, metrics
+from karpenter_tpu.utils.logging import get_logger
 
 
 class GarbageCollection:
@@ -29,9 +30,14 @@ class GarbageCollection:
             self._reconcile()
         except Exception as e:  # noqa: BLE001
             # GC is cloud-read-heavy; a transient outage just means this
-            # sweep is skipped (pkg/errors taxonomy — retry next round)
+            # sweep is skipped (pkg/errors taxonomy — retry next round).
+            # Skipped-but-visible: a silent swallow hides a persistent
+            # outage (kt-lint exception-hygiene)
             if not errors.is_retryable(e):
                 raise
+            get_logger(self.name).warn(
+                "gc sweep skipped on retryable error", error=str(e)[:200])
+            metrics.RECONCILE_ERRORS.inc(controller=self.name)
 
     def _reconcile(self) -> None:
         claims = self.cluster.nodeclaims.list()

@@ -47,6 +47,11 @@ class Interruption:
             except Exception as e:  # noqa: BLE001 — outage: poll next round
                 if not errors.is_retryable(e):
                     raise
+                from karpenter_tpu.utils.logging import get_logger
+                get_logger(self.name).warn(
+                    "interruption queue poll failed; retry next round",
+                    error=str(e)[:200])
+                metrics.RECONCILE_ERRORS.inc(controller=self.name)
                 return
             if not msgs:
                 return

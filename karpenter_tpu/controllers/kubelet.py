@@ -17,7 +17,8 @@ from karpenter_tpu.models import wellknown
 from karpenter_tpu.models.objects import Node, ObjectMeta
 from karpenter_tpu.models.taints import Taint
 from karpenter_tpu.providers.fake_cloud import INSTANCE_RUNNING, TAG_NODECLAIM
-from karpenter_tpu.utils import errors
+from karpenter_tpu.utils import errors, metrics
+from karpenter_tpu.utils.logging import get_logger
 
 
 class FakeKubelet:
@@ -33,6 +34,10 @@ class FakeKubelet:
         except Exception as e:  # noqa: BLE001 — skip the round on outage
             if not errors.is_retryable(e):
                 raise
+            get_logger(self.name).warn(
+                "kubelet round skipped on retryable error",
+                error=str(e)[:200])
+            metrics.RECONCILE_ERRORS.inc(controller=self.name)
 
     def _reconcile(self) -> None:
         for inst in self.cp.list_instances():

@@ -21,6 +21,8 @@ from typing import List, Optional
 from karpenter_tpu.cluster import Cluster
 from karpenter_tpu.models import wellknown
 from karpenter_tpu.models.objects import NodeClass
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils.logging import get_logger
 
 NODECLASS_FINALIZER = "karpenter.tpu/termination"
 HASH_VERSION = "v1"
@@ -118,11 +120,16 @@ class NodeClassStatus:
                 ", ".join(k for k, v in conds.items() if not v))
         self.cluster.nodeclasses.update(nc)
 
-    @staticmethod
-    def _safe(fn):
+    def _safe(self, fn):
         try:
             return fn()
-        except Exception:  # noqa: BLE001 — discovery failure ⇒ not ready
+        except Exception as e:  # noqa: BLE001 — discovery failure ⇒ not
+            # ready; recorded, not silent (kt-lint exception-hygiene): a
+            # nodeclass stuck NotReady must be attributable to the
+            # discovery call that keeps failing
+            get_logger(self.name).warn(
+                "nodeclass discovery call failed", error=str(e)[:200])
+            metrics.RECONCILE_ERRORS.inc(controller=self.name)
             return None
 
 

@@ -12,7 +12,9 @@ from __future__ import annotations
 
 from typing import Optional
 
+from karpenter_tpu.utils import metrics
 from karpenter_tpu.utils.clock import Clock, RealClock
+from karpenter_tpu.utils.logging import get_logger
 
 DEFAULT_REFRESH_INTERVAL = 300.0  # instance-type cache TTL class (cache.go)
 
@@ -48,8 +50,14 @@ class PricingRefresh(_IntervalController):
     def refresh(self) -> None:
         try:
             self.pricing.update()
-        except Exception:  # noqa: BLE001 — keep the stale book (static
-            pass  # fallback semantics, pricing.go:54-59)
+        except Exception as e:  # noqa: BLE001 — keep the stale book (static
+            # fallback semantics, pricing.go:54-59) — but visibly: a price
+            # book aging silently is how cost regressions go unnoticed
+            # (kt-lint exception-hygiene)
+            get_logger(self.name).warn(
+                "pricing update failed; keeping the stale book",
+                error=str(e)[:200])
+            metrics.RECONCILE_ERRORS.inc(controller=self.name)
 
 
 class InstanceTypeRefresh(_IntervalController):

@@ -10,7 +10,8 @@ from __future__ import annotations
 
 from karpenter_tpu.cluster import Cluster
 from karpenter_tpu.models.objects import COND_REGISTERED
-from karpenter_tpu.utils import errors
+from karpenter_tpu.utils import errors, metrics
+from karpenter_tpu.utils.logging import get_logger
 
 TAG_NAME = "Name"
 TAG_MANAGED_BY = "karpenter.tpu/managed-by"
@@ -31,6 +32,10 @@ class NodeClaimTagging:
         except Exception as e:  # noqa: BLE001 — tagging is cosmetic; retry
             if not errors.is_retryable(e):
                 raise
+            get_logger(self.name).warn(
+                "tagging pass skipped on retryable error",
+                error=str(e)[:200])
+            metrics.RECONCILE_ERRORS.inc(controller=self.name)
 
     def _reconcile(self) -> None:
         for claim in self.cluster.nodeclaims.list():

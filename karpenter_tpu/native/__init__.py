@@ -64,7 +64,11 @@ def hostops() -> Optional[object]:
         path = _ext_path()
         try:
             if not os.path.exists(path):
-                subprocess.run(
+                # the compiler runs under _build_lock on purpose: two
+                # controllers racing here must not spawn two `make`s over
+                # the same output file, and callers are told to warm this
+                # at startup, never inside a solve
+                subprocess.run(  # kt-lint: disable=lock-discipline
                     ["make", "-s", "hostops"], cwd=_NATIVE_DIR, timeout=120,
                     check=True, capture_output=True)
             _hostops = _load(path)

@@ -66,14 +66,32 @@ class FileLease:
         self.path = path
         self._lock_path = path + ".lock"
 
+    # bounded so a wedged peer process holding the flock demotes this
+    # replica (try_acquire returns False, the elector stays standby and
+    # retries) instead of freezing its run loop on an unbounded LOCK_EX
+    # wait (kt-lint lock-discipline)
+    FLOCK_TIMEOUT = 0.5
+
     def _with_flock(self, fn):
+        """Run `fn` under the sidecar flock; returns None (without running
+        `fn`) when the flock stays contended past FLOCK_TIMEOUT."""
         import fcntl
         fd = os.open(self._lock_path, os.O_CREAT | os.O_RDWR, 0o644)
         try:
-            fcntl.flock(fd, fcntl.LOCK_EX)
-            return fn()
+            deadline = time.monotonic() + self.FLOCK_TIMEOUT
+            while True:
+                try:
+                    fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                    break
+                except OSError:
+                    if time.monotonic() >= deadline:
+                        return None
+                    time.sleep(0.01)
+            try:
+                return fn()
+            finally:
+                fcntl.flock(fd, fcntl.LOCK_UN)
         finally:
-            fcntl.flock(fd, fcntl.LOCK_UN)
             os.close(fd)
 
     def _read(self) -> dict:
@@ -98,7 +116,9 @@ class FileLease:
                 self._write({"holder": identity, "expiry": now + duration})
                 return True
             return False
-        return self._with_flock(attempt)
+        # contended flock (None) = another replica is mid-acquire: report
+        # not-acquired; the elector retries on its retry_period cadence
+        return bool(self._with_flock(attempt))
 
     def release(self, identity: str) -> None:
         def attempt():

@@ -54,9 +54,20 @@ class SolverServiceClient:
         with self._lock:
             if self._sock is not None:
                 return self._sock
-            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-            s.settimeout(self.timeout)
+        # connect OUTSIDE the lock: a wedged daemon would otherwise stall
+        # every caller behind _lock for the full connect timeout (kt-lint
+        # lock-discipline); losers of the install race close their socket
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(self.timeout)
+        try:
             s.connect(self.socket_path)
+        except OSError:
+            s.close()
+            raise
+        with self._lock:
+            if self._sock is not None:
+                s.close()
+                return self._sock
             self._sock = s
             # a fresh connection may face a restarted daemon with an empty
             # catalog store — re-upload on demand
@@ -135,7 +146,11 @@ class SolverServiceClient:
         frame = struct.pack("<IQ", len(payload), rid) + payload
         try:
             with self._wlock:
-                sock.sendall(frame)
+                # holding the write lock across sendall is load-bearing:
+                # frames from concurrent senders must not interleave on
+                # the shared socket — responses are matched by request id,
+                # so only the WRITE needs serializing, and this is it
+                sock.sendall(frame)  # kt-lint: disable=lock-discipline
         except OSError as e:
             with self._lock:
                 self._pending.pop(rid, None)

@@ -124,14 +124,20 @@ class FakeApiServer:
                     return server._serve_watch(
                         self, kind,
                         int(q.get("resourceVersion", ["0"])[0]))
+                # snapshot under the lock, write the response outside it —
+                # a slow reader must not stall every writer behind the
+                # store lock (kt-lint lock-discipline)
+                items = rv = None
                 with server._lock:
                     if name is not None:
                         item = server._data.get(kind, {}).get(name)
-                        if item is None:
-                            return self._status(404, "NotFound")
-                        return self._json(200, item)
-                    items = list(server._data.get(kind, {}).values())
-                    rv = server._rv
+                    else:
+                        items = list(server._data.get(kind, {}).values())
+                        rv = server._rv
+                if name is not None:
+                    if item is None:
+                        return self._status(404, "NotFound")
+                    return self._json(200, item)
                 return self._json(200, {
                     "kind": kind.capitalize() + "List",
                     "apiVersion": "karpenter.tpu/v1",
@@ -158,8 +164,11 @@ class FakeApiServer:
                     return self._status(422, "Invalid")
                 with server._lock:
                     if name in server._data.setdefault(kind, {}):
-                        return self._status(409, "AlreadyExists")
-                    stored = server._commit(kind, name, item, "ADDED")
+                        stored = None
+                    else:
+                        stored = server._commit(kind, name, item, "ADDED")
+                if stored is None:
+                    return self._status(409, "AlreadyExists")
                 return self._json(201, stored)
 
             def do_PUT(self):
@@ -174,8 +183,11 @@ class FakeApiServer:
                 with server._lock:
                     if name not in server._data.setdefault(kind, {}):
                         # modify-of-deleted: the apiserver-404 analogue
-                        return self._status(404, "NotFound")
-                    stored = server._commit(kind, name, item, "MODIFIED")
+                        stored = None
+                    else:
+                        stored = server._commit(kind, name, item, "MODIFIED")
+                if stored is None:
+                    return self._status(404, "NotFound")
                 return self._json(200, stored)
 
             def do_DELETE(self):
@@ -184,13 +196,15 @@ class FakeApiServer:
                     return self._status(404, "NotFound")
                 with server._lock:
                     item = server._data.get(kind, {}).pop(name, None)
-                    if item is None:
-                        return self._status(404, "NotFound")
-                    server._rv += 1
-                    tomb = dict(item)
-                    tomb["metadata"] = dict(item["metadata"],
-                                            resourceVersion=str(server._rv))
-                    server._append_event(kind, "DELETED", tomb)
+                    if item is not None:
+                        server._rv += 1
+                        tomb = dict(item)
+                        tomb["metadata"] = dict(
+                            item["metadata"],
+                            resourceVersion=str(server._rv))
+                        server._append_event(kind, "DELETED", tomb)
+                if item is None:
+                    return self._status(404, "NotFound")
                 return self._json(200, tomb)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
@@ -223,10 +237,13 @@ class FakeApiServer:
     # -- watch -------------------------------------------------------------
     def _serve_watch(self, handler, kind: str, rv: int) -> None:
         with self._lock:
-            if self._log and rv < self._log[0][0] - 1 and rv > 0:
-                # the requested horizon fell off the log: 410 Gone, the
-                # client relists (informer ListAndWatch recovery)
-                return handler._status(410, "Expired")
+            # decide under the lock, answer outside it — the 410 write
+            # must not ride the store lock (kt-lint lock-discipline)
+            expired = bool(self._log) and 0 < rv < self._log[0][0] - 1
+        if expired:
+            # the requested horizon fell off the log: 410 Gone, the
+            # client relists (informer ListAndWatch recovery)
+            return handler._status(410, "Expired")
         handler.send_response(200)
         handler.send_header("Content-Type", "application/json")
         handler.send_header("Transfer-Encoding", "chunked")
@@ -321,24 +338,37 @@ class HttpBackend:
         headers = {"Content-Type": "application/json"} if payload else {}
         with tracing.child_span("store.http.request", method=method,
                                 path=path) as _sp:
-            with self._rpc_lock:
-                for attempt in (0, 1):
-                    if self._rpc_conn is None:
-                        self._rpc_conn = self._conn()
+            for attempt in (0, 1):
+                # check the keep-alive connection out of its one-slot
+                # pool and run the round trip OUTSIDE the lock: holding
+                # _rpc_lock across the wire call serialized every caller
+                # behind one slow response (kt-lint lock-discipline). A
+                # concurrent caller finding the slot empty pays a fresh
+                # connection instead of waiting.
+                with self._rpc_lock:
+                    conn, self._rpc_conn = self._rpc_conn, None
+                if conn is None:
+                    conn = self._conn()
+                try:
+                    conn.request(method, path, body=payload,
+                                 headers=headers)
+                    resp = conn.getresponse()
+                    data = resp.read()
+                except (OSError, http.client.HTTPException):
                     try:
-                        self._rpc_conn.request(method, path, body=payload,
-                                               headers=headers)
-                        resp = self._rpc_conn.getresponse()
-                        data = resp.read()
-                        break
-                    except (OSError, http.client.HTTPException):
-                        try:
-                            self._rpc_conn.close()
-                        except OSError:
-                            pass
-                        self._rpc_conn = None
-                        if attempt:
-                            raise
+                        conn.close()
+                    except OSError:
+                        pass
+                    if attempt:
+                        raise
+                    continue
+                with self._rpc_lock:
+                    if self._rpc_conn is None and not self._closed:
+                        self._rpc_conn = conn  # back into the pool
+                        conn = None
+                if conn is not None:
+                    conn.close()
+                break
             if _sp is not None:
                 _sp.attrs["status"] = resp.status
         try:

@@ -274,6 +274,12 @@ NODECLAIMS_INITIALIZED = _c(
 NODECLAIMS_TERMINATED = _c(
     "karpenter_nodeclaims_terminated_total",
     "NodeClaims terminated.", ("nodepool",))
+RECONCILE_ERRORS = _c(
+    "karpenter_tpu_controller_reconcile_errors_total",
+    "Errors a controller swallowed to keep the manager loop alive "
+    "(retryable cloud outages, discovery failures), by controller. A "
+    "silent swallow hides a persistent outage; this family is the "
+    "kt-lint exception-hygiene contract's metrics half.", ("controller",))
 INTERRUPTION_MESSAGES = _c(
     "karpenter_interruption_received_messages_total",
     "Interruption-queue messages received.", ("message_type",))

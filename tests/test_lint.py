@@ -1,0 +1,405 @@
+"""Tier-1 wiring for kt-lint (`python -m hack.analyze`, ISSUE 3).
+
+Three contracts:
+  * the repo is clean — zero findings outside baseline.json, zero stale
+    baseline entries (future PRs cannot reintroduce the flagged classes)
+  * each rule family detects its target pattern (positive), stays quiet
+    on the legitimate variant (negative), and honors
+    `# kt-lint: disable=<rule>` (suppressed)
+  * every baseline.json entry still resolves to a real finding — a fixed
+    finding must be removed from the baseline, not ride along forever
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from hack.analyze import core  # noqa: E402
+from hack.analyze.rules import (  # noqa: E402
+    exception_hygiene,
+    jit_purity,
+    lock_discipline,
+    observability,
+)
+
+
+def _check(tmp_path, source, rule, relname="snippet.py"):
+    """Run one rule over a fixture file; returns (findings, report)."""
+    p = tmp_path / relname
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(source))
+    report = core.run([str(p)], root=str(tmp_path), baseline=[],
+                      rules=[rule])
+    return report.findings, report
+
+
+# -- the repo gate ---------------------------------------------------------
+def test_repo_has_no_unsuppressed_findings():
+    report = core.run(["karpenter_tpu"], root=REPO)
+    assert report.findings == [], "\n".join(f.render()
+                                            for f in report.findings)
+    assert report.stale_baseline == []
+
+
+def test_cli_exits_zero_on_the_repo():
+    # the acceptance-criterion invocation, including the migrated
+    # metrics-docs check
+    proc = subprocess.run(
+        [sys.executable, "-m", "hack.analyze", "karpenter_tpu",
+         "--format", "json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["clean"] is True
+    assert doc["files"] > 50
+
+
+# -- jit-purity ------------------------------------------------------------
+_JIT_BAD = """
+    import os
+    import time
+
+    import jax
+    import numpy as np
+
+
+    @jax.jit
+    def bad(x):
+        y = x.item()
+        print(y)
+        z = np.asarray(x)
+        t = time.time()
+        home = os.environ["HOME"]
+        if x > 0:
+            return float(x)
+        return x
+"""
+
+
+def test_jit_purity_flags_host_effects(tmp_path):
+    findings, _ = _check(tmp_path, _JIT_BAD, jit_purity)
+    msgs = " | ".join(f.message for f in findings)
+    assert ".item()" in msgs
+    assert "print()" in msgs
+    assert "numpy call" in msgs
+    assert "host clock" in msgs
+    assert "os.environ" in msgs
+    assert "branch on traced value" in msgs
+    assert "float() on traced value" in msgs
+
+
+def test_jit_purity_static_args_and_host_code_are_exempt(tmp_path):
+    findings, _ = _check(tmp_path, """
+        import jax
+        from functools import partial
+
+
+        @partial(jax.jit, static_argnames=("n",))
+        def ok(x, n):
+            if n > 2:          # static: branch is trace-time, fine
+                return x * n
+            return x
+
+
+        def host_only(arr):
+            return arr.item()  # not jitted: host sync is the point
+    """, jit_purity)
+    assert findings == []
+
+
+def test_jit_purity_sees_the_assignment_form_and_bad_static_names(tmp_path):
+    findings, _ = _check(tmp_path, """
+        import jax
+        from functools import partial
+
+
+        def _impl(x, k):
+            return x.item()
+
+
+        solve = partial(jax.jit, static_argnames=("k", "zz"))(_impl)
+    """, jit_purity)
+    msgs = " | ".join(f.message for f in findings)
+    assert ".item()" in msgs
+    assert "'zz'" in msgs and "not a parameter" in msgs
+
+
+def test_jit_purity_flags_wrapper_built_per_call(tmp_path):
+    findings, _ = _check(tmp_path, """
+        import jax
+
+
+        def fresh_every_call(f, x):
+            return jax.jit(f)(x)
+    """, jit_purity)
+    assert any("fresh jit cache" in f.message for f in findings)
+    # module-level construction is the idiom, not a hazard
+    findings, _ = _check(tmp_path, """
+        import jax
+
+
+        def _impl(x):
+            return x
+
+
+        g = jax.jit(_impl)
+    """, jit_purity)
+    assert findings == []
+
+
+def test_jit_purity_suppression(tmp_path):
+    _, report = _check(tmp_path, """
+        import jax
+
+
+        @jax.jit
+        def measured(x):
+            return x.item()  # kt-lint: disable=jit-purity
+    """, jit_purity)
+    assert report.findings == []
+    assert len(report.suppressed) == 1
+
+
+# -- lock-discipline -------------------------------------------------------
+_LOCK_BAD = """
+    import threading
+    import time
+
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def sleeps_under_lock(self):
+            with self._lock:
+                time.sleep(1)
+
+        def sends_under_lock(self, sock, frame):
+            with self._lock:
+                sock.sendall(frame)
+
+        def double_acquire(self):
+            with self._lock:
+                with self._lock:
+                    return 1
+"""
+
+
+def test_lock_discipline_flags_blocking_and_reacquire(tmp_path):
+    findings, _ = _check(tmp_path, _LOCK_BAD, lock_discipline)
+    msgs = " | ".join(f.message for f in findings)
+    assert "time.sleep" in msgs
+    assert ".sendall()" in msgs
+    assert "already held" in msgs
+    assert len(findings) == 3
+
+
+def test_lock_discipline_negatives(tmp_path):
+    findings, _ = _check(tmp_path, """
+        import threading
+        import time
+
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Condition()
+                self.clock = object()
+
+            def pure_update(self):
+                with self._lock:
+                    self.n = 1
+
+            def condition_wait_is_the_mechanism(self):
+                with self._lock:
+                    self._lock.wait(timeout=0.5)
+
+            def deferred_closure_runs_later(self, sock):
+                with self._lock:
+                    def later():
+                        sock.sendall(b"x")
+                    self.cb = later
+
+            def clock_is_not_a_lock(self):
+                with self.clock:
+                    time.sleep(0)
+    """, lock_discipline)
+    assert findings == []
+
+
+def test_lock_discipline_flock(tmp_path):
+    findings, _ = _check(tmp_path, """
+        import fcntl
+
+
+        def blocking(fd):
+            fcntl.flock(fd, fcntl.LOCK_EX)
+
+
+        def bounded(fd):
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    """, lock_discipline)
+    assert len(findings) == 1
+    assert "LOCK_NB" in findings[0].message
+    assert findings[0].symbol == "blocking"
+
+
+def test_lock_discipline_suppression(tmp_path):
+    _, report = _check(tmp_path, """
+        import threading
+
+
+        class C:
+            def __init__(self):
+                self._wlock = threading.Lock()
+
+            def serialized_frame_write(self, sock, frame):
+                with self._wlock:
+                    sock.sendall(frame)  # kt-lint: disable=lock-discipline
+    """, lock_discipline)
+    assert report.findings == []
+    assert len(report.suppressed) == 1
+
+
+# -- exception-hygiene -----------------------------------------------------
+_CTRL = "karpenter_tpu/controllers/demo.py"
+
+
+def test_exception_hygiene_flags_silent_swallows(tmp_path):
+    findings, _ = _check(tmp_path, """
+        def reconcile(self):
+            try:
+                self._reconcile()
+            except Exception:
+                pass
+            try:
+                self._other()
+            except:  # noqa: E722
+                return
+    """, exception_hygiene, relname=_CTRL)
+    assert len(findings) == 2
+
+
+def test_exception_hygiene_accepts_recorded_or_reraised(tmp_path):
+    findings, _ = _check(tmp_path, """
+        def reconcile(self):
+            try:
+                self._reconcile()
+            except Exception as e:
+                self.cluster.record_event("NodeClaim", "x", "Err", str(e))
+            try:
+                self._b()
+            except Exception as e:
+                log.warn("skipped", error=str(e))
+            try:
+                self._c()
+            except Exception as e:
+                metrics.RECONCILE_ERRORS.inc(controller=self.name)
+            try:
+                self._d()
+            except Exception:
+                raise
+            try:
+                self._e()
+            except ValueError:
+                pass  # typed: a policy decision, out of scope
+    """, exception_hygiene, relname=_CTRL)
+    assert findings == []
+
+
+def test_exception_hygiene_conditional_raise_still_fails(tmp_path):
+    # `if not retryable: raise` with a silent fall-through is exactly the
+    # swallow the rule exists for
+    findings, _ = _check(tmp_path, """
+        def reconcile(self):
+            try:
+                self._reconcile()
+            except Exception as e:
+                if not errors.is_retryable(e):
+                    raise
+    """, exception_hygiene, relname=_CTRL)
+    assert len(findings) == 1
+
+
+def test_exception_hygiene_scoped_to_controllers(tmp_path):
+    findings, _ = _check(tmp_path, """
+        def watcher(self):
+            try:
+                self._loop()
+            except Exception:
+                pass
+    """, exception_hygiene, relname="karpenter_tpu/store/demo.py")
+    assert findings == []
+
+
+def test_exception_hygiene_suppression(tmp_path):
+    _, report = _check(tmp_path, """
+        def reconcile(self):
+            try:
+                self._reconcile()
+            except Exception:  # kt-lint: disable=exception-hygiene
+                pass
+    """, exception_hygiene, relname=_CTRL)
+    assert report.findings == []
+    assert len(report.suppressed) == 1
+
+
+# -- observability-conformance --------------------------------------------
+def test_observability_shape_checks(tmp_path):
+    findings, _ = _check(tmp_path, """
+        BAD_COUNTER = _c("karpenter_bad_counter", "no _total")
+        BAD_HISTO = _h("karpenter_hist_stuff", "no unit suffix")
+        BAD_GAUGE = _g("karpenter_gauge_total", "counter suffix on gauge")
+        BAD_PREFIX = _c("other_thing_total", "wrong namespace")
+        BAD_LABEL = _c("karpenter_ok_total", "bad label", ("Zone",))
+        OK = _h("karpenter_fine_duration_seconds", "ok", ("phase",))
+    """, observability)
+    msgs = " | ".join(f.message for f in findings)
+    assert "must end in _total" in msgs
+    assert "needs a unit suffix" in msgs
+    assert "must not end in _total" in msgs
+    assert "karpenter_ namespace prefix" in msgs
+    assert "label 'Zone'" in msgs
+    assert not any("karpenter_fine_duration_seconds" in f.message
+                   for f in findings)
+
+
+def test_observability_span_names(tmp_path):
+    findings, _ = _check(tmp_path, """
+        from karpenter_tpu.utils import tracing
+
+
+        def work():
+            with tracing.span("Bad-Span"):
+                pass
+            with tracing.span("provisioning.pass", pods=3):
+                pass
+    """, observability)
+    assert len(findings) == 1
+    assert "Bad-Span" in findings[0].message
+
+
+# -- baseline workflow -----------------------------------------------------
+def test_baseline_entries_still_resolve():
+    """Every grandfathered entry must match a finding the analyzer still
+    produces — entries whose code was fixed must be deleted."""
+    entries = core.load_baseline()
+    assert entries, "baseline.json should carry the grandfathered findings"
+    raw = core.run(["karpenter_tpu"], root=REPO, baseline=[])
+    for entry in entries:
+        assert any(core.baseline_matches(entry, f) for f in raw.findings), \
+            f"stale baseline entry (fix landed? remove it): {entry}"
+
+
+def test_stale_baseline_entry_is_an_error():
+    bogus = [{"rule": "lock-discipline", "path": "karpenter_tpu/nope.py",
+              "symbol": "gone", "contains": "x", "reason": "stale"}]
+    report = core.run(["karpenter_tpu"], root=REPO,
+                      baseline=core.load_baseline() + bogus)
+    assert bogus[0] in report.stale_baseline
+    assert not report.clean
