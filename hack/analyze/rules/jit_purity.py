@@ -75,19 +75,36 @@ def _jit_call(node: ast.AST) -> Optional[ast.Call]:
     return None
 
 
-def _static_names(call: Optional[ast.Call], fn: ast.FunctionDef) -> Set[str]:
+def _module_constants(tree: ast.Module) -> dict:
+    """Module-level `NAME = <expr>` assignments — the shared-statics
+    idiom (`_SWEEP_STATICS = ("max_nodes", ...)` reused across a jitted
+    wrapper and its donated variant) must resolve the same as an inline
+    literal tuple."""
+    out: dict = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            out[node.targets[0].id] = node.value
+    return out
+
+
+def _static_names(call: Optional[ast.Call], fn: ast.FunctionDef,
+                  consts: Optional[dict] = None) -> Set[str]:
     """Parameter names pinned static by static_argnames/static_argnums."""
     if call is None:
         return set()
     params = _param_names(fn)
     out: Set[str] = set()
     for kw in call.keywords:
+        value = kw.value
+        if isinstance(value, ast.Name) and consts:
+            value = consts.get(value.id, value)
         if kw.arg == "static_argnames":
-            for c in ast.walk(kw.value):
+            for c in ast.walk(value):
                 if isinstance(c, ast.Constant) and isinstance(c.value, str):
                     out.add(c.value)
         elif kw.arg == "static_argnums":
-            for c in ast.walk(kw.value):
+            for c in ast.walk(value):
                 if isinstance(c, ast.Constant) and isinstance(c.value, int) \
                         and 0 <= c.value < len(params):
                     out.add(params[c.value])
@@ -148,12 +165,13 @@ def check(ctx: FileContext) -> Iterator[Finding]:
                                   "print() in the solver hot path")
 
     seen: Set[int] = set()
+    consts = _module_constants(ctx.tree)
     for fn, spec in _jitted_functions(ctx):
         if id(fn) in seen:
             continue
         seen.add(id(fn))
         params = set(_param_names(fn))
-        static = _static_names(spec, fn)
+        static = _static_names(spec, fn, consts)
         traced = params - static
         for name in static - params:
             yield ctx.finding(

@@ -51,6 +51,49 @@ class Provisioner:
         # the oldest pending pod's age must shrink to zero as the
         # backlog drains — designs/limits.md:23-25 liveness discipline)
         self._first_pending: dict = {}
+        self._warmup_started = False
+
+    # -- startup warm-up (solver padding-bucket precompile) ---------------
+    def _maybe_warmup(self) -> None:
+        """Fire the solver's padding-bucket precompile ONCE per process,
+        in a background thread, gated by KARPENTER_TPU_WARMUP (off by
+        default: unit tests and tiny deployments must not pay a compile
+        storm at construction; production sets it so the first real
+        burst meets a fully-compiled kernel lattice — docs/solver-
+        pipeline.md).  A synthetic one-pod input pins the catalog and
+        existing-node buckets; the extra G-bucket shapes cover burst
+        sizes up to the 50k headline class."""
+        if self._warmup_started:
+            return
+        import os
+        raw = os.environ.get("KARPENTER_TPU_WARMUP", "").strip().lower()
+        if raw in ("", "0", "off", "false"):
+            self._warmup_started = True
+            return
+        if not self.cluster.nodepools.list(lambda p: not p.meta.deleting):
+            return  # no catalog yet — retry on a later pass
+        self._warmup_started = True
+
+        def _run():
+            from karpenter_tpu.utils.logging import get_logger
+            try:
+                from karpenter_tpu.models.resources import Resources
+                pod = Pod(meta=ObjectMeta(name="karpenter-warmup"),
+                          requests=Resources.parse(
+                              {"cpu": "100m", "memory": "128Mi"}))
+                inp = self._build_input([pod])
+                e = len(inp.existing_nodes)
+                warmed = self.solver.warmup(inp, shapes=((8, e), (512, e)))
+                get_logger("provisioning").info(
+                    "solver warm-up complete", programs=warmed)
+            except Exception as exc:  # noqa: BLE001
+                get_logger("provisioning").warn(
+                    "solver warm-up failed; first solves compile cold",
+                    error=str(exc)[:200])
+
+        import threading
+        threading.Thread(target=_run, name="solver-warmup",
+                         daemon=True).start()
 
     # -- batching (settings.md BATCH_IDLE/MAX_DURATION) -------------------
     def _batch_ready(self, pending: List[Pod]) -> bool:
@@ -73,6 +116,7 @@ class Provisioner:
 
     # -- reconcile --------------------------------------------------------
     def reconcile(self) -> None:
+        self._maybe_warmup()
         pending = [
             p for p in self.cluster.pending_pods()
             if NOMINATED_ANNOTATION not in p.meta.annotations

@@ -83,6 +83,26 @@ class GatedSolver:
                           source=source):
             return Scheduler(inp).solve()
 
+    def warmup(self, inp: ScheduleInput, shapes=()) -> int:
+        """Padding-bucket precompile at operator startup (never on the
+        solve path): delegates to the in-process solver's warmup() or the
+        solverd client's remote variant.  Best-effort — a warm-up failure
+        must degrade to cold first-solve compiles, never block or crash
+        the operator."""
+        if not self.options.feature_gates.tpu_solver:
+            return 0
+        fn = getattr(self.tpu, "warmup", None)
+        if fn is None:
+            return 0
+        try:
+            return fn(inp, shapes=shapes)
+        except Exception as e:  # noqa: BLE001
+            from karpenter_tpu.utils.logging import get_logger
+            get_logger("solver").warn(
+                "solver warm-up failed; first solves compile cold",
+                error=str(e)[:200])
+            return 0
+
     def solve_batch(self, inps: List[ScheduleInput],
                     source: str = "disruption",
                     max_nodes: Optional[int] = None):

@@ -104,6 +104,32 @@ def handle_batch(payloads: List[bytes]) -> List[bytes]:
         elif kind == "stats":
             responses[i] = ("result", {"batch_sizes": list(_batch_log),
                                        "catalogs": len(_catalogs)})
+        elif kind == "warmup":
+            # padding-bucket precompile against an uploaded catalog: the
+            # operator fires this at startup so the daemon's first real
+            # schedule request meets a fully-compiled kernel lattice
+            # (solve.py warmup; the persistent compile cache makes a
+            # daemon RESTART skip even this step's XLA work)
+            fp = body.get("fingerprint")
+            if fp not in _catalogs:
+                responses[i] = ("need_catalog", None)
+                continue
+            nodepools, instance_types = _catalogs[fp]
+            try:
+                inp = ScheduleInput(
+                    pods=body.get("pods") or [],
+                    nodepools=nodepools,
+                    instance_types=instance_types,
+                    existing_nodes=body.get("existing_nodes") or [],
+                    daemon_overhead=body.get("daemon_overhead") or {},
+                    remaining_limits=body.get("remaining_limits") or {},
+                )
+                warmed = _get_solver().warmup(
+                    inp, shapes=tuple(body.get("shapes") or ()),
+                    batch_sizes=tuple(body.get("batch_sizes") or (1,)))
+                responses[i] = ("result", {"warmed": warmed})
+            except Exception as e:  # noqa: BLE001
+                responses[i] = ("error", f"warmup failed: {e}")
 
     # schedule requests grouped by (catalog fingerprint, max_nodes) → one
     # device batch per group (the coalescing the C++ window exists to

@@ -230,6 +230,37 @@ class SolverServiceClient:
             raise SolverServiceError(f"stats failed: {body}")
         return body
 
+    def warmup(self, inp: ScheduleInput, shapes=(),
+               batch_sizes=(1,), _retry: bool = True) -> int:
+        """Remote padding-bucket precompile (solve.py TPUSolver.warmup):
+        ships a representative input so the daemon pre-traces the kernel
+        lattice before the first latency-sensitive schedule request.
+        Returns the number of programs warmed."""
+        fp, payload = self._fingerprint(inp)
+        self._ensure_catalog(fp, payload)
+        rid = self._send("warmup", {
+            "fingerprint": fp,
+            "pods": inp.pods,
+            "existing_nodes": inp.existing_nodes,
+            "daemon_overhead": inp.daemon_overhead,
+            "remaining_limits": inp.remaining_limits,
+            "shapes": tuple(shapes),
+            "batch_sizes": tuple(batch_sizes),
+        })
+        kind, body = self._wait(rid)
+        if kind == "need_catalog":
+            # restarted-empty daemon: same ledger-invalidation-and-replay
+            # discipline as solve_batch (one retry, then raise)
+            self._uploaded.clear()
+            if not _retry:
+                raise SolverServiceError(
+                    "service lost the catalog again after re-upload")
+            return self.warmup(inp, shapes=shapes,
+                               batch_sizes=batch_sizes, _retry=False)
+        if kind != "result":
+            raise SolverServiceError(f"warmup failed: {body}")
+        return int(body.get("warmed", 0))
+
     # -- the solver seam ---------------------------------------------------
     def solve(self, inp: ScheduleInput,
               max_nodes: Optional[int] = None) -> ScheduleResult:

@@ -45,12 +45,30 @@ flow, no recompiles inside the latency budget).
 
 from __future__ import annotations
 
+from collections import deque
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 
 EPS = 1e-3
+
+# -- trace/compile telemetry ---------------------------------------------
+# A jit cache miss re-executes the traced Python body (exactly once per
+# miss under plain jit), and a retrace is the only event that can trigger
+# an XLA compile — the persistent compilation cache can make a compile
+# cheap, but never make a trace invisible.  Counting body executions
+# therefore counts compiles conservatively: warmup() relies on this to
+# assert "zero compiles on the first real solve after warm-up"
+# (tests/test_solver_pipeline.py) without reaching into jax internals.
+TRACE_COUNT = 0
+TRACE_LOG: deque = deque(maxlen=256)  # recent trace shape keys (debug)
+
+
+def _note_trace(**statics) -> None:
+    global TRACE_COUNT
+    TRACE_COUNT += 1
+    TRACE_LOG.append(statics)
 # NOTE: no module-level jnp constants here — materializing a device array
 # at import time eagerly initializes whatever backend the site default
 # points at; importing the solver must never touch a device. The BIG
@@ -141,7 +159,7 @@ def _water_fill(cnt, base, xmax, elig, skew, mindom):
 
 
 def _expand_packed_mask(m, O: int):
-    """[R, ceil(O/8)] uint8 -> [R, O] bool: byte-gather along the column
+    """[G, ceil(O/8)] uint8 -> [G, O] bool: byte-gather along the column
     axis + bit shift (host side packs with np.packbits
     bitorder="little").  The shape assert is trace-time-free and turns a
     mask packed at the wrong column count (JAX would silently CLAMP the
@@ -197,6 +215,17 @@ def _solve_ffd_impl(
                                   # touches at most c existing nodes.
                                   # Caller guarantees K >= max group count
                                   # so the sparse form is lossless.
+    sparse_n: int = 0,            # static: >0 packs take_new the same way
+                                  # — top-K (count, index) pairs per group
+                                  # plus the per-group nonzero COUNT, so
+                                  # the host can verify losslessness (the
+                                  # new-node fan-out, unlike take_exist's,
+                                  # is only warm-start-estimated; on
+                                  # overflow the caller re-runs dense).
+                                  # The single-problem path's dense [G, N]
+                                  # row is its dominant result download
+                                  # the same way take_exist is the
+                                  # sweep's.
     mask_packed: bool = False,    # static: group_mask arrives bit-packed
                                   # as [G, ceil(O/8)] uint8 (little bit
                                   # order) and is expanded on device —
@@ -211,6 +240,9 @@ def _solve_ffd_impl(
     O = col_alloc.shape[0]
     PT = pt_alloc.shape[0]
     assert O == PT * zc, (O, PT, zc)
+    _note_trace(G=G, E=E, O=O, N=max_nodes, D=group_dbase.shape[1],
+                with_topology=with_topology, sparse_k=sparse_k,
+                sparse_n=sparse_n, mask_packed=mask_packed)
     if mask_packed:
         group_mask = _expand_packed_mask(group_mask, O)
 
@@ -649,8 +681,30 @@ def _solve_ffd_impl(
                 te_idx.astype(jnp.float32).reshape(-1)]      # G*K
     else:
         head = [outs["take_exist"].astype(jnp.float32).reshape(-1)]  # G*E
-    packed = jnp.concatenate(head + [
-        outs["take_new"].astype(jnp.float32).reshape(-1),    # G*N
+    if sparse_n:
+        # same prefix-sum-rank compaction for the NEW-node rows, plus the
+        # per-group nonzero count: unlike take_exist (where K bounds the
+        # group size by construction), the new-node fan-out is only
+        # estimated from the previous solve, so the count row is the
+        # host's lossless check — overflow re-runs dense (solve.py)
+        tn = outs["take_new"]                                # [G, N] i32
+        nzn = tn > 0
+        rankn = jnp.cumsum(nzn.astype(jnp.int32), axis=1) - 1
+        slotn = jnp.where(nzn, rankn, sparse_n)              # Kn = dropped
+        gin = jnp.broadcast_to(
+            jnp.arange(tn.shape[0], dtype=jnp.int32)[:, None], tn.shape)
+        nin = jnp.broadcast_to(
+            jnp.arange(tn.shape[1], dtype=jnp.int32)[None, :], tn.shape)
+        tn_cnt = jnp.zeros((tn.shape[0], sparse_n), tn.dtype).at[
+            gin, slotn].set(tn, mode="drop")
+        tn_idx = jnp.zeros((tn.shape[0], sparse_n), jnp.int32).at[
+            gin, slotn].set(nin, mode="drop")
+        mid = [tn_cnt.astype(jnp.float32).reshape(-1),       # G*Kn
+               tn_idx.astype(jnp.float32).reshape(-1),       # G*Kn
+               nzn.sum(-1).astype(jnp.float32)]              # G (nnz row)
+    else:
+        mid = [outs["take_new"].astype(jnp.float32).reshape(-1)]  # G*N
+    packed = jnp.concatenate(head + mid + [
         outs["unsched"].astype(jnp.float32).reshape(-1),     # G
         outs["dom_placed"].astype(jnp.float32).reshape(-1),  # G*D
         final["used"].reshape(-1),                            # N*R
@@ -663,7 +717,7 @@ def _solve_ffd_impl(
 
 
 solve_ffd = partial(jax.jit, static_argnames=(
-    "max_nodes", "zc", "with_topology", "sparse_k",
+    "max_nodes", "zc", "with_topology", "sparse_k", "sparse_n",
     "mask_packed"))(_solve_ffd_impl)
 
 
@@ -716,14 +770,12 @@ def _unpack_problem(buf, layout):
     return tuple(out)
 
 
-@partial(jax.jit, static_argnames=(
-    "layout", "max_nodes", "zc", "with_topology", "sparse_k",
-    "mask_packed"))
-def solve_ffd_coalesced(buf, col_alloc, col_daemon, pt_alloc, col_pool,
-                        pool_daemon, col_zone, col_ct,
-                        layout=None, max_nodes: int = 1024, zc: int = 1,
-                        with_topology: bool = True, sparse_k: int = 0,
-                        mask_packed: bool = False):
+def _solve_ffd_coalesced_impl(buf, col_alloc, col_daemon, pt_alloc,
+                              col_pool, pool_daemon, col_zone, col_ct,
+                              layout=None, max_nodes: int = 1024,
+                              zc: int = 1, with_topology: bool = True,
+                              sparse_k: int = 0, sparse_n: int = 0,
+                              mask_packed: bool = False):
     """solve_ffd fed from one coalesced problem buffer (see
     pack_problem).  Catalog args stay separate — they are
     device-resident across solves and never travel."""
@@ -738,7 +790,21 @@ def solve_ffd_coalesced(buf, col_alloc, col_daemon, pt_alloc, col_pool,
         group_skew, group_mindom, group_delig, group_whole,
         col_zone, col_ct, exist_zone, exist_ct,
         max_nodes=max_nodes, zc=zc, with_topology=with_topology,
-        sparse_k=sparse_k, mask_packed=mask_packed)
+        sparse_k=sparse_k, sparse_n=sparse_n, mask_packed=mask_packed)
+
+
+_COALESCED_STATICS = ("layout", "max_nodes", "zc", "with_topology",
+                      "sparse_k", "sparse_n", "mask_packed")
+solve_ffd_coalesced = partial(
+    jax.jit, static_argnames=_COALESCED_STATICS)(_solve_ffd_coalesced_impl)
+# The pipelined executor's variant: the problem buffer (arg 0) is DONATED
+# — the executing program may reuse its bytes for outputs, so the upload
+# slot it came from is dead the moment this dispatches (reuse raises; see
+# pipeline.DeviceSlots for the two-slot rotation that makes the next
+# upload land in fresh memory while this program is still running).
+solve_ffd_coalesced_donated = partial(
+    jax.jit, static_argnames=_COALESCED_STATICS,
+    donate_argnums=(0,))(_solve_ffd_coalesced_impl)
 
 # The consolidation simulator's batch axis (SURVEY §7 step 6): many
 # candidate-removal simulations against one cluster state share the catalog
@@ -752,21 +818,34 @@ _BATCH_AXES = (0, 0, 0, 0, 0,          # group_req..exist_remaining
                None, None,              # col_zone, col_ct (shared)
                0, 0)                    # exist_zone, exist_ct
 
-@partial(jax.jit, static_argnames=("max_nodes", "zc", "sparse_k",
-                                   "mask_packed"))
-def solve_ffd_batch(*args, max_nodes: int = 1024, zc: int = 1,
-                    sparse_k: int = 0, mask_packed: bool = False):
+def _solve_ffd_batch_impl(*args, max_nodes: int = 1024, zc: int = 1,
+                          sparse_k: int = 0, sparse_n: int = 0,
+                          mask_packed: bool = False):
     return jax.vmap(partial(_solve_ffd_impl, max_nodes=max_nodes, zc=zc,
-                            sparse_k=sparse_k, mask_packed=mask_packed),
+                            sparse_k=sparse_k, sparse_n=sparse_n,
+                            mask_packed=mask_packed),
                     in_axes=_BATCH_AXES)(*args)
+
+
+_BATCH_STATICS = ("max_nodes", "zc", "sparse_k", "sparse_n", "mask_packed")
+solve_ffd_batch = partial(
+    jax.jit, static_argnames=_BATCH_STATICS)(_solve_ffd_batch_impl)
+# pipelined variant: the per-problem stacked tensors (batch axis 0 in
+# _BATCH_AXES) are donated — they are rebuilt per chunk anyway, and
+# donation lets chunk i's outputs reuse chunk i's input memory while
+# chunk i+1's upload allocates fresh (the double-buffer invariant).
+# Catalog tensors (axis None) replicate across solves and must survive.
+solve_ffd_batch_donated = partial(
+    jax.jit, static_argnames=_BATCH_STATICS,
+    donate_argnums=tuple(
+        i for i, ax in enumerate(_BATCH_AXES) if ax == 0))(
+            _solve_ffd_batch_impl)
 
 
 _BIG = 2 ** 29  # mirrors encode.BIG (no import: encode must stay jax-free)
 
 
-@partial(jax.jit, static_argnames=("max_nodes", "zc", "sparse_k",
-                                   "mask_packed"))
-def solve_ffd_sweep(
+def _solve_ffd_sweep_impl(
     # per-simulation (vmapped axis 0)
     group_req,      # [B, G, R]
     group_count,    # [B, G]
@@ -834,9 +913,17 @@ def solve_ffd_sweep(
                          exclude_idx, price_cap, pool_limit)
 
 
-@partial(jax.jit, static_argnames=("max_nodes", "zc", "sparse_k",
-                                   "mask_packed"))
-def solve_ffd_sweep_topo(
+_SWEEP_STATICS = ("max_nodes", "zc", "sparse_k", "mask_packed")
+solve_ffd_sweep = partial(
+    jax.jit, static_argnames=_SWEEP_STATICS)(_solve_ffd_sweep_impl)
+# pipelined variant: per-simulation tensors (args 0-5) donate; the shared
+# snapshot/class tables replicate across chunks and must survive
+solve_ffd_sweep_donated = partial(
+    jax.jit, static_argnames=_SWEEP_STATICS,
+    donate_argnums=tuple(range(6)))(_solve_ffd_sweep_impl)
+
+
+def _solve_ffd_sweep_topo_impl(
     # per-simulation (vmapped axis 0)
     group_req,      # [B, G, R]
     group_count,    # [B, G]
@@ -893,20 +980,40 @@ def solve_ffd_sweep_topo(
                          group_skew, group_mindom, group_delig)
 
 
+solve_ffd_sweep_topo = partial(
+    jax.jit, static_argnames=_SWEEP_STATICS)(_solve_ffd_sweep_topo_impl)
+# pipelined variant: per-simulation tensors (args 0-12, incl. the
+# per-sim topology rows) donate
+solve_ffd_sweep_topo_donated = partial(
+    jax.jit, static_argnames=_SWEEP_STATICS,
+    donate_argnums=tuple(range(13)))(_solve_ffd_sweep_topo_impl)
+
+
 def unpack(packed, G: int, E: int, N: int, RDIM: int, D: int,
-           sparse_k: int = 0):
+           sparse_k: int = 0, sparse_n: int = 0):
     """Split the flat result buffer back into named host arrays.  With
     sparse_k > 0 the buffer's head carries top-K (count, index) pairs per
     group (see _solve_ffd_impl) and the dense [G, E] take_exist row is
     rebuilt here by scatter — top_k indices are distinct per row, so the
-    scatter is collision-free and lossless when K bounds the group size."""
+    scatter is collision-free and lossless when K bounds the group size.
+    sparse_n > 0 rebuilds take_new the same way; its K is only a
+    warm-start estimate, so the kernel's per-group nonzero-count row is
+    checked here and ``new_overflow`` reports a lossy compaction (the
+    caller re-runs dense)."""
     import numpy as np
-    # copy: device buffers surface as read-only views, and the topology
-    # repair pass (solve.py) mutates these arrays in place
-    flat = np.array(packed)
+    # writable host array: device buffers surface as read-only views, and
+    # the topology repair pass (solve.py) mutates these arrays in place.
+    # An already-writable numpy input (a batch row the caller pulled) is
+    # used as-is — per-sim arrays are disjoint slices, so in-place repair
+    # on the view never aliases another sim's decode.
+    flat = np.asarray(packed)
+    if not flat.flags.writeable:
+        flat = np.array(flat)
     K = sparse_k
+    Kn = sparse_n
     head = 2 * G * K if K else G * E
-    sizes = [head, G * N, G, G * D, N * RDIM, N, N, N, 1]
+    mid = (2 * G * Kn + G) if Kn else G * N
+    sizes = [head, mid, G, G * D, N * RDIM, N, N, N, 1]
     offs = np.cumsum([0] + sizes)
     if K:
         cnt = flat[offs[0]:offs[0] + G * K].reshape(G, K)
@@ -918,9 +1025,22 @@ def unpack(packed, G: int, E: int, N: int, RDIM: int, D: int,
         take_exist[np.nonzero(m)[0], idx[m]] = cnt[m]
     else:
         take_exist = flat[offs[0]:offs[1]].reshape(G, E)
+    new_overflow = False
+    if Kn:
+        cntn = flat[offs[1]:offs[1] + G * Kn].reshape(G, Kn)
+        idxn = flat[offs[1] + G * Kn:
+                    offs[1] + 2 * G * Kn].reshape(G, Kn).astype(np.int64)
+        nnz = flat[offs[1] + 2 * G * Kn:offs[2]]
+        new_overflow = bool((nnz > Kn).any())
+        take_new = np.zeros((G, N), dtype=flat.dtype)
+        mn_ = cntn > 0
+        take_new[np.nonzero(mn_)[0], idxn[mn_]] = cntn[mn_]
+    else:
+        take_new = flat[offs[1]:offs[2]].reshape(G, N)
     return dict(
         take_exist=take_exist,
-        take_new=flat[offs[1]:offs[2]].reshape(G, N),
+        take_new=take_new,
+        new_overflow=new_overflow,
         unsched=flat[offs[2]:offs[3]],
         dom_placed=flat[offs[3]:offs[4]].reshape(G, D),
         used=flat[offs[4]:offs[5]].reshape(N, RDIM),

@@ -1,0 +1,107 @@
+"""Pipelined execution layer for the TPU solver's device boundary.
+
+The round-5 live-TPU window proved the LINK (transfer + dispatch), not
+the kernel, is the floor on real hardware (BENCH_r05_live_window:
+config2 at 0.84x, config4 at 0.37x baseline on-chip), and a quarter of
+every 50k solve was host-side work serialized against the device.  This
+module holds the three mechanisms that overlap them:
+
+- **async dispatch** — jax dispatch is already asynchronous; the
+  pipeline exploits it deliberately: the jitted call is enqueued and the
+  host immediately moves on to encoding the NEXT problem (sweep chunk,
+  batch chunk), only blocking when that problem's results are consumed.
+- **two-stage chunk pipeline** (`run_pipeline`) — while chunk *i*
+  executes on device, chunk *i+1* encodes and uploads; chunk *i*'s
+  pull + decode runs after *i+1*'s dispatch.  In-flight depth is bounded
+  at ONE undecoded chunk, so host memory and device queue stay flat no
+  matter how many chunks a sweep carries.
+- **donated double-buffered uploads** (`DeviceSlots`) — per-problem
+  input buffers are committed to the device ahead of dispatch and
+  DONATED to the program (`donate_argnums`), so the program reuses its
+  input bytes for outputs instead of allocating; the two-slot rotation
+  guarantees the next upload lands in fresh memory while the previous
+  program is still reading its own.  Reusing a donated buffer raises
+  (jax deletes it) — it can never silently corrupt an in-flight solve.
+
+Gating: `KARPENTER_TPU_PIPELINE` — `off`/`0` restores the synchronous
+pre-pipeline behavior everywhere (the rollback knob), `on`/`1` forces
+the pipeline, anything else (including unset, and any malformed value —
+a config typo must degrade a knob, never crash the operator) resolves
+to AUTO: on only when there is a device link to overlap (not the CPU
+backend, where "device" work shares the host's cores and deferred pulls
+just make Python decode contend with XLA's thread pool — measured
+3.1 s -> 4.4 s on config4).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Iterable, List, Optional, Tuple
+
+
+def pipeline_enabled() -> bool:
+    """Resolve the pipeline gate (see module docstring).  Re-read per
+    solve so tests and operators can flip it without rebuilding the
+    solver."""
+    raw = os.environ.get("KARPENTER_TPU_PIPELINE", "auto").strip().lower()
+    if raw in ("off", "0", "false"):
+        return False
+    if raw in ("on", "1", "true"):
+        return True
+    import jax
+    return jax.default_backend() != "cpu"
+
+
+class DeviceSlots:
+    """Two-deep rotation of donated upload buffers.
+
+    `put` commits a host array to the device and returns the device
+    array to pass to a DONATED jit parameter.  The slot table keeps the
+    previous upload's reference alive until its replacement lands two
+    puts later — by which time the program consuming it has been
+    dispatched (the pipeline pulls results before dispatching a third
+    chunk), so no live program's input is ever reclaimed under it.
+    After dispatch the donated array is dead (jax deletes it); `put`
+    always allocates fresh, which is exactly the double-buffer
+    invariant: uploads never alias an executing program's memory.
+    """
+
+    def __init__(self, depth: int = 2):
+        self._slots: List[Optional[object]] = [None] * depth
+        self._i = 0
+
+    def put(self, host_arrays, sharding=None):
+        """device_put one array or a tuple of arrays into the next slot."""
+        import jax
+        if sharding is None:
+            arr = jax.device_put(host_arrays)
+        else:
+            arr = jax.device_put(host_arrays, sharding)
+        self._i = (self._i + 1) % len(self._slots)
+        self._slots[self._i] = arr
+        return arr
+
+
+def run_pipeline(items: Iterable, dispatch: Callable, complete: Callable,
+                 enabled: bool = True) -> None:
+    """Two-stage dispatch/complete pipeline over `items`.
+
+    `dispatch(item) -> handle` must only ENQUEUE device work (encode,
+    upload, async dispatch); `complete(item, handle)` pulls and decodes.
+    With `enabled`, chunk *i* completes after chunk *i+1* dispatches, so
+    its pull overlaps *i+1*'s device execution; in-flight depth is
+    bounded at one undecoded chunk.  Disabled, each item completes
+    before the next dispatches — the synchronous rollback order.
+    """
+    if not enabled:
+        for item in items:
+            complete(item, dispatch(item))
+        return
+    pending: Optional[Tuple] = None
+    for item in items:
+        handle = dispatch(item)
+        if pending is not None:
+            complete(*pending)
+        pending = (item, handle)
+    if pending is not None:
+        complete(*pending)

@@ -26,8 +26,10 @@
 //              (node_pods, node_groups, unsched_by_group)
 //       The _decode distribution loop: walk each group's kernel output
 //       rows and split its pods into existing-node assignments (written
-//       into `assignments` as pod-name -> node-name), per-new-node pod
-//       lists + contributing group indices, and per-group unschedulable
+//       into `assignments` as pod-name -> node-name), per-new-node
+//       SEGMENT lists — [(group_list, start, count), ...] slice views
+//       the caller wraps in scheduling.types.PodSegments — plus
+//       contributing group indices, and per-group unschedulable pod
 //       lists.  take_* must be C-contiguous int64.
 //
 // Attribute access goes through the instance dict when one exists
@@ -40,6 +42,7 @@
 #include <algorithm>
 #include <cstring>
 #include <string>
+#include <string_view>
 #include <unordered_map>
 #include <vector>
 
@@ -52,6 +55,10 @@ PyObject* s_meta;
 PyObject* s_name;
 PyObject* s_requests;
 PyObject* s_sort_key;
+PyObject* s_pods;
+PyObject* s_hostname;
+PyObject* s_segs;
+PyObject* s_tail;
 
 // Borrowed-reference attribute lookup through the instance dict; falls
 // back to nullptr (no error set) when the object has no dict or the key
@@ -321,12 +328,29 @@ PyObject* distribute(PyObject* /*self*/, PyObject* args) {
   }
   if (num_active > N) num_active = N;
 
-  // buffer per-node members in C++ vectors (5 ns pushes) and materialize
-  // exact-size Python lists at the end — PyList_Append per pod was ~60%
-  // of this function at 50k pods
-  std::vector<std::vector<PyObject*>> buf_pods(
-      static_cast<size_t>(num_active > 0 ? num_active : 0));
-  std::vector<std::vector<Py_ssize_t>> buf_groups(buf_pods.size());
+  // buffer per-node SEGMENTS — (group list, start, count) views into the
+  // contiguous group slices the kernel's fill order guarantees — and
+  // return THOSE, never materialized pod lists: at the 50k headline
+  // even a single PyList_GetSlice per node was ~50k scattered pod
+  // increfs of objects this path never reads (measured ~5-6 ms of the
+  // decode phase, cache-cold after the device step).  The caller wraps
+  // each node's segment list in scheduling.types.PodSegments, and the
+  // consumers that actually walk the pods pay the slice lazily, off the
+  // solve hot path.
+  struct Seg {
+    PyObject* pods;     // borrowed group list
+    Py_ssize_t ni;
+    Py_ssize_t gi;
+    Py_ssize_t start;
+    Py_ssize_t count;
+  };
+  // ONE flat record vector in fill (gi-major) order, regrouped per node
+  // by a counting pass below: the previous vector-of-vectors paid two
+  // heap allocations per active node, and cache/allocator-cold right
+  // after the device step those ~1.5k mallocs dominated the whole call
+  // (measured ~3 ms of the 782-node headline decode vs ~0.5 warm)
+  std::vector<Seg> recs;
+  recs.reserve(256);
 
   PyObject* node_pods = PyDict_New();
   PyObject* node_groups = PyDict_New();
@@ -368,10 +392,10 @@ PyObject* distribute(PyObject* /*self*/, PyObject* args) {
     for (Py_ssize_t ni = 0; ni < num_active && cursor < npods; ++ni) {
       const long long k = tn_row[ni];
       if (k <= 0) continue;
-      buf_groups[static_cast<size_t>(ni)].push_back(gi);
-      auto& vec = buf_pods[static_cast<size_t>(ni)];
-      for (long long j = 0; j < k && cursor < npods; ++j, ++cursor)
-        vec.push_back(PyList_GET_ITEM(pods, cursor));
+      const Py_ssize_t take =
+          std::min(static_cast<Py_ssize_t>(k), npods - cursor);
+      recs.push_back(Seg{pods, ni, gi, cursor, take});
+      cursor += take;
     }
 
     const long long u = un.data[gi];
@@ -382,42 +406,77 @@ PyObject* distribute(PyObject* /*self*/, PyObject* args) {
     }
   }
 
-  for (size_t ni = 0; ni < buf_pods.size(); ++ni) {
-    if (buf_pods[ni].empty() && buf_groups[ni].empty()) continue;
-    PyObject* key = PyLong_FromSsize_t(static_cast<Py_ssize_t>(ni));
-    if (key == nullptr) goto fail;
-    PyObject* plist =
-        PyList_New(static_cast<Py_ssize_t>(buf_pods[ni].size()));
-    PyObject* glist =
-        PyList_New(static_cast<Py_ssize_t>(buf_groups[ni].size()));
-    if (plist == nullptr || glist == nullptr) {
-      Py_XDECREF(plist);
-      Py_XDECREF(glist);
-      Py_DECREF(key);
-      goto fail;
+  {
+    // regroup the flat records per node: counting sort on ni (stable, so
+    // each node's segments stay in fill order, which is also its group
+    // order — (gi, ni) pairs are unique by construction)
+    const size_t NA = static_cast<size_t>(num_active > 0 ? num_active : 0);
+    std::vector<Py_ssize_t> cnt(NA, 0);
+    for (const Seg& s : recs) cnt[static_cast<size_t>(s.ni)]++;
+    std::vector<Py_ssize_t> ofs(NA + 1, 0);
+    for (size_t i = 0; i < NA; ++i) ofs[i + 1] = ofs[i] + cnt[i];
+    std::vector<const Seg*> ordered(recs.size());
+    {
+      std::vector<Py_ssize_t> pos(ofs.begin(), ofs.begin() + NA);
+      for (const Seg& s : recs)
+        ordered[static_cast<size_t>(pos[static_cast<size_t>(s.ni)]++)] = &s;
     }
-    for (size_t j = 0; j < buf_pods[ni].size(); ++j) {
-      Py_INCREF(buf_pods[ni][j]);
-      PyList_SET_ITEM(plist, static_cast<Py_ssize_t>(j), buf_pods[ni][j]);
-    }
-    bool ok = true;
-    for (size_t j = 0; ok && j < buf_groups[ni].size(); ++j) {
-      PyObject* v = PyLong_FromSsize_t(buf_groups[ni][j]);
-      if (v == nullptr)
-        ok = false;
-      else
-        PyList_SET_ITEM(glist, static_cast<Py_ssize_t>(j), v);
-    }
-    if (!ok || PyDict_SetItem(node_pods, key, plist) < 0 ||
-        PyDict_SetItem(node_groups, key, glist) < 0) {
+    for (size_t ni = 0; ni < NA; ++ni) {
+      const Py_ssize_t nseg = cnt[ni];
+      if (nseg == 0) continue;
+      const Seg* const* segs = ordered.data() + ofs[ni];
+      PyObject* key = PyLong_FromSsize_t(static_cast<Py_ssize_t>(ni));
+      if (key == nullptr) goto fail;
+      PyObject* plist = PyList_New(nseg);
+      if (plist != nullptr) {
+        for (Py_ssize_t j = 0; j < nseg; ++j) {
+          const Seg& s = *segs[j];
+          PyObject* t = PyTuple_New(3);
+          PyObject* a = t ? PyLong_FromSsize_t(s.start) : nullptr;
+          PyObject* b = t ? PyLong_FromSsize_t(s.count) : nullptr;
+          if (t == nullptr || a == nullptr || b == nullptr) {
+            Py_XDECREF(a);
+            Py_XDECREF(b);
+            Py_XDECREF(t);
+            Py_CLEAR(plist);
+            break;
+          }
+          Py_INCREF(s.pods);  // the group list itself — a handful of hot
+          PyTuple_SET_ITEM(t, 0, s.pods);  // objects, not 50k pods
+          PyTuple_SET_ITEM(t, 1, a);
+          PyTuple_SET_ITEM(t, 2, b);
+          PyList_SET_ITEM(plist, j, t);
+        }
+      }
+      // groups as a TUPLE: the decode claim key needs a hashable group
+      // set, and tuple() over an already-tuple is a no-op — the per-node
+      // list→tuple conversion disappears from the claim loop
+      PyObject* glist = PyTuple_New(nseg);
+      if (plist == nullptr || glist == nullptr) {
+        Py_XDECREF(plist);
+        Py_XDECREF(glist);
+        Py_DECREF(key);
+        goto fail;
+      }
+      bool ok = true;
+      for (Py_ssize_t j = 0; ok && j < nseg; ++j) {
+        PyObject* v = PyLong_FromSsize_t(segs[j]->gi);
+        if (v == nullptr)
+          ok = false;
+        else
+          PyTuple_SET_ITEM(glist, j, v);
+      }
+      if (!ok || PyDict_SetItem(node_pods, key, plist) < 0 ||
+          PyDict_SetItem(node_groups, key, glist) < 0) {
+        Py_DECREF(plist);
+        Py_DECREF(glist);
+        Py_DECREF(key);
+        goto fail;
+      }
       Py_DECREF(plist);
       Py_DECREF(glist);
       Py_DECREF(key);
-      goto fail;
     }
-    Py_DECREF(plist);
-    Py_DECREF(glist);
-    Py_DECREF(key);
   }
 
   {
@@ -436,12 +495,311 @@ fail:
   return nullptr;
 }
 
+// row_ids(arr_2d_contiguous, nrows) -> list[int]: first-occurrence
+// identity per row over the raw row bytes.  The decode claim cache keys
+// on the used-vector identity of each active node; the Python
+// tobytes-per-row walk was ~0.5 ms of the 782-node headline decode, and
+// np.unique(axis=0)'s void-row sort setup measured worse still.
+PyObject* row_ids(PyObject* /*self*/, PyObject* args) {
+  PyObject* arr;
+  Py_ssize_t nrows;
+  if (!PyArg_ParseTuple(args, "On", &arr, &nrows)) return nullptr;
+  Py_buffer view{};
+  if (PyObject_GetBuffer(arr, &view, PyBUF_C_CONTIGUOUS) != 0)
+    return nullptr;
+  const Py_ssize_t total_rows =
+      view.ndim >= 1 && view.shape != nullptr ? view.shape[0] : 0;
+  if (nrows < 0 || nrows > total_rows) {
+    PyBuffer_Release(&view);
+    PyErr_SetString(PyExc_ValueError, "row_ids: nrows out of range");
+    return nullptr;
+  }
+  const size_t rowbytes =
+      total_rows > 0 ? static_cast<size_t>(view.len / total_rows) : 0;
+  const char* base = static_cast<const char*>(view.buf);
+  PyObject* out = PyList_New(nrows);
+  if (out == nullptr) {
+    PyBuffer_Release(&view);
+    return nullptr;
+  }
+  {
+    // string_view keys point into the borrowed buffer — valid for the
+    // duration of this call only, which is all the map lives
+    std::unordered_map<std::string_view, long> seen;
+    seen.reserve(static_cast<size_t>(nrows));
+    for (Py_ssize_t i = 0; i < nrows; ++i) {
+      std::string_view key{base + static_cast<size_t>(i) * rowbytes,
+                           rowbytes};
+      auto it = seen.emplace(key, static_cast<long>(seen.size())).first;
+      PyObject* v = PyLong_FromLong(it->second);
+      if (v == nullptr) {
+        Py_DECREF(out);
+        PyBuffer_Release(&view);
+        return nullptr;
+      }
+      PyList_SET_ITEM(out, i, v);
+    }
+  }
+  PyBuffer_Release(&view);
+  return out;
+}
+
+// build_claims(node_pods, node_groups, pool, zone, ct, used_id,
+//              hostnames, seg_cls, claim_cls, resolve, new_claims,
+//              unschedulable) -> None
+//
+// The _decode claim-materialization loop at C speed.  Nodes sharing a
+// claim-shape key (pool, groups, zone, ct, used-row id) differ only in
+// pods + hostname, and the 50k headline has ~16 distinct shapes across
+// 782 nodes — so the Python work collapses to one `resolve(ni)`
+// callback per DISTINCT shape (the Requirements/type-ranking
+// computation, returning `(violation|None, proto_dict|None)`), while
+// the per-node stamping (PodSegments wrap, proto __dict__ copy, pods +
+// hostname, append) runs here.  The interpreter loop this replaces was
+// ~2-3 ms of the headline decode, cache-cold after the device step.
+//
+// node_pods/node_groups come from distribute() and iterate in ascending
+// node order (counting-sort insertion order), which keeps the claim
+// list order identical to the Python loop's range(num_active) walk.
+PyObject* build_claims(PyObject* /*self*/, PyObject* args) {
+  PyObject *node_pods, *node_groups, *pool, *zone, *ct, *used_id,
+      *hostnames, *seg_cls, *claim_cls, *resolve, *new_claims, *unsched;
+  if (!PyArg_ParseTuple(args, "OOOOOOOOOOOO", &node_pods, &node_groups,
+                        &pool, &zone, &ct, &used_id, &hostnames, &seg_cls,
+                        &claim_cls, &resolve, &new_claims, &unsched))
+    return nullptr;
+  if (!PyDict_Check(node_pods) || !PyDict_Check(node_groups) ||
+      !PyList_Check(used_id) || !PyList_Check(hostnames) ||
+      !PyType_Check(claim_cls) || !PyList_Check(new_claims) ||
+      !PyDict_Check(unsched)) {
+    PyErr_SetString(PyExc_TypeError, "build_claims: bad argument types");
+    return nullptr;
+  }
+  I64View pl, zn, cp;
+  if (!pl.acquire(pool, "pool") || !zn.acquire(zone, "zone") ||
+      !cp.acquire(ct, "ct"))
+    return nullptr;
+  const Py_ssize_t NA = std::min(
+      {PyList_GET_SIZE(used_id), PyList_GET_SIZE(hostnames),
+       pl.view.len / static_cast<Py_ssize_t>(sizeof(long long)),
+       zn.view.len / static_cast<Py_ssize_t>(sizeof(long long)),
+       cp.view.len / static_cast<Py_ssize_t>(sizeof(long long))});
+  PyTypeObject* claim_type = reinterpret_cast<PyTypeObject*>(claim_cls);
+  // PodSegments fast construction: tp_new + slot stores, skipping the
+  // interpreted __init__ (one Python frame per node, measured ~1 ms of
+  // the 782-node headline decode, cache-cold after the device step).
+  // The stores replicate __init__ exactly for the list argument this
+  // loop always passes (_segs adopts the fresh list, _tail starts
+  // empty).  Any failure — e.g. a seg_cls without those slots —
+  // permanently falls back to the plain constructor call.
+  PyTypeObject* seg_type =
+      PyType_Check(seg_cls) ? reinterpret_cast<PyTypeObject*>(seg_cls)
+                            : nullptr;
+  bool seg_fast = seg_type != nullptr && seg_type->tp_new != nullptr;
+
+  PyObject* cache = PyDict_New();
+  PyObject* empty_args = PyTuple_New(0);
+  if (cache == nullptr || empty_args == nullptr) {
+    Py_XDECREF(cache);
+    Py_XDECREF(empty_args);
+    return nullptr;
+  }
+
+  Py_ssize_t pos = 0;
+  PyObject *key, *plist;
+  while (PyDict_Next(node_pods, &pos, &key, &plist)) {
+    const Py_ssize_t ni = PyLong_AsSsize_t(key);
+    if (ni == -1 && PyErr_Occurred()) goto fail;
+    if (ni < 0 || ni >= NA) {
+      PyErr_SetString(PyExc_ValueError, "build_claims: node index out of "
+                                        "range");
+      goto fail;
+    }
+    PyObject* gis = PyDict_GetItemWithError(node_groups, key);  // borrowed
+    if (gis == nullptr) {
+      if (PyErr_Occurred()) goto fail;
+      PyErr_SetString(PyExc_ValueError,
+                      "build_claims: node missing from node_groups");
+      goto fail;
+    }
+
+    // claim-shape key: (pool, groups, zone, ct, used-row id)
+    PyObject* ckey = PyTuple_New(5);
+    PyObject* uid = PyList_GET_ITEM(used_id, ni);  // borrowed
+    if (ckey == nullptr) goto fail;
+    {
+      PyObject* a = PyLong_FromLongLong(pl.data[ni]);
+      PyObject* b = PyLong_FromLongLong(zn.data[ni]);
+      PyObject* c = PyLong_FromLongLong(cp.data[ni]);
+      if (a == nullptr || b == nullptr || c == nullptr) {
+        Py_XDECREF(a);
+        Py_XDECREF(b);
+        Py_XDECREF(c);
+        Py_DECREF(ckey);
+        goto fail;
+      }
+      PyTuple_SET_ITEM(ckey, 0, a);
+      Py_INCREF(gis);
+      PyTuple_SET_ITEM(ckey, 1, gis);
+      PyTuple_SET_ITEM(ckey, 2, b);
+      PyTuple_SET_ITEM(ckey, 3, c);
+      Py_INCREF(uid);
+      PyTuple_SET_ITEM(ckey, 4, uid);
+    }
+    PyObject* cached = PyDict_GetItemWithError(cache, ckey);  // borrowed
+    if (cached == nullptr) {
+      if (PyErr_Occurred()) {
+        Py_DECREF(ckey);
+        goto fail;
+      }
+      PyObject* fresh = PyObject_CallFunction(resolve, "n", ni);
+      if (fresh == nullptr || !PyTuple_Check(fresh) ||
+          PyTuple_GET_SIZE(fresh) != 2) {
+        if (fresh != nullptr)
+          PyErr_SetString(PyExc_TypeError,
+                          "build_claims: resolve must return "
+                          "(violation, proto)");
+        Py_XDECREF(fresh);
+        Py_DECREF(ckey);
+        goto fail;
+      }
+      const int rc = PyDict_SetItem(cache, ckey, fresh);
+      Py_DECREF(fresh);
+      if (rc < 0) {
+        Py_DECREF(ckey);
+        goto fail;
+      }
+      cached = PyDict_GetItemWithError(cache, ckey);  // borrowed, alive
+      if (cached == nullptr) {
+        Py_DECREF(ckey);
+        goto fail;
+      }
+    }
+    Py_DECREF(ckey);
+
+    PyObject* violation = PyTuple_GET_ITEM(cached, 0);
+    PyObject* proto = PyTuple_GET_ITEM(cached, 1);
+    if (violation != Py_None) {
+      // every pod of this node is unschedulable with the shape's reason:
+      // walk the raw (group_list, start, count) segments
+      if (!PyList_Check(plist)) {
+        PyErr_SetString(PyExc_TypeError,
+                        "build_claims: node_pods values must be lists");
+        goto fail;
+      }
+      for (Py_ssize_t si = 0; si < PyList_GET_SIZE(plist); ++si) {
+        PyObject* seg = PyList_GET_ITEM(plist, si);
+        if (!PyTuple_Check(seg) || PyTuple_GET_SIZE(seg) != 3) {
+          PyErr_SetString(PyExc_TypeError,
+                          "build_claims: malformed segment");
+          goto fail;
+        }
+        PyObject* lst = PyTuple_GET_ITEM(seg, 0);
+        const Py_ssize_t start = PyLong_AsSsize_t(PyTuple_GET_ITEM(seg, 1));
+        const Py_ssize_t count = PyLong_AsSsize_t(PyTuple_GET_ITEM(seg, 2));
+        if ((start == -1 || count == -1) && PyErr_Occurred()) goto fail;
+        if (!PyList_Check(lst) || start < 0 ||
+            start + count > PyList_GET_SIZE(lst)) {
+          PyErr_SetString(PyExc_ValueError,
+                          "build_claims: segment out of range");
+          goto fail;
+        }
+        for (Py_ssize_t j = start; j < start + count; ++j) {
+          PyObject* pod = PyList_GET_ITEM(lst, j);
+          PyObject* pname = pod_name_obj(pod);  // borrowed or nullptr
+          PyObject* pname_owned = nullptr;
+          if (pname == nullptr) {
+            PyObject* meta = PyObject_GetAttr(pod, s_meta);
+            pname_owned = meta ? PyObject_GetAttr(meta, s_name) : nullptr;
+            Py_XDECREF(meta);
+            if (pname_owned == nullptr) goto fail;
+            pname = pname_owned;
+          }
+          const int rc = PyDict_SetItem(unsched, pname, violation);
+          Py_XDECREF(pname_owned);
+          if (rc < 0) goto fail;
+        }
+      }
+      continue;
+    }
+    if (!PyDict_Check(proto)) {
+      PyErr_SetString(PyExc_TypeError,
+                      "build_claims: proto must be a dict");
+      goto fail;
+    }
+
+    // stamp the claim: PodSegments(plist), proto copy + pods/hostname,
+    // __new__ without __init__ (the dataclass __init__'s field walk and
+    // taint copies are exactly what the proto sharing avoids)
+    PyObject* segs_obj = nullptr;
+    if (seg_fast) {
+      segs_obj = seg_type->tp_new(seg_type, empty_args, nullptr);
+      if (segs_obj != nullptr) {
+        PyObject* tail = PyList_New(0);
+        if (tail == nullptr ||
+            PyObject_SetAttr(segs_obj, s_segs, plist) < 0 ||
+            PyObject_SetAttr(segs_obj, s_tail, tail) < 0) {
+          Py_XDECREF(tail);
+          Py_CLEAR(segs_obj);
+        } else {
+          Py_DECREF(tail);
+        }
+      }
+      if (segs_obj == nullptr) {
+        PyErr_Clear();
+        seg_fast = false;  // constructor path for the rest of the walk
+      }
+    }
+    if (segs_obj == nullptr) {
+      segs_obj = PyObject_CallOneArg(seg_cls, plist);
+      if (segs_obj == nullptr) goto fail;
+    }
+    PyObject* d = PyDict_Copy(proto);
+    PyObject* claim =
+        d ? claim_type->tp_new(claim_type, empty_args, nullptr) : nullptr;
+    PyObject** dictptr =
+        claim ? _PyObject_GetDictPtr(claim) : nullptr;
+    if (dictptr == nullptr ||
+        PyDict_SetItem(d, s_pods, segs_obj) < 0 ||
+        PyDict_SetItem(d, s_hostname, PyList_GET_ITEM(hostnames, ni)) < 0) {
+      if (claim != nullptr && dictptr == nullptr && !PyErr_Occurred())
+        PyErr_SetString(PyExc_TypeError,
+                        "build_claims: claim class must carry __dict__");
+      Py_XDECREF(segs_obj);
+      Py_XDECREF(d);
+      Py_XDECREF(claim);
+      goto fail;
+    }
+    Py_DECREF(segs_obj);  // d holds it
+    Py_XDECREF(*dictptr);
+    *dictptr = d;  // claim owns d
+    const int rc = PyList_Append(new_claims, claim);
+    Py_DECREF(claim);
+    if (rc < 0) goto fail;
+  }
+
+  Py_DECREF(cache);
+  Py_DECREF(empty_args);
+  Py_RETURN_NONE;
+
+fail:
+  Py_DECREF(cache);
+  Py_DECREF(empty_args);
+  return nullptr;
+}
+
 PyMethodDef kMethods[] = {
     {"group_pods", group_pods, METH_O,
      "Pod equivalence classes in FFD order (C++ fast path)."},
     {"distribute", distribute, METH_VARARGS,
      "Split each group's pods into existing/new/unschedulable per the "
      "kernel output (the _decode distribution loop)."},
+    {"row_ids", row_ids, METH_VARARGS,
+     "First-occurrence identity ids per row of a C-contiguous 2-D "
+     "array (the decode claim cache's used-vector identity)."},
+    {"build_claims", build_claims, METH_VARARGS,
+     "Stamp one NewNodeClaim per active node from per-shape protos "
+     "(the _decode claim loop; resolve() computes each distinct shape)."},
     {nullptr, nullptr, 0, nullptr},
 };
 
@@ -460,5 +818,9 @@ PyMODINIT_FUNC PyInit_kt_hostops() {
   s_name = PyUnicode_InternFromString("name");
   s_requests = PyUnicode_InternFromString("requests");
   s_sort_key = PyUnicode_InternFromString("sort_key");
+  s_pods = PyUnicode_InternFromString("pods");
+  s_hostname = PyUnicode_InternFromString("hostname");
+  s_segs = PyUnicode_InternFromString("_segs");
+  s_tail = PyUnicode_InternFromString("_tail");
   return PyModule_Create(&kModule);
 }

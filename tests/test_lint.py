@@ -113,6 +113,29 @@ def test_jit_purity_static_args_and_host_code_are_exempt(tmp_path):
     assert findings == []
 
 
+def test_jit_purity_resolves_shared_statics_constant(tmp_path):
+    # the shared-statics idiom: one module-level tuple reused by a jitted
+    # wrapper and its donated variant must exempt branches the same as an
+    # inline literal (solver/ffd.py _SWEEP_STATICS)
+    findings, _ = _check(tmp_path, """
+        import jax
+        from functools import partial
+
+
+        def _impl(x, flag):
+            if flag:           # static via the named constant: fine
+                return x * 2
+            return x
+
+
+        _STATICS = ("flag",)
+        solve = partial(jax.jit, static_argnames=_STATICS)(_impl)
+        solve_donated = partial(jax.jit, static_argnames=_STATICS,
+                                donate_argnums=(0,))(_impl)
+    """, jit_purity)
+    assert findings == []
+
+
 def test_jit_purity_sees_the_assignment_form_and_bad_static_names(tmp_path):
     findings, _ = _check(tmp_path, """
         import jax

@@ -110,9 +110,16 @@ class TestDistribute:
                 py_groups.setdefault(int(ni), []).append(gi)
                 cursor += k
         assert assignments == py_assign
-        assert {k: [id(p) for p in v] for k, v in node_pods.items()} == \
+        # node_pods carries (group_list, start, count) SEGMENTS — the
+        # lazy-slice contract _decode wraps in PodSegments
+        from karpenter_tpu.scheduling.types import PodSegments
+        assert {k: [id(p) for p in PodSegments(v)]
+                for k, v in node_pods.items()} == \
             {k: [id(p) for p in v] for k, v in py_pods.items()}
-        assert dict(node_groups) == py_groups
+        for segs in node_pods.values():
+            for lst, start, count in segs:
+                assert lst in groups and count > 0 and start >= 0
+        assert {k: list(v) for k, v in node_groups.items()} == py_groups
         assert unsched_by_group == {}
 
     def test_unschedulable_and_truncation(self):
@@ -126,8 +133,9 @@ class TestDistribute:
         assignments = {}
         node_pods, node_groups, unsched_by_group = NATIVE.distribute(
             groups, take_exist, take_new, unsched, [], 1, assignments)
+        from karpenter_tpu.scheduling.types import PodSegments
         assert assignments == {}
-        assert [p.meta.name for p in node_pods[0]] == ["u0"]
-        assert node_groups == {0: [0]}
+        assert [p.meta.name for p in PodSegments(node_pods[0])] == ["u0"]
+        assert node_groups == {0: (0,)}
         assert [p.meta.name for p in unsched_by_group[0]] == \
             ["u1", "u2", "u3"]

@@ -1,0 +1,431 @@
+"""The pipelined solver executor (docs/solver-pipeline.md).
+
+Three contracts, each load-bearing for the link-budget work:
+
+- **parity** — `KARPENTER_TPU_PIPELINE=on` (async dispatch, two-stage
+  chunk pipeline, donated double-buffered uploads, on-device take_new
+  compaction) is an execution strategy, not a semantics change: results
+  must be bit-identical to `off` on every path — single solve, generic
+  batch, consolidation sweep (light + heavy lane, multi-chunk), and
+  split-path residue.
+- **donation safety** — a donated input buffer is DEAD after dispatch:
+  reuse raises (JAX deletes it), it can never silently corrupt an
+  in-flight solve; the two-slot rotation always uploads fresh.
+- **warm-up** — after `TPUSolver.warmup()` the first real solve performs
+  zero kernel retraces (a retrace is the only event that can trigger an
+  XLA compile), asserted against `ffd.TRACE_COUNT`.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.models import (
+    Node,
+    NodePool,
+    ObjectMeta,
+    Pod,
+    PodAffinityTerm,
+    Resources,
+    TopologySpreadConstraint,
+    wellknown,
+)
+from karpenter_tpu.providers import generate_catalog
+from karpenter_tpu.providers.catalog import CatalogSpec
+from karpenter_tpu.scheduling import ExistingNode, ScheduleInput
+from karpenter_tpu.solver import TPUSolver
+from karpenter_tpu.solver import ffd
+from karpenter_tpu.solver import pipeline as pipelining
+
+CATALOG = generate_catalog(CatalogSpec(max_types=12, include_gpu=False))
+
+
+def mkpod(name, cpu="500m", mem="1Gi", **kw):
+    return Pod(meta=ObjectMeta(name=name, labels=kw.pop("labels", {})),
+               requests=Resources.parse({"cpu": cpu, "memory": mem}), **kw)
+
+
+def mkinput(pods, **kw):
+    pool = NodePool(meta=ObjectMeta(name="default"))
+    return ScheduleInput(pods=pods, nodepools=[pool],
+                         instance_types={"default": CATALOG}, **kw)
+
+
+def mkcluster(n):
+    nodes = []
+    for i in range(n):
+        node = Node(
+            meta=ObjectMeta(name=f"n{i}", labels={
+                wellknown.ZONE_LABEL: f"tpu-west-1{'abc'[i % 3]}",
+                wellknown.CAPACITY_TYPE_LABEL: ["spot", "on-demand"][i % 2],
+                wellknown.NODEPOOL_LABEL: "default",
+                wellknown.ARCH_LABEL: "amd64",
+                wellknown.OS_LABEL: "linux",
+                wellknown.HOSTNAME_LABEL: f"n{i}"}),
+            allocatable=Resources.of(cpu=16000, memory=32768, pods=58),
+            ready=True)
+        pod = mkpod(f"res{i}", cpu="500m", mem="1Gi")
+        pod.node_name = f"n{i}"
+        nodes.append(ExistingNode(
+            node=node, available=node.allocatable - pod.requests,
+            pods=[pod]))
+    return nodes
+
+
+def sweep_inputs(nodes, price_cap=0.5):
+    pool = NodePool(meta=ObjectMeta(name="default"))
+    return [ScheduleInput(
+        pods=list(nodes[i].pods), nodepools=[pool],
+        instance_types={"default": CATALOG},
+        existing_nodes=nodes[:i] + nodes[i + 1:], price_cap=price_cap,
+        exist_base=nodes, exist_excluded=(i,))
+        for i in range(len(nodes))]
+
+
+def assert_identical(a, b, ctx=""):
+    """Bit-identical ScheduleResults: same assignments, same
+    unschedulable set, and claim-for-claim equality including prices and
+    ranked type lists (floats come off the same computation on both
+    paths, so exact equality is the contract, not a tolerance)."""
+    assert dict(a.existing_assignments) == dict(b.existing_assignments), ctx
+    assert dict(a.unschedulable) == dict(b.unschedulable), ctx
+    assert len(a.new_claims) == len(b.new_claims), ctx
+
+    def key(c):
+        return (c.nodepool, sorted(p.meta.name for p in c.pods),
+                list(c.instance_type_names), c.price,
+                list(c.requests.v), c.hostname)
+    for ca, cb in zip(sorted(a.new_claims, key=key),
+                      sorted(b.new_claims, key=key)):
+        assert key(ca) == key(cb), ctx
+
+
+# ---------------------------------------------------------------------------
+# run_pipeline: the two-stage scheduler, host-level semantics
+# ---------------------------------------------------------------------------
+
+class TestRunPipeline:
+    def test_disabled_is_strictly_sequential(self):
+        log = []
+        pipelining.run_pipeline(
+            [1, 2, 3],
+            lambda i: log.append(("d", i)) or i * 10,
+            lambda i, h: log.append(("c", i, h)),
+            enabled=False)
+        assert log == [("d", 1), ("c", 1, 10), ("d", 2), ("c", 2, 20),
+                       ("d", 3), ("c", 3, 30)]
+
+    def test_enabled_overlaps_one_chunk(self):
+        # chunk i completes AFTER chunk i+1 dispatches (its pull overlaps
+        # i+1's device window) and in-flight depth never exceeds one
+        # undecoded chunk
+        log = []
+        pipelining.run_pipeline(
+            [1, 2, 3],
+            lambda i: log.append(("d", i)) or i * 10,
+            lambda i, h: log.append(("c", i, h)),
+            enabled=True)
+        assert log == [("d", 1), ("d", 2), ("c", 1, 10),
+                       ("d", 3), ("c", 2, 20), ("c", 3, 30)]
+        for n, (ev, *_) in enumerate(log):
+            in_flight = (len([e for e in log[:n + 1] if e[0] == "d"])
+                         - len([e for e in log[:n + 1] if e[0] == "c"]))
+            assert in_flight <= 2  # one executing + one undecoded
+
+    def test_empty_and_single_item(self):
+        log = []
+        pipelining.run_pipeline([], lambda i: i, lambda i, h: log.append(h),
+                                enabled=True)
+        assert log == []
+        pipelining.run_pipeline([7], lambda i: i, lambda i, h: log.append(h),
+                                enabled=True)
+        assert log == [7]
+
+    def test_dispatch_exception_propagates(self):
+        # a mid-pipeline failure must raise (callers wrap the loop in
+        # try/finally for their cache cleanup), not strand the pending
+        # chunk silently
+        def dispatch(i):
+            if i == 2:
+                raise RuntimeError("boom")
+            return i
+        done = []
+        with pytest.raises(RuntimeError):
+            pipelining.run_pipeline([1, 2, 3], dispatch,
+                                    lambda i, h: done.append(i),
+                                    enabled=True)
+        assert done == []  # chunk 1 was still in flight
+
+
+class TestGate:
+    def test_knob_values(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_PIPELINE", "off")
+        assert pipelining.pipeline_enabled() is False
+        monkeypatch.setenv("KARPENTER_TPU_PIPELINE", "on")
+        assert pipelining.pipeline_enabled() is True
+        # malformed values degrade to AUTO (off on the CPU test backend),
+        # never crash — a config typo must not take the operator down
+        monkeypatch.setenv("KARPENTER_TPU_PIPELINE", "bananas")
+        assert pipelining.pipeline_enabled() in (True, False)
+
+
+# ---------------------------------------------------------------------------
+# donation safety
+# ---------------------------------------------------------------------------
+
+class TestDonationSafety:
+    def test_donated_input_reuse_raises_never_corrupts(self):
+        import jax
+        f = jax.jit(lambda x: x * 2.0, donate_argnums=(0,))
+        slots = pipelining.DeviceSlots()
+        a = slots.put(np.arange(4, dtype=np.float32))
+        r1 = f(a)
+        np.testing.assert_array_equal(np.array(r1), [0.0, 2.0, 4.0, 6.0])
+        # the donated buffer is DEAD: both reads and re-dispatch raise —
+        # the failure mode is loud, never a silent wrong answer
+        with pytest.raises(Exception):
+            np.array(a)
+        with pytest.raises(Exception):
+            f(a)
+        # the rotation always uploads fresh: the next put is a new live
+        # buffer and the program it feeds computes correctly
+        b = slots.put(np.arange(4, dtype=np.float32) + 1)
+        r2 = f(b)
+        np.testing.assert_array_equal(np.array(r2), [2.0, 4.0, 6.0, 8.0])
+
+    def test_slots_hold_previous_upload_alive(self):
+        # slot depth 2: upload k is only overwritten by upload k+2, after
+        # the program consuming k has been dispatched
+        slots = pipelining.DeviceSlots()
+        a = slots.put(np.float32(1))
+        b = slots.put(np.float32(2))
+        assert any(s is a for s in slots._slots)
+        assert any(s is b for s in slots._slots)
+        c = slots.put(np.float32(3))
+        assert not any(s is a for s in slots._slots)
+        assert any(s is c for s in slots._slots)
+
+
+# ---------------------------------------------------------------------------
+# parity: pipeline on == pipeline off, bit-identical, every path
+# ---------------------------------------------------------------------------
+
+def run_both(fn, monkeypatch):
+    monkeypatch.setenv("KARPENTER_TPU_PIPELINE", "on")
+    on = fn()
+    monkeypatch.setenv("KARPENTER_TPU_PIPELINE", "off")
+    off = fn()
+    return on, off
+
+
+class TestPipelineParity:
+    def test_single_solve(self, monkeypatch):
+        nodes = mkcluster(6)
+        pods = ([mkpod(f"s{i}", cpu="250m", mem="512Mi") for i in range(40)]
+                + [mkpod(f"l{i}", cpu="12", mem="24Gi") for i in range(8)])
+        inp = mkinput(pods, existing_nodes=nodes)
+
+        def solve_twice():
+            # two solves per gate setting: the second rides the adaptive
+            # node bucket AND the warm-started take_new compaction
+            # (sparse_n engages only once _last_new_segments is measured)
+            s = TPUSolver(mesh="off")
+            return s.solve(inp), s.solve(inp)
+        (on1, on2), (off1, off2) = run_both(solve_twice, monkeypatch)
+        assert_identical(on1, off1, "first solve")
+        assert_identical(on2, off2, "warm solve")
+        assert_identical(on1, on2, "warm start must not drift")
+
+    def test_single_solve_coalesced_donated(self, monkeypatch):
+        # the donated coalesced kernel (the solve path the real chip
+        # runs): force the coalesced upload on so pipeline=on exercises
+        # DeviceSlots + solve_ffd_coalesced_donated
+        monkeypatch.setattr(TPUSolver, "_coalesce_upload", lambda self: True)
+        inp = mkinput([mkpod(f"p{i}") for i in range(60)],
+                      existing_nodes=mkcluster(4))
+
+        def solve_twice():
+            s = TPUSolver(mesh="off")
+            return s.solve(inp), s.solve(inp)
+        (on1, on2), (off1, off2) = run_both(solve_twice, monkeypatch)
+        assert_identical(on1, off1)
+        assert_identical(on2, off2)
+
+    def test_generic_batch(self, monkeypatch):
+        inps = [mkinput([mkpod(f"b{j}-{i}", cpu=c, mem=m)
+                         for i in range(n)])
+                for j, (n, c, m) in enumerate(
+                    [(30, "500m", "1Gi"), (5, "4", "8Gi"),
+                     (12, "250m", "512Mi"), (1, "15", "24Gi"),
+                     (8, "2", "4Gi"), (20, "1", "2Gi")])]
+        on, off = run_both(
+            lambda: TPUSolver(mesh="off").solve_batch(inps, max_nodes=16),
+            monkeypatch)
+        for i, (a, b) in enumerate(zip(on, off)):
+            assert_identical(a, b, f"batch[{i}]")
+
+    def test_sweep_light_and_heavy_lanes(self, monkeypatch):
+        nodes = mkcluster(12)
+        inps = sweep_inputs(nodes)
+        # heavy lane rider: a zone-spread candidate pod
+        sp = mkpod("sp", labels={"app": "w"}, topology_spread=[
+            TopologySpreadConstraint(topology_key=wellknown.ZONE_LABEL,
+                                     label_selector={"app": "w"})])
+        pool = NodePool(meta=ObjectMeta(name="default"))
+        inps.append(ScheduleInput(
+            pods=[sp], nodepools=[pool],
+            instance_types={"default": CATALOG},
+            existing_nodes=nodes[1:], exist_base=nodes, exist_excluded=(0,)))
+        on, off = run_both(
+            lambda: TPUSolver(mesh="off").solve_batch(inps, max_nodes=8),
+            monkeypatch)
+        for i, (a, b) in enumerate(zip(on, off)):
+            assert_identical(a, b, f"sweep[{i}]")
+
+    def test_sweep_multichunk(self, monkeypatch):
+        # >64 sims: the sweep's chunk loop becomes a REAL two-stage
+        # pipeline (chunk i+1 encodes while chunk i is in flight) with
+        # the donated per-sim tensors rotating through the slots
+        nodes = mkcluster(70)
+        inps = sweep_inputs(nodes)
+        on, off = run_both(
+            lambda: TPUSolver(mesh="off").solve_batch(inps, max_nodes=8),
+            monkeypatch)
+        assert len(on) == 70
+        for i, (a, b) in enumerate(zip(on, off)):
+            assert_identical(a, b, f"chunked-sweep[{i}]")
+
+    def test_split_path_residue(self, monkeypatch):
+        # required pod affinity peels off to the host oracle while the
+        # majority rides the (pipelined) device path
+        pods = [mkpod(f"web-{i}", labels={"app": "web"}) for i in range(80)]
+        pods += [mkpod(f"side-{i}", labels={"app": "side"},
+                       pod_affinities=[PodAffinityTerm(
+                           label_selector={"app": "web"},
+                           topology_key=wellknown.ZONE_LABEL,
+                           required=True, anti=False)])
+                 for i in range(3)]
+        inp = mkinput(pods)
+        on, off = run_both(lambda: TPUSolver(mesh="off").solve(inp),
+                           monkeypatch)
+        assert not on.unschedulable
+        assert_identical(on, off, "split residue")
+
+    def test_new_topk_dense_rollback(self, monkeypatch):
+        # KARPENTER_TPU_NEW_TOPK=0 forces the take_new result rows dense;
+        # the compacted form must be indistinguishable
+        inp = mkinput([mkpod(f"p{i}", cpu="2", mem="4Gi")
+                       for i in range(50)])
+
+        def warm_solve():
+            s = TPUSolver(mesh="off")
+            s.solve(inp)          # measure fan-out → engage compaction
+            return s.solve(inp)
+        compact = warm_solve()
+        monkeypatch.setenv("KARPENTER_TPU_NEW_TOPK", "0")
+        dense = warm_solve()
+        assert_identical(compact, dense, "take_new compaction")
+
+    def test_new_compaction_overflow_redoes_dense(self):
+        # a lowballed fan-out estimate must be DETECTED (the kernel's
+        # per-group nonzero-count row), redone dense, and re-measured —
+        # correctness never depends on the warm-start guess
+        pods = [mkpod(f"w{i}", cpu="15", mem="24Gi") for i in range(24)]
+        inp = mkinput(pods)
+        ref = TPUSolver(mesh="off").solve(inp)
+        s = TPUSolver(mesh="off")
+        s._last_active = 32            # engage the small node bucket
+        s._last_new_segments = 1       # lowball: K=8 < the real fan-out
+        res = s.solve(inp)
+        assert_identical(res, ref, "overflow redo")
+        assert s._last_new_segments >= len(res.new_claims)
+
+
+# ---------------------------------------------------------------------------
+# warm-up: padding-bucket precompile ⇒ zero retraces on the next solve
+# ---------------------------------------------------------------------------
+
+class TestWarmup:
+    def test_zero_retraces_after_warmup(self):
+        nodes = mkcluster(5)
+        inp = mkinput([mkpod(f"wu{i}", cpu="1", mem="2Gi")
+                       for i in range(30)], existing_nodes=nodes)
+        solver = TPUSolver(mesh="off")
+        warmed = solver.warmup(inp)
+        assert warmed > 0
+        before = ffd.TRACE_COUNT
+        res = solver.solve(inp)
+        assert not res.unschedulable
+        # a retrace is the only event that can trigger an XLA compile;
+        # zero retraces ⇒ the solve hit only jit-cached programs
+        assert ffd.TRACE_COUNT == before, (
+            f"solve after warmup retraced {ffd.TRACE_COUNT - before} "
+            f"program(s): {list(ffd.TRACE_LOG)[-4:]}")
+        # solve #2 switches to the compacted take_new program (kn>0 —
+        # _pick_sparse_n now has a measurement); the warm-up lattice
+        # must cover those tiers too, or the cliff just moves one solve
+        res = solver.solve(inp)
+        assert not res.unschedulable
+        assert ffd.TRACE_COUNT == before, (
+            f"SECOND solve after warmup retraced "
+            f"{ffd.TRACE_COUNT - before} program(s) "
+            f"(unwarmed take_new tier?): {list(ffd.TRACE_LOG)[-4:]}")
+
+    def test_warmup_covers_extra_shape_buckets(self):
+        # shapes=: extra (n_groups, n_existing) lattice points — the
+        # operator warms burst sizes it has not seen yet, then a solve
+        # LANDING in one of those buckets stays compile-free
+        inp = mkinput([mkpod(f"wx{i}", cpu="1", mem="2Gi")
+                       for i in range(4)])
+        solver = TPUSolver(mesh="off")
+        solver.warmup(inp, shapes=((20, 0),))
+        before = ffd.TRACE_COUNT
+        # 20 distinct pod classes → the G bucket the warm-up's shapes=
+        # point covered, not the tiny bucket `inp` itself lands in
+        big = mkinput([mkpod(f"wy{g}-{i}", cpu=f"{100 + g * 50}m",
+                             mem="1Gi")
+                       for g in range(20) for i in range(2)])
+        res = TPUSolver(mesh="off").solve(big)  # fresh solver, same cache
+        assert not res.unschedulable
+        assert ffd.TRACE_COUNT == before
+
+    def test_warmup_batch_lane(self):
+        # batch_sizes= warms the generic vmapped kernel (the solverd
+        # fused lane) so a post-warm-up solve_batch stays compile-free
+        inp = mkinput([mkpod(f"wb{i}", cpu="1", mem="2Gi")
+                       for i in range(6)])
+        solver = TPUSolver(mesh="off")
+        solver.warmup(inp, batch_sizes=(3,))
+        before = ffd.TRACE_COUNT
+        out = solver.solve_batch([inp, inp, inp])
+        assert all(not r.unschedulable for r in out)
+        assert ffd.TRACE_COUNT == before
+
+    def test_warmup_never_poisons_solver_state(self):
+        inp = mkinput([mkpod(f"wp{i}") for i in range(10)])
+        solver = TPUSolver(mesh="off")
+        ref = TPUSolver(mesh="off").solve(inp)
+        solver.warmup(inp)
+        assert solver._last_active is None
+        assert solver._last_new_segments is None
+        assert_identical(solver.solve(inp), ref)
+
+    def test_gated_solver_warmup_is_best_effort(self):
+        from karpenter_tpu.controllers.state import GatedSolver
+
+        class _Opts:
+            class feature_gates:
+                tpu_solver = True
+        gs = GatedSolver.__new__(GatedSolver)
+        gs.options = _Opts()
+
+        class _Boom:
+            def warmup(self, inp, shapes=()):
+                raise RuntimeError("device fell over")
+        gs.tpu = _Boom()
+        assert gs.warmup(None) == 0  # degrade, never raise
+        gs.tpu = object()            # no warmup attr at all
+        assert gs.warmup(None) == 0
+        _Opts.feature_gates.tpu_solver = False
+        assert gs.warmup(None) == 0

@@ -208,8 +208,19 @@ def fire_bench(round_no: int, bench_timeout_s: float) -> bool:
                 if live and not old_live:
                     write = True
                 elif live and old_live:
-                    write = (result.get("p50_ms") or 1e18) <= (
-                        old.get("p50_ms") or 1e18)
+                    # explicit None checks: `or 1e18` treated a p50 of 0
+                    # (falsy) as WORST, so a legitimately instant run
+                    # could never replace the artifact.  A new record
+                    # with no p50 can't prove itself better, and when
+                    # BOTH lack p50 the existing artifact stands.
+                    new_p50 = result.get("p50_ms")
+                    old_p50 = old.get("p50_ms")
+                    if new_p50 is None:
+                        write = False
+                    elif old_p50 is None:
+                        write = True
+                    else:
+                        write = new_p50 <= old_p50
             except (OSError, ValueError, AttributeError, TypeError):
                 # unreadable/odd-shaped artifact: only a LIVE run may
                 # replace it — a CPU-degraded run clobbering an artifact
@@ -327,7 +338,16 @@ def main() -> int:
                         # microbenchmarks (RTT/bandwidth/knob A/B) that
                         # ground the tunnel optimizations — the profile
                         # logs its own record to the attempts log
+                        # the profile measures link RTT/bandwidth, so the
+                        # concurrent-host-work guard must cover it the
+                        # same way it covers the bench: re-create the
+                        # sentinel fire_bench just removed for the
+                        # profile's duration (same stale-after-timeout
+                        # contract: it holds the firing timestamp)
+                        sentinel = os.path.join(REPO, ".bench_running")
                         try:
+                            with open(sentinel, "w") as f:
+                                f.write(str(time.time()))
                             subprocess.run(
                                 [sys.executable,
                                  os.path.join(HERE, "tunnel_profile.py")],
@@ -336,6 +356,11 @@ def main() -> int:
                                 stderr=subprocess.DEVNULL)
                         except (subprocess.TimeoutExpired, OSError):
                             pass
+                        finally:
+                            try:
+                                os.unlink(sentinel)
+                            except OSError:
+                                pass
                         # do NOT exit: the relay comes in WINDOWS, and a
                         # later window (warmer caches, quieter host) can
                         # beat this run — fire_bench only overwrites the
