@@ -1,0 +1,30 @@
+# Repo-level targets.  The native extension's own build lives in
+# native/Makefile (`make -C native`, `make -C native asan`).
+
+PY ?= python
+
+.PHONY: test multichip lint native asan
+
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
+		-p no:cacheprovider
+
+# The forced-8-device mesh parity suite: conftest provisions 8 virtual
+# CPU devices (xla_force_host_platform_device_count), so the shard_map
+# data path — residency, donation safety, compacted decode, the sharded
+# warmup gate, and bit-parity vs single-device — runs without TPU
+# hardware.  `bench.py --multichip` is the numbers side of the same
+# harness (MULTICHIP_rNN.json).
+multichip:
+	JAX_PLATFORMS=cpu $(PY) -m pytest \
+		tests/test_mesh_solver.py tests/test_solver_mesh.py \
+		-q -p no:cacheprovider
+
+lint:
+	$(PY) -m hack.analyze
+
+native:
+	$(MAKE) -C native
+
+asan:
+	$(MAKE) -C native asan

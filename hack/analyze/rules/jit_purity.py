@@ -26,6 +26,15 @@ with the parent):
   * building a jit wrapper inside a function body — a fresh jit cache
     per call forces a recompile every invocation
 
+The same walk descends into `shard_map` BODIES — functions passed (bare
+or as `partial(f, k=...)`) to `shard_map(...)` / `jax.experimental.
+shard_map.shard_map(...)`.  A sharded region is jit territory with a
+twist: there are no `static_argnames`, so every parameter is traced
+EXCEPT ones bound by keyword through the `partial` (the mesh executor's
+`axis_name=`/`max_nodes=` idiom — those are Python constants baked at
+wrap time).  Host effects and branch-on-traced inside a sharded body
+previously went unflagged entirely.
+
 Plus, for the hot-path modules (`solver/solve.py`, `solver/encode.py`,
 `solver/ffd.py`): `print(...)` anywhere — stdout inside the solve path
 is both a latency tax and a tracing side effect.
@@ -146,6 +155,48 @@ def _jitted_functions(ctx: FileContext):
                 yield by_name[target.id], spec
 
 
+def _is_shard_map(node: ast.AST) -> bool:
+    """A `shard_map(...)` call — bare name or any attribute path ending
+    in .shard_map (jax.experimental.shard_map.shard_map, sm.shard_map)."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    return ((isinstance(f, ast.Name) and f.id == "shard_map")
+            or (isinstance(f, ast.Attribute) and f.attr == "shard_map"))
+
+
+def _shard_map_bodies(ctx: FileContext):
+    """Yield (FunctionDef, static-param-names) for every same-file
+    function passed to shard_map — bare (`shard_map(body, ...)`) or
+    partial-wrapped (`shard_map(partial(body, 8, axis_name=...), ...)`).
+    BOTH kinds of partial bindings are Python constants baked at wrap
+    time, i.e. statics: keywords by name, positionals by consuming the
+    body's leading parameters in order."""
+    by_name = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.FunctionDef):
+            by_name.setdefault(node.name, node)
+    for node in ast.walk(ctx.tree):
+        if not _is_shard_map(node) or not node.args:
+            continue
+        body = node.args[0]
+        static: Set[str] = set()
+        n_pos = 0
+        if isinstance(body, ast.Call):
+            f = body.func
+            is_partial = ((isinstance(f, ast.Name) and f.id == "partial")
+                          or (isinstance(f, ast.Attribute)
+                              and f.attr == "partial"))
+            if is_partial and body.args:
+                static = {kw.arg for kw in body.keywords if kw.arg}
+                n_pos = len(body.args) - 1
+                body = body.args[0]
+        if isinstance(body, ast.Name) and body.id in by_name:
+            fn = by_name[body.id]
+            static |= set(_param_names(fn)[:n_pos])
+            yield fn, static
+
+
 def _names_in(node: ast.AST) -> Set[str]:
     return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
 
@@ -153,6 +204,67 @@ def _names_in(node: ast.AST) -> Set[str]:
 def _is_none_check(test: ast.AST) -> bool:
     return (isinstance(test, ast.Compare)
             and all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops))
+
+
+def _scan_body(ctx: FileContext, fn: ast.FunctionDef, traced: Set[str],
+               kind: str) -> Iterator[Finding]:
+    """The purity walk over one traced function body — shared by jitted
+    functions and shard_map bodies (`kind` names which, in messages)."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                if f.attr == "item":
+                    yield ctx.finding(RULE_NAME, node,
+                                      ".item() forces a device→host "
+                                      f"sync inside a {kind} function")
+                elif isinstance(f.value, ast.Name):
+                    if f.value.id in _NUMPY_ALIASES:
+                        yield ctx.finding(
+                            RULE_NAME, node,
+                            f"numpy call ({f.value.id}.{f.attr}) inside "
+                            f"a {kind} function — host round-trip; use "
+                            "jnp")
+                    elif f.value.id in _TIME_ALIASES:
+                        yield ctx.finding(
+                            RULE_NAME, node,
+                            f"{f.value.id}.{f.attr}() inside a {kind} "
+                            "function — host clock reads don't trace")
+                    elif f.value.id == "os" and f.attr == "getenv":
+                        yield ctx.finding(
+                            RULE_NAME, node,
+                            f"os.getenv inside a {kind} function — env "
+                            "reads bake into the trace")
+            elif isinstance(f, ast.Name):
+                if f.id == "print":
+                    yield ctx.finding(
+                        RULE_NAME, node,
+                        f"print() inside a {kind} function")
+                elif f.id in ("float", "int", "bool") and node.args:
+                    used = _names_in(node.args[0]) & traced
+                    if used:
+                        yield ctx.finding(
+                            RULE_NAME, node,
+                            f"{f.id}() on traced value "
+                            f"({', '.join(sorted(used))}) forces "
+                            "concretization under trace")
+        elif isinstance(node, ast.Attribute) and node.attr == "environ" \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "os":
+            yield ctx.finding(
+                RULE_NAME, node,
+                f"os.environ read inside a {kind} function")
+        elif isinstance(node, (ast.If, ast.While)):
+            if _is_none_check(node.test):
+                continue
+            used = _names_in(node.test) & traced
+            if used:
+                yield ctx.finding(
+                    RULE_NAME, node,
+                    f"Python branch on traced value "
+                    f"({', '.join(sorted(used))}) — "
+                    "TracerBoolConversionError at trace time; use "
+                    "lax.cond/jnp.where or mark it static")
 
 
 def check(ctx: FileContext) -> Iterator[Finding]:
@@ -172,65 +284,20 @@ def check(ctx: FileContext) -> Iterator[Finding]:
         seen.add(id(fn))
         params = set(_param_names(fn))
         static = _static_names(spec, fn, consts)
-        traced = params - static
         for name in static - params:
             yield ctx.finding(
                 RULE_NAME, spec or fn,
                 f"static_argnames names '{name}' which is not a parameter "
                 f"of {fn.name}() — jax raises at first call")
-        for node in ast.walk(fn):
-            if isinstance(node, ast.Call):
-                f = node.func
-                if isinstance(f, ast.Attribute):
-                    if f.attr == "item":
-                        yield ctx.finding(RULE_NAME, node,
-                                          ".item() forces a device→host "
-                                          "sync inside a jitted function")
-                    elif isinstance(f.value, ast.Name):
-                        if f.value.id in _NUMPY_ALIASES:
-                            yield ctx.finding(
-                                RULE_NAME, node,
-                                f"numpy call ({f.value.id}.{f.attr}) inside "
-                                "a jitted function — host round-trip; use "
-                                "jnp")
-                        elif f.value.id in _TIME_ALIASES:
-                            yield ctx.finding(
-                                RULE_NAME, node,
-                                f"{f.value.id}.{f.attr}() inside a jitted "
-                                "function — host clock reads don't trace")
-                        elif f.value.id == "os" and f.attr == "getenv":
-                            yield ctx.finding(
-                                RULE_NAME, node,
-                                "os.getenv inside a jitted function — env "
-                                "reads bake into the trace")
-                elif isinstance(f, ast.Name):
-                    if f.id == "print":
-                        yield ctx.finding(RULE_NAME, node,
-                                          "print() inside a jitted function")
-                    elif f.id in ("float", "int", "bool") and node.args:
-                        used = _names_in(node.args[0]) & traced
-                        if used:
-                            yield ctx.finding(
-                                RULE_NAME, node,
-                                f"{f.id}() on traced value "
-                                f"({', '.join(sorted(used))}) forces "
-                                "concretization under trace")
-            elif isinstance(node, ast.Attribute) and node.attr == "environ" \
-                    and isinstance(node.value, ast.Name) \
-                    and node.value.id == "os":
-                yield ctx.finding(RULE_NAME, node,
-                                  "os.environ read inside a jitted function")
-            elif isinstance(node, (ast.If, ast.While)):
-                if _is_none_check(node.test):
-                    continue
-                used = _names_in(node.test) & traced
-                if used:
-                    yield ctx.finding(
-                        RULE_NAME, node,
-                        f"Python branch on traced value "
-                        f"({', '.join(sorted(used))}) — "
-                        "TracerBoolConversionError at trace time; use "
-                        "lax.cond/jnp.where or mark it static")
+        yield from _scan_body(ctx, fn, params - static, kind="jitted")
+    # shard_map bodies trace with the mesh program: same purity rules,
+    # but statics come from partial keyword bindings, not static_argnames
+    for fn, static in _shard_map_bodies(ctx):
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        yield from _scan_body(ctx, fn, set(_param_names(fn)) - static,
+                              kind="shard_map body")
 
     # recompile hazard: a jit wrapper built inside a function body gets a
     # fresh compilation cache per call. Decorator expressions are not

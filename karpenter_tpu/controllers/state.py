@@ -32,6 +32,11 @@ class GatedSolver:
             self.tpu = SolverServiceClient(options.solver_endpoint)
         else:
             from karpenter_tpu.solver import TPUSolver
+            # SOLVER_MESH (options) configures the mesh story;
+            # KARPENTER_TPU_MESH is the operator's rollback knob and
+            # overrides inside _resolve_mesh — flipping it to "off" on a
+            # misbehaving deployment restores the single-device path
+            # without an image or options change
             self.tpu = TPUSolver(max_nodes=options.solver_max_nodes,
                                  mesh=getattr(options, "solver_mesh", "auto"))
             # warm the native host-ops build at startup, never inside a

@@ -76,6 +76,11 @@ class Options:
             opts.feature_gates = FeatureGates.parse(os.environ["FEATURE_GATES"])
         opts.solver_endpoint = os.environ.get(
             "SOLVER_ENDPOINT", opts.solver_endpoint)
+        # SOLVER_MESH configures the mesh story.  The KARPENTER_TPU_MESH
+        # rollback override is deliberately NOT parsed here: its single
+        # grammar owner is TPUSolver._mesh_env_spec, applied inside
+        # _resolve_mesh so it reaches every solver however built —
+        # including the one state.py constructs from this options value
         opts.solver_mesh = os.environ.get("SOLVER_MESH", opts.solver_mesh)
         opts.leader_elect = os.environ.get(
             "LEADER_ELECT", "").strip().lower() in ("1", "true", "yes")

@@ -10,6 +10,12 @@ stays fully distributed — one chip's HBM never holds the whole
 nodes×offerings state.
 """
 
-from karpenter_tpu.parallel.mesh import make_mesh, sharded_solve_ffd
+from karpenter_tpu.parallel.mesh import (
+    MaskRowRegistry,
+    MeshExecutor,
+    make_mesh,
+    sharded_solve_ffd,
+)
 
-__all__ = ["make_mesh", "sharded_solve_ffd"]
+__all__ = ["MaskRowRegistry", "MeshExecutor", "make_mesh",
+           "sharded_solve_ffd"]

@@ -43,8 +43,13 @@ def _get_solver():
         from karpenter_tpu.utils.platform import configure
         configure()  # also enables the shared persistent compile cache
         from karpenter_tpu.solver import TPUSolver
+        # SOLVER_MESH picks the daemon's mesh story the same way the
+        # operator options do; KARPENTER_TPU_MESH (read inside the
+        # solver per _resolve_mesh) stays the rollback override that
+        # beats whatever was configured here
         _solver = TPUSolver(
-            max_nodes=int(os.environ.get("KARPENTER_TPU_MAX_NODES", "2048")))
+            max_nodes=int(os.environ.get("KARPENTER_TPU_MAX_NODES", "2048")),
+            mesh=os.environ.get("SOLVER_MESH", "auto"))
     return _solver
 
 
@@ -102,8 +107,20 @@ def handle_batch(payloads: List[bytes]) -> List[bytes]:
             except KeyError as e:
                 responses[i] = ("error", f"catalog body missing {e}")
         elif kind == "stats":
+            # mesh observability: remote operators (and the multichip
+            # bench) see whether the daemon actually sharded, and how
+            # much O-axis traffic the resident path has shipped
+            mesh_info = None
+            if _solver is not None and _solver._mesh_exec is not None:
+                ex = _solver._mesh_exec
+                mesh_info = {
+                    "devices": _solver.mesh.size,
+                    "o_axis_transfers": len(ex.transfers),
+                    "o_axis_bytes": sum(b for _, b in ex.transfers),
+                }
             responses[i] = ("result", {"batch_sizes": list(_batch_log),
-                                       "catalogs": len(_catalogs)})
+                                       "catalogs": len(_catalogs),
+                                       "mesh": mesh_info})
         elif kind == "warmup":
             # padding-bucket precompile against an uploaded catalog: the
             # operator fires this at startup so the daemon's first real

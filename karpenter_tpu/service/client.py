@@ -12,6 +12,14 @@ the same invalidation signal TPUSolver uses) and referenced by hash
 thereafter, keeping the steady-state request small: pods + cluster deltas
 only. Concurrent requests coalesce in the daemon's native batch window
 into one vmapped device call.
+
+Mesh: the daemon owns the devices, so its mesh story is configured in
+ITS environment — `SOLVER_MESH` selects (backend._get_solver), and the
+`KARPENTER_TPU_MESH=off/auto/N` rollback knob overrides inside the
+daemon's solver exactly as in-process. `stats()` reports the resolved
+mesh (device count + resident-path O-axis transfer counters) so a remote
+operator can verify which story is live without shell access to the
+daemon host.
 """
 
 from __future__ import annotations

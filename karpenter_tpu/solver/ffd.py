@@ -75,6 +75,29 @@ def _note_trace(**statics) -> None:
 # sentinel lives in encode.py (the sole definition).
 
 
+def _axmax(x: jnp.ndarray, axis_name, axis=None) -> jnp.ndarray:
+    """Max over a (possibly mesh-sharded) axis: local max, then — under
+    `shard_map` (axis_name set) — an explicit all-reduce-max over the
+    mesh axis.  This is the kernel split's ONLY cross-device collective
+    shape: every column-axis winner selection reduces locally on each
+    device's catalog shard and combines via one `pmax`.  Max is exactly
+    associative (no rounding), so the sharded value is bit-identical to
+    the single-device reduction."""
+    r = jnp.max(x, axis=axis)
+    if axis_name is not None:
+        r = jax.lax.pmax(r, axis_name)
+    return r
+
+
+def _axany(x: jnp.ndarray, axis_name) -> jnp.ndarray:
+    """any() over a possibly-sharded axis (pmax over {0,1} — jax pmax
+    rejects bools)."""
+    r = jnp.any(x)
+    if axis_name is not None:
+        r = jax.lax.pmax(r.astype(jnp.int32), axis_name) > 0
+    return r
+
+
 def _fit_count(avail: jnp.ndarray, req: jnp.ndarray) -> jnp.ndarray:
     """How many pods of per-pod request `req` [R] fit in `avail` [..., R]."""
     safe = jnp.where(req > 0, req, 1.0)
@@ -234,6 +257,17 @@ def _solve_ffd_impl(
                                   # dominant download (O runs to ~11k
                                   # columns at full catalog), and the
                                   # tunnel makes bytes the cost.
+    axis_name=None,               # static: set (to the mesh axis name)
+                                  # ONLY inside a shard_map body — the
+                                  # column axes (O and PT) then arrive as
+                                  # per-device shards, the group-scan
+                                  # state stays replicated, and every
+                                  # column-axis winner selection reduces
+                                  # locally then all-reduce-maxes over
+                                  # the mesh (see _axmax).  None = the
+                                  # single-device program, lowered
+                                  # exactly as before this parameter
+                                  # existed.
 ):
     G, RDIM = group_req.shape
     E = exist_remaining.shape[0]
@@ -242,8 +276,12 @@ def _solve_ffd_impl(
     assert O == PT * zc, (O, PT, zc)
     _note_trace(G=G, E=E, O=O, N=max_nodes, D=group_dbase.shape[1],
                 with_topology=with_topology, sparse_k=sparse_k,
-                sparse_n=sparse_n, mask_packed=mask_packed)
+                sparse_n=sparse_n, mask_packed=mask_packed,
+                axis_name=axis_name)
     if mask_packed:
+        # a bit-packed mask cannot arrive as a mesh shard: the byte axis
+        # packs 8 columns and a shard boundary may split a byte
+        assert axis_name is None, "mask_packed has no sharded form"
         group_mask = _expand_packed_mask(group_mask, O)
 
     def pt_expand(a_pt):
@@ -331,7 +369,8 @@ def _solve_ffd_impl(
             cap_n = jnp.where(
                 active,
                 jnp.minimum(
-                    jnp.where(elig_pt, cap_npt, 0).max(axis=1), ncap),
+                    _axmax(jnp.where(elig_pt, cap_npt, 0), axis_name,
+                           axis=1), ncap),
                 0)
             # pool-limit clamp: the prefix-residual form charges earlier
             # same-pool nodes that an ALL-or-nothing fill will never
@@ -367,9 +406,10 @@ def _solve_ffd_impl(
             active_, node_pool_, num_active_ = active, node_pool, num_active
             for p in range(P):
                 cols_p = col_feas & (col_pool == p)
-                k_full = jnp.max(jnp.where(cols_p, per_col, 0))
+                k_full = _axmax(jnp.where(cols_p, per_col, 0), axis_name)
                 pool_room = jnp.all(limits[p] - pool_daemon[p] - req >= -EPS)
-                can = cols_p.any() & pool_room & (c_rem > 0) & (k_full > 0)
+                can = (_axany(cols_p, axis_name) & pool_room
+                       & (c_rem > 0) & (k_full > 0))
                 # whole-node groups must land the ENTIRE remainder on one
                 # node of one pool — a pool that can only take part of it
                 # (column capacity, or budget after the one-node daemon
@@ -464,7 +504,15 @@ def _solve_ffd_impl(
             # reshape + tiny [N,ZC,D] combine instead of a scatter-based
             # segment_max over the O axis
             zc_dom = col_dom[:zc]                              # [ZC]
-            slotmax = cap_no.reshape(-1, PT, zc).max(axis=1)   # [N, ZC]
+            if axis_name is not None:
+                # the per-slot domain pattern must be the GLOBAL leading
+                # block's, not each shard's: a shard of pure padding (or
+                # a dense zc=1 layout, where every column carries its own
+                # domain) would otherwise hand every device a different
+                # zc_dom.  Shard 0 owns the global first block.
+                zc_dom = jax.lax.all_gather(zc_dom, axis_name)[0]
+            slotmax = _axmax(cap_no.reshape(-1, PT, zc), axis_name,
+                             axis=1)                           # [N, ZC]
             cap_nd = jnp.where(
                 zc_dom[None, :, None] == dom_ids[None, None, :],
                 slotmax[:, :, None], 0).max(axis=1).T          # [D, N]
@@ -480,7 +528,7 @@ def _solve_ffd_impl(
             # rotation cycles over the REAL domain count (not the padded
             # bucket D): modulo the pad width, the residues are skewed and
             # most unpinned nodes land on one domain.
-            d_real = jnp.maximum(jnp.max(col_dom) + 1, 1)
+            d_real = jnp.maximum(_axmax(col_dom, axis_name) + 1, 1)
             score = (jnp.minimum(cap_nd, cnt) * jnp.int32(D + 1)
                      + (idx[None, :] + dom_ids[:, None]) % d_real)
             bd = jnp.argmax(score, axis=0).astype(jnp.int32)        # [N]
@@ -495,6 +543,10 @@ def _solve_ffd_impl(
                 kfull_pd.append(jnp.where(dom_cols & cols_p[None, :],
                                           per_col[None, :], 0).max(-1))  # [D]
             kfull_pd = jnp.stack(kfull_pd)                          # [P, D]
+            if axis_name is not None:
+                # one all-reduce for the whole [P, D] winner table
+                # instead of P×D scalar collectives
+                kfull_pd = jax.lax.pmax(kfull_pd, axis_name)
             rooms = jnp.stack([
                 jnp.all(limits[p] - pool_daemon[p] - req >= -EPS)
                 for p in range(P)])                                 # [P]
@@ -805,6 +857,34 @@ solve_ffd_coalesced = partial(
 solve_ffd_coalesced_donated = partial(
     jax.jit, static_argnames=_COALESCED_STATICS,
     donate_argnums=(0,))(_solve_ffd_coalesced_impl)
+
+
+def _solve_ffd_resident_impl(buf, mask_table, col_alloc, col_daemon,
+                             pt_alloc, col_pool, pool_daemon, col_zone,
+                             col_ct, layout=None, max_nodes: int = 1024,
+                             zc: int = 1, sparse_n: int = 0,
+                             axis_name=None):
+    """The mesh executor's kernel body (parallel/mesh.py wraps this in
+    `shard_map` + jit): one coalesced REPLICATED problem buffer, the
+    device-RESIDENT sharded catalog args, and a device-resident sharded
+    mask-row table.  The buffer's position 2 carries per-group row
+    indices into `mask_table` instead of the [G, O] mask itself — the
+    mask rows are content-addressed and resident across solves
+    (solve.py _MaskRowRegistry), so no O-axis array travels per solve.
+    The row gather runs on each device's local [C, O/devices] shard."""
+    (group_req, group_count, group_rows, exist_cap, exist_remaining,
+     pool_limit, group_ncap, group_dsel, group_dbase, group_dcap,
+     group_skew, group_mindom, group_delig, group_whole,
+     exist_zone, exist_ct) = _unpack_problem(buf, layout)
+    group_mask = mask_table[group_rows]
+    return _solve_ffd_impl(
+        group_req, group_count, group_mask, exist_cap, exist_remaining,
+        col_alloc, col_daemon, pt_alloc, col_pool, pool_daemon,
+        pool_limit, group_ncap, group_dsel, group_dbase, group_dcap,
+        group_skew, group_mindom, group_delig, group_whole,
+        col_zone, col_ct, exist_zone, exist_ct,
+        max_nodes=max_nodes, zc=zc, sparse_n=sparse_n,
+        axis_name=axis_name)
 
 # The consolidation simulator's batch axis (SURVEY §7 step 6): many
 # candidate-removal simulations against one cluster state share the catalog

@@ -80,6 +80,12 @@ class TPUSolver:
         - an int n: mesh over the first n devices.
         - a jax.sharding.Mesh: use as given (axis name "cat").
 
+        The env knob ``KARPENTER_TPU_MESH=off/auto/N`` OVERRIDES the
+        constructed spec (it is the operator's rollback lever, so it
+        must beat code defaults wherever the solver was built — operator
+        options, solverd daemon, bench).  Malformed values degrade to
+        the constructed spec, never crash.
+
         Resolution is lazy (first solve) so constructing a solver never
         initializes a JAX backend.
         """
@@ -110,6 +116,7 @@ class TPUSolver:
         self._mesh_spec = mesh
         self._mesh = None
         self._mesh_resolved = False
+        self._mesh_exec = None  # parallel.MeshExecutor once resolved
         self._last_active: Optional[int] = None  # node-axis warm start
         # take_new compaction warm start: the previous solve's max
         # per-group new-node fan-out (None = dense until measured)
@@ -126,32 +133,64 @@ class TPUSolver:
         """The resolved mesh (None = single-device)."""
         return self._resolve_mesh()
 
+    @staticmethod
+    def _mesh_env_spec(spec):
+        """Apply the KARPENTER_TPU_MESH rollback knob: "off"/"0" forces
+        single-device, "auto" forces auto, an integer forces that device
+        count; unset or malformed leaves the constructed spec alone."""
+        import os as _os
+        raw = _os.environ.get("KARPENTER_TPU_MESH", "").strip().lower()
+        if not raw:
+            return spec
+        if raw in ("off", "0", "false", "none"):
+            return None
+        if raw == "auto":
+            return "auto"
+        try:
+            return int(raw)
+        except ValueError:
+            return spec
+
     def _resolve_mesh(self):
         if self._mesh_resolved:
             return self._mesh
         self._mesh_resolved = True
-        spec = self._mesh_spec
+        spec = self._mesh_env_spec(self._mesh_spec)
         if spec in (None, 0, False, "off", ""):
             return None
         import jax
         from jax.sharding import Mesh
         if isinstance(spec, Mesh):
             self._mesh = spec if spec.size > 1 else None
-            return self._mesh
-        from karpenter_tpu.parallel import make_mesh
-        if spec == "auto":
-            n = len(jax.devices())
         else:
-            n = int(spec)
-        if n > 1:
-            self._mesh = make_mesh(n)
+            from karpenter_tpu.parallel import make_mesh
+            if spec == "auto":
+                n = len(jax.devices())
+            else:
+                try:
+                    n = int(spec)
+                except (TypeError, ValueError):
+                    n = 0  # malformed spec degrades to single-device
+            if n > 1:
+                self._mesh = make_mesh(n)
+        if self._mesh is not None:
+            from karpenter_tpu.parallel import MeshExecutor
+            # honor a caller-supplied Mesh's own axis name (make_mesh
+            # uses "cat"; hardcoding it here would reject foreign meshes
+            # at the first device_put)
+            self._mesh_exec = MeshExecutor(
+                self._mesh, axis=self._mesh.axis_names[0])
         return self._mesh
 
     def _pt_align(self) -> int:
-        """The (pool,type) axis pads to a bucket (jit-cache stability)
-        that also divides evenly over the mesh: the column axis O =
-        PT_pad × ZC shards over PT_pad blocks, so PT_pad must be a
-        multiple of the mesh size."""
+        """The (pool,type) axis pads to lcm(PT_ALIGN, mesh size): a
+        multiple of PT_ALIGN for jit-cache stability AND of the mesh
+        size so the column axis O = PT_pad × ZC splits on whole
+        (pool,type)-block boundaries — the shard_map kernel's local
+        pt-granular math requires every shard to hold whole blocks.
+        The lcm holds for EVERY mesh size, including ones that don't
+        divide PT_ALIGN (6, 48, 96, ... — regression-tested in
+        tests/test_mesh_solver.py at a non-divisor size)."""
         align = PT_ALIGN
         mesh = self._resolve_mesh()
         if mesh is None:
@@ -163,9 +202,10 @@ class TPUSolver:
         """(col, col2, gcol, rep) NamedShardings for the active mesh."""
         from jax.sharding import NamedSharding, PartitionSpec as P
         mesh = self._resolve_mesh()
-        return (NamedSharding(mesh, P("cat")),
-                NamedSharding(mesh, P("cat", None)),
-                NamedSharding(mesh, P(None, "cat")),
+        ax = mesh.axis_names[0]
+        return (NamedSharding(mesh, P(ax)),
+                NamedSharding(mesh, P(ax, None)),
+                NamedSharding(mesh, P(None, ax)),
                 NamedSharding(mesh, P()))
 
     def _catalog_encoding(self, inp: ScheduleInput):
@@ -214,25 +254,54 @@ class TPUSolver:
             import jax
             mesh = self._resolve_mesh()
             if mesh is not None:
-                # catalog columns shard over ICI; the kernel's column
-                # reductions lower to XLA collectives
-                col, col2, _, rep = self._shardings()
-                put_c = lambda a: jax.device_put(a, col)
-                put_c2 = lambda a: jax.device_put(a, col2)
-                put_r = lambda a: jax.device_put(a, rep)
+                # catalog columns shard over ICI as PRE-PARTITIONED
+                # per-device slices — uploaded once per catalog identity
+                # and resident until the catalog changes (the mesh data
+                # path's residency contract; MeshExecutor logs the bytes
+                # so tests can assert nothing O-axis travels per solve).
+                # pt_alloc shards in lockstep with the O grid (the
+                # shard_map kernel's local pt-granular fit math), where
+                # the GSPMD path replicated it.
+                from jax.sharding import PartitionSpec as _P
+                ex = self._mesh_exec
+                ax = ex.axis
+                put_c = lambda a: ex.put_sharded(a, _P(ax), "catalog")
+                put_c2 = lambda a: ex.put_sharded(a, _P(ax, None),
+                                                  "catalog")
+                put_r = ex.put_replicated
+                pt_put = put_c2
             else:
-                put_c = put_c2 = put_r = jax.device_put
+                put_c = put_c2 = put_r = pt_put = jax.device_put
+            # column-axis pads carry the TILED per-block (zone, ct)
+            # pattern rather than zeros: a mesh shard made purely of
+            # padding blocks must still see the global slot→domain map
+            # (ffd heavy branch zc_dom).  Pad values are semantically
+            # inert either way — padded blocks fit nothing and are in no
+            # group mask — so the single-device program is unaffected.
+            def _pad_tiled(a):
+                out = np.empty(O, a.dtype)
+                n = len(a)
+                out[:n] = a
+                if O > n and ZC:
+                    pat = a[:ZC] if n >= ZC else np.zeros(ZC, a.dtype)
+                    reps = -(-(O - n) // ZC)
+                    out[n:] = np.tile(pat, reps)[:O - n]
+                return out
             cat.device_args = dict(
                 col_alloc=put_c2(self._pad(cat.col_alloc, 0, O)),
                 col_daemon=put_c2(self._pad(cat.col_daemon, 0, O)),
-                pt_alloc=put_r(self._pad(cat.pt_alloc, 0, PT_pad)),
+                pt_alloc=pt_put(self._pad(cat.pt_alloc, 0, PT_pad)),
                 col_pool=put_c(self._pad(cat.col_pool, 0, O)),
-                col_zone=put_c(self._pad(cat.col_zone, 0, O)),
-                col_ct=put_c(self._pad(cat.col_ct, 0, O)),
+                col_zone=put_c(_pad_tiled(cat.col_zone)),
+                col_ct=put_c(_pad_tiled(cat.col_ct)),
                 pool_daemon=put_r(cat.pool_daemon),
                 O=O,
                 ZC=ZC,
             )
+            if mesh is not None:
+                from karpenter_tpu.parallel import MaskRowRegistry
+                cat.device_args["mask_registry"] = MaskRowRegistry(
+                    self._mesh_exec, O)
             self._cat = cat
             self._cat_entry = (key, cat)
             return cat
@@ -324,6 +393,21 @@ class TPUSolver:
             self._pad(enc.exist_ct, 0, E, value=-1),
         )
 
+    def _problem_args_mesh(self, enc: EncodedProblem, G: int, E: int,
+                           Db: int, O: int, registry):
+        """The mesh resident path's variant of _problem_args: identical
+        tuple layout, but slot 2 carries per-group ROW INDICES into the
+        device-resident content-addressed mask table instead of the
+        [G, O] mask itself — after the registry warm-up, no O-axis array
+        travels per solve (padded group slots hash to the reserved
+        all-false row 0).  Returns (prob, table): dispatch must use the
+        returned table snapshot — the ids are valid against IT even if a
+        concurrent ensure() (background warmup thread) cycles the
+        registry's live table."""
+        prob = self._problem_args(enc, G, E, Db, O)
+        rows, table = registry.ensure(prob[2])
+        return prob[:2] + (rows,) + prob[3:], table
+
     def _put_problem(self, prob, batched: bool = False):
         """Commit per-problem arrays to the mesh: `group_mask` (the only
         per-problem array with a column axis) shards like the catalog;
@@ -336,7 +420,7 @@ class TPUSolver:
         from jax.sharding import NamedSharding, PartitionSpec as P
         _, _, gcol, rep = self._shardings()
         if batched:
-            gcol = NamedSharding(mesh, P(None, None, "cat"))
+            gcol = NamedSharding(mesh, P(None, None, mesh.axis_names[0]))
         return tuple(
             jax.device_put(a, gcol if i == 2 else rep)
             for i, a in enumerate(prob))
@@ -613,7 +697,8 @@ class TPUSolver:
                 return b
         return self.max_nodes
 
-    def _make_run(self, prob, dev, mbits: bool, pipe: bool):
+    def _make_run(self, prob, dev, mbits: bool, pipe: bool,
+                  mesh_table=None):
         """Build the dispatch closure ``run(n, kn)`` for one padded
         problem — shared verbatim by _solve_attempt and warmup(), so
         warm-up requests exactly the programs the real solve will (the
@@ -623,6 +708,32 @@ class TPUSolver:
         re-uploads from the live host copy, because the donated slot dies
         with the program it fed (retries — slot exhaustion, compaction
         overflow — re-dispatch)."""
+        if self._resolve_mesh() is not None:
+            # mesh resident path: ONE coalesced replicated buffer through
+            # the donated two-slot rotation; the mask table and catalog
+            # shards are already resident, so this upload is the solve's
+            # entire host→device traffic (and it has no column axis)
+            ex = self._mesh_exec
+            buf, layout = ffd.pack_problem(prob)
+
+            def run(n, kn):
+                b = (self._upload_slots.put(buf, ex.rep) if pipe
+                     else buf)
+                out = ex.solve(b, mesh_table, dev, layout, n, kn,
+                               donate=pipe)
+                if pipe and not b.is_deleted():
+                    # donate_argnums marks the slot for reuse, but a
+                    # backend that can't alias the replicated buffer into
+                    # any output (CPU emulation: no same-shape output
+                    # exists) leaves it ALIVE — delete explicitly so the
+                    # dead-after-dispatch contract is uniform across
+                    # backends (a stale re-read raises loudly instead of
+                    # silently feeding a second dispatch).  Safe while
+                    # the program is in flight: PJRT holds its own usage
+                    # reference until execution completes.
+                    b.delete()
+                return out
+            return run
         coalesce = self._coalesce_upload()
         if coalesce:
             buf, layout = ffd.pack_problem(prob)
@@ -680,21 +791,45 @@ class TPUSolver:
         Db = bucket(enc.n_domains, D_BUCKETS)
         dev = cat.device_args
         mbits = self._mask_packed()
-        prob = self._problem_args(enc, G, E, Db, dev["O"], pack_mask=mbits)
+        if self._resolve_mesh() is not None:
+            prob, mesh_table = self._problem_args_mesh(
+                enc, G, E, Db, dev["O"], dev["mask_registry"])
+        else:
+            prob = self._problem_args(enc, G, E, Db, dev["O"],
+                                      pack_mask=mbits)
+            mesh_table = None
         pipe = pipelining.pipeline_enabled()
-        run = self._make_run(prob, dev, mbits, pipe)
+        run = self._make_run(prob, dev, mbits, pipe, mesh_table)
         t2 = _time.perf_counter()
         kn = self._pick_sparse_n(mn)
         disp_s = dev_s = pull_s = 0.0
+        skew_s = None
 
         def execute(n, k):
             # dispatch (enqueue the async jitted call), then block for the
             # device step, then pull + unpack — timed separately so the
             # new `dispatch`/`pull` phases make the overlap visible
-            nonlocal disp_s, dev_s, pull_s
+            nonlocal disp_s, dev_s, pull_s, skew_s
             t_a = _time.perf_counter()
             packed = run(n, k)
             t_b = _time.perf_counter()
+            if self._mesh_exec is not None and hasattr(
+                    packed, "addressable_shards"):
+                # per-device completion skew, measured BEFORE the global
+                # block (after it every shard is done and the loop would
+                # read 0 always) and WITHOUT copying (re-reading each
+                # replicated shard would be n_devices extra full-result
+                # downloads).  Sequential residual waits: per_dev[i] is
+                # the extra wait for device i after 0..i-1 finished, so
+                # a straggler shows as one dominant residual.  On the
+                # CPU parity host all "devices" share the cores and this
+                # is noise — real ICI skew shows only on hardware (docs).
+                per_dev = []
+                for sh in packed.addressable_shards:
+                    t_s = _time.perf_counter()
+                    sh.data.block_until_ready()
+                    per_dev.append(_time.perf_counter() - t_s)
+                skew_s = (max(per_dev) - min(per_dev)) if per_dev else 0.0
             try:
                 packed.block_until_ready()
             except AttributeError:
@@ -745,6 +880,11 @@ class TPUSolver:
             pad=(t2 - t1) * 1e3, dispatch=disp_s * 1e3,
             device=dev_s * 1e3, pull=pull_s * 1e3,
             repair=(t4 - t3) * 1e3, decode=(t5 - t4) * 1e3)
+        mesh = self._resolve_mesh()
+        if skew_s is not None:
+            # per-device skew rides last_phase_ms (the multichip bench
+            # reads it) and the dispatch/pull spans below
+            self.last_phase_ms["pull_skew"] = skew_s * 1e3
         # per-phase histograms + spans; the histogram's `encode` is the
         # pure encode interval — pregroup is its own phase (last_phase_ms
         # keeps folding it into encode for the bench's host-share line).
@@ -757,8 +897,13 @@ class TPUSolver:
                 ("repair", t3, t4 - t3), ("decode", t4, t5 - t4)):
             metrics.SOLVER_PHASE_DURATION.observe(
                 dur, phase=phase, path="solve")
+            attrs = {}
+            if mesh is not None and phase in ("dispatch", "pull"):
+                attrs["mesh_devices"] = mesh.size
+                if skew_s is not None and phase == "pull":
+                    attrs["mesh_skew_ms"] = round(skew_s * 1e3, 3)
             tracing.record_span(f"solver.phase.{phase}",
-                                wall0 + (lo - t0), dur)
+                                wall0 + (lo - t0), dur, **attrs)
         return res
 
     # -- warm-up: padding-bucket precompile --------------------------------
@@ -811,9 +956,17 @@ class TPUSolver:
         Db = bucket(enc.n_domains, D_BUCKETS)
         # dtype source of truth: a real _problem_args call on the real
         # encoding — warm-up zeros must match the solve's dtypes exactly
-        # or they compile DIFFERENT programs
-        proto = self._problem_args(enc, baseG, baseE, Db, dev["O"],
-                                   pack_mask=mbits)
+        # or they compile DIFFERENT programs.  Under a mesh this also
+        # registers the real encoding's mask rows, sizing the resident
+        # table at its steady-state capacity tier so post-warmup solves
+        # hit the exact sharded programs warm-up compiled.
+        if self._resolve_mesh() is not None:
+            proto, mesh_table = self._problem_args_mesh(
+                enc, baseG, baseE, Db, dev["O"], dev["mask_registry"])
+        else:
+            proto = self._problem_args(enc, baseG, baseE, Db, dev["O"],
+                                       pack_mask=mbits)
+            mesh_table = None
         _G_AX = (0, 1, 2, 3, 6, 7, 8, 9, 10, 11, 12, 13)
 
         def zeros_at(i, a, G2, E2):
@@ -839,7 +992,7 @@ class TPUSolver:
         for (G2, E2) in sorted(targets):
             prob2 = tuple(zeros_at(i, a, G2, E2)
                           for i, a in enumerate(proto))
-            run = self._make_run(prob2, dev, mbits, pipe)
+            run = self._make_run(prob2, dev, mbits, pipe, mesh_table)
             for mn in ladder:
                 # dense (kn=0, what solve #1 runs while _last_new_segments
                 # is unmeasured) PLUS every take_new compaction tier the
@@ -856,6 +1009,16 @@ class TPUSolver:
                     except AttributeError:
                         pass
                     warmed += 1
+        # the generic batched kernel runs the gcol-sharded DENSE-mask
+        # path under a mesh (solve_batch does not use the resident
+        # row-index form), so its warm proto must be the dense one —
+        # the mesh proto's slot 2 is [G] row indices, which would both
+        # break _put_problem's rank-3 batched spec and warm the wrong
+        # kernel signature
+        proto_b = proto
+        if batch_sizes and self._resolve_mesh() is not None:
+            proto_b = self._problem_args(enc, baseG, baseE, Db, dev["O"],
+                                         pack_mask=mbits)
         for bsz in batch_sizes:
             B = bucket(max(int(bsz), 1), B_BUCKETS)
             max_cnt = 1
@@ -863,7 +1026,7 @@ class TPUSolver:
                 max_cnt = max(max_cnt, len(pods))
             sk = self._pick_sparse_k(max_cnt, baseE)
             prob0 = tuple(zeros_at(i, a, baseG, baseE)
-                          for i, a in enumerate(proto))
+                          for i, a in enumerate(proto_b))
             stacked = self._put_problem(
                 tuple(np.zeros((B,) + a.shape, a.dtype) for a in prob0),
                 batched=True)

@@ -176,6 +176,110 @@ def test_jit_purity_flags_wrapper_built_per_call(tmp_path):
     assert findings == []
 
 
+def test_jit_purity_descends_into_shard_map_bodies(tmp_path):
+    # host effects and branch-on-traced inside a sharded region went
+    # unflagged before the rule learned shard_map: the body is jit
+    # territory (it traces with the mesh program) but carries no
+    # static_argnames — every parameter is traced unless bound by the
+    # partial's keywords
+    findings, _ = _check(tmp_path, """
+        import numpy as np
+        import jax
+        from functools import partial
+        from jax.experimental.shard_map import shard_map
+
+
+        def body(x, y):
+            print("tracing")        # host side effect per trace
+            a = np.asarray(x)       # host round-trip under trace
+            if y > 0:               # branch on traced parameter
+                return a
+            return x
+
+
+        def build(mesh, specs):
+            return shard_map(body, mesh=mesh, in_specs=specs,
+                             out_specs=specs)
+    """, jit_purity)
+    msgs = " | ".join(f.message for f in findings)
+    assert "print()" in msgs and "shard_map body" in msgs
+    assert "numpy call" in msgs
+    assert "branch on traced value" in msgs
+
+
+def test_jit_purity_shard_map_partial_keywords_are_static(tmp_path):
+    # the mesh executor idiom: shard_map(partial(body, max_nodes=...,
+    # axis_name=...)) — keyword-bound params are Python constants baked
+    # at wrap time, so branching on them is trace-time control flow, and
+    # `is None` structure checks stay exempt as everywhere else
+    findings, _ = _check(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+        from jax.experimental.shard_map import shard_map
+
+
+        def body(x, max_nodes, axis_name=None):
+            if max_nodes > 4:              # static via partial binding
+                x = x * 2
+            if axis_name is not None:      # structure check: exempt
+                x = jax.lax.pmax(x, axis_name)
+            return jnp.sum(x)
+
+
+        def build(mesh, specs):
+            return shard_map(partial(body, max_nodes=8, axis_name="cat"),
+                             mesh=mesh, in_specs=specs, out_specs=specs)
+    """, jit_purity)
+    assert findings == []
+
+
+def test_jit_purity_shard_map_partial_positionals_are_static(tmp_path):
+    # positional partial bindings consume the body's LEADING params in
+    # order — they are Python constants too, and the shift must not
+    # misattribute which remaining params receive traced operands
+    findings, _ = _check(tmp_path, """
+        import jax.numpy as jnp
+        from functools import partial
+        from jax.experimental.shard_map import shard_map
+
+
+        def body(k, zc, x):
+            if k > 4:                      # static via positional bind
+                x = x * 2
+            if zc == 1:                    # static via positional bind
+                x = x + 1
+            return jnp.sum(x)
+
+
+        def build(mesh, specs):
+            return shard_map(partial(body, 8, 2), mesh=mesh,
+                             in_specs=specs, out_specs=specs)
+    """, jit_purity)
+    assert findings == []
+
+
+def test_jit_purity_shard_map_attribute_form_and_traced_branch(tmp_path):
+    # jax.experimental.shard_map.shard_map(...) attribute form resolves
+    # too, and a positional partial binding does NOT make a param static
+    findings, _ = _check(tmp_path, """
+        import jax.experimental.shard_map as sm
+        from functools import partial
+
+
+        def body(x, y):
+            while x > 0:       # traced: x is a real array parameter
+                x = x - y
+            return x
+
+
+        def build(mesh, specs):
+            return sm.shard_map(partial(body), mesh=mesh, in_specs=specs,
+                                out_specs=specs)
+    """, jit_purity)
+    assert any("branch on traced value" in f.message for f in findings)
+
+
 def test_jit_purity_suppression(tmp_path):
     _, report = _check(tmp_path, """
         import jax

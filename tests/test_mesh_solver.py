@@ -1,0 +1,372 @@
+"""The mesh-native solver data path (parallel/mesh.py MeshExecutor).
+
+Contracts beyond test_solver_mesh.py's path parity:
+
+  * residency — after warm-up, NO O-axis (catalog/mask) array travels
+    host→device per solve: catalog shards upload once per catalog
+    identity, mask rows are content-addressed deltas, and the steady
+    state ships only the small coalesced problem buffer.  Asserted
+    against MeshExecutor.transfers, not trusted.
+  * donation safety — with the pipeline on, the replicated problem
+    buffer rides the donated two-slot rotation: the slot is DEAD after
+    dispatch (re-reading raises), so a sharded in-flight program's input
+    can never be silently overwritten.
+  * compacted decode — the take_new (solve) and take_exist (sweep)
+    result compactions are bit-identical under the mesh.
+  * warm-up — the sharded program lattice compiles zero new programs
+    across TWO post-warm-up solves (the single-device warmup gate,
+    mirrored for the mesh path).
+  * `KARPENTER_TPU_MESH` — off/auto/N rollback knob, with malformed
+    values degrading to the constructed spec.
+  * `_pt_align` — lcm-based (pool,type) padding at a mesh size that does
+    NOT divide PT_ALIGN (regression: the pad must split the column grid
+    on whole-block boundaries for every mesh size, not just divisors
+    of 64).
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.models import (
+    Node,
+    NodePool,
+    ObjectMeta,
+    Pod,
+    Resources,
+    wellknown,
+)
+from karpenter_tpu.providers import generate_catalog
+from karpenter_tpu.providers.catalog import CatalogSpec
+from karpenter_tpu.scheduling import ExistingNode, ScheduleInput
+from karpenter_tpu.solver import TPUSolver, ffd
+from karpenter_tpu.solver.solve import PT_ALIGN
+
+CATALOG = generate_catalog(CatalogSpec(max_types=12, include_gpu=False))
+
+
+def mkpod(name, cpu="500m", mem="1Gi", **kw):
+    return Pod(meta=ObjectMeta(name=name, labels=kw.pop("labels", {})),
+               requests=Resources.parse({"cpu": cpu, "memory": mem}), **kw)
+
+
+def mkinput(pods, **kw):
+    pool = NodePool(meta=ObjectMeta(name="default"))
+    return ScheduleInput(pods=pods, nodepools=[pool],
+                         instance_types={"default": CATALOG}, **kw)
+
+
+def mkcluster(n):
+    nodes = []
+    for i in range(n):
+        node = Node(
+            meta=ObjectMeta(name=f"n{i}", labels={
+                wellknown.ZONE_LABEL: f"tpu-west-1{'abc'[i % 3]}",
+                wellknown.CAPACITY_TYPE_LABEL: ["spot", "on-demand"][i % 2],
+                wellknown.NODEPOOL_LABEL: "default",
+                wellknown.ARCH_LABEL: "amd64",
+                wellknown.OS_LABEL: "linux",
+                wellknown.HOSTNAME_LABEL: f"n{i}"}),
+            allocatable=Resources.of(cpu=16000, memory=32768, pods=58),
+            ready=True)
+        pod = mkpod(f"res{i}", cpu="500m", mem="1Gi")
+        pod.node_name = f"n{i}"
+        nodes.append(ExistingNode(
+            node=node, available=node.allocatable - pod.requests,
+            pods=[pod]))
+    return nodes
+
+
+def canon(res):
+    return (
+        sorted((c.nodepool, tuple(sorted(p.meta.name for p in c.pods)),
+                tuple(c.instance_type_names), round(c.price, 9))
+               for c in res.new_claims),
+        dict(res.existing_assignments),
+        set(res.unschedulable),
+    )
+
+
+class TestResidency:
+    def test_zero_o_axis_transfers_after_warmup(self):
+        inp = mkinput([mkpod(f"p{i}", cpu="1", mem="2Gi")
+                       for i in range(40)], existing_nodes=mkcluster(4))
+        solver = TPUSolver(mesh=8, max_nodes=64)
+        solver.warmup(inp)
+        solver.solve(inp)  # engages the take_new compaction warm start
+        ex = solver._mesh_exec
+        before = len(ex.transfers)
+        for _ in range(3):
+            res = solver.solve(inp)
+        assert not res.unschedulable
+        after = ex.transfers[before:]
+        assert after == [], (
+            f"steady-state solves shipped O-axis arrays: {after}")
+
+    def test_new_mask_content_is_a_delta_not_a_reupload(self):
+        inp = mkinput([mkpod(f"a{i}") for i in range(10)])
+        solver = TPUSolver(mesh=8, max_nodes=64)
+        solver.solve(inp)
+        ex = solver._mesh_exec
+        reg = solver._cat.device_args["mask_registry"]
+        rows0 = reg.n_rows
+        cat_bytes = sum(b for k, b in ex.transfers if k == "catalog")
+        before = len(ex.transfers)
+        # a NEW pod class (different requests ⇒ different column mask is
+        # not guaranteed, so force one via a zone selector)
+        from karpenter_tpu.models import Requirement, Requirements
+        p = mkpod("zoned")
+        p.requirements = Requirements(Requirement.make(
+            wellknown.ZONE_LABEL, "In", "tpu-west-1a"))
+        solver.solve(mkinput([p]))
+        delta = ex.transfers[before:]
+        assert reg.n_rows > rows0
+        # only mask-row deltas travelled — never the catalog again, and
+        # the delta is row-sized, not table-sized
+        assert all(k == "mask-rows" for k, _ in delta)
+        assert sum(b for _, b in delta) < cat_bytes / 4
+
+    def test_catalog_pre_partitioned_per_device(self):
+        solver = TPUSolver(mesh=8, max_nodes=64)
+        solver.solve(mkinput([mkpod("probe")]))
+        dev = solver._cat.device_args
+        total = sharded = 0
+        for k in ("col_alloc", "col_daemon", "pt_alloc", "col_pool",
+                  "col_zone", "col_ct"):
+            a = dev[k]
+            assert len(a.sharding.device_set) == 8, k
+            shard = a.sharding.shard_shape(a.shape)
+            assert shard[0] == a.shape[0] // 8, k  # even split, no pad
+            total += a.nbytes
+            sharded += a.nbytes
+        # per-device residency of the sharded state is exactly 1/8
+        per_dev = sharded // 8
+        assert per_dev * 8 == sharded
+        # and the resident mask table shards the same way
+        t = dev["mask_registry"].table
+        assert t.sharding.shard_shape(t.shape)[1] == t.shape[1] // 8
+
+
+class TestDonationSafety:
+    def test_sharded_slot_dead_after_dispatch(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_PIPELINE", "on")
+        inp = mkinput([mkpod(f"d{i}") for i in range(12)])
+        ref = canon(TPUSolver(mesh="off").solve(inp))
+        solver = TPUSolver(mesh=8, max_nodes=64)
+        assert canon(solver.solve(inp)) == ref
+        # the donated slot fed the dispatched program and is DEAD: any
+        # re-read raises loudly — it can never feed a second dispatch or
+        # silently corrupt the in-flight solve
+        slots = solver._upload_slots
+        last = slots._slots[slots._i]
+        assert last is not None
+        with pytest.raises(Exception):
+            np.asarray(last)
+        # the rotation always uploads fresh: the next solves work and
+        # stay bit-identical
+        assert canon(solver.solve(inp)) == ref
+        assert canon(solver.solve(inp)) == ref
+
+
+class TestCompactedDecode:
+    def test_take_new_compaction_parity_on_mesh(self):
+        # solve #2 engages the warm-started take_new compaction
+        # (sparse_n > 0); the compacted pull must decode bit-identically
+        # to both the mesh's dense first solve and the single device
+        inp = mkinput([mkpod(f"c{i}", cpu="2", mem="4Gi")
+                       for i in range(30)])
+        single = TPUSolver(mesh="off", max_nodes=64)
+        meshed = TPUSolver(mesh=8, max_nodes=64)
+        r1s, r1m = single.solve(inp), meshed.solve(inp)
+        assert meshed._last_new_segments is not None
+        r2s, r2m = single.solve(inp), meshed.solve(inp)
+        assert canon(r1m) == canon(r1s)
+        assert canon(r2m) == canon(r2s) == canon(r1s)
+
+    def test_sweep_take_exist_compaction_parity_on_mesh(self):
+        # E pads to 64 for a 33-node snapshot, so the top-K take_exist
+        # compaction engages (2*K < E_pad) on both solvers — the sweep's
+        # compacted download decodes identically under the mesh
+        nodes = mkcluster(33)
+        pool = NodePool(meta=ObjectMeta(name="default"))
+        inps = [ScheduleInput(
+            pods=list(nodes[i].pods), nodepools=[pool],
+            instance_types={"default": CATALOG},
+            existing_nodes=nodes[:i] + nodes[i + 1:],
+            exist_base=nodes, exist_excluded=(i,))
+            for i in range(0, 33, 3)]
+        ra = TPUSolver(mesh="off").solve_batch(inps, max_nodes=8)
+        rb = TPUSolver(mesh=8).solve_batch(inps, max_nodes=8)
+        assert [canon(x) for x in ra] == [canon(x) for x in rb]
+
+
+class TestMeshWarmupGate:
+    def test_sharded_lattice_zero_new_programs_two_solves(self):
+        # tier-1 mirror of the single-device warmup gate: after a
+        # mesh-aware warmup(), TWO post-warm-up solves (dense first,
+        # compacted second) execute zero new kernel traces — the sharded
+        # (G, E, N)×compaction lattice was pre-traced through the SAME
+        # _make_run closure the solve uses
+        inp = mkinput([mkpod(f"w{i}", cpu="1", mem="2Gi")
+                       for i in range(24)], existing_nodes=mkcluster(3))
+        solver = TPUSolver(mesh=8, max_nodes=64)
+        warmed = solver.warmup(inp)
+        assert warmed > 0
+        before = ffd.TRACE_COUNT
+        res = solver.solve(inp)
+        assert not res.unschedulable
+        res = solver.solve(inp)
+        assert not res.unschedulable
+        assert ffd.TRACE_COUNT == before, (
+            f"post-warmup mesh solves retraced "
+            f"{ffd.TRACE_COUNT - before} program(s): "
+            f"{list(ffd.TRACE_LOG)[-4:]}")
+
+    def test_warmup_batch_sizes_under_mesh(self):
+        # the solverd daemon's warmup RPC defaults batch_sizes=(1,) —
+        # under a mesh the batched kernel runs the DENSE gcol path, so
+        # its warm proto must not be the resident row-index one (which
+        # crashed _put_problem's rank-3 batched spec and would have
+        # warmed a nonexistent kernel signature)
+        inp = mkinput([mkpod(f"b{i}") for i in range(10)],
+                      existing_nodes=mkcluster(2))
+        solver = TPUSolver(mesh=8, max_nodes=64)
+        warmed = solver.warmup(inp, batch_sizes=(1,))
+        assert warmed > 0
+        # and the batched path still solves + matches single-device
+        ref = TPUSolver(mesh="off").solve_batch([inp], max_nodes=64)
+        got = solver.solve_batch([inp], max_nodes=64)
+        assert [canon(x) for x in got] == [canon(x) for x in ref]
+
+
+class TestMeshKnob:
+    def test_off_forces_single_device(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_MESH", "off")
+        s = TPUSolver(mesh=8)
+        assert s.mesh is None
+
+    def test_explicit_count_overrides_spec(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_MESH", "2")
+        s = TPUSolver(mesh="off")
+        assert s.mesh is not None and s.mesh.size == 2
+
+    def test_auto_and_malformed(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_MESH", "auto")
+        s = TPUSolver(mesh="off")
+        assert s.mesh is not None and s.mesh.size == 8
+        # a config typo degrades to the constructed spec, never crashes
+        monkeypatch.setenv("KARPENTER_TPU_MESH", "bananas")
+        s = TPUSolver(mesh="off")
+        assert s.mesh is None
+        monkeypatch.setenv("KARPENTER_TPU_MESH", "bananas")
+        s = TPUSolver(mesh=2)
+        assert s.mesh is not None and s.mesh.size == 2
+
+    def test_options_plumbing(self, monkeypatch):
+        from karpenter_tpu.operator.options import Options
+        monkeypatch.setenv("SOLVER_MESH", "off")
+        # the rollback knob is deliberately NOT copied into options —
+        # its single grammar owner is TPUSolver._mesh_env_spec, so it
+        # still overrides a solver BUILT from these options (the
+        # state.py construction path)
+        monkeypatch.setenv("KARPENTER_TPU_MESH", "2")
+        opts = Options.from_env()
+        assert opts.solver_mesh == "off"
+        s = TPUSolver(mesh=opts.solver_mesh)
+        assert s.mesh is not None and s.mesh.size == 2
+
+
+class TestMaskRowRegistry:
+    """Host-side registry logic at tiny monkeypatched capacity tiers —
+    the capacity-boundary cases a real catalog never hits in one test
+    run (review regressions: clamped-delta corruption, spurious
+    capacity cycles on duplicate-heavy batches, beyond-last-tier
+    growth)."""
+
+    def _registry(self, monkeypatch, tiers, up, O=16):
+        from karpenter_tpu.parallel import mesh as mesh_mod
+        monkeypatch.setattr(mesh_mod, "MASK_ROW_BUCKETS", tiers)
+        monkeypatch.setattr(mesh_mod, "MASK_UPLOAD_BUCKETS", up)
+        ex = mesh_mod.MeshExecutor(mesh_mod.make_mesh(2))
+        return mesh_mod.MaskRowRegistry(ex, O)
+
+    @staticmethod
+    def _rows(bits, O=16):
+        out = np.zeros((len(bits), O), dtype=bool)
+        for i, b in enumerate(bits):
+            out[i, b] = True
+        return out
+
+    def test_delta_at_capacity_boundary_never_clamps(self, monkeypatch):
+        # upload-pad bucket (4) larger than the table's remaining
+        # capacity (1): an un-clamped pad made dynamic_update_slice
+        # clamp the start index — new rows landed over registered ones
+        # and the registered slots went stale (silently wrong masks)
+        reg = self._registry(monkeypatch, tiers=(8,), up=(4,))
+        idx1, t1 = reg.ensure(self._rows([1, 2, 3, 4, 5, 6]))
+        idx2, t2 = reg.ensure(self._rows([7]))   # fills row 8 of 8
+        assert reg.n_rows == 8 and t2.shape[0] == 8
+        host = np.asarray(t2)
+        np.testing.assert_array_equal(host[idx1],
+                                      self._rows([1, 2, 3, 4, 5, 6]))
+        np.testing.assert_array_equal(host[idx2], self._rows([7]))
+
+    def test_duplicate_heavy_batch_is_not_a_capacity_cycle(
+            self, monkeypatch):
+        # a solve hands ensure() every padded group row — overwhelmingly
+        # duplicates.  Counting len(rows) against capacity forced a
+        # reset + full re-upload EVERY solve once G_pad neared the last
+        # tier; only DISTINCT unseen rows may count
+        reg = self._registry(monkeypatch, tiers=(4, 8), up=(1, 2))
+        reg.ensure(self._rows([1, 2]))
+        before = len(reg.ex.transfers)
+        dup = self._rows([1] * 20)               # 20 rows, zero unseen
+        idx, table = reg.ensure(dup)
+        assert reg.resets == 0
+        assert reg.ex.transfers[before:] == []   # pure cache hit
+        np.testing.assert_array_equal(np.asarray(table)[idx], dup)
+
+    def test_working_set_beyond_last_tier_grows_not_wedges(
+            self, monkeypatch):
+        # a working set that alone exceeds the last tier can't be helped
+        # by a capacity cycle — the table grows past it (power-of-two)
+        # instead of resetting forever / writing out of range
+        reg = self._registry(monkeypatch, tiers=(2, 4), up=(1,))
+        rows = self._rows(list(range(1, 7)))     # 6 distinct + reserved
+        idx, table = reg.ensure(rows)
+        assert reg.resets == 0 and reg.n_rows == 7
+        assert table.shape[0] == 8
+        np.testing.assert_array_equal(np.asarray(table)[idx], rows)
+        # and STAYS grown: a repeat of the same working set is a pure
+        # cache hit, not a capacity cycle + full re-upload every solve
+        # (the cycle check must compare against the live capacity, and
+        # never fire with nothing unseen)
+        before = len(reg.ex.transfers)
+        idx2, table2 = reg.ensure(rows)
+        assert reg.resets == 0
+        assert reg.ex.transfers[before:] == []
+        np.testing.assert_array_equal(idx2, idx)
+        # churn within the grown capacity flushes a delta, still no cycle
+        idx3, table3 = reg.ensure(self._rows([7]))
+        assert reg.resets == 0 and table3.shape[0] == 8
+
+
+class TestPtAlignNonDivisor:
+    def test_lcm_alignment_at_mesh_six(self):
+        # 6 does not divide PT_ALIGN=64: the pad must rise to
+        # lcm(64, 6) = 192 so the column grid splits on whole
+        # (pool,type)-block boundaries across 6 devices — and the solve
+        # must stay bit-identical to single-device
+        import math
+        inp = mkinput([mkpod(f"s{i}") for i in range(20)])
+        s6 = TPUSolver(mesh=6)
+        align = s6._pt_align()
+        assert align == 192 == math.lcm(PT_ALIGN, 6)
+        ref = canon(TPUSolver(mesh="off").solve(inp))
+        assert canon(s6.solve(inp)) == ref
+        dev = s6._cat.device_args
+        ZC = dev["ZC"]
+        PT_pad = dev["O"] // ZC
+        assert PT_pad % 6 == 0 and PT_pad % align == 0
+        da = dev["col_alloc"]
+        assert len(da.sharding.device_set) == 6
+        assert da.sharding.shard_shape(da.shape)[0] == da.shape[0] // 6
