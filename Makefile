@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test multichip lint native asan
+.PHONY: test multichip lint native asan repro-crash
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
@@ -28,3 +28,19 @@ native:
 
 asan:
 	$(MAKE) -C native asan
+
+# Drive the ASan-instrumented solverd through the historical
+# second-MLIR-lowering crash sequence (hack/repro_mlir_crash.py: three
+# schedule requests in distinct padding buckets — the crash was on the
+# second; the third proves the fix holds past it — persistent compile
+# cache disabled so lowering really happens). Exit 0 = survived (the
+# persistent-thread-state fix holding); exit 1 = reproduced, with the
+# daemon's stderr + any ASan report archived under native/build/asan/.
+# See docs/static-analysis.md#the-second-mlir-lowering-crash.
+repro-crash: asan
+	mkdir -p native/build/asan
+	KT_SOLVERD=native/build/asan/kt_solverd \
+	JAX_PLATFORMS=cpu KARPENTER_TPU_FORCE_CPU=1 \
+	$(PY) hack/repro_mlir_crash.py --rounds 3 \
+		> native/build/asan/repro-report.txt 2>&1; \
+	rc=$$?; cat native/build/asan/repro-report.txt; exit $$rc

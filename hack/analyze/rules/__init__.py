@@ -6,8 +6,10 @@ from hack.analyze.rules import (
     jit_purity,
     lock_discipline,
     observability,
+    socket_discipline,
 )
 
-ALL_RULES = (jit_purity, lock_discipline, exception_hygiene, observability)
+ALL_RULES = (jit_purity, lock_discipline, exception_hygiene, observability,
+             socket_discipline)
 
 RULE_NAMES = tuple(r.RULE_NAME for r in ALL_RULES)

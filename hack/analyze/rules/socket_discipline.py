@@ -1,0 +1,178 @@
+"""socket-discipline: blocking socket ops without a deadline in the
+wire-protocol layers (service/ and store/).
+
+ISSUE 7's post-mortem shape: a solverd worker wedged mid-compile and the
+client's reader thread sat in a bare `recv` until a 300 s socket default
+elapsed — the control plane's "crash" detection latency was whatever
+timeout someone forgot to set. In the layers that talk to peers that can
+die or wedge (`karpenter_tpu/service/`, `karpenter_tpu/store/`), every
+blocking socket operation must run under an explicit deadline.
+
+Sub-checks (one rule name, per-finding suppressible):
+
+  * socket-op-without-timeout — a socket created in a function
+    (`X = socket.socket(...)`) whose same-function `connect` / `recv` /
+    `recvfrom` / `send` / `sendall` happens with no `X.settimeout(...)`
+    earlier in that function. Listener-only sockets (nothing but
+    `bind`/`listen`/`accept`/`setsockopt`/`close`) are exempt — a
+    server's accept loop blocks by design and `close()` unblocks it.
+  * explicit-settimeout-none — `X.settimeout(None)` switches a socket
+    to unbounded blocking; legitimate only for watch-style streams,
+    which must say so with a suppression.
+  * bare-recv-no-deadline — `.recv(...)` / `.recvfrom(...)` on a socket
+    that was NOT created in the function (a parameter or attribute),
+    inside a class (or module, for module-level helpers) that never
+    calls `.settimeout` at all. A class that sets a timeout anywhere is
+    trusted to have a deadline story (helpers like `_read_exact` read
+    sockets their constructor already bounded); a class with NO
+    settimeout has none.
+
+Scope: only files under karpenter_tpu/service/ and karpenter_tpu/store/
+— the reconcile/controller layers don't own raw sockets, and flagging
+test fixtures would be noise.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from hack.analyze.core import FileContext, Finding
+
+RULE_NAME = "socket-discipline"
+
+_SCOPES = ("karpenter_tpu/service/", "karpenter_tpu/store/")
+# the listener exemption is implicit: bind/listen/accept are simply not
+# in _BLOCKING, so a socket used only as a server listener never matches
+_BLOCKING = {"connect", "recv", "recvfrom", "send", "sendall"}
+_RECV_OPS = {"recv", "recvfrom"}
+
+
+def _in_scope(ctx: FileContext) -> bool:
+    return any(ctx.rel.startswith(p) for p in _SCOPES)
+
+
+def _is_socket_ctor(value: ast.AST) -> bool:
+    """socket.socket(...) — the attribute form the repo uses."""
+    if not isinstance(value, ast.Call):
+        return False
+    fn = value.func
+    return (isinstance(fn, ast.Attribute) and fn.attr == "socket"
+            and isinstance(fn.value, ast.Name) and fn.value.id == "socket")
+
+
+def _receiver_text(fn: ast.Attribute) -> Optional[str]:
+    try:
+        return ast.unparse(fn.value)
+    except (ValueError, TypeError):
+        return None
+
+
+def _function_bodies(tree: ast.Module):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _walk_own(func: ast.AST):
+    """Walk a function's OWN statements, skipping nested function/lambda
+    subtrees — those are yielded (and analyzed) separately by
+    _function_bodies; double-visiting them duplicates findings and
+    pollutes the per-function created/settimeout maps."""
+    stack = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _scope_has_settimeout(ctx: FileContext, func: ast.AST) -> bool:
+    """Does the enclosing class (or whole module for module-level
+    helpers) call .settimeout anywhere?"""
+    scope: ast.AST = ctx.tree
+    cur = ctx.parent(func)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            scope = cur
+            break
+        cur = ctx.parent(cur)
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "settimeout":
+            return True
+    return False
+
+
+def check(ctx: FileContext) -> Iterator[Finding]:
+    if not _in_scope(ctx):
+        return
+    for func in _function_bodies(ctx.tree):
+        # one linear pass in source order: creations, settimeouts, ops
+        created: Dict[str, int] = {}            # receiver text → line
+        timeout_set: Dict[str, int] = {}        # receiver text → line
+        ops: List[Tuple[str, str, ast.Call]] = []
+        for node in _walk_own(func):
+            if isinstance(node, ast.Assign) and _is_socket_ctor(node.value):
+                for tgt in node.targets:
+                    try:
+                        name = ast.unparse(tgt)
+                    except (ValueError, TypeError):
+                        continue
+                    created[name] = min(created.get(name, node.lineno),
+                                        node.lineno)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute):
+                recv = _receiver_text(node.func)
+                if recv is None:
+                    continue
+                attr = node.func.attr
+                if attr == "settimeout":
+                    arg = node.args[0] if node.args else None
+                    if isinstance(arg, ast.Constant) and arg.value is None:
+                        yield ctx.finding(
+                            RULE_NAME, node,
+                            f"`{recv}.settimeout(None)` switches to "
+                            "unbounded blocking — legitimate only for "
+                            "watch-style streams, and those must carry a "
+                            "suppression explaining why")
+                    else:
+                        # keep the EARLIEST settimeout line per receiver:
+                        # _walk_own visits in stack (reverse-ish) order,
+                        # and a later re-tune (`settimeout(1); connect;
+                        # settimeout(30); recv`) must not shadow the
+                        # creation-time deadline
+                        timeout_set[recv] = min(
+                            timeout_set.get(recv, node.lineno),
+                            node.lineno)
+                elif attr in _BLOCKING:
+                    ops.append((recv, attr, node))
+        for recv, attr, node in ops:
+            made = created.get(recv)
+            if made is None:
+                continue  # not provably a local socket: see bare-recv
+            ts = timeout_set.get(recv)
+            if ts is None or ts > node.lineno:
+                yield ctx.finding(
+                    RULE_NAME, node,
+                    f"blocking `{recv}.{attr}()` on a socket created "
+                    "without `settimeout` — a wedged peer holds this "
+                    "call forever; set a deadline at creation")
+        # listener-only sockets never reach here: their ops (bind/
+        # listen/accept) are not in _BLOCKING
+
+        # bare-recv: sockets this function did not create, in a scope
+        # with no deadline story at all
+        for recv, attr, node in ops:
+            if attr not in _RECV_OPS or recv in created:
+                continue
+            if not _scope_has_settimeout(ctx, func):
+                yield ctx.finding(
+                    RULE_NAME, node,
+                    f"bare `{recv}.{attr}()` in a scope that never sets "
+                    "a socket timeout — the enclosing class/module has "
+                    "no deadline story; bound the socket where it is "
+                    "created or here")

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Minimal reproducer for the kt_solverd second-MLIR-lowering segfault.
+
+Since seed, kt_solverd (the embedded-CPython solver daemon) has died on
+its SECOND XLA compile: the first schedule request traces, lowers, and
+compiles fine; a second request whose padded shape misses the trace
+cache segfaults inside MLIR lowering. The 4 always-failing
+test_solver_service tests and the flaky test_ha full-topology test are
+all this one crash.
+
+This script is the smallest driver of that sequence:
+
+  1. spawn the daemon (default build/kt_solverd, or $KT_SOLVERD — point
+     it at build/asan/kt_solverd for an AddressSanitizer report, which
+     is what `make repro-crash` does)
+  2. send one schedule request at shape A and wait for the result
+  3. send one schedule request at shape B (a different padding bucket,
+     so the daemon must lower a SECOND program) and wait
+  4. exit 0 if both answered and the daemon is still alive; exit 1 with
+     the daemon's stderr tail if it died
+
+The persistent JAX compilation cache is deliberately DISABLED in the
+daemon's environment: a warm cache skips lowering entirely and hides
+the crash.
+
+Usage:
+  python hack/repro_mlir_crash.py [--rounds N] [--keep-cache]
+  make repro-crash          # ASan build + this script, report archived
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DAEMON = os.environ.get(
+    "KT_SOLVERD", os.path.join(REPO, "native", "build", "kt_solverd"))
+
+
+def spawn(sock: str, stderr_path: str, keep_cache: bool) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["KARPENTER_TPU_FORCE_CPU"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["KARPENTER_TPU_MAX_NODES"] = "64"
+    if not keep_cache:
+        # force real lowering: a warm persistent cache masks the crash
+        env.pop("JAX_COMPILATION_CACHE_DIR", None)
+        env["JAX_ENABLE_COMPILATION_CACHE"] = "false"
+    # ASan: keep going after leak reports, log to the archived file
+    env.setdefault("ASAN_OPTIONS",
+                   "abort_on_error=0:halt_on_error=0:"
+                   f"log_path={stderr_path}.asan")
+    stderr_f = open(stderr_path, "ab")
+    try:
+        proc = subprocess.Popen(
+            [DAEMON, "--socket", sock, "--idle-ms", "5", "--max-ms", "50"],
+            env=env, stderr=stderr_f)
+    finally:
+        stderr_f.close()
+    for _ in range(100):
+        if os.path.exists(sock):
+            return proc
+        if proc.poll() is not None:
+            break
+        time.sleep(0.1)
+    raise SystemExit(f"daemon never bound {sock}; stderr:\n"
+                     + tail(stderr_path))
+
+
+def tail(path: str, n: int = 4000) -> str:
+    out = []
+    for p in sorted(os.listdir(os.path.dirname(path) or ".")):
+        full = os.path.join(os.path.dirname(path) or ".", p)
+        if full.startswith(path) and os.path.isfile(full):
+            with open(full, "rb") as f:
+                out.append(f"--- {p} ---\n"
+                           + f.read().decode(errors="replace")[-n:])
+    return "\n".join(out) or "<empty>"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=2,
+                    help="distinct compile shapes to request (default 2: "
+                    "the crash is on the second)")
+    ap.add_argument("--keep-cache", action="store_true",
+                    help="leave the persistent compile cache enabled "
+                    "(hides the crash; for control runs)")
+    args = ap.parse_args()
+
+    if not os.path.exists(DAEMON):
+        print(f"daemon binary missing: {DAEMON}\n"
+              "build it first: make -C native solverd   (or: make asan)",
+              file=sys.stderr)
+        return 2
+
+    from karpenter_tpu.models import NodePool, ObjectMeta, Pod, Resources
+    from karpenter_tpu.providers import generate_catalog
+    from karpenter_tpu.providers.catalog import CatalogSpec
+    from karpenter_tpu.scheduling import ScheduleInput
+    from karpenter_tpu.service import SolverServiceClient
+
+    catalog = generate_catalog(CatalogSpec(max_types=8, include_gpu=False))
+    pool = NodePool(meta=ObjectMeta(name="default"))
+
+    def mkinp(tag: str, classes: int) -> ScheduleInput:
+        # `classes` distinct request shapes -> `classes` pod groups -> a
+        # distinct (G,E,N) padding bucket per round, so every round is a
+        # fresh trace + MLIR lowering (identical pods collapse into one
+        # group and hit the trace cache, hiding the crash)
+        pods = [Pod(meta=ObjectMeta(name=f"{tag}-{c}-{i}"),
+                    requests=Resources.parse(
+                        {"cpu": f"{500 + 10 * c}m", "memory": "1Gi"}))
+                for c in range(classes) for i in range(3)]
+        return ScheduleInput(pods=pods, nodepools=[pool],
+                             instance_types={"default": catalog})
+
+    tmp = tempfile.mkdtemp(prefix="kt-repro-")
+    sock = os.path.join(tmp, "kt.sock")
+    stderr_path = os.path.join(tmp, "solverd.stderr")
+    proc = spawn(sock, stderr_path, keep_cache=args.keep_cache)
+    client = SolverServiceClient(sock, timeout=300)
+    try:
+        # group counts landing in distinct G buckets (solve.py G_BUCKETS
+        # = 1,4,8,...) -> each round is a fresh trace + MLIR lowering
+        for round_i, n in enumerate([1, 3, 6][:args.rounds], start=1):
+            t0 = time.time()
+            try:
+                res = client.solve(mkinp(f"r{round_i}", n))
+            except Exception as e:  # noqa: BLE001
+                print(f"round {round_i} (n={n}): client error after "
+                      f"{time.time() - t0:.1f}s: {e}", file=sys.stderr)
+                time.sleep(1.0)
+                rc = proc.poll()
+                print(f"daemon exit status: {rc}", file=sys.stderr)
+                print(tail(stderr_path), file=sys.stderr)
+                print(f"REPRODUCED: daemon died on compile #{round_i}",
+                      file=sys.stderr)
+                return 1
+            print(f"round {round_i} (n={n}): ok in {time.time() - t0:.1f}s "
+                  f"({res.node_count()} nodes)")
+        if proc.poll() is not None:
+            print(f"daemon exited {proc.returncode} after answering",
+                  file=sys.stderr)
+            print(tail(stderr_path), file=sys.stderr)
+            return 1
+        print("NOT reproduced: daemon survived all rounds")
+        return 0
+    finally:
+        client.close()
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        print(f"artifacts in {tmp}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

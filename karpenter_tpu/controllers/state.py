@@ -25,11 +25,41 @@ class GatedSolver:
     def __init__(self, options, cluster: Cluster):
         self.options = options
         self.cluster = cluster
+        # lazily-built in-process solver used as DEGRADED MODE when the
+        # remote service is down/breaker-open (ISSUE 7): better than the
+        # oracle, never constructed while the service is healthy. The
+        # lock guards the lazy init — the provisioner and the disruption
+        # simulator share this GatedSolver and can hit the degraded path
+        # concurrently, and a TPUSolver construction is too expensive to
+        # duplicate.
+        self._remote = bool(options.solver_endpoint)
+        self._local = None
+        import threading
+        self._local_init_lock = threading.Lock()
         if options.solver_endpoint:
             # remote TPU-owning solver process (native/solverd.cc): same
-            # solve/solve_batch seam, coalesced in the daemon's window
-            from karpenter_tpu.service import SolverServiceClient
-            self.tpu = SolverServiceClient(options.solver_endpoint)
+            # solve/solve_batch seam, coalesced in the daemon's window.
+            # The client carries the shared availability layer — bounded
+            # retries with backoff, per-request deadlines shipped in the
+            # frame, and the circuit breaker whose open state is what
+            # "degraded mode" means operationally.
+            from karpenter_tpu.service import (
+                CircuitBreaker,
+                RetryPolicy,
+                SolverServiceClient,
+            )
+            timeout = getattr(options, "service_request_timeout", 60.0)
+            self.tpu = SolverServiceClient(
+                options.solver_endpoint,
+                timeout=timeout,
+                retry=RetryPolicy(
+                    attempts=getattr(options, "service_retry_attempts", 3),
+                    deadline=timeout),
+                breaker=CircuitBreaker(
+                    threshold=getattr(options,
+                                      "service_breaker_threshold", 5),
+                    cooldown=getattr(options,
+                                     "service_breaker_cooldown", 10.0)))
         else:
             from karpenter_tpu.solver import TPUSolver
             # SOLVER_MESH (options) configures the mesh story;
@@ -53,6 +83,44 @@ class GatedSolver:
     # stalled loop or spurious unschedulable verdicts.
     ORACLE_SHED_LIMIT = 8000
 
+    def _local_solver(self):
+        """The degraded-mode in-process solver behind the remote client.
+        None when this GatedSolver IS the in-process solver (nothing to
+        degrade to but the oracle) or the fallback is disabled."""
+        if not self._remote or not getattr(
+                self.options, "service_local_fallback", True):
+            return None
+        if self._local is None:
+            with self._local_init_lock:
+                if self._local is None:
+                    from karpenter_tpu.solver import TPUSolver
+                    self._local = TPUSolver(
+                        max_nodes=self.options.solver_max_nodes,
+                        mesh=getattr(self.options, "solver_mesh", "auto"))
+        return self._local
+
+    def _degraded_solve(self, inp: ScheduleInput, source: str,
+                        max_nodes: Optional[int]):
+        """One in-process solve while the service is unavailable.
+        Returns None to fall through to the oracle."""
+        local = self._local_solver()
+        if local is None:
+            return None
+        from karpenter_tpu.solver import UnsupportedPods
+        from karpenter_tpu.utils import tracing
+        try:
+            with tracing.span("solver.degraded_local", source=source,
+                              pods=len(inp.pods)):
+                return local.solve(inp, max_nodes=max_nodes)
+        except UnsupportedPods:
+            return None
+        except Exception as e:  # noqa: BLE001
+            from karpenter_tpu.utils.logging import get_logger
+            get_logger("solver").warn(
+                "degraded-mode local solve failed; falling back to oracle",
+                source=source, error=str(e)[:200])
+            return None
+
     def solve(self, inp: ScheduleInput, source: str = "solver",
               max_nodes: Optional[int] = None):
         from karpenter_tpu.scheduling import Scheduler
@@ -66,10 +134,13 @@ class GatedSolver:
             except Exception as e:  # noqa: BLE001
                 from karpenter_tpu.utils.logging import get_logger
                 get_logger("solver").warn(
-                    "device solve failed; falling back to oracle",
+                    "device solve failed; entering degraded mode",
                     source=source, error=str(e)[:200])
                 self.cluster.record_event(
                     "Provisioner", source, "SolverFallback", str(e))
+                res = self._degraded_solve(inp, source, max_nodes)
+                if res is not None:
+                    return res
         metrics.SOLVER_SOLVES.inc(path="oracle")
         # load shedding is only sound for PROVISIONING (unsolved pods stay
         # pending and retry): a disruption simulation must judge its whole
@@ -149,6 +220,46 @@ class GatedSolver:
             except Exception as e:  # noqa: BLE001
                 self.cluster.record_event(
                     "Provisioner", source, "SolverFallback", str(e))
+                local = self._local_solver()
+                if local is not None:
+                    try:
+                        t0 = _time.perf_counter()
+                        results = local.solve_batch(inps,
+                                                    max_nodes=max_nodes)
+                        if results:
+                            per = (_time.perf_counter() - t0) / len(results)
+                            for _ in results:
+                                metrics.SCHEDULING_SIMULATION_DURATION \
+                                    .observe(per)
+                        return results
+                    except UnsupportedPods:
+                        # per-input retry on the LOCAL solver/oracle
+                        # only: re-entering self.solve here would pay a
+                        # fresh remote retry deadline per input against
+                        # the service we just watched fail
+                        def _per_input_degraded():
+                            for inp in inps:
+                                # observe BEFORE yielding: a timer held
+                                # across the yield would also clock the
+                                # consumer's work (and an abandoned
+                                # generator's whole lifetime) into the
+                                # simulation histogram
+                                t0 = _time.perf_counter()
+                                res = self._degraded_solve(
+                                    inp, source, max_nodes)
+                                if res is None:
+                                    metrics.SOLVER_SOLVES.inc(
+                                        path="oracle")
+                                    res = Scheduler(inp).solve()
+                                metrics.SCHEDULING_SIMULATION_DURATION \
+                                    .observe(_time.perf_counter() - t0)
+                                yield res
+                        return _per_input_degraded()
+                    except Exception as e2:  # noqa: BLE001
+                        from karpenter_tpu.utils.logging import get_logger
+                        get_logger("solver").warn(
+                            "degraded-mode local batch failed; oracle",
+                            source=source, error=str(e2)[:200])
 
         def _lazy():
             metrics.SOLVER_SOLVES.inc(path="oracle")

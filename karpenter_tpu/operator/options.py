@@ -57,6 +57,20 @@ class Options:
     # None = in-process solver. Lets control-plane replicas share one
     # TPU-owning process (SURVEY §2.3 leader-election note).
     solver_endpoint: "str | None" = None
+    # solver-service availability knobs (service/resilience.py): one
+    # request deadline (also shipped in the frame so the daemon sheds
+    # work its caller abandoned), bounded retries, and the circuit
+    # breaker that puts the control plane into explicit degraded mode
+    # (in-process solver, then oracle) when the daemon is down/wedged
+    service_request_timeout: float = 60.0
+    service_retry_attempts: int = 3
+    service_breaker_threshold: int = 5
+    service_breaker_cooldown: float = 10.0
+    # degraded mode: while the breaker is open (or any remote solve
+    # fails), fall back to a lazily-built in-process TPUSolver before
+    # the host oracle. Disable to keep the old endpoint->oracle-only
+    # behavior (e.g. a control-plane host too small for a solver).
+    service_local_fallback: bool = True
     # HA: active/passive replicas racing a shared lease (core LEADER_ELECT;
     # charts/karpenter/values.yaml:35 runs 2 replicas). lease_file names a
     # FileLease shared by replicas on one host.
@@ -76,6 +90,26 @@ class Options:
             opts.feature_gates = FeatureGates.parse(os.environ["FEATURE_GATES"])
         opts.solver_endpoint = os.environ.get(
             "SOLVER_ENDPOINT", opts.solver_endpoint)
+        if "KARPENTER_TPU_SERVICE_TIMEOUT" in os.environ:
+            opts.service_request_timeout = float(
+                os.environ["KARPENTER_TPU_SERVICE_TIMEOUT"])
+        if "KARPENTER_TPU_SERVICE_RETRIES" in os.environ:
+            opts.service_retry_attempts = int(
+                os.environ["KARPENTER_TPU_SERVICE_RETRIES"])
+        if "KARPENTER_TPU_SERVICE_BREAKER_THRESHOLD" in os.environ:
+            opts.service_breaker_threshold = int(
+                os.environ["KARPENTER_TPU_SERVICE_BREAKER_THRESHOLD"])
+        if "KARPENTER_TPU_SERVICE_BREAKER_COOLDOWN" in os.environ:
+            opts.service_breaker_cooldown = float(
+                os.environ["KARPENTER_TPU_SERVICE_BREAKER_COOLDOWN"])
+        if "KARPENTER_TPU_SERVICE_LOCAL_FALLBACK" in os.environ:
+            # "on" included: the sibling knobs (PIPELINE, MESH) use
+            # on/off grammar and the docs table shows this default as
+            # `on` — an operator following that convention must not
+            # silently disable the fallback
+            opts.service_local_fallback = (
+                os.environ["KARPENTER_TPU_SERVICE_LOCAL_FALLBACK"]
+                .strip().lower() in ("1", "true", "yes", "on"))
         # SOLVER_MESH configures the mesh story.  The KARPENTER_TPU_MESH
         # rollback override is deliberately NOT parsed here: its single
         # grammar owner is TPUSolver._mesh_env_spec, applied inside

@@ -4,8 +4,29 @@ to one TPU-owning solver process over a framed unix socket. The daemon
 window — the reference's `pkg/batcher` pattern natively — and hands each
 batch to `backend.handle_batch` in its embedded interpreter, where
 catalog-sharing requests fuse into one vmapped device solve.
+
+Crash isolation (ISSUE 7): the daemon runs as a disposable WORKER under
+`SolverdSupervisor` (restart-on-crash with backoff); the client carries
+the availability layer — shared `RetryPolicy`, `CircuitBreaker`, and
+per-request deadlines — so the control plane degrades to its in-process
+solver instead of hanging when the worker dies.
 """
 
-from karpenter_tpu.service.client import SolverServiceClient
+from karpenter_tpu.service.client import (
+    SolverServiceClient,
+    SolverServiceError,
+    SolverServiceTransportError,
+    SolverServiceUnavailable,
+)
+from karpenter_tpu.service.resilience import CircuitBreaker, RetryPolicy
+from karpenter_tpu.service.supervisor import SolverdSupervisor
 
-__all__ = ["SolverServiceClient"]
+__all__ = [
+    "SolverServiceClient",
+    "SolverServiceError",
+    "SolverServiceTransportError",
+    "SolverServiceUnavailable",
+    "CircuitBreaker",
+    "RetryPolicy",
+    "SolverdSupervisor",
+]

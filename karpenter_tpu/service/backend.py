@@ -22,8 +22,11 @@ Responses: ("result", ScheduleResult) | ("ok", None) |
 from __future__ import annotations
 
 import pickle
+import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
+
+from karpenter_tpu.utils import faults
 
 _catalogs: Dict[str, Tuple[list, dict]] = {}
 _solver = None
@@ -31,6 +34,10 @@ _solver = None
 # device — exposed via the ("stats", _) request for tests/observability;
 # bounded so a long-running daemon doesn't grow it forever
 _batch_log: deque = deque(maxlen=1024)
+# requests shed because their caller's deadline had already passed when
+# the batch reached Python (the client frame's body["deadline"]) — the
+# daemon's half of the per-request-deadline contract, reported via stats
+_shed_count = 0
 
 
 def _get_solver():
@@ -77,7 +84,13 @@ def _solve_group(inps: List, max_nodes: Optional[int] = None) -> List:
 
 
 def handle_batch(payloads: List[bytes]) -> List[bytes]:
+    global _shed_count
     from karpenter_tpu.scheduling import ScheduleInput
+
+    # fault-matrix hook (utils/faults.py): `crash` here is the
+    # worker-killed-mid-batch scenario — the supervisor must restart the
+    # process and clients must fail their in-flight requests fast
+    faults.fire("solverd.handle_batch")
 
     n = len(payloads)
     responses: List[Optional[tuple]] = [None] * n
@@ -120,6 +133,7 @@ def handle_batch(payloads: List[bytes]) -> List[bytes]:
                 }
             responses[i] = ("result", {"batch_sizes": list(_batch_log),
                                        "catalogs": len(_catalogs),
+                                       "shed": _shed_count,
                                        "mesh": mesh_info})
         elif kind == "warmup":
             # padding-bucket precompile against an uploaded catalog: the
@@ -127,6 +141,17 @@ def handle_batch(payloads: List[bytes]) -> List[bytes]:
             # schedule request meets a fully-compiled kernel lattice
             # (solve.py warmup; the persistent compile cache makes a
             # daemon RESTART skip even this step's XLA work)
+            deadline = body.get("deadline")
+            if deadline is not None and time.time() >= deadline:
+                # the shed contract covers warmup FIRST of all: it is
+                # the most expensive request kind, and a queued warmup
+                # whose caller already gave up would hold the single
+                # batcher thread through minutes of compile while real
+                # schedule requests wait behind it
+                _shed_count += 1
+                responses[i] = ("error",
+                                "deadline exceeded before warmup (shed)")
+                continue
             fp = body.get("fingerprint")
             if fp not in _catalogs:
                 responses[i] = ("need_catalog", None)
@@ -162,6 +187,17 @@ def handle_batch(payloads: List[bytes]) -> List[bytes]:
         fp = body.get("fingerprint")
         if "pods" not in body:
             responses[i] = ("error", "schedule body missing pods")
+            continue
+        deadline = body.get("deadline")
+        if deadline is not None and time.time() >= deadline:
+            # the caller's deadline already passed (it timed out, fell
+            # back, and will re-send the pods next pass): solving now
+            # burns the device for a result nobody reads, and behind a
+            # restart backlog it keeps the daemon permanently late —
+            # shed instead (peers share this host's clock)
+            _shed_count += 1
+            responses[i] = ("error", "deadline exceeded before solve "
+                                     "(shed)")
             continue
         if fp not in _catalogs:
             responses[i] = ("need_catalog", None)

@@ -13,6 +13,21 @@ thereafter, keeping the steady-state request small: pods + cluster deltas
 only. Concurrent requests coalesce in the daemon's native batch window
 into one vmapped device call.
 
+Resilience (ISSUE 7): every request runs under one shared
+:class:`~karpenter_tpu.service.resilience.RetryPolicy` — bounded
+attempts, exponential backoff + jitter, and a per-request deadline that
+rides the wire frame (``body["deadline"]``, absolute epoch seconds —
+unix-socket peers share a clock) so the daemon sheds work its caller
+has already abandoned. A shared
+:class:`~karpenter_tpu.service.resilience.CircuitBreaker` trips after
+consecutive transport failures and fails fast while open, which is what
+puts `GatedSolver` into explicit degraded mode (in-process solver, then
+oracle) instead of paying a timeout per solve against a dead daemon.
+Transport failures (connect/send/receive/timeout) raise
+:class:`SolverServiceTransportError` and are retried; application
+errors from a live daemon raise plain :class:`SolverServiceError` and
+are not (the daemon answering is proof the transport works).
+
 Mesh: the daemon owns the devices, so its mesh story is configured in
 ITS environment — `SOLVER_MESH` selects (backend._get_solver), and the
 `KARPENTER_TPU_MESH=off/auto/N` rollback knob overrides inside the
@@ -29,20 +44,41 @@ import pickle
 import socket
 import struct
 import threading
-from typing import Dict, List, Optional, Tuple
+import time
+from typing import Callable, Dict, List, Optional, Tuple
 
 from karpenter_tpu.scheduling.types import ScheduleInput, ScheduleResult
-from karpenter_tpu.utils import tracing
+from karpenter_tpu.service.resilience import CircuitBreaker, RetryPolicy
+from karpenter_tpu.utils import faults, metrics, tracing
+
+# mirror of the daemon's kMaxFrame: a length prefix past this is frame
+# desynchronization (a torn write, a corrupted header), not a real
+# response — kill the connection instead of trying to allocate it
+_MAX_FRAME = 256 << 20
 
 
 class SolverServiceError(RuntimeError):
-    pass
+    """Base failure; also the daemon-reported application errors."""
+
+
+class SolverServiceTransportError(SolverServiceError):
+    """Connect/send/receive/timeout failures — the retryable class."""
+
+
+class SolverServiceUnavailable(SolverServiceError):
+    """Fail-fast signal while the circuit breaker is open."""
 
 
 class SolverServiceClient:
-    def __init__(self, socket_path: str, timeout: float = 60.0):
+    def __init__(self, socket_path: str, timeout: float = 60.0,
+                 retry: Optional[RetryPolicy] = None,
+                 breaker: Optional[CircuitBreaker] = None):
         self.socket_path = socket_path
         self.timeout = timeout
+        # the retry policy's deadline defaults to the legacy `timeout`
+        # knob so existing constructors keep their wait bound
+        self.retry = retry or RetryPolicy(deadline=timeout)
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
         self._sock: Optional[socket.socket] = None
         self._wlock = threading.Lock()
         self._lock = threading.Lock()
@@ -95,12 +131,23 @@ class SolverServiceClient:
                 pass
 
     def _read_loop(self, sock: socket.socket) -> None:
+        """Reader-thread framing loop. The failure contract is absolute:
+        HOWEVER this loop dies — clean EOF, mid-frame EOF, a timeout, an
+        oversized length prefix, an injected fault, even an unexpected
+        exception — the `finally` block fails every outstanding waiter
+        fast. A waiter left to sleep out its full deadline against a
+        dead connection is the bug this structure exists to prevent."""
         try:
             while True:
+                faults.fire("service.client.recv")
                 header = self._read_exact(sock, 12)
                 if header is None:
-                    break
+                    break  # clean or mid-frame EOF: peer died
                 plen, rid = struct.unpack("<IQ", header)
+                if plen > _MAX_FRAME:
+                    # frame desync/corruption: nothing after this point
+                    # can be trusted — drop the connection
+                    break
                 payload = self._read_exact(sock, plen)
                 if payload is None:
                     break
@@ -117,21 +164,23 @@ class SolverServiceClient:
                         self._responses[rid] = resp
                 if ev is not None:
                     ev.set()
-        except OSError:
+        except Exception:  # noqa: BLE001 — reader death is handled, not raised
             pass
-        # connection died: drop the socket so the next call reconnects, and
-        # release every waiter
-        with self._lock:
-            if self._sock is sock:
-                self._sock = None
-            for rid, ev in self._pending.items():
-                self._responses.setdefault(
-                    rid, ("error", "connection to solver service lost"))
-                ev.set()
-        try:
-            sock.close()
-        except OSError:
-            pass
+        finally:
+            # connection died: drop the socket so the next call
+            # reconnects, and release every waiter
+            with self._lock:
+                if self._sock is sock:
+                    self._sock = None
+                for rid, ev in self._pending.items():
+                    self._responses.setdefault(
+                        rid, ("transport", "connection to solver service "
+                                           "lost"))
+                    ev.set()
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     @staticmethod
     def _read_exact(sock, n: int) -> Optional[bytes]:
@@ -145,7 +194,11 @@ class SolverServiceClient:
 
     # -- framing ----------------------------------------------------------
     def _send(self, kind: str, body: dict) -> int:
-        sock = self._ensure_connected()
+        try:
+            sock = self._ensure_connected()
+        except OSError as e:
+            raise SolverServiceTransportError(
+                f"solver service connect failed: {e}") from e
         payload = pickle.dumps((kind, body), protocol=pickle.HIGHEST_PROTOCOL)
         with self._lock:
             rid = self._next_id
@@ -153,28 +206,44 @@ class SolverServiceClient:
             self._pending[rid] = threading.Event()
         frame = struct.pack("<IQ", len(payload), rid) + payload
         try:
+            out = faults.fire("service.client.send", frame)
             with self._wlock:
                 # holding the write lock across sendall is load-bearing:
                 # frames from concurrent senders must not interleave on
                 # the shared socket — responses are matched by request id,
                 # so only the WRITE needs serializing, and this is it
-                sock.sendall(frame)  # kt-lint: disable=lock-discipline
-        except OSError as e:
+                sock.sendall(out)  # kt-lint: disable=lock-discipline
+            if len(out) != len(frame):
+                # injected truncation: the daemon now waits mid-frame for
+                # bytes that will never come — kill the connection so it
+                # sees EOF (the torn-write failure shape end to end)
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                raise OSError("fault-injected frame truncation")
+        except (OSError, faults.FaultInjected) as e:
             with self._lock:
                 self._pending.pop(rid, None)
                 if self._sock is sock:
                     self._sock = None
-            raise SolverServiceError(f"solver service send failed: {e}") from e
+            raise SolverServiceTransportError(
+                f"solver service send failed: {e}") from e
         return rid
 
-    def _wait(self, rid: int) -> tuple:
+    def _wait(self, rid: int, deadline: Optional[float] = None) -> tuple:
+        """Block for rid's response until `deadline` (absolute epoch
+        seconds; defaults to now + timeout). Reader death sets a
+        ("transport", msg) marker, surfaced as the retryable class."""
+        if deadline is None:
+            deadline = time.time() + self.timeout
         with self._lock:
             ev = self._pending[rid]
-        if not ev.wait(self.timeout):
+        if not ev.wait(max(0.0, deadline - time.time())):
             with self._lock:
                 self._pending.pop(rid, None)
                 self._responses.pop(rid, None)
-            raise SolverServiceError("solver service timed out")
+            raise SolverServiceTransportError("solver service timed out")
         with self._lock:
             self._pending.pop(rid, None)
             resp = self._responses.pop(rid)
@@ -182,7 +251,59 @@ class SolverServiceClient:
             # the daemon's internal-error marker (pickled None) or any
             # other malformed response
             raise SolverServiceError("solver service internal error")
+        if resp[0] == "transport":
+            raise SolverServiceTransportError(
+                f"solver service: {resp[1]}")
         return resp
+
+    # -- retry/breaker ----------------------------------------------------
+    def _with_retries(self, fn: Callable[[float], object]):
+        """Run `fn(deadline)` under the shared policy: breaker check up
+        front (fail fast while open), bounded attempts with backoff on
+        transport failures, everything inside ONE deadline. Application
+        errors from a live daemon count as breaker successes — a daemon
+        that answers is reachable, whatever it answered."""
+        br = self.breaker
+        if br is not None and not br.allow():
+            raise SolverServiceUnavailable(
+                "solver service circuit breaker open: failing fast")
+        deadline = time.time() + self.retry.deadline
+        attempt = 1
+        while True:
+            try:
+                out = fn(deadline)
+            except SolverServiceTransportError:
+                if br is not None:
+                    br.record_failure()
+                remaining = deadline - time.time()
+                if attempt >= self.retry.attempts or remaining <= 0:
+                    raise
+                if br is not None and not br.allow():
+                    # our own failures tripped it mid-loop: stop burning
+                    # the remaining attempts against a known-dead peer
+                    raise SolverServiceUnavailable(
+                        "solver service circuit breaker open: failing "
+                        "fast") from None
+                metrics.SERVICE_RETRIES.inc()
+                time.sleep(min(self.retry.backoff(attempt), remaining))
+                attempt += 1
+                continue
+            except SolverServiceError:
+                if br is not None:
+                    br.record_success()
+                raise
+            except BaseException:
+                # anything unexpected (a malformed response body, a
+                # KeyboardInterrupt mid-wait) must still RELEASE the
+                # half-open probe slot, or the breaker wedges in
+                # fail-fast forever; counting it as a failure is the
+                # conservative release
+                if br is not None:
+                    br.record_failure()
+                raise
+            if br is not None:
+                br.record_success()
+            return out
 
     # -- catalog fingerprinting -------------------------------------------
     def _fingerprint(self, inp: ScheduleInput) -> Tuple[str, bytes]:
@@ -209,14 +330,19 @@ class SolverServiceClient:
             self._strong[fp] = tuple(inp.instance_types.values())
         return cached[0], cached[1]
 
-    def _ensure_catalog(self, fp: str, payload: bytes) -> None:
+    def _ensure_catalog(self, fp: str, payload: bytes,
+                        deadline: Optional[float] = None) -> None:
         # connect FIRST: the upload ledger is per-connection state (a
         # reconnect clears it), so consulting it before the connection is
         # established reads a stale ledger from the previous daemon and
         # skips an upload the fresh daemon never saw — the need_catalog
         # retry in solve_batch then remains as the backstop for the
         # check-then-die race, not the primary path
-        self._ensure_connected()
+        try:
+            self._ensure_connected()
+        except OSError as e:
+            raise SolverServiceTransportError(
+                f"solver service connect failed: {e}") from e
         if fp in self._uploaded:
             return
         body = pickle.loads(payload)
@@ -225,13 +351,15 @@ class SolverServiceClient:
             "nodepools": body["nodepools"],
             "instance_types": body["instance_types"],
         })
-        kind, _ = self._wait(rid)
+        kind, _ = self._wait(rid, deadline)
         if kind != "ok":
             raise SolverServiceError(f"catalog upload failed: {kind}")
         self._uploaded.add(fp)
 
     def stats(self) -> dict:
-        """Server-side batch/coalescing counters (observability + tests)."""
+        """Server-side batch/coalescing counters (observability + tests).
+        Deliberately outside the breaker: diagnostics must keep working
+        exactly when the breaker says the data path is unhealthy."""
         rid = self._send("stats", {})
         kind, body = self._wait(rid)
         if kind != "result":
@@ -239,13 +367,20 @@ class SolverServiceClient:
         return body
 
     def warmup(self, inp: ScheduleInput, shapes=(),
-               batch_sizes=(1,), _retry: bool = True) -> int:
+               batch_sizes=(1,)) -> int:
         """Remote padding-bucket precompile (solve.py TPUSolver.warmup):
         ships a representative input so the daemon pre-traces the kernel
         lattice before the first latency-sensitive schedule request.
         Returns the number of programs warmed."""
         fp, payload = self._fingerprint(inp)
-        self._ensure_catalog(fp, payload)
+        return self._with_retries(
+            lambda deadline: self._warmup_once(
+                inp, fp, payload, shapes, batch_sizes, deadline))
+
+    def _warmup_once(self, inp: ScheduleInput, fp: str, payload: bytes,
+                     shapes, batch_sizes, deadline: float,
+                     _catalog_retry: bool = True) -> int:
+        self._ensure_catalog(fp, payload, deadline)
         rid = self._send("warmup", {
             "fingerprint": fp,
             "pods": inp.pods,
@@ -254,17 +389,18 @@ class SolverServiceClient:
             "remaining_limits": inp.remaining_limits,
             "shapes": tuple(shapes),
             "batch_sizes": tuple(batch_sizes),
+            "deadline": deadline,
         })
-        kind, body = self._wait(rid)
+        kind, body = self._wait(rid, deadline)
         if kind == "need_catalog":
             # restarted-empty daemon: same ledger-invalidation-and-replay
             # discipline as solve_batch (one retry, then raise)
             self._uploaded.clear()
-            if not _retry:
+            if not _catalog_retry:
                 raise SolverServiceError(
                     "service lost the catalog again after re-upload")
-            return self.warmup(inp, shapes=shapes,
-                               batch_sizes=batch_sizes, _retry=False)
+            return self._warmup_once(inp, fp, payload, shapes, batch_sizes,
+                                     deadline, _catalog_retry=False)
         if kind != "result":
             raise SolverServiceError(f"warmup failed: {body}")
         return int(body.get("warmed", 0))
@@ -275,21 +411,24 @@ class SolverServiceClient:
         return self.solve_batch([inp], max_nodes=max_nodes)[0]
 
     def solve_batch(self, inps: List[ScheduleInput],
-                    max_nodes: Optional[int] = None,
-                    _retry: bool = True) -> List[ScheduleResult]:
+                    max_nodes: Optional[int] = None) -> List[ScheduleResult]:
         """`max_nodes` rides the schedule request so the disruption
         simulator's tiny-kernel cap survives the solverd deployment — the
         shared-TPU shape the cap matters most for."""
         if not inps:
             return []
         with tracing.span("service.solve_batch", requests=len(inps)):
-            return self._solve_batch_rpc(inps, max_nodes, _retry)
+            return self._with_retries(
+                lambda deadline: self._solve_batch_once(
+                    inps, max_nodes, deadline))
 
-    def _solve_batch_rpc(self, inps: List[ScheduleInput],
-                         max_nodes: Optional[int],
-                         _retry: bool) -> List[ScheduleResult]:
+    def _solve_batch_once(self, inps: List[ScheduleInput],
+                          max_nodes: Optional[int],
+                          deadline: float,
+                          _catalog_retry: bool = True
+                          ) -> List[ScheduleResult]:
         fp, payload = self._fingerprint(inps[0])
-        self._ensure_catalog(fp, payload)
+        self._ensure_catalog(fp, payload, deadline)
         # the traceparent-style context field: the daemon extracts it, runs
         # the solve under the caller's trace, and ships its spans back on
         # the result so remote-solver phases stitch into this pass's trace
@@ -297,7 +436,7 @@ class SolverServiceClient:
         rids = []
         for inp in inps:
             f, p = self._fingerprint(inp)
-            self._ensure_catalog(f, p)
+            self._ensure_catalog(f, p, deadline)
             rids.append(self._send("schedule", {
                 "fingerprint": f,
                 "pods": inp.pods,
@@ -307,12 +446,15 @@ class SolverServiceClient:
                 "price_cap": inp.price_cap,
                 "max_nodes": max_nodes,
                 "traceparent": tp,
+                # the daemon sheds a request whose caller's deadline has
+                # already passed (peers share this host's clock)
+                "deadline": deadline,
             }))
         out: List[ScheduleResult] = []
         lost_catalog = False
         try:
             for rid in rids:
-                kind, body = self._wait(rid)
+                kind, body = self._wait(rid, deadline)
                 if kind == "result":
                     remote_spans = getattr(body, "_remote_spans", None)
                     if remote_spans:
@@ -343,8 +485,9 @@ class SolverServiceClient:
             # once; schedule requests are stateless, so re-solving the
             # already-answered inputs is harmless.
             self._uploaded.clear()
-            if not _retry:
+            if not _catalog_retry:
                 raise SolverServiceError(
                     "service lost the catalog again after re-upload")
-            return self.solve_batch(inps, max_nodes=max_nodes, _retry=False)
+            return self._solve_batch_once(inps, max_nodes, deadline,
+                                          _catalog_retry=False)
         return out

@@ -34,7 +34,7 @@ from karpenter_tpu.solver.encode import (
     bucket,
     encode,
 )
-from karpenter_tpu.utils import metrics, tracing
+from karpenter_tpu.utils import faults, metrics, tracing
 
 R = len(RESOURCE_AXIS)
 
@@ -810,6 +810,10 @@ class TPUSolver:
             # device step, then pull + unpack — timed separately so the
             # new `dispatch`/`pull` phases make the overlap visible
             nonlocal disp_s, dev_s, pull_s, skew_s
+            # fault-matrix hook: `error` here is a failed device dispatch
+            # (GatedSolver must fall back), `delay` a slow device — host-
+            # side and before tracing, so it cannot leak into the program
+            faults.fire("solver.dispatch")
             t_a = _time.perf_counter()
             packed = run(n, k)
             t_b = _time.perf_counter()

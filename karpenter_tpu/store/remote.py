@@ -32,6 +32,8 @@ import threading
 import uuid
 from typing import Dict, List, Optional, Tuple
 
+from karpenter_tpu.utils import faults
+
 _LEN = struct.Struct(">I")
 
 
@@ -180,16 +182,20 @@ class RemoteBackend:
     on a second, peer events buffered for the cluster to drain on its
     reconcile cadence (informer semantics: level-driven, resync-safe)."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, timeout: float = 30.0):
         self.client_id = uuid.uuid4().hex
-        self._rpc = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._rpc.connect(path)
+        self._path = path
+        self._timeout = timeout
         self._rpc_lock = threading.Lock()
-        _send(self._rpc, {"op": "hello", "client": self.client_id})
-        _recv(self._rpc)
+        self._rpc: Optional[socket.socket] = self._rpc_connect()
         self._watch_sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._watch_sock.connect(path)
+        self._watch_sock.settimeout(timeout)
+        self._watch_sock.connect(self._path)
         _send(self._watch_sock, {"op": "watch", "client": self.client_id})
+        # the watch STREAM blocks indefinitely by design: events arrive
+        # whenever peers write, and close() unblocks the reader — an idle
+        # timeout here would tear down a healthy quiet stream
+        self._watch_sock.settimeout(None)  # kt-lint: disable=socket-discipline
         self._events: List[Tuple[str, str, str, Optional[object]]] = []
         self._events_lock = threading.Lock()
         self._closed = False
@@ -211,12 +217,56 @@ class RemoteBackend:
                 self._events.append(
                     (msg["kind"], msg["verb"], msg["name"], obj))
 
+    def _rpc_connect(self) -> socket.socket:
+        # every RPC is bounded: a wedged store daemon demotes this
+        # replica (the caller sees the error and retries/records)
+        # instead of freezing its reconcile loop forever behind one recv
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(self._timeout)
+        try:
+            s.connect(self._path)
+            _send(s, {"op": "hello", "client": self.client_id})
+            _recv(s)
+        except OSError:
+            s.close()
+            raise
+        return s
+
+    def _drop_rpc(self) -> None:
+        # caller holds _rpc_lock. The protocol has no request ids: a
+        # timeout or partial read leaves response bytes in flight, and
+        # reusing the socket would pair the NEXT request with the
+        # PREVIOUS response — the connection must die with the failure;
+        # the next _call reconnects fresh
+        if self._rpc is not None:
+            try:
+                self._rpc.close()
+            except OSError:
+                pass
+            self._rpc = None
+
     def _call(self, msg: dict) -> dict:
+        try:
+            faults.fire("store.remote.rpc")
+        except faults.FaultInjected as e:
+            # translate to the store's native failure type so callers'
+            # existing outage handling (retry next pass, record event)
+            # is what the fault exercises
+            raise ConnectionError(str(e)) from e
         with self._rpc_lock:
-            _send(self._rpc, dict(msg, origin=self.client_id))
-            out = _recv(self._rpc)
-        if out is None:
-            raise ConnectionError("store daemon closed the connection")
+            try:
+                if self._rpc is None:
+                    self._rpc = self._rpc_connect()
+                _send(self._rpc, dict(msg, origin=self.client_id))
+                out = _recv(self._rpc)
+            except OSError as e:
+                # includes a failed RECONNECT: callers' outage handling
+                # is keyed on ConnectionError, never raw OSError subtypes
+                self._drop_rpc()
+                raise ConnectionError(f"store rpc failed: {e}") from e
+            if out is None:
+                self._drop_rpc()
+                raise ConnectionError("store daemon closed the connection")
         return out
 
     # -- StoreBackend interface -------------------------------------------
@@ -249,6 +299,8 @@ class RemoteBackend:
     def close(self) -> None:
         self._closed = True
         for s in (self._rpc, self._watch_sock):
+            if s is None:
+                continue  # the RPC socket may be down awaiting reconnect
             try:
                 s.close()
             except OSError:

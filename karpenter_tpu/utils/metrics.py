@@ -310,6 +310,23 @@ SOLVER_PHASE_DURATION = _h(
     "Per-phase wall-clock of one device solve, by execution path "
     "(solve = single-problem attempt, sweep = batched consolidation "
     "sweep).", ("phase", "path"))
+# -- solver-service availability (ISSUE 7): the crash-isolation story's
+# -- observable half — without these, a daemon crash-loop looks identical
+# -- to a healthy idle service from the operator's scrape
+SERVICE_RETRIES = _c(
+    "karpenter_tpu_service_retries_total",
+    "Solver-service RPCs retried after a transport failure (connect/"
+    "send/receive/timeout), before the breaker or the caller gave up.")
+SERVICE_BREAKER_STATE = _g(
+    "karpenter_tpu_service_breaker_state",
+    "Solver-service circuit breaker state: 0=closed (healthy), 1=open "
+    "(failing fast, control plane in degraded mode), 2=half-open (one "
+    "probe in flight).")
+SERVICE_WORKER_RESTARTS = _c(
+    "karpenter_tpu_service_worker_restarts_total",
+    "Supervised kt_solverd worker processes restarted after an "
+    "unexpected exit (crash containment; a climbing series means a "
+    "crash loop the backoff is absorbing).")
 SOLVER_RESIDUE_PODS = _c(
     "karpenter_tpu_solver_residue_pods_total",
     "Pods solved host-side as split-solve residue.")

@@ -178,8 +178,10 @@ std::vector<Request> collect_batch() {
 }
 
 // One embedded-Python call per batch: handle_batch(list[bytes]) -> list[bytes]
+// Caller must hold the GIL (the batcher thread's PERSISTENT thread state —
+// see the batcher thread body for why per-batch PyGILState_Ensure/Release
+// cycling deadlocked the second MLIR lowering).
 void dispatch_batch(PyObject* handler, std::vector<Request>& batch) {
-  PyGILState_STATE gil = PyGILState_Ensure();
   PyObject* payloads = PyList_New(static_cast<Py_ssize_t>(batch.size()));
   for (size_t i = 0; i < batch.size(); ++i) {
     PyList_SET_ITEM(
@@ -191,7 +193,6 @@ void dispatch_batch(PyObject* handler, std::vector<Request>& batch) {
   Py_DECREF(payloads);
   if (out == nullptr) {
     PyErr_Print();
-    PyGILState_Release(gil);
     const char kErr[] = "\x80\x04N.";  // pickled None = internal error marker
     for (auto& req : batch)
       send_response(req.conn, req.id, kErr, sizeof kErr - 1);
@@ -215,7 +216,6 @@ void dispatch_batch(PyObject* handler, std::vector<Request>& batch) {
     if (PyErr_Occurred()) PyErr_Print();
   }
   Py_DECREF(out);
-  PyGILState_Release(gil);
 }
 
 void on_signal(int) {
@@ -286,11 +286,31 @@ int main(int argc, char** argv) {
                g_batcher.max_ms, g_batcher.max_batch);
 
   std::thread batcher_thread([&handler] {
+    // THE second-MLIR-lowering fix (the seed's "segfault"; empirically a
+    // wedge): the old per-batch PyGILState_Ensure/Release cycle DESTROYED
+    // this thread's PyThreadState after every batch (Release drops the
+    // gilstate counter to zero and deletes the state). JAX keeps
+    // per-thread trace/compile state rooted in Python thread-locals —
+    // i.e. in that thread state — so the first solve worked, and the
+    // second request whose padded shape needed a fresh MLIR lowering
+    // blocked forever on state owned by the deleted PyThreadState
+    // (hack/repro_mlir_crash.py reproduces; a persistent state across
+    // both batches completes both compiles). A normal Python thread's
+    // state lives for the thread's lifetime — give this thread the same
+    // contract: Ensure ONCE, then cycle only the GIL via
+    // PyEval_SaveThread/RestoreThread so Python daemon threads still run
+    // between batches.
+    PyGILState_STATE gil = PyGILState_Ensure();
+    PyThreadState* self_state = PyEval_SaveThread();
     while (!g_stop.load()) {
       std::vector<Request> batch = collect_batch();
       if (batch.empty()) continue;
+      PyEval_RestoreThread(self_state);
       dispatch_batch(handler, batch);
+      self_state = PyEval_SaveThread();
     }
+    PyEval_RestoreThread(self_state);
+    PyGILState_Release(gil);
   });
 
   while (!g_stop.load()) {

@@ -11,6 +11,15 @@ environment. We update the config back before any backend initializes.
 
 import os
 
+import pytest
+
+# Tier-1 must NEVER run with fault injection armed: an inherited
+# KARPENTER_TPU_FAULTS (from a shell that just drove the fault matrix by
+# hand) would silently poison every suite in this process AND every
+# daemon subprocess the suite spawns. Scrub it before karpenter_tpu
+# imports anywhere (utils/faults.py arms from the environment at import).
+os.environ.pop("KARPENTER_TPU_FAULTS", None)
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -30,3 +39,15 @@ _CACHE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), ".jax_cache")
 jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+@pytest.fixture(autouse=True)
+def _faults_disarmed():
+    """Belt-and-braces for the fault harness: whatever a test armed
+    (programmatically or via a monkeypatched env), the registry is clear
+    before AND after it — one forgotten disarm() cannot poison the rest
+    of the suite."""
+    from karpenter_tpu.utils import faults
+    faults.disarm()
+    yield
+    faults.disarm()

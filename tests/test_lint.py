@@ -26,6 +26,7 @@ from hack.analyze.rules import (  # noqa: E402
     jit_purity,
     lock_discipline,
     observability,
+    socket_discipline,
 )
 
 
@@ -509,6 +510,146 @@ def test_observability_span_names(tmp_path):
     """, observability)
     assert len(findings) == 1
     assert "Bad-Span" in findings[0].message
+
+
+# -- socket-discipline -----------------------------------------------------
+_SVC = "karpenter_tpu/service/demo.py"
+
+_SOCK_BAD = """
+    import socket
+
+
+    def connect_no_deadline(path):
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(path)
+        return s.recv(4)
+"""
+
+
+def test_socket_discipline_flags_timeoutless_blocking_ops(tmp_path):
+    findings, _ = _check(tmp_path, _SOCK_BAD, socket_discipline,
+                         relname=_SVC)
+    msgs = " | ".join(f.message for f in findings)
+    assert "`s.connect()`" in msgs
+    assert "`s.recv()`" in msgs
+    assert len(findings) == 2
+
+
+def test_socket_discipline_negatives(tmp_path):
+    findings, _ = _check(tmp_path, """
+        import socket
+
+
+        def bounded(path, timeout):
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.settimeout(timeout)
+            s.connect(path)
+            return s.recv(4)
+
+
+        def listener_only(path):
+            # a server's accept loop blocks by design; close() unblocks
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.bind(path)
+            s.listen(8)
+            return s
+
+
+        def retuned_after_connect(path):
+            # connect-timeout-then-op-timeout: the creation-time
+            # deadline governs; a later re-tune must not false-positive
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.settimeout(1.0)
+            s.connect(path)
+            s.settimeout(30.0)
+            return s.recv(4)
+    """, socket_discipline, relname=_SVC)
+    assert findings == []
+
+
+def test_socket_discipline_flags_settimeout_none(tmp_path):
+    findings, _ = _check(tmp_path, """
+        import socket
+
+
+        def unbounded(path):
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.settimeout(5.0)
+            s.connect(path)
+            s.settimeout(None)
+            return s
+    """, socket_discipline, relname=_SVC)
+    assert len(findings) == 1
+    assert "settimeout(None)" in findings[0].message
+
+
+def test_socket_discipline_bare_recv_needs_a_deadline_story(tmp_path):
+    # a class that NEVER sets a timeout has no deadline story: its recv
+    # helpers are flagged
+    findings, _ = _check(tmp_path, """
+        class Reader:
+            def read_exact(self, sock, n):
+                return sock.recv(n)
+    """, socket_discipline, relname=_SVC)
+    assert len(findings) == 1
+    assert "no deadline story" in findings[0].message
+    # a class that bounds its sockets at creation is trusted: helpers
+    # reading those sockets stay quiet (service/client.py _read_exact)
+    findings, _ = _check(tmp_path, """
+        import socket
+
+
+        class Client:
+            def connect(self, path, timeout):
+                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                s.settimeout(timeout)
+                s.connect(path)
+                return s
+
+            def read_exact(self, sock, n):
+                return sock.recv(n)
+    """, socket_discipline, relname=_SVC)
+    assert findings == []
+
+
+def test_socket_discipline_nested_function_not_double_visited(tmp_path):
+    # a nested helper is analyzed once (as its own function), not again
+    # while walking its parent — double-visiting duplicated findings
+    findings, _ = _check(tmp_path, """
+        import socket
+
+
+        def outer(path):
+            def watch():
+                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                s.connect(path)
+                return s
+            return watch
+    """, socket_discipline, relname=_SVC)
+    assert len(findings) == 1
+
+
+def test_socket_discipline_scoped_to_wire_layers(tmp_path):
+    findings, _ = _check(tmp_path, _SOCK_BAD, socket_discipline,
+                         relname="karpenter_tpu/controllers/demo.py")
+    assert findings == []
+
+
+def test_socket_discipline_suppression(tmp_path):
+    _, report = _check(tmp_path, """
+        import socket
+
+
+        def watch_stream(path):
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.settimeout(5.0)
+            s.connect(path)
+            # events arrive whenever peers write; close() unblocks
+            s.settimeout(None)  # kt-lint: disable=socket-discipline
+            return s
+    """, socket_discipline, relname=_SVC)
+    assert report.findings == []
+    assert len(report.suppressed) == 1
 
 
 # -- baseline workflow -----------------------------------------------------

@@ -195,6 +195,24 @@ class TestSolverService:
             assert set(r.unschedulable) == set(l.unschedulable), i
             assert r.node_count() == l.node_count(), i
 
+    def test_survives_repeated_fresh_lowerings(self, client):
+        """Regression for the seed's second-MLIR-lowering deadlock
+        (docs/static-analysis.md#the-second-mlir-lowering-crash): each
+        distinct group count lands in a fresh (G,E,N) padding bucket, so
+        every request below forces the daemon's embedded interpreter
+        through a NEW trace + MLIR lowering. The old per-batch
+        PyGILState_Ensure/Release cycle wedged on the second one; the
+        persistent batcher thread state must survive them all."""
+        for classes in (3, 6):
+            pods = [Pod(meta=ObjectMeta(name=f"ml{classes}-{c}-{i}"),
+                        requests=Resources.parse(
+                            {"cpu": f"{500 + 10 * c}m", "memory": "1Gi"}))
+                    for c in range(classes) for i in range(2)]
+            inp = ScheduleInput(pods=pods, nodepools=[POOL],
+                                instance_types={"default": CATALOG})
+            res = client.solve(inp)
+            assert not res.unschedulable, f"lowering #{classes} wedged"
+
     def test_error_response_on_garbage(self, daemon):
         s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         s.connect(daemon)
