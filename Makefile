@@ -3,10 +3,23 @@
 
 PY ?= python
 
-.PHONY: test multichip lint native asan repro-crash
+.PHONY: test tier1 multichip lint native asan repro-crash
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
+		-p no:cacheprovider
+
+# The timed tier-1 gate, with the persistent .jax_cache warmed FIRST:
+# the suite sits at ~650-760 s against the 870 s timeout and only fits
+# when the kernel lattice is compile-cached — a cold cache pays tens of
+# seconds per bucketed shape inside the timed window.  The warmer is
+# best-effort (`-` prefix: its failure must never block the run; a
+# missed shape just compiles inside the suite as it always did).
+# Documented in docs/operations.md §Development gates.
+tier1:
+	-JAX_PLATFORMS=cpu $(PY) hack/warm_tier1_cache.py
+	JAX_PLATFORMS=cpu timeout -k 10 870 $(PY) -m pytest tests/ -q \
+		-m 'not slow' --continue-on-collection-errors \
 		-p no:cacheprovider
 
 # The forced-8-device mesh parity suite: conftest provisions 8 virtual

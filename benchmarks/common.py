@@ -24,6 +24,16 @@ def run(metric: str, target_ms: float, make_input, solve=None, repeats: int = 5,
     # the parent bench only captures this config's stdout JSON (VERDICT
     # r3 #1: record the actual probe error, not just the fallback); one
     # writer shared with the headline bench and the relay watchdog
+    import os
+    # the repeat loop re-solves ONE input: with the delta path on the
+    # warm reps would measure cache reuse, not the config's solve — the
+    # delta story has its own bench (config7_churn.py, which pins both
+    # stories itself).  Pinned hard, with a notice when overriding an
+    # export (same discipline as the multichip bench's MESH handling).
+    if os.environ.get("KARPENTER_TPU_DELTA", "off") != "off":
+        print("config bench: ignoring exported KARPENTER_TPU_DELTA "
+              "(repeat loops must measure full solves)", file=sys.stderr)
+    os.environ["KARPENTER_TPU_DELTA"] = "off"
     from karpenter_tpu.utils.platform import initialize, log_attempt
     platform = initialize(attempt_log=log_attempt)
     from karpenter_tpu.solver import TPUSolver

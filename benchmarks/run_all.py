@@ -1,4 +1,4 @@
-"""Run all 6 config benchmarks; one JSON line each on stdout.
+"""Run all 7 config benchmarks; one JSON line each on stdout.
 
     python benchmarks/run_all.py            # real device if available
     JAX_PLATFORMS=cpu python benchmarks/run_all.py
@@ -17,7 +17,7 @@ import sys
 HERE = os.path.dirname(os.path.abspath(__file__))
 CONFIGS = ["config1_inflate.py", "config2_mixed.py", "config3_topology.py",
            "config4_consolidation.py", "config5_burst.py",
-           "config6_interruption.py"]
+           "config6_interruption.py", "config7_churn.py"]
 TIMEOUT = float(os.environ.get("KARPENTER_TPU_BENCH_TIMEOUT", "600"))
 
 if __name__ == "__main__":

@@ -22,6 +22,12 @@ with the parent):
   * `if`/`while` on a traced parameter    — TracerBoolConversionError
     (static_argnames/argnums parameters are exempt; `is None` checks are
     exempt — they branch on structure, not value)
+  * reads of the mutable delta SolveCache (`solve_cache`/`delta_cache`/
+    `_delta_cache` names) — the cache is host-side mutable state shared
+    with the reconcile/invalidation threads; a read under trace bakes
+    one snapshot into the compiled program and silently ignores every
+    later invalidation.  Snapshot it BEFORE dispatch (the same
+    ensure()-returns-the-table discipline as MaskRowRegistry).
   * `static_argnames` naming a parameter the function doesn't have
   * building a jit wrapper inside a function body — a fresh jit cache
     per call forces a recompile every invocation
@@ -54,6 +60,10 @@ _HOT_PATH = ("karpenter_tpu/solver/solve.py",
              "karpenter_tpu/solver/ffd.py")
 _NUMPY_ALIASES = {"np", "numpy", "onp"}
 _TIME_ALIASES = {"time", "_time"}
+# the delta SolveCache's conventional spellings (solver/delta.py,
+# TPUSolver._delta_cache, controllers' solve_cache wiring): host-side
+# mutable state that must never be read inside a traced body
+_SOLVE_CACHE_NAMES = {"solve_cache", "delta_cache", "_delta_cache"}
 
 
 def _is_jax_jit(node: ast.AST) -> bool:
@@ -254,6 +264,18 @@ def _scan_body(ctx: FileContext, fn: ast.FunctionDef, traced: Set[str],
             yield ctx.finding(
                 RULE_NAME, node,
                 f"os.environ read inside a {kind} function")
+        elif (isinstance(node, ast.Attribute)
+              and node.attr in _SOLVE_CACHE_NAMES) or \
+                (isinstance(node, ast.Name)
+                 and node.id in _SOLVE_CACHE_NAMES):
+            name = node.attr if isinstance(node, ast.Attribute) else node.id
+            yield ctx.finding(
+                RULE_NAME, node,
+                f"read of the mutable SolveCache ({name}) inside a "
+                f"{kind} function — delta-cache state mutates on the "
+                "host (invalidation feed, record stores); a traced read "
+                "bakes one snapshot into the compiled program. Snapshot "
+                "it before dispatch")
         elif isinstance(node, (ast.If, ast.While)):
             if _is_none_check(node.test):
                 continue

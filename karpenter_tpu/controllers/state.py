@@ -16,6 +16,60 @@ from karpenter_tpu.scheduling import ExistingNode, ScheduleInput
 from karpenter_tpu.scheduling.types import effective_request
 
 
+class SolveCacheFeed:
+    """Cluster-event half of the solver's delta SolveCache
+    (solver/delta.py): subscribes a cluster watch and drains store
+    mutations into (dirty pod names, dirty node names) for
+    ``TPUSolver.delta_invalidate`` — only the touched groups/nodes are
+    invalidated, so a steady-state pass stays O(churn).  Node-shaped
+    events (nodes, nodeclaims) invalidate cached node rows; pod events
+    mark their groups changed.  A ChangeMonitor gates the invalidation
+    log line so a churn-heavy cluster doesn't spam per-pass."""
+
+    _NODE_KINDS = ("nodes", "nodeclaims")
+
+    def __init__(self, cluster: Cluster):
+        from karpenter_tpu.utils.logging import ChangeMonitor
+        self._watch = cluster.watch()
+        self._monitor = ChangeMonitor()
+
+    def drain(self):
+        """(dirty pod names, dirty node names, flood).  The cluster
+        Watch's bounded buffer drops OLD events on overflow — harmless
+        for its level-driven consumers, but THIS consumer is
+        edge-driven: a dropped node event is a lost invalidation.  A
+        full drain therefore reports flood=True and the cache degrades
+        to all-dirty (one counted fallback), never a silent miss."""
+        events = self._watch.drain()
+        pods: set = set()
+        nodes: set = set()
+        for ev in events:
+            if ev.kind == "pods":
+                pods.add(ev.name)
+            elif ev.kind in self._NODE_KINDS:
+                nodes.add(ev.name)
+        flood = len(events) >= (self._watch._buffer.maxlen or 0)
+        return pods, nodes, flood
+
+    def feed(self, solver) -> None:
+        """Drain and forward to a solver that supports the delta seam
+        (the in-process TPUSolver; the remote client's daemon runs its
+        own value-based diff and needs no feed)."""
+        pods, nodes, flood = self.drain()
+        if not pods and not nodes and not flood:
+            return
+        inval = getattr(solver, "delta_invalidate", None)
+        if inval is None:
+            return
+        inval(pods=pods, nodes=nodes, flood=flood)
+        from karpenter_tpu.utils.logging import get_logger
+        if self._monitor.has_changed(
+                "delta-invalidate", (len(pods), len(nodes), flood)):
+            get_logger("solver").debug(
+                "delta cache invalidation", pods=len(pods),
+                nodes=len(nodes), flood=flood)
+
+
 class GatedSolver:
     """The TPU solver behind its feature gate with the CPU oracle as
     fallback — shared by the provisioner and the disruption simulator so
@@ -67,8 +121,16 @@ class GatedSolver:
             # overrides inside _resolve_mesh — flipping it to "off" on a
             # misbehaving deployment restores the single-device path
             # without an image or options change
-            self.tpu = TPUSolver(max_nodes=options.solver_max_nodes,
-                                 mesh=getattr(options, "solver_mesh", "auto"))
+            # SOLVER_DELTA configures the incremental delta-solve story
+            # the same way; KARPENTER_TPU_DELTA is its rollback knob,
+            # resolved inside the solver
+            self.tpu = TPUSolver(
+                max_nodes=options.solver_max_nodes,
+                mesh=getattr(options, "solver_mesh", "auto"),
+                delta=getattr(options, "solver_delta", "auto"))
+            # event-driven delta-cache invalidation: cluster watch →
+            # dirty pod/node names → TPUSolver.delta_invalidate
+            self._delta_feed = SolveCacheFeed(cluster)
             # warm the native host-ops build at startup, never inside a
             # latency-sensitive solve
             from karpenter_tpu.native import hostops
@@ -96,7 +158,9 @@ class GatedSolver:
                     from karpenter_tpu.solver import TPUSolver
                     self._local = TPUSolver(
                         max_nodes=self.options.solver_max_nodes,
-                        mesh=getattr(self.options, "solver_mesh", "auto"))
+                        mesh=getattr(self.options, "solver_mesh", "auto"),
+                        delta=getattr(self.options, "solver_delta",
+                                      "auto"))
         return self._local
 
     def _degraded_solve(self, inp: ScheduleInput, source: str,
@@ -127,6 +191,9 @@ class GatedSolver:
         from karpenter_tpu.solver import UnsupportedPods
         from karpenter_tpu.utils import metrics, tracing
         if self.options.feature_gates.tpu_solver:
+            feed = getattr(self, "_delta_feed", None)
+            if feed is not None:
+                feed.feed(self.tpu)
             try:
                 return self.tpu.solve(inp, max_nodes=max_nodes)
             except UnsupportedPods:

@@ -53,6 +53,11 @@ class Options:
     # device when >1 is visible (SURVEY §2.3 ICI sharding); "off" forces
     # single-device; an integer uses the first n devices
     solver_mesh: str = "auto"
+    # incremental delta solves (solver/delta.py): "auto" engages on
+    # steady-state repeats above the min-size gate, "on" forces, "off"
+    # disables. KARPENTER_TPU_DELTA is the rollback override, resolved
+    # inside the solver exactly like KARPENTER_TPU_MESH.
+    solver_delta: str = "auto"
     # unix-socket path of a kt_solverd solver service (native/solverd.cc);
     # None = in-process solver. Lets control-plane replicas share one
     # TPU-owning process (SURVEY §2.3 leader-election note).
@@ -116,6 +121,12 @@ class Options:
         # _resolve_mesh so it reaches every solver however built —
         # including the one state.py constructs from this options value
         opts.solver_mesh = os.environ.get("SOLVER_MESH", opts.solver_mesh)
+        # SOLVER_DELTA configures the delta-solve story; the
+        # KARPENTER_TPU_DELTA rollback override is deliberately NOT
+        # parsed here — its single grammar owner is
+        # TPUSolver._delta_env_spec (same discipline as the mesh knob)
+        opts.solver_delta = os.environ.get("SOLVER_DELTA",
+                                           opts.solver_delta)
         opts.leader_elect = os.environ.get(
             "LEADER_ELECT", "").strip().lower() in ("1", "true", "yes")
         opts.lease_file = os.environ.get("LEASE_FILE", opts.lease_file)

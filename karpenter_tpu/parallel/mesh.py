@@ -209,6 +209,47 @@ class MeshExecutor:
             self._progs[key] = prog
         return prog
 
+    def _delta_program(self, layout, max_nodes: int, zc: int):
+        """The seeded delta kernel under shard_map: the replicated
+        suffix buffer plus the column-sharded seed masks and the
+        resident mask table/catalog shards.  Cached by statics like the
+        resident program (never a fresh jit cache per call)."""
+        key = ("delta", layout, max_nodes, zc)
+        prog = self._progs.get(key)
+        if prog is None:
+            ax = self.axis
+            body = partial(ffd._solve_ffd_delta_resident_impl,
+                           layout=layout, max_nodes=max_nodes, zc=zc,
+                           axis_name=ax)
+            sm = shard_map(
+                body, mesh=self.mesh,
+                in_specs=(P(),            # suffix problem buffer
+                          P(None, ax),    # seed_colmask [A_pad, O]
+                          P(None, ax),    # mask_table [C, O]
+                          P(ax, None),    # col_alloc
+                          P(ax, None),    # col_daemon
+                          P(ax, None),    # pt_alloc
+                          P(ax),          # col_pool
+                          P(),            # pool_daemon
+                          P(ax),          # col_zone
+                          P(ax)),         # col_ct
+                out_specs=P(), check_rep=False)
+            prog = jax.jit(sm)  # kt-lint: disable=jit-purity
+            self._progs[key] = prog
+        return prog
+
+    def solve_delta(self, buf, seed_colmask, mask_table, dev: dict,
+                    layout, max_nodes: int):
+        """Dispatch one seeded delta solve (solver/delta.py): the
+        suffix problem buffer replicates, the seed column masks arrive
+        column-sharded (the caller committed them via put_sharded, so
+        the transfer is logged), everything else is resident."""
+        prog = self._delta_program(layout, max_nodes, dev["ZC"])
+        return prog(buf, seed_colmask, mask_table,
+                    dev["col_alloc"], dev["col_daemon"],
+                    dev["pt_alloc"], dev["col_pool"],
+                    dev["pool_daemon"], dev["col_zone"], dev["col_ct"])
+
     def solve(self, buf, mask_table, dev: dict, layout, max_nodes: int,
               sparse_n: int, donate: bool):
         """Dispatch one resident-path solve.  `buf` is the coalesced

@@ -54,9 +54,15 @@ def _get_solver():
         # operator options do; KARPENTER_TPU_MESH (read inside the
         # solver per _resolve_mesh) stays the rollback override that
         # beats whatever was configured here
+        # SOLVER_DELTA configures the daemon's delta-solve story the
+        # same way; KARPENTER_TPU_DELTA stays the rollback override.
+        # The delta cache lives server-side (records are device-adjacent
+        # and far too heavy to ship per request): the daemon's own
+        # value-based diff re-validates unpickled pods/nodes per pass.
         _solver = TPUSolver(
             max_nodes=int(os.environ.get("KARPENTER_TPU_MAX_NODES", "2048")),
-            mesh=os.environ.get("SOLVER_MESH", "auto"))
+            mesh=os.environ.get("SOLVER_MESH", "auto"),
+            delta=os.environ.get("SOLVER_DELTA", "auto"))
     return _solver
 
 

@@ -1,0 +1,661 @@
+"""Incremental delta solves: O(churn) steady-state passes.
+
+Production traffic is not 50k cold pods per pass — it is a warm cluster
+where a few hundred pods churn per reconcile loop, yet the full path
+re-encodes and re-solves the whole snapshot every time.  This module
+holds the solver-side half of the delta machinery:
+
+  * ``SolveCache`` — a bounded per-catalog-identity store of the previous
+    solve (``DeltaRecord``: the ``EncodedProblem``, the kernel's decoded
+    output rows, group identity keys, and per-node fingerprints), plus
+    the event-driven dirty sets the controllers feed
+    (``controllers/state.py`` drains cluster watch events into
+    ``TPUSolver.delta_invalidate``).
+  * ``plan()`` — diff the new pass against the record: the longest
+    common PREFIX of the FFD group order is bit-reusable (the kernel is
+    a deterministic sequential scan, so a group's fill depends only on
+    the fills before it), everything after is the restricted SUFFIX.
+  * ``build()`` — encode only the suffix (unchanged suffix groups reuse
+    their cached rows; truly new/changed groups re-encode) and REPLAY
+    the prefix's state host-side: consumed exist_remaining, per-node
+    used vectors, and surviving-column masks, mirroring the kernel's
+    float32 arithmetic op-for-op (the `_np_fit_count` discipline) so the
+    seeded scan is bit-identical to the full solve's suffix steps.
+  * ``merge()`` — stitch the cached prefix rows and the seeded suffix
+    output back into one (enc, out) pair; the ordinary ``_decode`` then
+    produces a result exactly equal to the full re-solve's.
+
+Exactness is the contract: any condition that could break it — topology
+constraints, resident required anti-affinity, finite pool limits, price
+caps, node churn, catalog change, a suffix that crosses the padding
+bucket of the full problem — is a conservative FALLBACK to the full
+solve, counted in ``karpenter_tpu_solver_delta_passes_total{outcome=
+"fallback"}`` so no fallback is ever silent.
+
+The kernel-side half (seeded scan start) lives in solver/ffd.py
+(`solve_ffd_delta`, `_solve_ffd_delta_resident_impl`); the dispatch
+plumbing in solver/solve.py (`_try_delta`); the controller-side event
+feed in controllers/state.py (`SolveCacheFeed`).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from karpenter_tpu.models import wellknown
+from karpenter_tpu.models.resources import RESOURCE_AXIS
+from karpenter_tpu.scheduling.types import effective_request
+from karpenter_tpu.solver.ffd import EPS
+from karpenter_tpu.solver.encode import (
+    BIG,
+    EncodedProblem,
+    _has_required_anti,
+    _label_matrix,
+    _np_fit_count,
+    _Vocab,
+    bucket,
+    exist_group_ok,
+    group_column_mask,
+)
+
+R = len(RESOURCE_AXIS)
+
+# below this many groups the full solve is already sub-millisecond on a
+# warm jit cache and a delta pass would only add seeded-program compiles;
+# "auto" mode disengages, "on" forces (unit tests, tiny deployments)
+DELTA_MIN_GROUPS = 24
+# padding tiers for the seeded node-slot axis (the [A_pad, O] seed
+# column-mask upload keys the jit cache like every other padded axis)
+SEED_BUCKETS = (16, 64, 256, 1024, 2048, 4096)
+# dirty-set flood bound: past this the per-name bookkeeping costs more
+# than the fallback it prevents — collapse to "everything dirty"
+_DIRTY_CAP = 50_000
+
+
+@dataclass
+class _NodeFP:
+    """Value snapshot of one ExistingNode at record time.  Compared by
+    VALUE on the next pass (never by object identity — the controller
+    rebuilds wrappers per pass, and the solverd daemon unpickles fresh
+    objects per request), so in-place label/taint/readiness mutations
+    and remote round-trips are both handled."""
+    name: str
+    labels: dict
+    taints: list
+    ready: bool
+    deleting: bool
+    avail: np.ndarray           # [R] f32 — must match bit-for-bit
+    res_anti: bool              # any resident pod carries required anti
+
+
+@dataclass
+class DeltaRecord:
+    """One cached solve: everything the next pass needs to reuse the
+    unchanged prefix and seed the suffix."""
+    cat: object                 # CatalogEncoding (strong ref: keys stay valid)
+    enc: EncodedProblem
+    groups: List[list]          # enc.groups (FFD order)
+    gkeys: List[Tuple[int, tuple]]   # per group: (gid, member-name tuple)
+    out_te: np.ndarray          # [G, E] f32 take_exist (dense, unpadded)
+    out_tn: np.ndarray          # [G, NA] f32 take_new (dense, unpadded)
+    node_pool: np.ndarray       # [NA] i32
+    num_active: int
+    node_fps: List[_NodeFP]
+    res_anti_any: bool
+    # lazy caches, carried forward across delta passes while the catalog
+    # and node set hold: the per-call existing-node label matrices and
+    # the per-class opener feasibility rows
+    exist_tables: Optional[tuple] = None
+    feas_cache: Dict[int, np.ndarray] = field(default_factory=dict)
+
+    @property
+    def n_groups(self) -> int:
+        return len(self.groups)
+
+
+@dataclass
+class DeltaPlan:
+    record: DeltaRecord
+    m: int                      # common-prefix length (FFD order)
+    new_prefix: List[list]      # groups[:m] of the NEW pass (live pods)
+    suffix: List[list]          # groups[m:] of the NEW pass
+    reuse: List[Optional[int]]  # per suffix group: prior row index or None
+
+
+class SolveCache:
+    """Bounded per-(catalog-identity) store of DeltaRecords plus the
+    dirty sets fed by cluster events.  One per TPUSolver; the
+    controller-side ``SolveCacheFeed`` (controllers/state.py) drains
+    watch events into ``invalidate`` via ``TPUSolver.delta_invalidate``.
+    """
+
+    def __init__(self, capacity: int = 4):
+        import threading
+        self.capacity = capacity
+        # the provisioner and the disruption simulator share one
+        # GatedSolver (and its TPUSolver), so solves — and the watch
+        # feed's invalidations — can race; all structural mutation
+        # happens under this lock.  Records themselves are effectively
+        # immutable once published (the lazy tables are idempotent).
+        self._lock = threading.Lock()
+        self._records: "OrderedDict[int, DeltaRecord]" = OrderedDict()
+        self.dirty_pods: set = set()
+        self.dirty_nodes: set = set()
+        self.all_dirty = False   # dirty-set flood: force one fallback
+        # invalidation generation: bumped on every invalidate() so a
+        # store can tell whether NEW dirt arrived after the snapshot
+        # its solve consumed (put must never discard such dirt)
+        self._gen = 0
+        # observability for tests/debug: the last pass's verdict
+        self.last_outcome: Optional[str] = None
+        self.last_reason: Optional[str] = None
+
+    def get(self, cat) -> Optional[DeltaRecord]:
+        with self._lock:
+            rec = self._records.get(id(cat))
+            if rec is not None:
+                self._records.move_to_end(id(cat))
+            return rec
+
+    def put(self, cat, rec: DeltaRecord, consumed=None) -> None:
+        """Publish a fresh record.  `consumed` is the dirty SNAPSHOT the
+        solve that built it observed (dirty_snapshot()): only that dirt
+        is retired — invalidations that arrived mid-solve (another
+        thread's feed) stay dirty, or the next pass could engage
+        against state an event flagged and values can't disprove.
+        consumed=None retires nothing (pure conservatism: stale dirt
+        costs one counted fallback, whose full solve then retires it)."""
+        with self._lock:
+            self._records[id(cat)] = rec
+            self._records.move_to_end(id(cat))
+            while len(self._records) > self.capacity:
+                self._records.popitem(last=False)
+            if consumed is not None:
+                pods, nodes, flood, gen = consumed
+                self.dirty_pods -= pods
+                self.dirty_nodes -= nodes
+                if flood and gen == self._gen:
+                    # no invalidation landed since the snapshot: the
+                    # flood the solve observed is fully absorbed
+                    self.all_dirty = False
+
+    def invalidate(self, pods=(), nodes=(), flood: bool = False) -> None:
+        with self._lock:
+            self._gen += 1
+            self.dirty_pods.update(pods)
+            self.dirty_nodes.update(nodes)
+            if flood or (len(self.dirty_pods) > _DIRTY_CAP
+                         or len(self.dirty_nodes) > _DIRTY_CAP):
+                self.all_dirty = True
+                self.dirty_pods.clear()
+                self.dirty_nodes.clear()
+
+    def dirty_snapshot(self):
+        """(dirty_pods, dirty_nodes, all_dirty, gen) as one consistent
+        view — plan() must not watch the sets mutate mid-diff, and
+        put() retires exactly this view."""
+        with self._lock:
+            return (frozenset(self.dirty_pods),
+                    frozenset(self.dirty_nodes), self.all_dirty,
+                    self._gen)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records.clear()
+            self.dirty_pods.clear()
+            self.dirty_nodes.clear()
+            self.all_dirty = False
+
+
+def _fingerprint(en) -> _NodeFP:
+    node = en.node
+    return _NodeFP(
+        name=en.name,
+        labels=dict(node.labels),
+        taints=list(node.taints),
+        ready=node.ready,
+        deleting=node.meta.deleting,
+        avail=np.array(en.available.v, dtype=np.float32),
+        res_anti=_has_required_anti(en.pods),
+    )
+
+
+def _nodes_unchanged(rec: DeltaRecord, existing, dirty_nodes) -> bool:
+    """Every existing node matches its stored fingerprint by VALUE
+    (labels, taints, readiness, available capacity, resident required
+    anti-affinity).  Any mismatch — including an event-marked dirty
+    node, whose fingerprint may be stale in ways values can't show —
+    fails the whole check; node churn is a counted fallback, not a
+    partial re-encode (prefix fills depend on the full node tensor)."""
+    fps = rec.node_fps
+    if len(existing) != len(fps):
+        return False
+    for en, fp in zip(existing, fps):
+        if en.name != fp.name or en.name in dirty_nodes:
+            return False
+        if en.charge_pool is not None:
+            return False
+        node = en.node
+        if node.meta.deleting != fp.deleting or node.ready != fp.ready:
+            return False
+        if node.labels != fp.labels or node.taints != fp.taints:
+            return False
+        av = np.asarray(en.available.v, dtype=np.float32)
+        if not np.array_equal(av, fp.avail):
+            return False
+        if _has_required_anti(en.pods) != fp.res_anti:
+            return False
+    return True
+
+
+def _same_group(g, prev_g, names) -> bool:
+    """One pod class unchanged: same member count and member names, in
+    order.  The list == fast path covers identical objects (the common
+    in-process case) at C speed; the name walk covers re-unpickled pods
+    (the solverd daemon's case)."""
+    if len(g) != len(names):
+        return False
+    if g == prev_g:
+        return True
+    return all(p.meta.name == n for p, n in zip(g, names))
+
+
+def plan(rec: Optional[DeltaRecord], inp, groups, dirty,
+         min_groups: int, g_buckets) -> "DeltaPlan | str":
+    """Diff the new pass against the record.  `dirty` is the caller's
+    SolveCache.dirty_snapshot() — taken once per pass so put() can
+    retire exactly what this diff observed.  Returns a DeltaPlan, or a
+    fallback-reason string (every string return is counted)."""
+    if rec is None:
+        return "cold"
+    dirty_pods, dirty_nodes, all_dirty, _gen = dirty
+    if all_dirty:
+        return "nodes"
+    if inp.price_cap is not None:
+        return "price-cap"
+    if any(lim is not None
+           for lim in (inp.remaining_limits or {}).values()):
+        return "limits"
+    if len(groups) < min_groups:
+        return "small"
+    for g in groups:
+        rep = g[0]
+        if rep.topology_spread or rep.pod_affinities or rep.preferences:
+            return "topology"
+    if rec.res_anti_any:
+        return "topology"
+    if not _nodes_unchanged(rec, inp.existing_nodes, dirty_nodes):
+        return "nodes"
+
+    prev_groups, prev_keys = rec.groups, rec.gkeys
+    m = 0
+    limit = min(len(groups), rec.n_groups)
+    while m < limit:
+        gid, names = prev_keys[m]
+        g = groups[m]
+        if g[0].scheduling_group_id() != gid:
+            break
+        if dirty_pods and any(n in dirty_pods for n in names):
+            break
+        if not _same_group(g, prev_groups[m], names):
+            break
+        m += 1
+    suffix = groups[m:]
+    if suffix and (bucket(len(suffix), g_buckets)
+                   >= bucket(len(groups), g_buckets)):
+        # the restricted slab would pad to the full problem's bucket —
+        # no win, and a fresh seeded program compile for nothing
+        return "bucket"
+
+    prev_by_gid = {prev_keys[i][0]: i for i in range(m, rec.n_groups)}
+    reuse: List[Optional[int]] = []
+    for g in suffix:
+        i = prev_by_gid.get(g[0].scheduling_group_id())
+        if i is not None:
+            _, names = prev_keys[i]
+            if (not (dirty_pods and any(n in dirty_pods for n in names))
+                    and _same_group(g, prev_groups[i], names)):
+                reuse.append(i)
+                continue
+        reuse.append(None)
+    return DeltaPlan(record=rec, m=m, new_prefix=groups[:m],
+                     suffix=suffix, reuse=reuse)
+
+
+def _exist_tables(rec: DeltaRecord):
+    """Per-call existing-node label matrices, built lazily ONCE per node
+    set (nodes are value-stable while the record engages) — the same
+    vocab/matrix construction encode() performs per full pass, so a
+    fresh suffix group's exist row is bit-identical to what the full
+    encode would produce."""
+    if rec.exist_tables is None:
+        existing = rec.enc.existing
+        vocab = _Vocab()
+        keys = sorted({k for en in existing for k in en.node.labels})
+        matrices = _label_matrix(vocab, keys,
+                                 [en.node.labels for en in existing])
+        rec.exist_tables = (vocab, matrices)
+    return rec.exist_tables
+
+
+def _exist_row(rec: DeltaRecord, rep) -> np.ndarray:
+    """encode()'s per-group existing-node allowance row for a fresh
+    group, on the cached matrices and the SHARED eligibility verdict
+    (encode.exist_group_ok — one definition, no drift); topology-inert,
+    so ecap is BIG where the node qualifies, exactly the
+    inactive-encoder shape."""
+    existing = rec.enc.existing
+    vocab, matrices = _exist_tables(rec)
+    ok = exist_group_ok(rep, vocab, matrices, existing)
+    return np.where(ok, BIG, 0).astype(np.int32)
+
+
+def _feas_row(rec: DeltaRecord, cat, gi: int) -> np.ndarray:
+    """The kernel's open-new column feasibility for prior group `gi`:
+    group_mask ∧ (one pod fits a fresh node of the column) — the
+    `cols_p` term of the opener's colmask, cached per class id."""
+    gid, _ = rec.gkeys[gi]
+    row = rec.feas_cache.get(gid)
+    if row is None:
+        fit = _np_fit_count(cat.col_alloc - cat.col_daemon,
+                            rec.enc.group_req[gi])
+        row = rec.enc.group_mask[gi] & (fit >= 1)
+        if len(rec.feas_cache) > 4096:
+            rec.feas_cache.clear()
+        rec.feas_cache[gid] = row
+    return row
+
+
+@dataclass
+class SuffixProblem:
+    """The restricted problem build()'s output: unpadded suffix rows +
+    the replayed prefix seed state."""
+    group_req: np.ndarray
+    group_count: np.ndarray
+    group_mask: np.ndarray      # [Gd, O_real] bool
+    exist_cap: np.ndarray       # [Gd, E] i32
+    merged_reqs: List[list]
+    exist_remaining: np.ndarray  # [E, R] f32 — consumed by the prefix
+    seed_used: np.ndarray       # [A, R] f32
+    seed_pool: np.ndarray       # [A] i32
+    seed_colmask: np.ndarray    # [A, O_real] bool
+    A: int                      # seeded (prefix-opened) node count
+    reencoded: int              # suffix groups that needed a fresh encode
+
+
+def build(plan_: DeltaPlan, cat) -> "SuffixProblem | None":
+    """Encode the suffix and replay the prefix seed state.  Every
+    float32 step mirrors the kernel's arithmetic op-for-op (same
+    operand order, same EPS) so the seeded scan reproduces the full
+    solve's suffix bit-for-bit.  Returns None when the cached output
+    violates a replay invariant (paranoia guard → counted fallback)."""
+    rec = plan_.record
+    enc = rec.enc
+    m = plan_.m
+    E = len(enc.existing)
+    O_real = len(cat.columns)
+    Gd = len(plan_.suffix)
+    req = enc.group_req
+
+    # -- suffix rows: reuse cached encodings, re-encode only the churn --
+    group_req = np.zeros((Gd, R), dtype=np.float32)
+    group_count = np.zeros(Gd, dtype=np.int32)
+    group_mask = np.zeros((Gd, O_real), dtype=bool)
+    exist_cap = np.zeros((Gd, E), dtype=np.int32)
+    merged_reqs: List[list] = []
+    reenc = 0
+    for j, (g, ridx) in enumerate(zip(plan_.suffix, plan_.reuse)):
+        if ridx is not None:
+            group_req[j] = req[ridx]
+            group_count[j] = enc.group_count[ridx]
+            group_mask[j] = enc.group_mask[ridx]
+            if E:
+                exist_cap[j] = enc.exist_cap[ridx]
+            merged_reqs.append(enc.merged_reqs[ridx])
+        else:
+            reenc += 1
+            rep = g[0]
+            group_req[j] = np.array(effective_request(rep).v,
+                                    dtype=np.float32)
+            group_count[j] = len(g)
+            gmask, merged = group_column_mask(cat, rep)
+            group_mask[j] = gmask
+            merged_reqs.append(merged)
+            if E:
+                exist_cap[j] = _exist_row(rec, rep)
+
+    # -- prefix replay: exist_remaining after the prefix's fills --------
+    # same per-group sequential order and the same two ops (product,
+    # subtract) as the kernel's scan step, so rounding agrees exactly
+    er = enc.exist_remaining.copy()
+    te = rec.out_te
+    for g in range(m):
+        row = te[g]
+        if row.any():
+            er -= row[:, None] * req[g]
+
+    # -- prefix replay: seeded node slots -------------------------------
+    tn = rec.out_tn
+    NA = rec.num_active
+    if NA:
+        nz = tn[:, :NA] > 0
+        if not nz.any(axis=0).all():
+            return None  # an active node nobody filled: replay invariant
+        opener = nz.argmax(axis=0)
+        if (np.diff(opener) < 0).any():
+            return None  # node order not monotone in opener group
+        A = int(np.searchsorted(opener, m))
+    else:
+        opener = np.zeros(0, dtype=np.int64)
+        A = 0
+
+    seed_used = np.zeros((A, R), dtype=np.float32)
+    seed_pool = rec.node_pool[:A].astype(np.int32, copy=True)
+    seed_colmask = np.zeros((A, O_real), dtype=bool)
+    if A:
+        pool_rows = cat.pool_daemon[seed_pool]          # [A, R] f32
+        opener_a = opener[:A]
+        # opener colmask base: cols_p of the opening group ∩ the node's
+        # pool (the kernel's step-3 new_colmask, before capacity)
+        for gi in np.unique(opener_a):
+            feas = _feas_row(rec, cat, int(gi))
+            sel = opener_a == gi
+            seed_colmask[sel] = (feas[None, :]
+                                 & (cat.col_pool[None, :]
+                                    == seed_pool[sel, None]))
+        for g in range(m):
+            row = tn[g, :A]
+            sel = row > 0
+            if not sel.any():
+                continue
+            prod = row[:, None] * req[g]                # f32, like the kernel
+            opened = sel & (opener_a == g)
+            touched = sel & ~opened
+            if opened.any():
+                # the kernel SETS pool_daemon + k·req at open time
+                seed_used[opened] = pool_rows[opened] + prod[opened]
+            if touched.any():
+                seed_used[touched] = seed_used[touched] + prod[touched]
+                # in-flight touch narrows the mask to the group's columns
+                seed_colmask[touched] &= enc.group_mask[g][None, :]
+        # final capacity mask: pt-granular fit against the final used
+        # vector (the kernel applies it every step; used only grows, so
+        # the final application is the binding one)
+        zc = max(cat.zc, 1)
+        PT = O_real // zc
+        ok_pt = np.all(
+            cat.pt_alloc[None, :, :] - seed_used[:, None, :] >= -EPS,
+            axis=-1)                                    # [A, PT]
+        seed_colmask &= np.broadcast_to(
+            ok_pt[:, :, None], (A, PT, zc)).reshape(A, O_real)
+
+    return SuffixProblem(
+        group_req=group_req, group_count=group_count,
+        group_mask=group_mask, exist_cap=exist_cap,
+        merged_reqs=merged_reqs, exist_remaining=er,
+        seed_used=seed_used, seed_pool=seed_pool,
+        seed_colmask=seed_colmask, A=A, reencoded=reenc)
+
+
+def merge(plan_: DeltaPlan, sp: SuffixProblem, cat, inp,
+          out_s: Optional[dict], Gd: int):
+    """Stitch the cached prefix rows and the seeded suffix output into
+    one (EncodedProblem, out) pair for the ordinary decode.  With an
+    empty suffix (pure reuse / tail removal) out_s is None and the
+    merged output is the prefix alone — no kernel ran at all."""
+    rec = plan_.record
+    enc_p = rec.enc
+    m = plan_.m
+    E = len(enc_p.existing)
+    D = enc_p.n_domains
+    A = sp.A
+    G = m + Gd
+
+    if out_s is None:
+        num_active = A
+        te = rec.out_te[:m]
+        tn = rec.out_tn[:m, :A]
+        used = sp.seed_used
+        node_pool = sp.seed_pool
+        node_dom = np.full(A, -1, dtype=np.int32)
+        node_zone, node_ct = node_dom, node_dom
+    else:
+        num_active = int(out_s["num_active"])
+        te = np.concatenate(
+            [rec.out_te[:m], out_s["take_exist"][:Gd, :E]], axis=0)
+        tn_pref = np.zeros((m, num_active), dtype=rec.out_tn.dtype)
+        tn_pref[:, :A] = rec.out_tn[:m, :A]
+        tn = np.concatenate(
+            [tn_pref, out_s["take_new"][:Gd, :num_active]], axis=0)
+        used = out_s["used"]
+        node_pool = out_s["node_pool"]
+        node_zone = out_s["node_zone"]
+        node_ct = out_s["node_ct"]
+
+    out_m = dict(
+        take_exist=te,
+        take_new=tn,
+        new_overflow=False,
+        unsched=np.zeros(G, dtype=np.float32),
+        dom_placed=np.zeros((G, D), dtype=np.float32),
+        used=used,
+        node_pool=np.asarray(node_pool, dtype=np.int32),
+        node_zone=np.asarray(node_zone, dtype=np.int32),
+        node_ct=np.asarray(node_ct, dtype=np.int32),
+        num_active=num_active,
+    )
+
+    def cc(a, b):
+        return np.concatenate([a, b], axis=0) if Gd else a.copy()
+
+    inert_i = np.zeros(Gd, dtype=np.int32)
+    groups_m = list(plan_.suffix)
+    enc_m = EncodedProblem(
+        group_req=cc(enc_p.group_req[:m], sp.group_req),
+        group_count=cc(enc_p.group_count[:m], sp.group_count),
+        group_mask=cc(enc_p.group_mask[:m], sp.group_mask),
+        exist_cap=cc(enc_p.exist_cap[:m], sp.exist_cap),
+        # the ORIGINAL capacities — replay always restarts from them
+        exist_remaining=enc_p.exist_remaining,
+        col_alloc=cat.col_alloc,
+        col_daemon=cat.col_daemon,
+        col_price=cat.col_price,
+        col_pool=cat.col_pool,
+        pool_limit=enc_p.pool_limit,
+        group_ncap=cc(enc_p.group_ncap[:m],
+                      np.full(Gd, BIG, dtype=np.int32)),
+        group_dsel=cc(enc_p.group_dsel[:m], inert_i),
+        group_dbase=cc(enc_p.group_dbase[:m],
+                       np.zeros((Gd, D), dtype=np.int32)),
+        group_dcap=cc(enc_p.group_dcap[:m],
+                      np.full((Gd, D), BIG, dtype=np.int32)),
+        group_skew=cc(enc_p.group_skew[:m],
+                      np.full(Gd, BIG, dtype=np.int32)),
+        group_mindom=cc(enc_p.group_mindom[:m], inert_i),
+        group_delig=cc(enc_p.group_delig[:m],
+                       np.zeros((Gd, D), dtype=bool)),
+        group_whole_node=cc(enc_p.group_whole_node[:m],
+                            np.zeros(Gd, dtype=bool)),
+        col_zone=cat.col_zone,
+        col_ct=cat.col_ct,
+        exist_zone=enc_p.exist_zone,
+        exist_ct=enc_p.exist_ct,
+        zone_values=enc_p.zone_values,
+        ct_values=enc_p.ct_values,
+        n_domains=D,
+        static_allowed=(list(enc_p.static_allowed[:m])
+                        + [{wellknown.ZONE_LABEL: None,
+                            wellknown.CAPACITY_TYPE_LABEL: None}
+                           for _ in range(Gd)]),
+        residue=[],
+        groups=list(plan_.new_prefix) + groups_m,
+        columns=cat.columns,
+        existing=list(inp.existing_nodes),
+        pools=cat.pools,
+        merged_reqs=list(enc_p.merged_reqs[:m]) + sp.merged_reqs,
+    )
+    return enc_m, out_m
+
+
+def tables_reusable(old: DeltaRecord, new: DeltaRecord) -> bool:
+    """Whether `old`'s lazily-built exist tables are valid for `new`:
+    the label matrices key on each node's labels/taints/readiness in
+    order, so any node-set difference invalidates them (available
+    capacity and resident anti flags don't participate)."""
+    if len(old.node_fps) != len(new.node_fps):
+        return False
+    for a, b in zip(old.node_fps, new.node_fps):
+        if (a.name != b.name or a.labels != b.labels
+                or a.taints != b.taints or a.ready != b.ready
+                or a.deleting != b.deleting):
+            return False
+    return True
+
+
+def make_record(cat, enc: EncodedProblem, out: dict, inp
+                ) -> Optional[DeltaRecord]:
+    """Build a DeltaRecord from a finished solve, or None when the
+    solve is ineligible as a delta base: anything stranded, any
+    topology activity in the encoding, synthetic charge-pool nodes, or
+    finite pool limits (their device arithmetic has no exact host
+    mirror)."""
+    G = enc.n_groups
+    E = len(enc.existing)
+    if G == 0:
+        return None
+    unsched = np.asarray(out["unsched"])[:G]
+    if unsched.sum() > 0:
+        return None
+    if inp.price_cap is not None:
+        return None
+    if any(lim is not None
+           for lim in (inp.remaining_limits or {}).values()):
+        return None
+    if (enc.group_dsel[:G] != 0).any() or \
+            (enc.group_ncap[:G] < BIG).any() or \
+            enc.group_whole_node[:G].any():
+        return None
+    if any(v is not None for d in enc.static_allowed for v in d.values()):
+        return None
+    if any(en.charge_pool is not None for en in enc.existing):
+        return None
+
+    na = int(out["num_active"])
+    te = np.ascontiguousarray(
+        np.asarray(out["take_exist"])[:G, :E], dtype=np.float32)
+    tn = np.ascontiguousarray(
+        np.asarray(out["take_new"])[:G, :na], dtype=np.float32)
+    node_pool = np.ascontiguousarray(
+        np.asarray(out["node_pool"])[:na], dtype=np.int32)
+    gkeys = [(g[0].scheduling_group_id(),
+              tuple(p.meta.name for p in g)) for g in enc.groups]
+    node_fps = [_fingerprint(en) for en in enc.existing]
+    return DeltaRecord(
+        cat=cat, enc=enc, groups=list(enc.groups), gkeys=gkeys,
+        out_te=te, out_tn=tn, node_pool=node_pool, num_active=na,
+        node_fps=node_fps,
+        res_anti_any=any(fp.res_anti for fp in node_fps))

@@ -199,6 +199,28 @@ def _eval_requirements(
     return ok
 
 
+def exist_group_ok(rep: Pod, vocab: "_Vocab",
+                   matrices: Dict[str, np.ndarray],
+                   existing: Sequence[ExistingNode]) -> np.ndarray:
+    """Per-existing-node eligibility verdict for one pod class:
+    requirements-matched ∧ not-deleting ∧ ready ∧ taints-tolerated.
+    ONE definition shared by encode()'s per-group loop and the delta
+    path's re-encode of a changed group (solver/delta.py) — the delta
+    contract is bit-parity with a full re-solve, so the two must never
+    drift."""
+    ok = _eval_requirements(rep.requirements, vocab, matrices,
+                            len(existing))
+    for ei, en in enumerate(existing):
+        if not ok[ei]:
+            continue
+        node = en.node
+        if node.meta.deleting or not node.ready:
+            ok[ei] = False
+        elif not tolerates_all(node.taints, rep.tolerations):
+            ok[ei] = False
+    return ok
+
+
 def group_pods(pods: List[Pod]) -> List[List[Pod]]:
     """Equivalence classes in FFD order (size desc, then name for stability).
 
@@ -1279,16 +1301,8 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None,
                 # union verdict cached per pod class; usable+taints folded in
                 ok = exist_shared.group_ok(rep)[shared_rows]
             else:
-                ok = _eval_requirements(rep.requirements, exist_vocab,
-                                        exist_matrices, E)
-                for ei, en in enumerate(inp.existing_nodes):
-                    if not ok[ei]:
-                        continue
-                    node = en.node
-                    if node.meta.deleting or not node.ready:
-                        ok[ei] = False
-                    elif not tolerates_all(node.taints, rep.tolerations):
-                        ok[ei] = False
+                ok = exist_group_ok(rep, exist_vocab, exist_matrices,
+                                    inp.existing_nodes)
             cap_row = np.where(ok, t["ecap"], 0).astype(np.int32)
             # static topology domain restrictions → per-node allowance
             for key, (_, ex_ids) in dom_arrays.items():

@@ -220,6 +220,20 @@ def _solve_ffd_impl(
     col_ct: jnp.ndarray,          # [O] i32
     exist_zone: jnp.ndarray,      # [E] i32
     exist_ct: jnp.ndarray,        # [E] i32
+    seed_used: jnp.ndarray = None,     # [N, R] f32 — delta-seeded start:
+                                  # the scan resumes from a previous
+                                  # solve's prefix state (solver/delta.py)
+                                  # instead of the all-zeros init.  The
+                                  # caller guarantees the seeded slots are
+                                  # a contiguous [0, A) block and that the
+                                  # problem is topology-free (node_zone/ct
+                                  # stay -1).
+    seed_colmask: jnp.ndarray = None,  # [A_pad, O] bool — surviving-column
+                                  # masks of the seeded slots (rows past
+                                  # the active count are all-false, which
+                                  # is exactly the unopened-slot state)
+    seed_pool: jnp.ndarray = None,     # [N] i32
+    seed_active: jnp.ndarray = None,   # [N] bool
     max_nodes: int = 1024,
     zc: int = 1,                  # grid stride: columns per (pool,type)
     with_topology: bool = True,   # static: False skips TRACING the heavy
@@ -277,7 +291,7 @@ def _solve_ffd_impl(
     _note_trace(G=G, E=E, O=O, N=max_nodes, D=group_dbase.shape[1],
                 with_topology=with_topology, sparse_k=sparse_k,
                 sparse_n=sparse_n, mask_packed=mask_packed,
-                axis_name=axis_name)
+                axis_name=axis_name, seeded=seed_used is not None)
     if mask_packed:
         # a bit-packed mask cannot arrive as a mesh shard: the byte axis
         # packs 8 columns and a shard boundary may split a byte
@@ -309,17 +323,41 @@ def _solve_ffd_impl(
     dom_ids = jnp.arange(D, dtype=jnp.int32)
     idx = jnp.arange(N, dtype=jnp.int32)
 
-    init = dict(
-        exist_rem=exist_remaining,
-        used=jnp.zeros((N, RDIM), jnp.float32),
-        colmask=jnp.zeros((N, O), bool),
-        active=jnp.zeros((N,), bool),
-        node_pool=jnp.zeros((N,), jnp.int32),
-        node_zone=jnp.full((N,), -1, jnp.int32),
-        node_ct=jnp.full((N,), -1, jnp.int32),
-        num_active=jnp.int32(0),
-        limits=pool_limit,
-    )
+    if seed_used is not None:
+        # delta-seeded start: exist_remaining arrives already consumed by
+        # the prefix (host replay, solver/delta.py), the seeded node
+        # slots carry their used/colmask/pool state, and everything past
+        # them is the ordinary unopened-slot zero state.  num_active is
+        # derived from the seed mask, so the scan appends new nodes
+        # exactly where the full solve's suffix would.
+        # seed_colmask is padded to a non-empty bucket tier by the
+        # caller (delta.SEED_BUCKETS), so the static row slice is
+        # always well-formed
+        colmask0 = jnp.zeros((N, O), bool).at[
+            :seed_colmask.shape[0], :].set(seed_colmask)
+        init = dict(
+            exist_rem=exist_remaining,
+            used=seed_used,
+            colmask=colmask0,
+            active=seed_active,
+            node_pool=seed_pool,
+            node_zone=jnp.full((N,), -1, jnp.int32),
+            node_ct=jnp.full((N,), -1, jnp.int32),
+            num_active=seed_active.astype(jnp.int32).sum(),
+            limits=pool_limit,
+        )
+    else:
+        init = dict(
+            exist_rem=exist_remaining,
+            used=jnp.zeros((N, RDIM), jnp.float32),
+            colmask=jnp.zeros((N, O), bool),
+            active=jnp.zeros((N,), bool),
+            node_pool=jnp.zeros((N,), jnp.int32),
+            node_zone=jnp.full((N,), -1, jnp.int32),
+            node_ct=jnp.full((N,), -1, jnp.int32),
+            num_active=jnp.int32(0),
+            limits=pool_limit,
+        )
 
     def _clamp_pool_limits(cap_n, node_pool, limits, req):
         # pool limits are COLLECTIVE: clamp each node's cap by what the
@@ -885,6 +923,77 @@ def _solve_ffd_resident_impl(buf, mask_table, col_alloc, col_daemon,
         col_zone, col_ct, exist_zone, exist_ct,
         max_nodes=max_nodes, zc=zc, sparse_n=sparse_n,
         axis_name=axis_name)
+
+def _solve_ffd_delta_impl(buf, col_alloc, col_daemon, pt_alloc, col_pool,
+                          pool_daemon, col_zone, col_ct, layout=None,
+                          max_nodes: int = 1024, zc: int = 1,
+                          sparse_n: int = 0, mask_packed: bool = False,
+                          seed_packed: bool = False):
+    """The delta path's seeded kernel (single-device): one coalesced
+    buffer carrying the restricted SUFFIX problem (the changed groups
+    only) PLUS the prefix seed state — used/pool/active for the node
+    slots a previous solve's unchanged prefix opened, and their
+    surviving-column masks.  exist_remaining arrives pre-consumed by the
+    prefix (host replay in solver/delta.py mirrors the kernel's own
+    arithmetic op-for-op, so the seeded scan is bit-identical to the
+    full solve's suffix steps).  Topology-free by contract — the delta
+    path falls back to a full solve for anything else — so the heavy
+    branch is never traced (with_topology=False)."""
+    (group_req, group_count, group_mask, exist_cap, exist_remaining,
+     pool_limit, group_ncap, group_dsel, group_dbase, group_dcap,
+     group_skew, group_mindom, group_delig, group_whole,
+     exist_zone, exist_ct, seed_used, seed_pool, seed_active,
+     seed_colmask) = _unpack_problem(buf, layout)
+    if seed_packed:
+        seed_colmask = _expand_packed_mask(seed_colmask,
+                                           col_alloc.shape[0])
+    return _solve_ffd_impl(
+        group_req, group_count, group_mask, exist_cap, exist_remaining,
+        col_alloc, col_daemon, pt_alloc, col_pool, pool_daemon,
+        pool_limit, group_ncap, group_dsel, group_dbase, group_dcap,
+        group_skew, group_mindom, group_delig, group_whole,
+        col_zone, col_ct, exist_zone, exist_ct,
+        seed_used=seed_used, seed_colmask=seed_colmask,
+        seed_pool=seed_pool, seed_active=seed_active,
+        max_nodes=max_nodes, zc=zc, with_topology=False,
+        sparse_n=sparse_n, mask_packed=mask_packed)
+
+
+_DELTA_STATICS = ("layout", "max_nodes", "zc", "sparse_n", "mask_packed",
+                  "seed_packed")
+solve_ffd_delta = partial(
+    jax.jit, static_argnames=_DELTA_STATICS)(_solve_ffd_delta_impl)
+
+
+def _solve_ffd_delta_resident_impl(buf, seed_colmask, mask_table,
+                                   col_alloc, col_daemon, pt_alloc,
+                                   col_pool, pool_daemon, col_zone,
+                                   col_ct, layout=None,
+                                   max_nodes: int = 1024, zc: int = 1,
+                                   axis_name=None):
+    """Mesh variant of the delta kernel (parallel/mesh.py wraps it in
+    shard_map): the suffix problem's slot 2 carries row indices into the
+    resident mask table (exactly like _solve_ffd_resident_impl), and the
+    seed column masks arrive as a separate column-sharded operand — the
+    one per-delta-solve O-axis transfer, logged by the executor so the
+    residency accounting stays honest."""
+    (group_req, group_count, group_rows, exist_cap, exist_remaining,
+     pool_limit, group_ncap, group_dsel, group_dbase, group_dcap,
+     group_skew, group_mindom, group_delig, group_whole,
+     exist_zone, exist_ct, seed_used, seed_pool,
+     seed_active) = _unpack_problem(buf, layout)
+    group_mask = mask_table[group_rows]
+    return _solve_ffd_impl(
+        group_req, group_count, group_mask, exist_cap, exist_remaining,
+        col_alloc, col_daemon, pt_alloc, col_pool, pool_daemon,
+        pool_limit, group_ncap, group_dsel, group_dbase, group_dcap,
+        group_skew, group_mindom, group_delig, group_whole,
+        col_zone, col_ct, exist_zone, exist_ct,
+        seed_used=seed_used, seed_colmask=seed_colmask,
+        seed_pool=seed_pool, seed_active=seed_active,
+        max_nodes=max_nodes, zc=zc, with_topology=False,
+        axis_name=axis_name)
+
 
 # The consolidation simulator's batch axis (SURVEY §7 step 6): many
 # candidate-removal simulations against one cluster state share the catalog

@@ -70,7 +70,7 @@ class UnsupportedPods(Exception):
 
 
 class TPUSolver:
-    def __init__(self, max_nodes: int = 1024, mesh="auto"):
+    def __init__(self, max_nodes: int = 1024, mesh="auto", delta="auto"):
         """`mesh` selects the multi-chip story (SURVEY §2.3: shard the
         column axis over ICI):
 
@@ -88,6 +88,16 @@ class TPUSolver:
 
         Resolution is lazy (first solve) so constructing a solver never
         initializes a JAX backend.
+
+        ``delta`` selects the incremental delta-solve story
+        (solver/delta.py): "auto" (default) engages on steady-state
+        repeats of problems with at least ``delta.DELTA_MIN_GROUPS``
+        pod classes; "on" forces engagement regardless of size (tests,
+        tiny deployments); "off"/None disables.  The env knob
+        ``KARPENTER_TPU_DELTA=on/off/auto`` OVERRIDES the constructed
+        spec, exactly like KARPENTER_TPU_MESH — it is the operator's
+        rollback lever and must beat code defaults wherever the solver
+        was built; malformed values degrade to the constructed spec.
         """
         self.max_nodes = max_nodes
         # relaxation-loop wall-clock budget (seconds; None = unbounded,
@@ -123,6 +133,12 @@ class TPUSolver:
         self._last_new_segments: Optional[int] = None
         # donated-upload rotation for the pipelined dispatch path
         self._upload_slots = pipelining.DeviceSlots()
+        # incremental delta solves (solver/delta.py): previous-solve
+        # records per catalog identity + the controller-fed dirty sets
+        from karpenter_tpu.solver import delta as _deltamod
+        self._delta_spec = delta
+        self._delta_resolved = None
+        self._delta_cache = _deltamod.SolveCache()
         # per-solve host/device phase breakdown (ms), refreshed by
         # _solve_attempt — the observability the north-star budget needs
         # (encode+decode host share must stay well under the solve time)
@@ -181,6 +197,52 @@ class TPUSolver:
             self._mesh_exec = MeshExecutor(
                 self._mesh, axis=self._mesh.axis_names[0])
         return self._mesh
+
+    @staticmethod
+    def _delta_env_spec(spec):
+        """Apply the KARPENTER_TPU_DELTA rollback knob: "off"/"0" forces
+        the full-solve path, "on" forces engagement (no min-size gate),
+        "auto" restores the default gating; unset or malformed leaves
+        the constructed spec alone (same discipline as
+        _mesh_env_spec)."""
+        import os as _os
+        raw = _os.environ.get("KARPENTER_TPU_DELTA", "").strip().lower()
+        if not raw:
+            return spec
+        if raw in ("off", "0", "false", "none"):
+            return None
+        if raw in ("on", "1", "true", "yes"):
+            # symmetric with the off-synonyms: the sibling 1/0-grammar
+            # knobs (COALESCE, WARMUP) make "1" a natural spelling
+            return "on"
+        if raw == "auto":
+            return "auto"
+        return spec
+
+    def _resolve_delta(self):
+        """The delta mode for this solver: False (disabled), "auto"
+        (min-size gated), or "on" (forced).  Resolved once — the env
+        override is an operator restart-time lever, like the mesh's."""
+        if self._delta_resolved is None:
+            spec = self._delta_env_spec(self._delta_spec)
+            if spec in (None, 0, False, "off", ""):
+                self._delta_resolved = (False,)
+            elif spec == "on":
+                self._delta_resolved = ("on",)
+            else:
+                self._delta_resolved = ("auto",)
+        return self._delta_resolved[0]
+
+    def delta_invalidate(self, pods=(), nodes=(),
+                         flood: bool = False) -> None:
+        """Event-driven invalidation feed (controllers/state.py
+        SolveCacheFeed): pod names whose groups must re-encode, node
+        names whose cached rows can no longer be trusted; flood=True
+        when the event stream may have dropped entries (watch-buffer
+        overflow) — everything is then treated dirty until a full
+        solve refreshes the record.  Thread-safe; retired when a solve
+        stores a fresh record against the snapshot it observed."""
+        self._delta_cache.invalidate(pods=pods, nodes=nodes, flood=flood)
 
     def _pt_align(self) -> int:
         """The (pool,type) axis pads to lcm(PT_ALIGN, mesh size): a
@@ -755,6 +817,220 @@ class TPUSolver:
                                      sparse_n=kn, mask_packed=mbits)
         return run
 
+    # -- incremental delta solves (solver/delta.py) -----------------------
+    def _delta_fallback(self, reason: str) -> None:
+        """Count one non-engaged pass.  Every pass through the delta
+        seam is either outcome="delta" or outcome="fallback" — no
+        silent fallbacks (the bench's win condition reads this)."""
+        cache = self._delta_cache
+        cache.last_outcome, cache.last_reason = "fallback", reason
+        metrics.SOLVER_DELTA_PASSES.inc(outcome="fallback")
+        return None
+
+    def _delta_problem_args(self, rec, sp, G: int, E: int, Db: int,
+                            O: int):
+        """The suffix problem's padded kernel arguments — identical
+        layout, dtypes, and pad values to _problem_args, built from the
+        SuffixProblem's unpadded rows (the topology tensors are the
+        inactive-encoder constants: the delta path engages only on
+        topology-free problems)."""
+        enc_p = rec.enc
+        Gd = len(sp.group_count)
+        D = enc_p.n_domains
+        return (
+            self._pad(sp.group_req, 0, G),
+            self._pad(sp.group_count, 0, G),
+            self._pad(self._pad(sp.group_mask, 1, O), 0, G),
+            self._pad(self._pad(sp.exist_cap, 1, E), 0, G),
+            self._pad(sp.exist_remaining, 0, E),
+            enc_p.pool_limit,
+            self._pad(np.full(Gd, BIG, dtype=np.int32), 0, G),
+            np.zeros(G, dtype=np.int32),
+            np.zeros((G, Db), dtype=np.int32),
+            self._pad(self._pad(
+                np.full((Gd, D), BIG, dtype=np.int32), 1, Db), 0, G),
+            self._pad(np.full(Gd, BIG, dtype=np.int32), 0, G),
+            np.zeros(G, dtype=np.int32),
+            np.zeros((G, Db), dtype=bool),
+            np.zeros(G, dtype=bool),
+            self._pad(enc_p.exist_zone, 0, E, value=-1),
+            self._pad(enc_p.exist_ct, 0, E, value=-1),
+        )
+
+    def _run_delta(self, prob16, seeds, seed_colmask, dev, mn: int,
+                   mbits: bool):
+        """Dispatch one seeded delta solve — shared verbatim by
+        _try_delta and warmup(delta_shapes=...), the same
+        no-drift discipline as _make_run.  `prob16` carries the DENSE
+        group mask (slot 2); packing happens here so the mesh branch
+        can feed the registry the dense rows."""
+        if self._resolve_mesh() is not None:
+            from jax.sharding import PartitionSpec as _P
+            ex = self._mesh_exec
+            rows, table = dev["mask_registry"].ensure(prob16[2])
+            prob = prob16[:2] + (rows,) + prob16[3:] + seeds
+            buf, layout = ffd.pack_problem(prob)
+            # the one per-delta-solve O-axis transfer: the seed column
+            # masks, committed pre-partitioned and LOGGED (kind
+            # "delta-seed") so the residency accounting stays honest
+            cm = ex.put_sharded(seed_colmask, _P(None, ex.axis),
+                                "delta-seed")
+            return ex.solve_delta(buf, cm, table, dev, layout, mn)
+        if mbits:
+            prob16 = prob16[:2] + (np.packbits(
+                prob16[2], axis=-1, bitorder="little"),) + prob16[3:]
+            cm = np.packbits(seed_colmask, axis=-1, bitorder="little")
+        else:
+            cm = seed_colmask
+        buf, layout = ffd.pack_problem(prob16 + seeds + (cm,))
+        return ffd.solve_ffd_delta(
+            buf, dev["col_alloc"], dev["col_daemon"], dev["pt_alloc"],
+            dev["col_pool"], dev["pool_daemon"], dev["col_zone"],
+            dev["col_ct"], layout=layout, max_nodes=mn, zc=dev["ZC"],
+            mask_packed=mbits, seed_packed=mbits)
+
+    def _try_delta(self, inp: ScheduleInput, cat,
+                   groups) -> Optional[ScheduleResult]:
+        """The delta pass: diff against the cached record, seed the
+        restricted suffix solve, merge, decode.  Returns None on any
+        conservative fallback (counted) — the caller then runs the
+        ordinary full path, whose finished solve refills the cache."""
+        self._delta_consumed = None  # never consume a stale snapshot
+        mode = self._resolve_delta()
+        if not mode or not groups:
+            return None
+        if len(cat.columns) == 0:
+            return self._delta_fallback("shape")
+        import time as _time
+        from karpenter_tpu.solver import delta as deltam
+        cache = self._delta_cache
+        wall0 = _time.time()
+        t0 = _time.perf_counter()
+        rec = cache.get(cat)
+        # ONE dirty snapshot per pass: plan diffs against it, and the
+        # eventual record store (here or _delta_store after a fallback)
+        # retires exactly it — mid-solve invalidations stay dirty
+        self._delta_consumed = cache.dirty_snapshot()
+        ming = 0 if mode == "on" else deltam.DELTA_MIN_GROUPS
+        plan = deltam.plan(rec, inp, groups, self._delta_consumed,
+                           ming, G_BUCKETS)
+        if isinstance(plan, str):
+            return self._delta_fallback(plan)
+        sp = deltam.build(plan, cat)
+        if sp is None:
+            return self._delta_fallback("seed")
+        mn = self._adaptive_max_nodes()
+        if sp.A >= mn:
+            mn = self.max_nodes
+        if sp.A >= mn:
+            return self._delta_fallback("slots")
+        Gd = len(plan.suffix)
+        dev = cat.device_args
+        out_s = None
+        disp_s = dev_s = pull_s = 0.0
+        if Gd:
+            Gp = bucket(Gd, G_BUCKETS)
+            E = bucket(len(inp.existing_nodes), E_BUCKETS)
+            Db = bucket(plan.record.enc.n_domains, D_BUCKETS)
+            mbits = self._mask_packed()
+            prob16 = self._delta_problem_args(plan.record, sp, Gp, E,
+                                              Db, dev["O"])
+            A_pad = min(bucket(max(sp.A, 1), deltam.SEED_BUCKETS), mn)
+            seeds = (self._pad(sp.seed_used, 0, mn),
+                     self._pad(sp.seed_pool, 0, mn),
+                     np.arange(mn) < sp.A)
+            cm = np.zeros((A_pad, dev["O"]), dtype=bool)
+            cm[:sp.A, :len(cat.columns)] = sp.seed_colmask
+            t1 = _time.perf_counter()
+            # fault-matrix hook, same point as the full path's dispatch
+            faults.fire("solver.dispatch")
+            t_a = _time.perf_counter()
+            packed = self._run_delta(prob16, seeds, cm, dev, mn, mbits)
+            t_b = _time.perf_counter()
+            try:
+                packed.block_until_ready()
+            except AttributeError:
+                pass
+            t_c = _time.perf_counter()
+            out_s = ffd.unpack(np.array(packed), Gp, E, mn, R, Db)
+            t_d = _time.perf_counter()
+            disp_s, dev_s, pull_s = t_b - t_a, t_c - t_b, t_d - t_c
+            if out_s["unsched"][:Gd].sum() > 0:
+                # stranded pods need the full path's rescue/retry
+                # machinery (slot exhaustion, capacity) — the kernel
+                # time is wasted, the verdict never is
+                return self._delta_fallback("stranded")
+        else:
+            t1 = _time.perf_counter()
+        t2 = _time.perf_counter()
+        enc_m, out_m = deltam.merge(plan, sp, cat, inp, out_s, Gd)
+        self._repair_whole_node(enc_m, out_m)
+        self._repair_topology(enc_m, out_m)
+        res = self._decode(enc_m, out_m)
+        t3 = _time.perf_counter()
+        # warm-start continuity: the next (full or delta) solve adapts
+        # exactly as if this had been a full pass
+        na = self._last_active = int(out_m["num_active"])
+        segs = (int((out_m["take_new"][:enc_m.n_groups, :na] > 0)
+                    .sum(axis=1).max()) if na and enc_m.n_groups else 0)
+        self._last_new_segments = max(segs, 1)
+        new_rec = deltam.make_record(cat, enc_m, out_m, inp)
+        if new_rec is not None:
+            # nodes and catalog held — the lazily-built exist tables
+            # and opener feasibility rows stay valid; carry them over
+            new_rec.exist_tables = plan.record.exist_tables
+            new_rec.feas_cache = plan.record.feas_cache
+            cache.put(cat, new_rec, consumed=self._delta_consumed)
+        cache.last_outcome, cache.last_reason = "delta", None
+        metrics.SOLVER_DELTA_PASSES.inc(outcome="delta")
+        metrics.SOLVER_DELTA_GROUPS_REENCODED.set(sp.reencoded)
+        enc_ms = (t1 - t0) * 1e3 + getattr(self, "_pregroup_ms", 0.0)
+        self._pregroup_ms = 0.0
+        self.last_phase_ms = {
+            "delta_encode": enc_ms, "dispatch": disp_s * 1e3,
+            "device": dev_s * 1e3, "pull": pull_s * 1e3,
+            "decode": (t3 - t2) * 1e3}
+        for phase, lo, dur in (
+                ("delta_encode", t0, t1 - t0), ("dispatch", t1, disp_s),
+                ("device", t1 + disp_s, dev_s),
+                ("pull", t1 + disp_s + dev_s, pull_s),
+                ("decode", t2, t3 - t2)):
+            metrics.SOLVER_PHASE_DURATION.observe(
+                dur, phase=phase, path="solve")
+            tracing.record_span(f"solver.phase.{phase}",
+                                wall0 + (lo - t0), dur,
+                                groups_reencoded=sp.reencoded)
+        return res
+
+    def _delta_store(self, inp: ScheduleInput, cat, enc, out,
+                     groups) -> None:
+        """Cache a finished FULL solve as the next pass's delta base.
+        Best-effort and strictly read-only on the solve's outputs."""
+        mode = self._resolve_delta()
+        if not mode or groups is None:
+            return
+        from karpenter_tpu.solver import delta as deltam
+        if mode != "on" and len(groups) < deltam.DELTA_MIN_GROUPS:
+            return
+        rec = deltam.make_record(cat, enc, out, inp)
+        if rec is not None:
+            old = self._delta_cache.get(cat)
+            if old is not None:
+                # feasibility rows key on (catalog, class id) — always
+                # valid; the exist tables key on the node set and must
+                # not survive node churn (the fuzz matrix's node-churn
+                # class caught exactly this)
+                rec.feas_cache = old.feas_cache
+                if deltam.tables_reusable(old, rec):
+                    rec.exist_tables = old.exist_tables
+            # retire only the dirt the seam's snapshot observed this
+            # pass (set by _try_delta before it fell through here);
+            # None retires nothing — pure conservatism
+            self._delta_cache.put(
+                cat, rec,
+                consumed=getattr(self, "_delta_consumed", None))
+            self._delta_consumed = None
+
     def _solve_attempt(self, inp: ScheduleInput,
                        max_nodes: Optional[int] = None,
                        groups=None) -> ScheduleResult:
@@ -767,6 +1043,16 @@ class TPUSolver:
         wall0 = _time.time()
         t0 = _time.perf_counter()
         cat = self._catalog_encoding(inp)
+        if max_nodes is None and groups is not None:
+            # the delta seam: engaged passes return here with a result
+            # bit-identical to the full re-solve below; every
+            # non-engaged pass is a counted fallback and falls through
+            res = self._try_delta(inp, cat, groups)
+            if res is not None:
+                return res
+            # the fallback check is not encode time
+            wall0 = _time.time()
+            t0 = _time.perf_counter()
         enc = self._encode_checked(inp, cat, groups=groups)
         t1 = _time.perf_counter()
         self.last_phase_ms = {
@@ -880,6 +1166,9 @@ class TPUSolver:
         t4 = _time.perf_counter()
         res = self._decode(enc, out)
         t5 = _time.perf_counter()
+        if max_nodes is None and groups is not None:
+            # a finished full solve is the next pass's delta base
+            self._delta_store(inp, cat, enc, out, groups)
         self.last_phase_ms.update(
             pad=(t2 - t1) * 1e3, dispatch=disp_s * 1e3,
             device=dev_s * 1e3, pull=pull_s * 1e3,
@@ -912,7 +1201,8 @@ class TPUSolver:
 
     # -- warm-up: padding-bucket precompile --------------------------------
     def warmup(self, inp: ScheduleInput, *, shapes=(),
-               max_nodes_list=None, batch_sizes=()) -> int:
+               max_nodes_list=None, batch_sizes=(),
+               delta_shapes=()) -> int:
         """Pre-trace/compile the kernel programs a workload shaped like
         ``inp`` will hit, so the first real solve after operator startup
         performs ZERO XLA compiles (asserted against ffd.TRACE_COUNT in
@@ -933,6 +1223,11 @@ class TPUSolver:
         ``batch_sizes`` additionally warms the generic batched kernel at
         those fused-request counts (the solverd daemon's lane) at the
         configured node ceiling.
+        ``delta_shapes`` — (suffix_groups, seeded_nodes) points — warms
+        the SEEDED delta kernel (restricted-slab lattice) at those
+        bucket tiers crossed with the node ladder, through the same
+        _run_delta closure the delta pass dispatches with; empty by
+        default so ordinary warm-ups never pay seeded-program compiles.
 
         Values are zeros — the jit cache keys on shapes/dtypes/statics
         only — so a warm-up program costs one device step of masked
@@ -1044,6 +1339,43 @@ class TPUSolver:
             except AttributeError:
                 pass
             warmed += 1
+        if delta_shapes and self._resolve_delta():
+            from karpenter_tpu.solver import delta as deltam
+            P = max(len(cat.pools), 1)
+            for g, a in delta_shapes:
+                Gd = bucket(max(int(g), 1), G_BUCKETS)
+                zero16 = (
+                    np.zeros((Gd, R), np.float32),
+                    np.zeros(Gd, np.int32),
+                    np.zeros((Gd, dev["O"]), bool),
+                    np.zeros((Gd, baseE), np.int32),
+                    np.zeros((baseE, R), np.float32),
+                    np.full((P, R), np.inf, np.float32),
+                    np.zeros(Gd, np.int32),
+                    np.zeros(Gd, np.int32),
+                    np.zeros((Gd, Db), np.int32),
+                    np.zeros((Gd, Db), np.int32),
+                    np.zeros(Gd, np.int32),
+                    np.zeros(Gd, np.int32),
+                    np.zeros((Gd, Db), bool),
+                    np.zeros(Gd, bool),
+                    np.full(baseE, -1, np.int32),
+                    np.full(baseE, -1, np.int32),
+                )
+                for mn in ladder:
+                    A_pad = min(bucket(max(int(a), 1),
+                                       deltam.SEED_BUCKETS), mn)
+                    seeds = (np.zeros((mn, R), np.float32),
+                             np.zeros(mn, np.int32),
+                             np.zeros(mn, bool))
+                    cm = np.zeros((A_pad, dev["O"]), bool)
+                    packed = self._run_delta(zero16, seeds, cm, dev,
+                                             mn, mbits)
+                    try:
+                        packed.block_until_ready()
+                    except AttributeError:
+                        pass
+                    warmed += 1
         return warmed
 
     # -- split solve: device for the supported majority, host oracle for

@@ -310,6 +310,22 @@ SOLVER_PHASE_DURATION = _h(
     "Per-phase wall-clock of one device solve, by execution path "
     "(solve = single-problem attempt, sweep = batched consolidation "
     "sweep).", ("phase", "path"))
+# -- incremental delta solves (solver/delta.py): the O(churn) steady-state
+# -- path's observable half.  outcome="delta" passes reused the cached
+# -- prefix; outcome="fallback" passes ran the full solve for a
+# -- conservative reason (topology, node churn, catalog change, bucket
+# -- crossing, cold cache) — every fallback is counted here, never silent
+SOLVER_DELTA_PASSES = _c(
+    "karpenter_tpu_solver_delta_passes_total",
+    "Passes through the delta-solve seam by outcome: delta = the "
+    "restricted suffix solve ran (result bit-identical to a full "
+    "re-solve), fallback = a conservative exactness guard sent the "
+    "pass to the full path.", ("outcome",))
+SOLVER_DELTA_GROUPS_REENCODED = _g(
+    "karpenter_tpu_solver_delta_groups_reencoded",
+    "Pod classes freshly re-encoded in the last delta pass (the churn "
+    "the pass actually paid for; unchanged suffix classes reuse their "
+    "cached rows).")
 # -- solver-service availability (ISSUE 7): the crash-isolation story's
 # -- observable half — without these, a daemon crash-loop looks identical
 # -- to a healthy idle service from the operator's scrape

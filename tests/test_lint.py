@@ -177,6 +177,68 @@ def test_jit_purity_flags_wrapper_built_per_call(tmp_path):
     assert findings == []
 
 
+def test_jit_purity_flags_solve_cache_reads_in_traced_bodies(tmp_path):
+    # the delta SolveCache (solver/delta.py) is host-side mutable state
+    # shared with the invalidation feed; a read inside a jitted or
+    # shard_map body bakes one snapshot into the compiled program and
+    # silently ignores every later invalidation
+    findings, _ = _check(tmp_path, """
+        import jax
+
+
+        class S:
+            @jax.jit
+            def bad(self, x):
+                rows = self._delta_cache.records
+                return x + len(rows)
+    """, jit_purity)
+    assert any("SolveCache" in f.message for f in findings)
+    findings, _ = _check(tmp_path, """
+        import jax
+        from functools import partial
+        from jax.experimental.shard_map import shard_map
+
+
+        def _body(x, delta_cache=None):
+            return x + delta_cache
+        prog = shard_map(_body, mesh=None, in_specs=None, out_specs=None)
+    """, jit_purity)
+    assert any("SolveCache" in f.message for f in findings)
+
+
+def test_jit_purity_solve_cache_reads_outside_trace_are_fine(tmp_path):
+    # the legitimate pattern: snapshot the cache BEFORE dispatch (the
+    # ensure()-returns-the-table discipline) — host code reading the
+    # cache is the whole point
+    findings, _ = _check(tmp_path, """
+        import jax
+
+
+        @jax.jit
+        def kernel(x, rows):
+            return x * rows
+
+
+        class S:
+            def dispatch(self, x):
+                rows = self._delta_cache.snapshot()  # host side: fine
+                return kernel(x, rows)
+    """, jit_purity)
+    assert findings == []
+
+
+def test_jit_purity_solve_cache_suppression(tmp_path):
+    findings, _ = _check(tmp_path, """
+        import jax
+
+
+        @jax.jit
+        def bad(x, solve_cache):  # kt-lint: disable=jit-purity
+            return x + solve_cache
+    """, jit_purity)
+    assert findings == []
+
+
 def test_jit_purity_descends_into_shard_map_bodies(tmp_path):
     # host effects and branch-on-traced inside a sharded region went
     # unflagged before the rule learned shard_map: the body is jit
