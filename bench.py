@@ -452,6 +452,8 @@ def multichip_main(n_devices: int = 8, reps: int = 16) -> None:
             "single_min_ms": round(min(t50s), 1),
         },
     }
+    from benchmarks.common import env_fingerprint
+    result["env"] = env_fingerprint("cpu-mesh-emulation", reps=reps)
     log_attempt({"stage": "multichip", **result, "ts": time.time()})
     print(json.dumps(result))
     print(f"multichip: 5k mesh min={mesh_min:.1f}ms "
@@ -460,6 +462,109 @@ def multichip_main(n_devices: int = 8, reps: int = 16) -> None:
           f"50k parity={parity_50k} nodes={r50m.node_count()} "
           f"${r50m.total_price():.2f}, steady O-axis transfers="
           f"{len(steady_transfers)}", file=sys.stderr)
+
+
+def flight_overhead_main(reps: int = 24) -> None:
+    """`bench.py --flight`: the flight recorder's acceptance bench — the
+    always-on fingerprint-only record must add <1% of the 50k headline
+    solve's p50 (ISSUE 9).  Methodology, per the host-noise discipline
+    (±50% CPU variance; min over ≥15 reps is the stable signal):
+
+      * reps run as interleaved off/on PAIRS with the order ALTERNATING
+        each pair — on this host the second solve of a back-to-back pair
+        runs systematically slower regardless of arm (measured ~+15%),
+        so a fixed order would charge that position tax to one arm;
+      * the A/B gate compares arm p10s (p10 filters the noise like min
+        but survives a single lucky outlier rep, which on this host can
+        swing the raw min by >10% — measured; the p50 spread alone is
+        several times the 1% budget);
+      * the recorder seam is ALSO timed directly during the on-arm
+        (wall clock around `_flight_record`) — the noise-free
+        corroboration of what the A/B difference estimates.
+
+    Exits 1 when p10(on) − p10(off) exceeds 1% of the off-arm p50."""
+    # the repeat loop re-solves one input: full solves only (the same
+    # pinning discipline as the headline)
+    os.environ["KARPENTER_TPU_DELTA"] = "off"
+    from karpenter_tpu.utils.platform import initialize
+    platform = initialize(attempt_log=log_attempt)
+    from karpenter_tpu.solver import TPUSolver
+    from karpenter_tpu.utils import flightrecorder
+
+    inp = build_input(50_000)
+    solver = TPUSolver(max_nodes=2048)
+    solver, res, platform = first_solve_with_retry(solver, inp, platform)
+    assert not res.unschedulable
+    solver.solve(inp)  # settle the adaptive node bucket
+
+    record_ms = []
+    orig_record = TPUSolver._flight_record
+
+    def timed_record(self, *a, **kw):
+        t0 = time.perf_counter()
+        out = orig_record(self, *a, **kw)
+        # on-arm invocations only: the off-arm call is a microsecond
+        # early-return, and mixing those samples in would halve the
+        # reported per-record cost
+        if os.environ.get("KARPENTER_TPU_FLIGHT") == "on":
+            record_ms.append((time.perf_counter() - t0) * 1000.0)
+        return out
+    TPUSolver._flight_record = timed_record
+    try:
+        times = {"off": [], "on": []}
+        for i in range(reps):
+            order = ("off", "on") if i % 2 == 0 else ("on", "off")
+            for arm in order:
+                os.environ["KARPENTER_TPU_FLIGHT"] = arm
+                t0 = time.perf_counter()
+                solver.solve(inp)
+                times[arm].append((time.perf_counter() - t0) * 1000.0)
+    finally:
+        TPUSolver._flight_record = orig_record
+        os.environ.pop("KARPENTER_TPU_FLIGHT", None)
+    assert len(flightrecorder.RECORDER) > 0, \
+        "recorder-on arm produced no flight records"
+    assert record_ms, "the recorder seam never fired on the on-arm"
+
+    def stats(ts):
+        srt = sorted(ts)
+        return {"min": round(srt[0], 2),
+                "p10": round(srt[max(0, int(round(0.10 * len(srt)))
+                                     - 1)], 2),
+                "p50": round(statistics.median(srt), 2)}
+    s_off, s_on = stats(times["off"]), stats(times["on"])
+    overhead_ms = s_on["p10"] - s_off["p10"]
+    overhead_pct = 100.0 * overhead_ms / s_off["p50"]
+    rec_p50 = statistics.median(record_ms)
+    rec_share_pct = 100.0 * rec_p50 / s_off["p50"]
+    ok = overhead_pct < 1.0
+    from benchmarks.common import env_fingerprint
+    result = {
+        "metric": "flight-recorder overhead on the 50k headline solve",
+        "value": round(overhead_pct, 3),
+        "unit": "% of p50 (p10-on minus p10-off)",
+        "pass": ok,
+        "threshold_pct": 1.0,
+        "reps_per_arm": reps,
+        "off_ms": s_off, "on_ms": s_on,
+        "overhead_ms_p10": round(overhead_ms, 2),
+        "overhead_pct_of_p50": round(overhead_pct, 3),
+        "record_seam_ms_p50": round(rec_p50, 3),
+        "record_seam_pct_of_p50": round(rec_share_pct, 3),
+        "runs_off_ms": [round(t, 1) for t in times["off"]],
+        "runs_on_ms": [round(t, 1) for t in times["on"]],
+        "platform": platform,
+        "env": env_fingerprint(platform, reps=reps,
+                               times_ms=times["on"]),
+    }
+    log_attempt({"stage": "flight-overhead", **result, "ts": time.time()})
+    print(json.dumps(result))
+    print(f"flight overhead: p10-vs-p10 {overhead_ms:+.1f}ms "
+          f"({overhead_pct:+.2f}% of off p50 {s_off['p50']}ms); "
+          f"recorder seam itself {rec_p50:.3f}ms/solve "
+          f"({rec_share_pct:.3f}%) pass={ok}", file=sys.stderr)
+    if not ok:
+        raise SystemExit(1)
 
 
 def main() -> None:
@@ -584,6 +689,9 @@ def main() -> None:
                             else sub_res.node_count() <= onodes_5k),
         "configs": configs,
     }
+    from benchmarks.common import env_fingerprint
+    result["env"] = env_fingerprint(platform, reps=len(times),
+                                    times_ms=times)
     log_attempt({"stage": "result", **result, "ts": time.time()})
     print(json.dumps(result))
     print(f"nodes={res.node_count()} total_price=${res.total_price():.2f}/h "
@@ -595,23 +703,30 @@ def main() -> None:
           file=sys.stderr)
 
 
+def _int_opt(argv, flag, default, usage):
+    """Shared `--flag N` integer parsing for the mode dispatch below —
+    a typo exits with usage, never a traceback."""
+    if flag not in argv:
+        return default
+    try:
+        return int(argv[argv.index(flag) + 1])
+    except (IndexError, ValueError):
+        print(f"usage: {usage} ({flag} needs an integer)",
+              file=sys.stderr)
+        raise SystemExit(2)
+
+
 if __name__ == "__main__":
     if "--multichip" in sys.argv[1:]:
         # forced-N-virtual-device mesh bench (MULTICHIP_rNN.json);
         # optional `--devices N` / `--reps R` override the 8×16 default
         argv = sys.argv[1:]
-
-        def _opt(flag, default):
-            if flag not in argv:
-                return default
-            try:
-                return int(argv[argv.index(flag) + 1])
-            except (IndexError, ValueError):
-                print(f"usage: bench.py --multichip [--devices N] "
-                      f"[--reps R] ({flag} needs an integer)",
-                      file=sys.stderr)
-                raise SystemExit(2)
-        multichip_main(n_devices=_opt("--devices", 8),
-                       reps=_opt("--reps", 16))
+        usage = "bench.py --multichip [--devices N] [--reps R]"
+        multichip_main(n_devices=_int_opt(argv, "--devices", 8, usage),
+                       reps=_int_opt(argv, "--reps", 16, usage))
+    elif "--flight" in sys.argv[1:]:
+        argv = sys.argv[1:]
+        flight_overhead_main(reps=_int_opt(
+            argv, "--reps", 24, "bench.py --flight [--reps R]"))
     else:
         main()

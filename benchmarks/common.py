@@ -14,6 +14,51 @@ import statistics
 import sys
 import time
 
+# the knobs that change what a bench number MEANS — recorded into every
+# result line so two BENCH_*.json files are comparable without forensics
+_KNOB_VARS = (
+    "KARPENTER_TPU_MESH", "SOLVER_MESH",
+    "KARPENTER_TPU_DELTA", "SOLVER_DELTA",
+    "KARPENTER_TPU_PIPELINE", "KARPENTER_TPU_MASK_BITS",
+    "KARPENTER_TPU_COALESCE", "KARPENTER_TPU_SWEEP_TOPK",
+    "KARPENTER_TPU_NEW_TOPK", "KARPENTER_TPU_FLIGHT",
+    "KARPENTER_TPU_MAX_NODES",
+)
+
+
+def env_fingerprint(platform=None, reps=None, times_ms=None) -> dict:
+    """Machine-readable provenance stamped into every BENCH_*.json line:
+    platform + device count, the solver knob state, rep count, and the
+    min/p10/p50 spread — the ±50% host-noise caveat as data (min/p10
+    over ≥15 reps is the stable signal on this host class, per the
+    bench discipline), not tribal knowledge."""
+    import os
+    import platform as _plat
+    fp = {
+        "platform": platform,
+        "machine": _plat.machine(),
+        "python": _plat.python_version(),
+        "knobs": {k: os.environ[k] for k in _KNOB_VARS
+                  if os.environ.get(k) is not None},
+        "noise_discipline": "±50% host CPU variance; compare min/p10 "
+                            "over >=15 reps, not single medians",
+    }
+    try:
+        import jax
+        fp["devices"] = len(jax.devices())
+        fp["backend"] = jax.default_backend()
+    except Exception:  # noqa: BLE001 — provenance, never a bench failure
+        pass
+    if reps is not None:
+        fp["reps"] = reps
+    if times_ms:
+        ordered = sorted(times_ms)
+        fp["ms_min"] = round(ordered[0], 2)
+        fp["ms_p10"] = round(
+            ordered[max(0, int(round(0.10 * len(ordered))) - 1)], 2)
+        fp["ms_p50"] = round(statistics.median(ordered), 2)
+    return fp
+
 
 def run(metric: str, target_ms: float, make_input, solve=None, repeats: int = 5,
         extra=None):
@@ -54,6 +99,7 @@ def run(metric: str, target_ms: float, make_input, solve=None, repeats: int = 5,
         "unit": "ms",
         "vs_baseline": round(target_ms / ms, 3),
         "platform": platform,
+        "env": env_fingerprint(platform, reps=repeats, times_ms=times),
     }
     if extra:
         line.update(extra(res))

@@ -37,7 +37,7 @@ def _metrics_docs_findings() -> List[Finding]:
     spec = importlib.util.spec_from_file_location("check_metrics_docs", path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    return [
+    findings = [
         Finding(rule="observability-conformance",
                 path="docs/observability.md", line=1, symbol="<doc>",
                 message=f"metric family `{name}` is registered in "
@@ -45,6 +45,15 @@ def _metrics_docs_findings() -> List[Finding]:
                 snippet="")
         for name in mod.missing_families()
     ]
+    findings += [
+        Finding(rule="observability-conformance",
+                path="docs/operations.md", line=1, symbol="<doc>",
+                message=f"debug route `{route}` is served in "
+                        "karpenter_tpu/ but unlisted here",
+                snippet="")
+        for route in mod.missing_routes()
+    ]
+    return findings
 
 
 def main(argv=None) -> int:

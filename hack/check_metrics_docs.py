@@ -1,20 +1,30 @@
 #!/usr/bin/env python
-"""Assert every metric family registered in utils/metrics.py appears in
-docs/observability.md — the catalogue is the operator's contract surface
-(the reference keeps metrics.md in lockstep the same way), and a family
-that ships undocumented is invisible to whoever builds the dashboards.
+"""Assert the operator's contract surfaces stay documented:
 
-Run directly (exit 1 lists the missing families) or via the tier-1
-wrapper tests/test_metrics_docs.py.
+  * every metric family registered in utils/metrics.py appears in
+    docs/observability.md — the catalogue is the dashboard-builders'
+    contract (the reference keeps metrics.md in lockstep the same way);
+  * every `/debug/*` HTTP route served anywhere in karpenter_tpu/
+    appears in docs/operations.md — an undocumented debug endpoint is
+    invisible to the operator runbook (ISSUE 9 satellite).
+
+Run directly (exit 1 lists what's missing) or via the tier-1 wrappers
+tests/test_metrics_docs.py and `python -m hack.analyze`
+(observability-conformance).
 """
 
 from __future__ import annotations
 
 import os
+import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DOC = os.path.join(REPO, "docs", "observability.md")
+OPS_DOC = os.path.join(REPO, "docs", "operations.md")
+PKG = os.path.join(REPO, "karpenter_tpu")
+
+_ROUTE_RE = re.compile(r"""["'](/debug/[a-z0-9_]+)["']""")
 
 
 def missing_families() -> list:
@@ -32,15 +42,46 @@ def missing_families() -> list:
             if f"`{name}`" not in doc]
 
 
+def declared_routes() -> set:
+    """Every /debug/* string literal in the package — the HTTP handlers
+    compare the request path against exactly these literals, so the
+    regex IS the serving surface (a dynamic route would be its own
+    conformance smell)."""
+    routes = set()
+    for root, _dirs, files in os.walk(PKG):
+        if "__pycache__" in root:
+            continue
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            with open(os.path.join(root, fname), encoding="utf-8") as f:
+                routes.update(_ROUTE_RE.findall(f.read()))
+    return routes
+
+
+def missing_routes() -> list:
+    with open(OPS_DOC, encoding="utf-8") as f:
+        doc = f.read()
+    return [r for r in sorted(declared_routes()) if f"`{r}`" not in doc]
+
+
 def main() -> int:
+    rc = 0
     missing = missing_families()
     if missing:
         print("families registered in utils/metrics.py but missing from "
               "docs/observability.md:", file=sys.stderr)
         for name in missing:
             print(f"  {name}", file=sys.stderr)
-        return 1
-    return 0
+        rc = 1
+    routes = missing_routes()
+    if routes:
+        print("/debug routes served in karpenter_tpu/ but missing from "
+              "docs/operations.md:", file=sys.stderr)
+        for r in routes:
+            print(f"  {r}", file=sys.stderr)
+        rc = 1
+    return rc
 
 
 if __name__ == "__main__":

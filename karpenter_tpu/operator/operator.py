@@ -115,13 +115,56 @@ class Operator:
                 elif path == "/debug/traces":
                     # recent completed traces as Chrome trace-event JSON
                     # (Perfetto / chrome://tracing loadable); ?trace_id=
-                    # narrows to one — the id an event or log line stamped
+                    # narrows to one — the id an event or log line
+                    # stamped; ?limit= caps the trace count so a large
+                    # ring never dumps unbounded JSON.  The export also
+                    # carries otherData.spansDropped (the collector's
+                    # eviction counter).
                     from karpenter_tpu.utils import tracing
-                    tid = (parse_qs(url.query).get("trace_id")
-                           or [None])[0]
-                    self._respond(200,
-                                  json.dumps(tracing.chrome_trace(tid)) +
-                                  "\n", "application/json")
+                    q = parse_qs(url.query)
+                    tid = (q.get("trace_id") or [None])[0]
+                    try:
+                        limit = int((q.get("limit") or [""])[0])
+                    except ValueError:
+                        limit = None
+                    self._respond(
+                        200,
+                        json.dumps(tracing.chrome_trace(tid, limit)) +
+                        "\n", "application/json; charset=utf-8")
+                elif path == "/debug/dashboard":
+                    # the ONE merged fleet view (utils/telemetry.py):
+                    # operator + registered sources (supervisor) + the
+                    # solverd worker via its stats RPC; ?format=html for
+                    # the no-tooling rendering
+                    from karpenter_tpu.utils import telemetry
+                    doc = telemetry.collect(
+                        extra={"worker": op._worker_snapshot})
+                    fmt = (parse_qs(url.query).get("format")
+                           or ["json"])[0]
+                    if fmt == "html":
+                        self._respond(200, telemetry.render_html(doc),
+                                      "text/html; charset=utf-8")
+                    else:
+                        self._respond(
+                            200, json.dumps(doc, default=str) + "\n",
+                            "application/json; charset=utf-8")
+                elif path == "/debug/flight":
+                    # the flight-recorder tail (request records);
+                    # ?trace_id= narrows to the records of one trace,
+                    # ?limit= caps the count (default 32)
+                    from karpenter_tpu.utils import flightrecorder
+                    q = parse_qs(url.query)
+                    tid = (q.get("trace_id") or [None])[0]
+                    try:
+                        limit = int((q.get("limit") or ["32"])[0])
+                    except ValueError:
+                        limit = 32
+                    self._respond(
+                        200,
+                        json.dumps({"records": flightrecorder.RECORDER
+                                    .tail(limit, trace_id=tid)},
+                                   default=str) + "\n",
+                        "application/json; charset=utf-8")
                 elif path == "/debug/state":
                     c = op.env.cluster
                     self._respond(200, json.dumps({
@@ -134,6 +177,27 @@ class Operator:
                     self._respond(404, "not found\n")
 
         return Handler
+
+    def _worker_snapshot(self):
+        """The solverd worker's section of the dashboard merge: its
+        stats RPC response (which carries the worker-process telemetry
+        snapshot — solve rate, phase latencies, delta split, flight
+        tail) plus the client-side in-flight and breaker view only this
+        process knows.  None in the in-process-solver topology (no
+        worker to ask); raises on a dead worker and telemetry.collect
+        renders the error — the dashboard must keep serving exactly
+        when the fleet is degraded."""
+        gs = getattr(self.env, "solver", None)
+        client = getattr(gs, "tpu", None)
+        if not getattr(gs, "_remote", False) or client is None:
+            return None
+        st = client.stats()
+        snap = dict(st.pop("telemetry", None) or {})
+        snap["stats"] = st
+        snap["shed"] = st.get("shed", 0)
+        snap["in_flight"] = len(client._pending)
+        snap["breaker"] = client.breaker.state
+        return snap
 
     def serve(self) -> None:
         if self._servers:

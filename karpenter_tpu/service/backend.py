@@ -89,6 +89,43 @@ def _solve_group(inps: List, max_nodes: Optional[int] = None) -> List:
         return out
 
 
+def _flight_record_batch(fp: str, inps: List, results: List,
+                         max_nodes) -> None:
+    """One flight record per fused solverd batch (the daemon's half of
+    the request-record split): the catalog fingerprint the requests
+    referenced, per-request pod counts, and a bit-exact digest per
+    result — the solver's own per-attempt records carry the phase
+    detail; this row ties a wire batch to them.  Best-effort: the black
+    box must never fail a batch."""
+    try:
+        from karpenter_tpu.utils import flightrecorder as fr
+        from karpenter_tpu.utils import metrics, tracing
+        rec = fr.RECORDER
+        if not rec.enabled:
+            return
+        solver = _solver
+        metrics.FLIGHT_RECORDS.inc(kind="batch")
+        rec.record(
+            kind="batch",
+            trace_id=tracing.current_trace_id(),
+            catalog={"fingerprint": fp},
+            fingerprint=fp[:16] if isinstance(fp, str) else None,
+            pods=sum(len(i.pods) for i in inps),
+            groups=len(inps),
+            knobs={"max_nodes": max_nodes},
+            phase_ms=dict(getattr(solver, "last_phase_ms", {}) or {})
+            if solver is not None else {},
+            delta=None,
+            retraces=None,
+            device_memory_peak_bytes=None,
+            result={"requests": len(inps),
+                    "digests": [fr.result_digest(r) for r in results]},
+            capture=None,
+        )
+    except Exception:  # noqa: BLE001 — telemetry, never the data path
+        pass
+
+
 def handle_batch(payloads: List[bytes]) -> List[bytes]:
     global _shed_count
     from karpenter_tpu.scheduling import ScheduleInput
@@ -137,10 +174,19 @@ def handle_batch(payloads: List[bytes]) -> List[bytes]:
                     "o_axis_transfers": len(ex.transfers),
                     "o_axis_bytes": sum(b for _, b in ex.transfers),
                 }
+            # the worker's telemetry snapshot rides the stats RPC: this
+            # is how the daemon's solve-rate, phase latencies, delta
+            # split, retraces, and flight-recorder tail reach the
+            # operator's GET /debug/dashboard without the daemon
+            # exposing its own HTTP surface (utils/telemetry.py merges
+            # it alongside the supervisor's and the operator's own)
+            from karpenter_tpu.utils import telemetry
             responses[i] = ("result", {"batch_sizes": list(_batch_log),
                                        "catalogs": len(_catalogs),
                                        "shed": _shed_count,
-                                       "mesh": mesh_info})
+                                       "mesh": mesh_info,
+                                       "telemetry":
+                                           telemetry.local_snapshot()})
         elif kind == "warmup":
             # padding-bucket precompile against an uploaded catalog: the
             # operator fires this at startup so the daemon's first real
@@ -238,6 +284,7 @@ def handle_batch(payloads: List[bytes]) -> List[bytes]:
             with ctx:
                 with tracing.span("solverd.solve_batch", requests=len(idxs)):
                     results = _solve_group(inps, max_nodes=max_nodes)
+            _flight_record_batch(fp, inps, results, max_nodes)
             spans = [s.to_dict() for s in ctx.spans]
             for i, res in zip(idxs, results):
                 responses[i] = ("result", res)

@@ -113,6 +113,13 @@ class SolverdSupervisor:
             target=self._monitor_loop, daemon=True,
             name="solverd-supervisor")
         self._monitor.start()
+        # forward worker-lifecycle state to the operator's dashboard:
+        # in the in-process topology (tests, embedded supervision) the
+        # operator's GET /debug/dashboard merges this source; the
+        # standalone CLI exports the same numbers via --metrics-port
+        from karpenter_tpu.utils import telemetry
+        self._telemetry_fn = self.stats  # one bound object: unregister
+        telemetry.register_source("supervisor", self._telemetry_fn)
         if wait_for_socket:
             self.wait_ready(timeout)
 
@@ -143,7 +150,24 @@ class SolverdSupervisor:
         raise TimeoutError(
             f"solverd worker never accepted on {self.socket_path}")
 
+    def stats(self) -> dict:
+        """Snapshot for the telemetry merge (utils/telemetry.py): the
+        worker-lifecycle state only this process knows — restart count,
+        liveness, last exit code, crash-loop give-up."""
+        return {
+            "restarts": self.restarts,
+            "running": self.running,
+            "gave_up": self.gave_up,
+            "last_exit": self.last_exit,
+            "worker_pid": self.worker_pid,
+            "socket": self.socket_path,
+        }
+
     def stop(self, timeout: float = 10.0) -> None:
+        from karpenter_tpu.utils import telemetry
+        fn = getattr(self, "_telemetry_fn", None)
+        if fn is not None:
+            telemetry.unregister_source("supervisor", fn)
         # order matters: join the monitor FIRST (its waits are all
         # short and stop-aware), THEN kill whatever worker is current —
         # terminating before the join races a backoff-respawn and

@@ -69,6 +69,16 @@ def _note_trace(**statics) -> None:
     global TRACE_COUNT
     TRACE_COUNT += 1
     TRACE_LOG.append(statics)
+    # exported half of the counter (`karpenter_tpu_solver_retraces_total`
+    # by padded shape bucket): the warm-up gates assert TRACE_COUNT
+    # in-process, but a deployed operator only sees /metrics — a series
+    # climbing post-warmup is a padding-bucket cliff the lattice missed.
+    # Bucket cardinality is bounded by the warm-up lattice itself
+    # (a few dozen programs per deployment).
+    from karpenter_tpu.utils import metrics
+    metrics.SOLVER_RETRACES.inc(bucket="G{G}_E{E}_O{O}_N{N}".format(
+        G=statics.get("G", 0), E=statics.get("E", 0),
+        O=statics.get("O", 0), N=statics.get("N", 0)))
 # NOTE: no module-level jnp constants here — materializing a device array
 # at import time eagerly initializes whatever backend the site default
 # points at; importing the solver must never touch a device. The BIG

@@ -81,6 +81,23 @@ class DeviceSlots:
         self._slots[self._i] = arr
         return arr
 
+    def occupancy(self) -> int:
+        """Slots holding a LIVE device buffer — donated buffers die with
+        the program that consumed them, so steady-state occupancy under
+        the pipeline is the double-buffer depth minus the dead slots.
+        Telemetry only (`karpenter_tpu_solver_donated_slots_in_use`);
+        never consulted by the rotation itself."""
+        live = 0
+        for arr in self._slots:
+            if arr is None:
+                continue
+            try:
+                if not arr.is_deleted():
+                    live += 1
+            except AttributeError:
+                live += 1  # a host array has no deletion story
+        return live
+
 
 def run_pipeline(items: Iterable, dispatch: Callable, complete: Callable,
                  enabled: bool = True) -> None:

@@ -817,6 +817,58 @@ class TPUSolver:
                                      sparse_n=kn, mask_packed=mbits)
         return run
 
+    # -- flight recorder (utils/flightrecorder.py) ------------------------
+    def _flight_record(self, inp: ScheduleInput, cat, enc,
+                       res: ScheduleResult, kind: str) -> None:
+        """One black-box record per solve attempt: what the solve saw
+        (catalog identity, problem fingerprint, resolved knobs), what it
+        paid (phase timings, retraces, device-memory watermark), and
+        what it answered (bit-exact result digest).  Fingerprint-only by
+        default — budgeted <1% of the headline p50 (`bench.py --flight`);
+        the full-capture path (`KARPENTER_TPU_FLIGHT_CAPTURE`) pickled
+        the input before the solve ran (`_solve_attempt`)."""
+        from karpenter_tpu.utils import flightrecorder as fr
+        from karpenter_tpu.utils.profiling import device_memory_peak
+        # the device-runtime gauges are tentpole part 2, independent of
+        # the recorder gate (part 1): KARPENTER_TPU_FLIGHT=off must not
+        # silently freeze /metrics at the last sampled watermark
+        mem = device_memory_peak()
+        if mem:
+            metrics.SOLVER_DEVICE_MEMORY_PEAK.set(mem)
+        metrics.SOLVER_DONATED_SLOTS.set(self._upload_slots.occupancy())
+        rec = fr.RECORDER
+        if not rec.enabled:
+            return
+        from karpenter_tpu.solver import ffd as _ffd
+        mesh = self._mesh if self._mesh_resolved else None
+        delta_mode = self._resolve_delta()
+        cache = self._delta_cache
+        metrics.FLIGHT_RECORDS.inc(kind=kind)
+        rec.record(
+            kind=kind,
+            trace_id=tracing.current_trace_id(),
+            catalog=fr.catalog_identity(cat),
+            fingerprint=fr.problem_fingerprint(enc),
+            pods=len(inp.pods),
+            groups=enc.n_groups,
+            knobs={
+                "max_nodes": self.max_nodes,
+                "mesh": mesh.size if mesh is not None else 0,
+                "delta": delta_mode if delta_mode else "off",
+                "pipeline": pipelining.pipeline_enabled(),
+                "topk_segments": self._last_new_segments,
+            },
+            phase_ms={k: round(v, 3)
+                      for k, v in self.last_phase_ms.items()},
+            delta={"outcome": getattr(cache, "last_outcome", None),
+                   "reason": getattr(cache, "last_reason", None)},
+            retraces=_ffd.TRACE_COUNT - getattr(self, "_flight_tr0",
+                                                _ffd.TRACE_COUNT),
+            device_memory_peak_bytes=mem,
+            result=fr.result_digest(res),
+            capture=getattr(self, "_flight_capture", None),
+        )
+
     # -- incremental delta solves (solver/delta.py) -----------------------
     def _delta_fallback(self, reason: str) -> None:
         """Count one non-engaged pass.  Every pass through the delta
@@ -1000,6 +1052,7 @@ class TPUSolver:
             tracing.record_span(f"solver.phase.{phase}",
                                 wall0 + (lo - t0), dur,
                                 groups_reencoded=sp.reencoded)
+        self._flight_record(inp, cat, enc_m, res, "delta")
         return res
 
     def _delta_store(self, inp: ScheduleInput, cat, enc, out,
@@ -1040,6 +1093,16 @@ class TPUSolver:
         # end of this method overwrites any sub-solve's leftovers
         self._last_oracle_judged = set()
         self._last_slots_exhausted = False
+        # flight-recorder prelude: snapshot the retrace counter (the
+        # record reports this attempt's compile activity) and, in
+        # full-capture mode, pickle the problem BEFORE solving — a crash
+        # mid-solve must still leave the repro input on disk
+        from karpenter_tpu.utils import flightrecorder as _fr
+        self._flight_tr0 = ffd.TRACE_COUNT
+        self._flight_capture = _fr.RECORDER.capture_problem(
+            {"inp": inp, "max_nodes": max_nodes,
+             "solver_max_nodes": self.max_nodes}) \
+            if _fr.RECORDER.capture_enabled() else None
         wall0 = _time.time()
         t0 = _time.perf_counter()
         cat = self._catalog_encoding(inp)
@@ -1197,6 +1260,7 @@ class TPUSolver:
                     attrs["mesh_skew_ms"] = round(skew_s * 1e3, 3)
             tracing.record_span(f"solver.phase.{phase}",
                                 wall0 + (lo - t0), dur, **attrs)
+        self._flight_record(inp, cat, enc, res, "solve")
         return res
 
     # -- warm-up: padding-bucket precompile --------------------------------

@@ -346,6 +346,34 @@ SERVICE_WORKER_RESTARTS = _c(
 SOLVER_RESIDUE_PODS = _c(
     "karpenter_tpu_solver_residue_pods_total",
     "Pods solved host-side as split-solve residue.")
+# -- observability substrate (ISSUE 9): the flight recorder, the
+# -- device-runtime telemetry, and the trace ring's drop accounting
+FLIGHT_RECORDS = _c(
+    "karpenter_tpu_flight_records_total",
+    "Flight-recorder records written, by record kind (solve = one "
+    "single-problem attempt, delta = an engaged delta pass, batch = one "
+    "fused solverd batch).", ("kind",))
+SOLVER_RETRACES = _c(
+    "karpenter_tpu_solver_retraces_total",
+    "Kernel-body retraces (each is the only event that can trigger an "
+    "XLA compile), by padded shape bucket. Post-warmup steady state "
+    "must hold this flat — a climbing series means a padding-bucket "
+    "cliff the warm-up lattice missed.", ("bucket",))
+SOLVER_DEVICE_MEMORY_PEAK = _g(
+    "karpenter_tpu_solver_device_memory_peak_bytes",
+    "Peak device-memory bytes in use, sampled after each solve "
+    "(PJRT memory_stats; 0 when the backend does not report — the "
+    "XLA:CPU emulation path).")
+SOLVER_DONATED_SLOTS = _g(
+    "karpenter_tpu_solver_donated_slots_in_use",
+    "Donated upload slots currently holding a live (undeleted) device "
+    "buffer in the pipelined executor's double-buffer rotation.")
+TRACE_SPANS_DROPPED = _c(
+    "karpenter_tpu_trace_spans_dropped_total",
+    "Spans evicted from the trace collector's bounded buffers (oldest "
+    "finished trace pushed out of the ring, an orphaned in-progress "
+    "trace evicted, or a pathological trace hitting the per-trace span "
+    "cap) — the visibility half of the ring's silent-eviction bargain.")
 SOLVER_ORACLE_BACKSTOP = _c(
     "karpenter_tpu_solver_oracle_backstop_total",
     "Solves where the full-oracle backstop beat the decomposed paths "

@@ -9,6 +9,15 @@ the solver"), gated the same way:
   KARPENTER_TPU_PROFILE_DIR=<dir>    additionally trace every solve into
                                      <dir> (one trace per solve, for
                                      offline xprof analysis)
+  KARPENTER_TPU_PROFILE=<dir>|1      the one-knob spelling of the same
+                                     per-solve trace hook (ISSUE 9): a
+                                     directory value traces there; a bare
+                                     truthy value traces into
+                                     KARPENTER_TPU_PROFILE_DIR or
+                                     ./profiles.  Opt-in — the recorder
+                                     and metrics stay the always-on layer;
+                                     this hook is the heavyweight XLA
+                                     deep-dive.
 
 Disabled (the default), `trace_solve` is a no-op context manager with one
 dict lookup of overhead — nothing rides the 200 ms budget.
@@ -43,11 +52,45 @@ def maybe_start_server(log=None) -> Optional[int]:
     return port
 
 
+def device_memory_peak() -> int:
+    """Peak device-memory bytes in use across local devices (PJRT
+    `memory_stats`), the per-solve watermark the flight recorder and
+    `karpenter_tpu_solver_device_memory_peak_bytes` sample.  0 when the
+    backend does not report (the XLA:CPU emulation path) — absence of
+    telemetry must read as zero, never raise into a solve."""
+    try:
+        import jax
+        peak = 0
+        for d in jax.local_devices():
+            ms = d.memory_stats()
+            if ms:
+                peak = max(peak, int(ms.get(
+                    "peak_bytes_in_use", ms.get("bytes_in_use", 0))))
+        return peak
+    except Exception:  # noqa: BLE001 — telemetry, not control flow
+        return 0
+
+
+def profile_trace_dir() -> Optional[str]:
+    """Resolve the per-solve trace destination: KARPENTER_TPU_PROFILE
+    (a directory, or a bare truthy value deferring to
+    KARPENTER_TPU_PROFILE_DIR / ./profiles), else KARPENTER_TPU_PROFILE_DIR
+    alone.  None = the hook is off (the default)."""
+    raw = os.environ.get("KARPENTER_TPU_PROFILE", "").strip()
+    legacy = os.environ.get("KARPENTER_TPU_PROFILE_DIR")
+    if not raw or raw.lower() in ("0", "false", "off", "no"):
+        return legacy or None
+    if raw.lower() in ("1", "true", "yes", "on"):
+        return legacy or "profiles"
+    return raw  # a directory path
+
+
 @contextlib.contextmanager
 def trace_solve(name: str = "solve"):
-    """Trace one solve into KARPENTER_TPU_PROFILE_DIR when set; otherwise
-    a no-op. The annotation names the region in xprof."""
-    trace_dir = os.environ.get("KARPENTER_TPU_PROFILE_DIR")
+    """Trace one solve into the resolved profile directory when the
+    KARPENTER_TPU_PROFILE / KARPENTER_TPU_PROFILE_DIR hook is armed;
+    otherwise a no-op. The annotation names the region in xprof."""
+    trace_dir = profile_trace_dir()
     if not trace_dir:
         yield
         return

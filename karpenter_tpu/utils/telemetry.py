@@ -1,0 +1,245 @@
+"""Fleet telemetry aggregation (ISSUE 9 tentpole part 3).
+
+The control plane is several processes — operator replicas, the solverd
+supervisor, the kt_solverd worker — each with its own metrics registry
+and flight-recorder ring.  This module is the merge point: every process
+can produce a compact `local_snapshot()` of its observable state, other
+in-process components (the supervisor) register themselves as snapshot
+*sources*, the solverd worker's snapshot arrives through the stats RPC,
+and `merge()` folds them into the ONE view `GET /debug/dashboard`
+serves: solve rate, p50/p99 phase latencies, delta hit/fallback split,
+queue depth, shed/retry/breaker/restart state, and the flight-recorder
+tail — the aggregated-view half of the request-record + aggregated-view
+split (the flight recorder is the request-record half).
+
+Everything here is read-only over the metrics registry and best-effort:
+a dashboard render must never throw into the operator's HTTP thread.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from karpenter_tpu.utils import metrics
+
+_lock = threading.Lock()
+_sources: Dict[str, Callable[[], dict]] = {}
+# solve-rate window: (monotonic ts, total solves) of the previous
+# snapshot, so successive dashboard scrapes see a rate, not a total
+_rate_state = {"ts": None, "total": None}
+
+
+def register_source(name: str, fn: Callable[[], dict]) -> None:
+    """Register an in-process snapshot source (the solverd supervisor
+    registers its restart/liveness state here on start()).  Last
+    registration per name wins — a restarted component replaces its
+    predecessor."""
+    with _lock:
+        _sources[name] = fn
+
+
+def unregister_source(name: str, fn: Optional[Callable] = None) -> None:
+    """Remove a source; with `fn` given, only when it is still the
+    registered callable — a stopped component must not evict the
+    replacement that took its name."""
+    with _lock:
+        if fn is None or _sources.get(name) is fn:
+            _sources.pop(name, None)
+
+
+def _series(metric) -> dict:
+    """A labeled metric's samples as {label-values-joined: value};
+    unlabeled metrics map the empty key.  Snapshot under the metric's
+    own lock: a solve thread registering a first-time label key resizes
+    the dict, and an unlocked iteration here would raise into the
+    dashboard's HTTP thread."""
+    vals = getattr(metric, "_values", None)
+    if vals is None:
+        return {}
+    with metric._lock:
+        items = sorted(vals.items())
+    return {"/".join(k) if k else "": v for k, v in items}
+
+
+def _quantile_upper(buckets, counts, total: int, q: float) -> float:
+    """Histogram quantile as the upper bound of the first bucket whose
+    cumulative count reaches q·total — the standard conservative read of
+    a Prometheus-style histogram (exact values are gone; the bound is
+    what dashboards alert on)."""
+    need = q * total
+    for b, c in zip(buckets, counts):
+        if c >= need:
+            return b
+    return float("inf")
+
+
+def phase_latency_summary() -> dict:
+    """{phase/path: {count, p50_ms, p99_ms}} from the solver phase
+    histogram — the per-request spans aggregated into the fleet view."""
+    h = metrics.SOLVER_PHASE_DURATION
+    out = {}
+    with h._lock:  # same snapshot discipline as _series
+        totals = sorted(h._totals.items())
+        all_counts = {k: list(v) for k, v in h._counts.items()}
+    for key, total in totals:
+        counts = all_counts.get(key, [])
+        out["/".join(key)] = {
+            "count": total,
+            "p50_ms": round(
+                _quantile_upper(h.buckets, counts, total, 0.50) * 1e3, 3),
+            "p99_ms": round(
+                _quantile_upper(h.buckets, counts, total, 0.99) * 1e3, 3),
+        }
+    return out
+
+
+def local_snapshot(flight_tail: int = 16) -> dict:
+    """This process's observable state: the compact dict every process
+    role (operator, solverd backend, supervisor CLI) can produce and the
+    dashboard merges."""
+    from karpenter_tpu.utils import flightrecorder, tracing  # noqa: F401
+    solves = _series(metrics.SOLVER_SOLVES)
+    total = sum(solves.values())
+    now = time.monotonic()
+    rate = None
+    with _lock:
+        if _rate_state["ts"] is not None and now > _rate_state["ts"]:
+            rate = max(0.0, (total - _rate_state["total"])
+                       / (now - _rate_state["ts"]))
+        _rate_state["ts"], _rate_state["total"] = now, total
+    return {
+        "ts": time.time(),
+        "pid": os.getpid(),
+        "queue_depth": metrics.SCHEDULING_QUEUE_DEPTH.value(),
+        "solves": solves,
+        "solves_total": total,
+        "solve_rate_per_s": None if rate is None else round(rate, 3),
+        "phase_latency_ms": phase_latency_summary(),
+        "delta": {
+            "passes": _series(metrics.SOLVER_DELTA_PASSES),
+            "groups_reencoded":
+                metrics.SOLVER_DELTA_GROUPS_REENCODED.value(),
+        },
+        "service": {
+            "retries": metrics.SERVICE_RETRIES.value(),
+            "breaker_state": metrics.SERVICE_BREAKER_STATE.value(),
+            "worker_restarts": metrics.SERVICE_WORKER_RESTARTS.value(),
+        },
+        "retraces": sum(_series(metrics.SOLVER_RETRACES).values()),
+        "device_memory_peak_bytes":
+            metrics.SOLVER_DEVICE_MEMORY_PEAK.value(),
+        "donated_slots_in_use": metrics.SOLVER_DONATED_SLOTS.value(),
+        "spans_dropped": metrics.TRACE_SPANS_DROPPED.value(),
+        "flight_records": _series(metrics.FLIGHT_RECORDS),
+        "flight_tail": flightrecorder.RECORDER.tail(flight_tail),
+    }
+
+
+def collect(extra: Optional[Dict[str, Callable[[], dict]]] = None,
+            flight_tail: int = 16) -> dict:
+    """Gather every reachable snapshot — this process, every registered
+    source (supervisor), and the caller's extra sources (the operator
+    passes one that runs the solverd stats RPC) — then merge.  A source
+    that throws becomes {"error": ...}: diagnostics must keep rendering
+    exactly when part of the fleet is down."""
+    try:
+        snaps: Dict[str, dict] = {
+            "operator": local_snapshot(flight_tail=flight_tail)}
+    except Exception as e:  # noqa: BLE001 — the contract is absolute
+        snaps = {"operator": {"error": str(e)[:200]}}
+    with _lock:
+        named = list(_sources.items())
+    if extra:
+        named += list(extra.items())
+    for name, fn in named:
+        try:
+            snap = fn()
+        except Exception as e:  # noqa: BLE001 — render what IS reachable
+            snap = {"error": str(e)[:200]}
+        if snap is not None:
+            snaps[name] = snap
+    return merge(snaps)
+
+
+def merge(snapshots: Dict[str, dict]) -> dict:
+    """Fold named per-process snapshots into one dashboard document:
+    the raw per-process sections stay under `processes`, and the `fleet`
+    rollup answers the operator's first-glance questions (is work
+    flowing, is anything shedding/restarting/breaker-open, is the delta
+    path engaged)."""
+    def num(snap, *path, default=0.0):
+        cur = snap
+        for p in path:
+            if not isinstance(cur, dict):
+                return default
+            cur = cur.get(p)
+        return cur if isinstance(cur, (int, float)) else default
+
+    fleet = {
+        "queue_depth": sum(num(s, "queue_depth")
+                           for s in snapshots.values()),
+        "solves_total": sum(num(s, "solves_total")
+                            for s in snapshots.values()),
+        "shed": sum(max(num(s, "stats", "shed"), num(s, "shed"))
+                    for s in snapshots.values()),
+        "worker_restarts": max(
+            (max(num(s, "service", "worker_restarts"),
+                 num(s, "restarts")) for s in snapshots.values()),
+            default=0.0),
+        "breaker_state": max(
+            (num(s, "service", "breaker_state")
+             for s in snapshots.values()), default=0.0),
+        "retries": sum(num(s, "service", "retries")
+                       for s in snapshots.values()),
+        "delta_passes": {},
+        "spans_dropped": sum(num(s, "spans_dropped")
+                             for s in snapshots.values()),
+    }
+    for s in snapshots.values():
+        passes = s.get("delta", {}).get("passes") \
+            if isinstance(s.get("delta"), dict) else None
+        if isinstance(passes, dict):
+            for k, v in passes.items():
+                fleet["delta_passes"][k] = \
+                    fleet["delta_passes"].get(k, 0) + v
+    return {"generated_at": time.time(),
+            "processes": snapshots,
+            "fleet": fleet}
+
+
+def render_html(doc: dict) -> str:
+    """One self-contained HTML page over the merged document — the
+    no-tooling view (`GET /debug/dashboard?format=html`); the JSON form
+    is the API."""
+    import html as _html
+    import json as _json
+    fleet = doc.get("fleet", {})
+    rows = "".join(
+        f"<tr><td>{_html.escape(str(k))}</td>"
+        f"<td>{_html.escape(_json.dumps(v))}</td></tr>"
+        for k, v in sorted(fleet.items()))
+    sections = []
+    for name, snap in sorted(doc.get("processes", {}).items()):
+        body = _html.escape(_json.dumps(snap, indent=2, default=str))
+        sections.append(
+            f"<h2>{_html.escape(name)}</h2><pre>{body}</pre>")
+    return (
+        "<!doctype html><html><head><meta charset='utf-8'>"
+        "<title>karpenter-tpu dashboard</title>"
+        "<style>body{font-family:monospace;margin:1.5em}"
+        "table{border-collapse:collapse}"
+        "td{border:1px solid #999;padding:2px 8px}"
+        "pre{background:#f6f6f6;padding:8px;overflow-x:auto}</style>"
+        "</head><body><h1>karpenter-tpu operator dashboard</h1>"
+        f"<h2>fleet</h2><table>{rows}</table>"
+        + "".join(sections) + "</body></html>")
+
+
+def reset() -> None:
+    """Clear registered sources and the rate window (tests)."""
+    with _lock:
+        _sources.clear()
+        _rate_state["ts"] = _rate_state["total"] = None

@@ -48,6 +48,17 @@ _enabled_override: Optional[bool] = None
 _tl = threading.local()
 
 
+def _count_dropped(n: int) -> None:
+    """Eviction accounting (`karpenter_tpu_trace_spans_dropped_total`):
+    the bounded buffers drop spans by design, and the drop count is what
+    tells an operator the ring was too small for the trace volume —
+    surfaced by `GET /debug/traces` alongside the export.  Imported
+    lazily so tracing stays importable from the metrics module's own
+    test fixtures without a cycle."""
+    from karpenter_tpu.utils import metrics
+    metrics.TRACE_SPANS_DROPPED.inc(n)
+
+
 def tracing_enabled() -> bool:
     if _enabled_override is not None:
         return _enabled_override
@@ -123,25 +134,41 @@ class _Collector:
             return 64
 
     def add(self, span: Span, finalize: bool = False) -> None:
-        with self._lock:
-            spans = self._live.get(span.trace_id)
-            if spans is None:
-                # a late span for an already-finished trace (an async
-                # batcher window closing after the root) joins its entry
-                for tid, fspans in self._finished:
-                    if tid == span.trace_id:
-                        if len(fspans) < _MAX_SPANS_PER_TRACE:
-                            fspans.append(span)
+        dropped = 0
+        try:
+            with self._lock:
+                spans = self._live.get(span.trace_id)
+                if spans is None:
+                    # a late span for an already-finished trace (an
+                    # async batcher window closing after the root) joins
+                    # its entry
+                    late = next((fspans for tid, fspans in self._finished
+                                 if tid == span.trace_id), None)
+                    if late is not None:
+                        if len(late) < _MAX_SPANS_PER_TRACE:
+                            late.append(span)
+                        else:
+                            dropped += 1
                         return
-                spans = self._live[span.trace_id] = []
-                while len(self._live) > _MAX_LIVE_TRACES:
-                    self._live.popitem(last=False)
-            if len(spans) < _MAX_SPANS_PER_TRACE:
-                spans.append(span)
-            if finalize:
-                done = self._live.pop(span.trace_id, None)
-                if done is not None:
-                    self._finished.append((span.trace_id, done))
+                    spans = self._live[span.trace_id] = []
+                    while len(self._live) > _MAX_LIVE_TRACES:
+                        _, orphaned = self._live.popitem(last=False)
+                        dropped += len(orphaned)
+                if len(spans) < _MAX_SPANS_PER_TRACE:
+                    spans.append(span)
+                else:
+                    dropped += 1
+                if finalize:
+                    done = self._live.pop(span.trace_id, None)
+                    if done is not None:
+                        if len(self._finished) == self._finished.maxlen:
+                            # the deque silently evicts its oldest trace
+                            # to make room — those spans are drops too
+                            dropped += len(self._finished[0][1])
+                        self._finished.append((span.trace_id, done))
+        finally:
+            if dropped:
+                _count_dropped(dropped)
 
     def take(self, trace_id: str) -> List[Span]:
         """Remove and return an in-progress trace's spans (the extract
@@ -337,13 +364,23 @@ def finished_traces(trace_id: Optional[str] = None) -> List[tuple]:
     return _collector.finished(trace_id)
 
 
-def chrome_trace(trace_id: Optional[str] = None) -> dict:
+def chrome_trace(trace_id: Optional[str] = None,
+                 limit: Optional[int] = None) -> dict:
     """Chrome trace-event JSON (the `traceEvents` array format) of the
     completed-trace ring buffer, loadable in Perfetto / chrome://tracing.
     Spans become complete ("X") events; each trace maps to one pid so
-    Perfetto groups its spans, threads map to tids within it."""
+    Perfetto groups its spans, threads map to tids within it.  `limit`
+    keeps only the most recent N traces (the `?limit=` parameter on
+    `GET /debug/traces` — a large ring must not dump unbounded JSON);
+    `otherData.spansDropped` carries the collector's eviction counter so
+    a truncated-looking trace is distinguishable from a dropped one."""
+    traces = finished_traces(trace_id)
+    if limit is not None and limit >= 0:
+        # slice from the front: traces[-0:] would be the WHOLE list, the
+        # exact opposite of the cap ?limit=0 asks for
+        traces = traces[len(traces) - limit:] if limit else []
     events: List[dict] = []
-    for pid, (tid_, spans) in enumerate(finished_traces(trace_id), start=1):
+    for pid, (tid_, spans) in enumerate(traces, start=1):
         events.append({
             "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
             "args": {"name": f"trace {tid_[:16]}"},
@@ -361,7 +398,11 @@ def chrome_trace(trace_id: Optional[str] = None) -> dict:
                 "args": {"trace_id": sp.trace_id, "span_id": sp.span_id,
                          "parent_id": sp.parent_id, **sp.attrs},
             })
-    return {"displayTimeUnit": "ms", "traceEvents": events}
+    from karpenter_tpu.utils import metrics
+    return {"displayTimeUnit": "ms", "traceEvents": events,
+            "otherData": {
+                "spansDropped": int(metrics.TRACE_SPANS_DROPPED.value()),
+                "tracesReturned": len(traces)}}
 
 
 def reset() -> None:

@@ -1,0 +1,605 @@
+"""Observability substrate suite (ISSUE 9): flight recorder + replay,
+device-runtime telemetry, fleet dashboard, trace-ring drop accounting,
+and trace continuity across a supervised worker restart.
+
+Layers, cheapest first:
+
+  * recorder units — ring bound, gate, JSONL spill, fingerprint
+    determinism, full capture
+  * replay — a flight record captured from a 50k-pod solve re-executes
+    through the real `tools/kt_replay.py` CLI (subprocess) and
+    reproduces bit-identical nodes/cost
+  * device telemetry — the exported retrace counter stays flat across
+    two post-warmup solves (the PR 5/6 warmup gates, now asserted on
+    the /metrics surface instead of only `ffd.TRACE_COUNT`)
+  * the real supervised topology — kt_solverd under SolverdSupervisor:
+    a worker crash mid-solve still yields ONE stitched trace on the
+    same trace id, and `GET /debug/dashboard` merges operator +
+    supervisor + worker into one snapshot
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.models import NodePool, ObjectMeta, Pod, Resources
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.providers import generate_catalog
+from karpenter_tpu.providers.catalog import CatalogSpec
+from karpenter_tpu.scheduling import ScheduleInput
+from karpenter_tpu.service import SolverdSupervisor, SolverServiceError
+from karpenter_tpu.solver import TPUSolver
+from karpenter_tpu.utils import flightrecorder, metrics, telemetry, tracing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CATALOG = generate_catalog(CatalogSpec(max_types=10, include_gpu=False))
+POOL = NodePool(meta=ObjectMeta(name="default"))
+
+
+def mkinp(tag, n=12, cpu="500m", mem="1Gi"):
+    pods = [Pod(meta=ObjectMeta(name=f"{tag}-p{i}"),
+                requests=Resources.parse({"cpu": cpu, "memory": mem}))
+            for i in range(n)]
+    return ScheduleInput(pods=pods, nodepools=[POOL],
+                         instance_types={"default": CATALOG})
+
+
+def retrace_total() -> float:
+    return sum(telemetry._series(metrics.SOLVER_RETRACES).values())
+
+
+@pytest.fixture
+def fresh_recorder(monkeypatch):
+    """A clean recorder ring per test; the module singleton is shared
+    process-wide, so tests must not read each other's tails."""
+    flightrecorder.RECORDER.reset()
+    yield flightrecorder.RECORDER
+    flightrecorder.RECORDER.reset()
+
+
+# --------------------------------------------------------------------------
+# recorder units
+# --------------------------------------------------------------------------
+class TestFlightRecorder:
+    def test_always_on_and_bounded(self, fresh_recorder, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_FLIGHT_BUFFER", "4")
+        fresh_recorder.reset()  # re-read the ring size
+        assert fresh_recorder.enabled
+        for i in range(10):
+            fresh_recorder.record(kind="solve", trace_id=f"t{i}")
+        assert len(fresh_recorder) == 4
+        tail = fresh_recorder.tail(32)
+        assert [r["trace_id"] for r in tail] == ["t6", "t7", "t8", "t9"]
+        # seq keeps counting past evictions: records are identifiable
+        # even after the ring wrapped
+        assert tail[-1]["seq"] == 10
+
+    def test_gate_off(self, fresh_recorder, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_FLIGHT", "off")
+        assert fresh_recorder.record(kind="solve") is None
+        assert len(fresh_recorder) == 0
+
+    def test_tail_limit_zero_is_empty(self, fresh_recorder):
+        fresh_recorder.record(kind="solve")
+        # recs[-0:] would be the WHOLE ring — ?limit=0 must mean none
+        assert fresh_recorder.tail(0) == []
+        assert fresh_recorder.tail(-3) == []
+
+    def test_capture_requires_recorder_on(self, fresh_recorder,
+                                          monkeypatch, tmp_path):
+        # a capture no record references is an orphan, not a repro —
+        # the capture gate must follow the recorder gate
+        monkeypatch.setenv("KARPENTER_TPU_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("KARPENTER_TPU_FLIGHT_CAPTURE", "1")
+        monkeypatch.setenv("KARPENTER_TPU_FLIGHT", "off")
+        assert not fresh_recorder.capture_enabled()
+        monkeypatch.delenv("KARPENTER_TPU_FLIGHT")
+        assert fresh_recorder.capture_enabled()
+        # captures number independently: two captures, two files
+        p1 = fresh_recorder.capture_problem({"inp": 1})
+        p2 = fresh_recorder.capture_problem({"inp": 2})
+        assert p1 != p2 and os.path.exists(p1) and os.path.exists(p2)
+
+    def test_trace_id_filter(self, fresh_recorder):
+        fresh_recorder.record(kind="solve", trace_id="aaa")
+        fresh_recorder.record(kind="solve", trace_id="bbb")
+        fresh_recorder.record(kind="delta", trace_id="aaa")
+        got = fresh_recorder.tail(32, trace_id="aaa")
+        assert [r["kind"] for r in got] == ["solve", "delta"]
+
+    def test_jsonl_spill_and_load(self, fresh_recorder, monkeypatch,
+                                  tmp_path):
+        monkeypatch.setenv("KARPENTER_TPU_FLIGHT_DIR", str(tmp_path))
+        for i in range(3):
+            fresh_recorder.record(kind="solve", trace_id=f"s{i}",
+                                  result={"nodes": i})
+        path = tmp_path / f"flight-{os.getpid()}.jsonl"
+        assert path.exists()
+        rows = flightrecorder.load_records(str(path))
+        assert [r["result"]["nodes"] for r in rows] == [0, 1, 2]
+        # a torn trailing line (crashed writer) must not poison the file
+        with open(path, "a") as f:
+            f.write('{"seq": 99, "trunc')
+        assert len(flightrecorder.load_records(str(path))) == 3
+
+    def test_solve_writes_a_record(self, fresh_recorder):
+        solver = TPUSolver(max_nodes=64, mesh="off")
+        res = solver.solve(mkinp("rec"))
+        assert not res.unschedulable
+        tail = fresh_recorder.tail(8)
+        assert tail, "solve produced no flight record"
+        rec = tail[-1]
+        assert rec["kind"] in ("solve", "delta")
+        assert rec["pods"] == 12 and rec["groups"] == 1
+        assert rec["catalog"]["pools"] == ["default"]
+        assert rec["knobs"]["max_nodes"] == 64
+        assert rec["result"]["nodes"] == res.node_count()
+        assert rec["result"]["price_hex"] == \
+            float(res.total_price()).hex()
+        assert set(rec["phase_ms"]) >= {"encode", "device", "decode"}
+        assert rec["delta"]["outcome"] in ("delta", "fallback")
+
+    def test_fingerprint_is_deterministic_and_discriminating(
+            self, fresh_recorder):
+        s1 = TPUSolver(max_nodes=64, mesh="off")
+        s1.solve(mkinp("fpa"))
+        s2 = TPUSolver(max_nodes=64, mesh="off")
+        s2.solve(mkinp("fpa"))  # same shape/requests, fresh solver
+        s3 = TPUSolver(max_nodes=64, mesh="off")
+        s3.solve(mkinp("fpb", cpu="2"))  # different problem
+        a, b, c = [r["fingerprint"] for r in fresh_recorder.tail(8)]
+        assert a == b, "identical problems must fingerprint identically"
+        assert c != a, "a different problem must fingerprint differently"
+
+    def test_full_capture_roundtrip(self, fresh_recorder, monkeypatch,
+                                    tmp_path):
+        monkeypatch.setenv("KARPENTER_TPU_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("KARPENTER_TPU_FLIGHT_CAPTURE", "1")
+        solver = TPUSolver(max_nodes=64, mesh="off")
+        res = solver.solve(mkinp("cap"))
+        rec = fresh_recorder.tail(4)[-1]
+        assert rec["capture"] and os.path.exists(rec["capture"])
+        import pickle
+        with open(rec["capture"], "rb") as f:
+            payload = pickle.load(f)
+        assert len(payload["inp"].pods) == 12
+        assert payload["solver_max_nodes"] == 64
+        # in-process replay parity (the CLI path is exercised at the
+        # 50k shape below): same input, fresh solver, same digest
+        ref = TPUSolver(max_nodes=64, mesh="off").solve(payload["inp"])
+        assert ref.node_count() == rec["result"]["nodes"]
+        assert float(ref.total_price()).hex() == \
+            rec["result"]["price_hex"]
+        assert res.node_count() == ref.node_count()
+
+
+# --------------------------------------------------------------------------
+# replay: the 50k-pod acceptance shape through the real CLI
+# --------------------------------------------------------------------------
+class TestReplay50k:
+    def test_50k_capture_replays_bit_identical(self, fresh_recorder,
+                                               monkeypatch, tmp_path):
+        """A flight record captured from a 50k-pod solve replays through
+        `tools/kt_replay.py` (real subprocess, fresh interpreter) and
+        reproduces bit-identical nodes/cost — the one-command-repro
+        acceptance gate.  Shapes mirror tests/test_scale.py so the
+        kernel programs share the suite's persistent compile cache."""
+        catalog = generate_catalog()
+        sizes = [{"cpu": "250m", "memory": "512Mi"},
+                 {"cpu": "1", "memory": "2Gi"},
+                 {"cpu": "2", "memory": "8Gi"},
+                 {"cpu": "4", "memory": "8Gi"}]
+        pods = [Pod(meta=ObjectMeta(name=f"f{i}"),
+                    requests=Resources.parse(sizes[i % len(sizes)]))
+                for i in range(50_000)]
+        inp = ScheduleInput(
+            pods=pods,
+            nodepools=[NodePool(meta=ObjectMeta(name="default"))],
+            instance_types={"default": catalog})
+        monkeypatch.setenv("KARPENTER_TPU_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("KARPENTER_TPU_FLIGHT_CAPTURE", "1")
+        solver = TPUSolver(max_nodes=4096, mesh="off", delta="off")
+        res = solver.solve(inp)
+        assert not res.unschedulable
+        rec = fresh_recorder.tail(4)[-1]
+        assert rec["pods"] == 50_000
+        assert rec["capture"]
+        jsonl = str(tmp_path / f"flight-{os.getpid()}.jsonl")
+
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env["KARPENTER_TPU_FORCE_CPU"] = "1"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(REPO,
+                                                        ".jax_cache")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "kt_replay.py"),
+             jsonl, "--seq", str(rec["seq"])],
+            capture_output=True, text=True, timeout=600, env=env)
+        assert proc.returncode == 0, (
+            f"kt_replay failed:\n{proc.stdout}\n{proc.stderr}")
+        out = json.loads(proc.stdout)
+        assert out["diffs"] == []
+        assert out["replayed"]["nodes"] == res.node_count()
+        assert out["replayed"]["price_hex"] == \
+            float(res.total_price()).hex()
+        assert "bit-identical" in proc.stderr
+
+
+# --------------------------------------------------------------------------
+# device-runtime telemetry
+# --------------------------------------------------------------------------
+class TestDeviceTelemetry:
+    def test_retrace_counter_exported_and_zero_post_warmup(self):
+        """The PR 5/6 warmup gates on the /metrics surface: the exported
+        retrace counter must not move across TWO post-warmup solves
+        (solve #2 switches to the compacted take_new program — an
+        unwarmed tier would show here, exactly like ffd.TRACE_COUNT)."""
+        inp = mkinp("retr", n=30, cpu="1", mem="2Gi")
+        solver = TPUSolver(mesh="off")
+        assert solver.warmup(inp) > 0
+        before = retrace_total()
+        assert not solver.solve(inp).unschedulable
+        assert not solver.solve(inp).unschedulable
+        assert retrace_total() == before, (
+            "post-warmup solves retraced; the exported counter moved")
+        rendered = metrics.REGISTRY.render()
+        assert "karpenter_tpu_solver_retraces_total" in rendered
+        # the bucket label carries the padded shape for attribution
+        assert 'bucket="G' in rendered
+
+    def test_memory_and_slot_gauges_exported(self, fresh_recorder):
+        solver = TPUSolver(max_nodes=64, mesh="off")
+        solver.solve(mkinp("gauge"))
+        rendered = metrics.REGISTRY.render()
+        assert "karpenter_tpu_solver_device_memory_peak_bytes" in rendered
+        assert "karpenter_tpu_solver_donated_slots_in_use" in rendered
+        rec = fresh_recorder.tail(2)[-1]
+        assert rec["device_memory_peak_bytes"] is not None
+        assert rec["retraces"] >= 0
+
+    def test_gauges_update_with_recorder_off(self, fresh_recorder,
+                                             monkeypatch):
+        # tentpole part 2 (device-runtime gauges) is independent of
+        # part 1: KARPENTER_TPU_FLIGHT=off must not freeze /metrics
+        monkeypatch.setenv("KARPENTER_TPU_FLIGHT", "off")
+        metrics.SOLVER_DONATED_SLOTS.set(-1.0)
+        solver = TPUSolver(max_nodes=64, mesh="off")
+        assert not solver.solve(mkinp("offg")).unschedulable
+        assert metrics.SOLVER_DONATED_SLOTS.value() >= 0
+        assert len(fresh_recorder) == 0  # the ring gate still held
+
+    def test_profile_hook_resolution(self, monkeypatch):
+        from karpenter_tpu.utils.profiling import profile_trace_dir
+        monkeypatch.delenv("KARPENTER_TPU_PROFILE", raising=False)
+        monkeypatch.delenv("KARPENTER_TPU_PROFILE_DIR", raising=False)
+        assert profile_trace_dir() is None
+        monkeypatch.setenv("KARPENTER_TPU_PROFILE", "/tmp/xprof")
+        assert profile_trace_dir() == "/tmp/xprof"
+        monkeypatch.setenv("KARPENTER_TPU_PROFILE", "1")
+        assert profile_trace_dir() == "profiles"
+        monkeypatch.setenv("KARPENTER_TPU_PROFILE_DIR", "/tmp/legacy")
+        assert profile_trace_dir() == "/tmp/legacy"
+
+
+# --------------------------------------------------------------------------
+# trace-ring drop accounting + export polish
+# --------------------------------------------------------------------------
+class TestTraceDrops:
+    def test_finished_ring_eviction_is_counted(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_TRACE_BUFFER", "2")
+        tracing.reset()  # re-reads the ring size
+        tracing.set_enabled(True)
+        try:
+            before = metrics.TRACE_SPANS_DROPPED.value()
+            for i in range(4):
+                with tracing.span(f"drop.root{i}"):
+                    pass
+            assert metrics.TRACE_SPANS_DROPPED.value() > before
+            doc = tracing.chrome_trace()
+            assert doc["otherData"]["spansDropped"] >= \
+                metrics.TRACE_SPANS_DROPPED.value() - before
+        finally:
+            tracing.set_enabled(None)
+            tracing.reset()
+
+    def test_chrome_trace_limit(self):
+        tracing.set_enabled(True)
+        try:
+            tracing.reset()
+            for i in range(5):
+                with tracing.span(f"lim.root{i}"):
+                    pass
+            full = tracing.chrome_trace()
+            assert full["otherData"]["tracesReturned"] == 5
+            capped = tracing.chrome_trace(limit=2)
+            assert capped["otherData"]["tracesReturned"] == 2
+            # limit=0 must return NO traces (the [-0:] whole-list trap)
+            assert tracing.chrome_trace(limit=0)["otherData"][
+                "tracesReturned"] == 0
+            # most recent traces survive the cap
+            names = {e["name"] for e in capped["traceEvents"]
+                     if e.get("ph") == "X"}
+            assert names == {"lim.root3", "lim.root4"}
+        finally:
+            tracing.set_enabled(None)
+            tracing.reset()
+
+
+# --------------------------------------------------------------------------
+# telemetry merge units
+# --------------------------------------------------------------------------
+class TestTelemetryMerge:
+    def test_local_snapshot_shape(self):
+        snap = telemetry.local_snapshot()
+        for key in ("queue_depth", "solves", "phase_latency_ms", "delta",
+                    "service", "retraces", "flight_tail",
+                    "spans_dropped"):
+            assert key in snap, key
+
+    def test_merge_rolls_up_fleet(self):
+        a = {"queue_depth": 3, "solves_total": 10, "spans_dropped": 1,
+             "service": {"retries": 2, "breaker_state": 0,
+                         "worker_restarts": 0},
+             "delta": {"passes": {"delta": 4, "fallback": 1}}}
+        b = {"queue_depth": 1, "stats": {"shed": 5},
+             "service": {"retries": 1, "breaker_state": 1,
+                         "worker_restarts": 2},
+             "delta": {"passes": {"delta": 2}}}
+        c = {"restarts": 3, "running": True}  # a supervisor snapshot
+        doc = telemetry.merge({"operator": a, "worker": b,
+                               "supervisor": c})
+        fleet = doc["fleet"]
+        assert fleet["queue_depth"] == 4
+        assert fleet["shed"] == 5
+        assert fleet["breaker_state"] == 1
+        assert fleet["worker_restarts"] == 3
+        assert fleet["retries"] == 3
+        assert fleet["delta_passes"] == {"delta": 6, "fallback": 1}
+        assert doc["processes"]["supervisor"]["restarts"] == 3
+
+    def test_collect_tolerates_a_dead_source(self):
+        def boom():
+            raise RuntimeError("worker unreachable")
+        doc = telemetry.collect(extra={"worker": boom})
+        assert doc["processes"]["worker"]["error"].startswith(
+            "worker unreachable")
+        assert "operator" in doc["processes"]
+
+    def test_registered_source_lifecycle(self):
+        telemetry.register_source("x", lambda: {"queue_depth": 7})
+        try:
+            doc = telemetry.collect()
+            assert doc["processes"]["x"]["queue_depth"] == 7
+        finally:
+            telemetry.unregister_source("x")
+        assert "x" not in telemetry.collect()["processes"]
+
+    def test_render_html(self):
+        doc = telemetry.merge({"operator": telemetry.local_snapshot()})
+        html = telemetry.render_html(doc)
+        assert html.startswith("<!doctype html>")
+        assert "fleet" in html and "operator" in html
+
+
+# --------------------------------------------------------------------------
+# bench provenance
+# --------------------------------------------------------------------------
+class TestBenchProvenance:
+    def test_env_fingerprint_shape(self, monkeypatch):
+        sys.path.insert(0, REPO)
+        from benchmarks.common import env_fingerprint
+        monkeypatch.setenv("KARPENTER_TPU_DELTA", "off")
+        fp = env_fingerprint("cpu", reps=16,
+                             times_ms=[10.0, 12.0, 11.0, 30.0])
+        assert fp["platform"] == "cpu"
+        assert fp["reps"] == 16
+        assert fp["knobs"]["KARPENTER_TPU_DELTA"] == "off"
+        assert fp["ms_min"] == 10.0
+        assert fp["ms_p50"] == 11.5
+        assert "noise_discipline" in fp
+        assert fp.get("devices", 8) == 8  # conftest forces 8 virtual
+
+
+# --------------------------------------------------------------------------
+# the real supervised topology: trace continuity + dashboard
+# --------------------------------------------------------------------------
+def _worker_env(extra=None):
+    from tests.test_faults import worker_env
+    return worker_env(extra)
+
+
+@pytest.fixture(scope="module")
+def supervised_topology(tmp_path_factory):
+    """ONE supervised kt_solverd shared by the topology tests: the first
+    worker incarnation carries a crash fault (skip the catalog batch,
+    die inside the next one — the SIGKILL-mid-solve shape); the fault is
+    scrubbed after spawn, so every restarted worker is healthy."""
+    from tests.test_solver_service import build_daemon
+    build_daemon()
+    tmp = tmp_path_factory.mktemp("flight_topology")
+    sock = str(tmp / "kt.sock")
+    sup = SolverdSupervisor(
+        sock,
+        env=_worker_env({"KARPENTER_TPU_FAULTS":
+                         "solverd.handle_batch=crash::1:1"}),
+        extra_args=["--idle-ms", "10", "--max-ms", "100"],
+        stderr_path=str(tmp / "worker.stderr"),
+        backoff_base=0.2, backoff_max=1.0)
+    sup.start(wait_for_socket=True, timeout=60)
+    sup.env.pop("KARPENTER_TPU_FAULTS", None)
+    yield sup, sock
+    sup.stop()
+
+
+class TestTraceContinuityAcrossRestart:
+    def test_worker_crash_mid_solve_yields_one_stitched_trace(
+            self, supervised_topology):
+        """Satellite: the worker dies mid-solve, the supervisor restarts
+        it, the client's retry re-injects the SAME traceparent, and the
+        restarted worker's spans stitch into ONE trace on the original
+        trace id."""
+        from karpenter_tpu.service import (CircuitBreaker, RetryPolicy,
+                                           SolverServiceClient)
+        sup, sock = supervised_topology
+        client = SolverServiceClient(
+            sock, timeout=180,
+            retry=RetryPolicy(attempts=4, base_backoff=0.3,
+                              deadline=180),
+            breaker=CircuitBreaker(threshold=50))
+        tracing.set_enabled(True)
+        tracing.reset()
+        try:
+            with tracing.span("flight.restart_root") as sp:
+                tid = sp.trace_id
+                # batch 1 (catalog upload) passes the fault's `after`
+                # budget; batch 2 (this schedule) crashes the worker —
+                # when running solo this test pays the crash, after
+                # another topology test the budget may already be spent
+                # and the solve just succeeds (continuity still holds)
+                res = client.solve(mkinp("stitch", 10))
+            assert not res.unschedulable
+            finished = tracing.finished_traces(tid)
+            assert len(finished) == 1, (
+                "the restart must NOT fork the trace: one trace id, "
+                f"one entry — got {len(finished)}")
+            names = {s.name for s in finished[0][1]}
+            assert "service.solve_batch" in names
+            assert "solverd.solve_batch" in names, (
+                f"remote spans did not stitch back: {sorted(names)}")
+            # every span in the entry belongs to the ONE trace
+            assert {s.trace_id for s in finished[0][1]} == {tid}
+        finally:
+            tracing.set_enabled(None)
+            tracing.reset()
+            client.close()
+
+
+class TestDashboardSupervisedTopology:
+    def test_dashboard_merges_operator_supervisor_worker(
+            self, supervised_topology, fresh_recorder):
+        """Acceptance: GET /debug/dashboard returns ONE merged snapshot
+        covering operator + supervisor + solverd worker — queue depth,
+        shed, restarts, breaker state, delta split — against the real
+        supervised topology."""
+        from karpenter_tpu.operator.operator import Operator
+        sup, sock = supervised_topology
+        opts = Options(batch_idle_duration=0,
+                       solver_endpoint=sock,
+                       service_request_timeout=120.0,
+                       service_retry_attempts=3,
+                       service_breaker_threshold=50,
+                       service_local_fallback=False,
+                       solver_max_nodes=128)
+        op = Operator(options=opts, metrics_port=0, health_port=0)
+        op.serve()
+        try:
+            # prime the worker with a real solve (retry across any
+            # leftover crash-fault budget and the restarted worker's
+            # jax import)
+            client = op.env.solver.tpu
+            deadline = time.time() + 120
+            res = None
+            while time.time() < deadline:
+                try:
+                    res = client.solve(mkinp("dash", 8))
+                    break
+                except SolverServiceError:
+                    time.sleep(0.5)
+            assert res is not None and not res.unschedulable
+
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{op.metrics_port}"
+                    "/debug/dashboard", timeout=30) as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith(
+                    "application/json")
+                doc = json.loads(r.read().decode())
+
+            procs = doc["processes"]
+            assert set(procs) >= {"operator", "supervisor", "worker"}, \
+                sorted(procs)
+            # supervisor: worker-lifecycle state
+            assert procs["supervisor"]["running"] is True
+            assert procs["supervisor"]["restarts"] >= 0
+            assert procs["supervisor"]["worker_pid"] == sup.worker_pid
+            # worker: the stats RPC's telemetry snapshot + client view
+            worker = procs["worker"]
+            assert "stats" in worker and worker["stats"]["catalogs"] >= 1
+            assert worker["stats"]["batch_sizes"], \
+                "worker served no batches?"
+            assert worker["breaker"] == "closed"
+            assert "flight_tail" in worker, sorted(worker)
+            kinds = {rec.get("kind") for rec in worker["flight_tail"]}
+            assert "batch" in kinds or "solve" in kinds
+            # operator: its own registry view
+            assert "queue_depth" in procs["operator"]
+            # fleet rollup: the first-glance keys the acceptance names
+            fleet = doc["fleet"]
+            for key in ("queue_depth", "shed", "worker_restarts",
+                        "breaker_state", "delta_passes"):
+                assert key in fleet, key
+
+            # the HTML rendering serves from the same document
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{op.metrics_port}"
+                    "/debug/dashboard?format=html", timeout=30) as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith("text/html")
+                assert b"dashboard" in r.read()
+
+            # /debug/flight serves the operator-local ring
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{op.metrics_port}"
+                    "/debug/flight?limit=5", timeout=30) as r:
+                assert r.status == 200
+                assert "records" in json.loads(r.read().decode())
+
+            # /debug/traces carries the drop counter + honors ?limit=
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{op.metrics_port}"
+                    "/debug/traces?limit=3", timeout=30) as r:
+                assert r.status == 200
+                tdoc = json.loads(r.read().decode())
+                assert "spansDropped" in tdoc["otherData"]
+                assert tdoc["otherData"]["tracesReturned"] <= 3
+        finally:
+            client.close()
+            op.stop()
+
+    def test_dashboard_survives_a_dead_worker(self, supervised_topology):
+        """The dashboard must keep serving exactly when the fleet is
+        degraded: with the worker section unreachable the document still
+        renders, carrying the error."""
+        from karpenter_tpu.operator.operator import Operator
+        sup, sock = supervised_topology
+        opts = Options(batch_idle_duration=0,
+                       solver_endpoint=str(sock) + ".nowhere",
+                       service_request_timeout=2.0,
+                       service_retry_attempts=1,
+                       service_local_fallback=False,
+                       solver_max_nodes=128)
+        op = Operator(options=opts, metrics_port=0, health_port=0)
+        op.serve()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{op.metrics_port}"
+                    "/debug/dashboard", timeout=30) as r:
+                assert r.status == 200
+                doc = json.loads(r.read().decode())
+            assert "error" in doc["processes"]["worker"]
+            assert "operator" in doc["processes"]
+        finally:
+            op.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))
