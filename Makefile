@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test tier1 multichip lint native asan repro-crash
+.PHONY: test tier1 multichip lint native asan repro-crash saturation-smoke
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
@@ -32,6 +32,16 @@ multichip:
 	JAX_PLATFORMS=cpu $(PY) -m pytest \
 		tests/test_mesh_solver.py tests/test_solver_mesh.py \
 		-q -p no:cacheprovider
+
+# ~30 s in-process multi-tenant saturation check (ISSUE 11): 4 tenant
+# clients drive mixed traffic through the loopback window harness
+# (service/loopback.py — real framing + real backend, no native build);
+# asserts zero lost requests, zero sheds at this sizing, cross-tenant
+# fusion happening, and bit-exact parity vs solo solves.  The full
+# bench (8 tenants, native daemon, the >=2x fusion throughput gate) is
+# `python benchmarks/config8_saturation.py` -> BENCH_r09.json.
+saturation-smoke:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/config8_saturation.py --smoke
 
 lint:
 	$(PY) -m hack.analyze

@@ -113,7 +113,13 @@ class GatedSolver:
                     threshold=getattr(options,
                                       "service_breaker_threshold", 5),
                     cooldown=getattr(options,
-                                     "service_breaker_cooldown", 10.0)))
+                                     "service_breaker_cooldown", 10.0)),
+                # multi-tenant fleet identity (ISSUE 11): one cluster =
+                # one tenant by default, so a shared solverd queues this
+                # control plane fairly against its peer clusters
+                tenant=getattr(options, "service_tenant", None)
+                or getattr(options, "cluster_name", None),
+                priority=getattr(options, "service_priority", 0))
         else:
             from karpenter_tpu.solver import TPUSolver
             # SOLVER_MESH (options) configures the mesh story;

@@ -76,6 +76,12 @@ class Options:
     # the host oracle. Disable to keep the old endpoint->oracle-only
     # behavior (e.g. a control-plane host too small for a solver).
     service_local_fallback: bool = True
+    # multi-tenant solver fleet (ISSUE 11): the tenant name this control
+    # plane declares on every schedule frame (None = cluster_name — one
+    # cluster, one tenant), and the admission-control priority rank (the
+    # daemon sheds lowest priority first when a tenant queue is full)
+    service_tenant: "str | None" = None
+    service_priority: int = 0
     # HA: active/passive replicas racing a shared lease (core LEADER_ELECT;
     # charts/karpenter/values.yaml:35 runs 2 replicas). lease_file names a
     # FileLease shared by replicas on one host.
@@ -115,6 +121,11 @@ class Options:
             opts.service_local_fallback = (
                 os.environ["KARPENTER_TPU_SERVICE_LOCAL_FALLBACK"]
                 .strip().lower() in ("1", "true", "yes", "on"))
+        opts.service_tenant = os.environ.get(
+            "KARPENTER_TPU_TENANT", opts.service_tenant)
+        if "KARPENTER_TPU_PRIORITY" in os.environ:
+            opts.service_priority = int(
+                os.environ["KARPENTER_TPU_PRIORITY"])
         # SOLVER_MESH configures the mesh story.  The KARPENTER_TPU_MESH
         # rollback override is deliberately NOT parsed here: its single
         # grammar owner is TPUSolver._mesh_env_spec, applied inside

@@ -15,18 +15,22 @@ solver instead of hanging when the worker dies.
 from karpenter_tpu.service.client import (
     SolverServiceClient,
     SolverServiceError,
+    SolverServiceShed,
     SolverServiceTransportError,
     SolverServiceUnavailable,
 )
 from karpenter_tpu.service.resilience import CircuitBreaker, RetryPolicy
+from karpenter_tpu.service.scheduler import TenantScheduler
 from karpenter_tpu.service.supervisor import SolverdSupervisor
 
 __all__ = [
     "SolverServiceClient",
     "SolverServiceError",
+    "SolverServiceShed",
     "SolverServiceTransportError",
     "SolverServiceUnavailable",
     "CircuitBreaker",
     "RetryPolicy",
     "SolverdSupervisor",
+    "TenantScheduler",
 ]

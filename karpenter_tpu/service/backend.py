@@ -11,17 +11,30 @@ Requests are pickled tuples `(kind, body)`:
       list objects per fingerprint, TPUSolver's identity-keyed device
       cache holds across requests.
   ("schedule", {"fingerprint", "pods", "existing_nodes", "daemon_overhead",
-                "remaining_limits", "price_cap"})
-      One scheduling problem. All schedule requests in a batch that share
-      a fingerprint fuse into ONE vmapped device call (solve_batch).
+                "remaining_limits", "price_cap", "tenant", "priority",
+                "deadline"})
+      One scheduling problem.  Schedule requests flow through the
+      tenant-aware dispatcher (service/scheduler.py, ISSUE 11): bounded
+      per-tenant queues with weighted deficit-round-robin fairness,
+      priority-aware admission, and CROSS-TENANT fusion — requests whose
+      encoded problems land in the same padded (G,E,N) bucket fuse into
+      ONE vmapped device call even when they come from different
+      clusters.  The per-(fingerprint,max_nodes) fusion that used to
+      live inline here is now the inner stage of that scheduler.
 
 Responses: ("result", ScheduleResult) | ("ok", None) |
-           ("need_catalog", None) | ("error", message).
+           ("need_catalog", None) | ("error", message) |
+           ("shed", {reason, tenant, queue_depth, eta_ms,
+                     retry_after_ms})
+The shed body doubles as the backpressure hint; successful results carry
+the same hint as `result._backpressure` so clients pace retries from the
+server's own queue estimate.
 """
 
 from __future__ import annotations
 
 import pickle
+import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
@@ -30,14 +43,46 @@ from karpenter_tpu.utils import faults
 
 _catalogs: Dict[str, Tuple[list, dict]] = {}
 _solver = None
-# per-handle_batch sizes of the schedule groups actually fused onto the
+# per-dispatch sizes of the schedule groups actually fused onto the
 # device — exposed via the ("stats", _) request for tests/observability;
-# bounded so a long-running daemon doesn't grow it forever
+# bounded so a long-running daemon doesn't grow it forever.  Reset on
+# worker init (reset_worker_state) and snapshotted under _state_lock:
+# in-process harnesses restart the LOGICAL worker without restarting the
+# process, and stats() must never report pre-restart history.
 _batch_log: deque = deque(maxlen=1024)
-# requests shed because their caller's deadline had already passed when
-# the batch reached Python (the client frame's body["deadline"]) — the
-# daemon's half of the per-request-deadline contract, reported via stats
+# requests shed because their caller's deadline had already passed (at
+# ingest or while queued in the tenant scheduler) or because admission
+# control refused them — the daemon's half of the deadline/backpressure
+# contract, reported via stats
 _shed_count = 0
+# the tenant-aware dispatcher (built lazily so stats-only callers never
+# pay for it); guarded, like the counters above, by _state_lock
+_scheduler = None
+_state_lock = threading.Lock()
+
+
+def reset_worker_state() -> None:
+    """A fresh LOGICAL worker: clear the per-worker dispatch history
+    (batch log, shed count, tenant queues/ledgers).  Called by the
+    daemon right after importing this module (native/solverd.cc) and by
+    in-process harnesses (service/loopback.py) on start, so a restarted
+    worker never reports its predecessor's stats.  Uploaded catalogs
+    survive deliberately — they are content-addressed and the
+    need_catalog handshake re-validates them anyway."""
+    global _shed_count, _scheduler
+    with _state_lock:
+        _batch_log.clear()
+        _shed_count = 0
+        _scheduler = None
+
+
+def _get_scheduler():
+    global _scheduler
+    with _state_lock:
+        if _scheduler is None:
+            from karpenter_tpu.service.scheduler import TenantScheduler
+            _scheduler = TenantScheduler()
+        return _scheduler
 
 
 def _get_solver():
@@ -90,13 +135,13 @@ def _solve_group(inps: List, max_nodes: Optional[int] = None) -> List:
 
 
 def _flight_record_batch(fp: str, inps: List, results: List,
-                         max_nodes) -> None:
+                         max_nodes, tenants=()) -> None:
     """One flight record per fused solverd batch (the daemon's half of
     the request-record split): the catalog fingerprint the requests
-    referenced, per-request pod counts, and a bit-exact digest per
-    result — the solver's own per-attempt records carry the phase
-    detail; this row ties a wire batch to them.  Best-effort: the black
-    box must never fail a batch."""
+    referenced, per-request pod counts, the tenants the fusion mixed,
+    and a bit-exact digest per result — the solver's own per-attempt
+    records carry the phase detail; this row ties a wire batch to them.
+    Best-effort: the black box must never fail a batch."""
     try:
         from karpenter_tpu.utils import flightrecorder as fr
         from karpenter_tpu.utils import metrics, tracing
@@ -112,7 +157,8 @@ def _flight_record_batch(fp: str, inps: List, results: List,
             fingerprint=fp[:16] if isinstance(fp, str) else None,
             pods=sum(len(i.pods) for i in inps),
             groups=len(inps),
-            knobs={"max_nodes": max_nodes},
+            knobs={"max_nodes": max_nodes,
+                   "tenants": sorted(set(tenants))},
             phase_ms=dict(getattr(solver, "last_phase_ms", {}) or {})
             if solver is not None else {},
             delta=None,
@@ -126,7 +172,122 @@ def _flight_record_batch(fp: str, inps: List, results: List,
         pass
 
 
-def handle_batch(payloads: List[bytes]) -> List[bytes]:
+def _bucket_key(fp: str, max_nodes, body: dict) -> tuple:
+    """The fusion-bucket key: requests fuse only when their PADDED device
+    shapes match — same catalog fingerprint, same node-axis cap (a
+    static kernel shape), same padded group-count and existing-node
+    buckets.  This is exactly the jit-cache key the warmup lattice
+    pre-traces, so a cross-tenant fused batch reuses warmed programs
+    instead of opening new compile cliffs.  The group count normally
+    arrives as the client-computed `groups_hint` (so this daemon's
+    single batcher thread doesn't pay a second O(pods) grouping per
+    frame; a wrong hint only costs fusion efficiency — the solve
+    re-groups authoritatively); hintless frames run `group_pods` here,
+    and anything unexpected degrades the key to per-fingerprint
+    fusion — the pre-scheduler behavior — rather than failing the
+    request."""
+    try:
+        from karpenter_tpu.solver.encode import bucket, group_pods
+        from karpenter_tpu.solver.solve import E_BUCKETS, G_BUCKETS
+        hint = body.get("groups_hint")
+        n_groups = int(hint) if isinstance(hint, int) and hint > 0 \
+            else len(group_pods(body["pods"]))
+        g = bucket(max(n_groups, 1), G_BUCKETS)
+        e = bucket(len(body.get("existing_nodes") or []), E_BUCKETS)
+    except Exception:  # noqa: BLE001 — degrade, never refuse
+        g = e = None
+    return (fp, max_nodes, g, e)
+
+
+def _tenant_of(body: dict, conn_ids, i: int) -> str:
+    """Client-declared tenant, else a per-connection identity (each
+    control-plane replica's connection is its own tenant by default)."""
+    tenant = body.get("tenant")
+    if tenant:
+        return str(tenant)
+    if conn_ids is not None and i < len(conn_ids):
+        return f"conn-{conn_ids[i]}"
+    return "default"
+
+
+def _dispatch_fused(key, batch) -> List[tuple]:
+    """The inner dispatch stage: one fused (fingerprint, max_nodes,
+    bucket) group → one vmapped device call.  Runs OUTSIDE the
+    scheduler's queue lock (only the dispatcher election serializes it).
+    Returns one response tuple per batch item."""
+    from karpenter_tpu.scheduling import ScheduleInput
+    from karpenter_tpu.utils import tracing
+    fp, max_nodes = key[0], key[1]
+    with _state_lock:
+        _batch_log.append(len(batch))
+        cat = _catalogs.get(fp)
+    if cat is None:
+        # the catalog vanished between admission and dispatch (only
+        # possible through an in-process reset): the handshake recovers
+        return [("need_catalog", None)] * len(batch)
+    nodepools, instance_types = cat
+    inps = []
+    for item in batch:
+        _i, body = item.payload
+        inps.append(ScheduleInput(
+            pods=body["pods"],
+            nodepools=nodepools,
+            instance_types=instance_types,
+            existing_nodes=body.get("existing_nodes") or [],
+            daemon_overhead=body.get("daemon_overhead") or {},
+            remaining_limits=body.get("remaining_limits") or {},
+            price_cap=body.get("price_cap"),
+        ))
+    # stitch the fused solve into the CALLER's trace: extract the
+    # first traceparent in the group (a fused batch normally comes
+    # from one operator client), run the solve as its child, and ship
+    # the recorded spans back on each matching response — the spans
+    # belong to the caller's ring buffer, not this daemon's
+    tp = next((item.payload[1].get("traceparent") for item in batch
+               if item.payload[1].get("traceparent")), None)
+    ctx = tracing.extract(tp)
+    try:
+        with ctx:
+            with tracing.span("solverd.solve_batch", requests=len(batch),
+                              tenants=len({it.tenant for it in batch})):
+                results = _solve_group(inps, max_nodes=max_nodes)
+        _flight_record_batch(fp, inps, results, max_nodes,
+                             tenants=[it.tenant for it in batch])
+        hint = _get_scheduler().backpressure()
+        spans = [s.to_dict() for s in ctx.spans]
+        out: List[tuple] = []
+        for item, res in zip(batch, results):
+            if spans and item.payload[1].get("traceparent") == tp:
+                try:
+                    # exactly ONE response carries the group's spans: a
+                    # fused 60-sim batch attaching (and the client
+                    # adopting) the same list per result would
+                    # duplicate every span ~60x in the caller's trace
+                    res._remote_spans = spans
+                    spans = []
+                except AttributeError:
+                    pass  # a slotted result type: spans are best-effort
+            try:
+                # explicit backpressure: the client adapts its retry
+                # pacing to the daemon's own queue estimate instead of
+                # blind exponential backoff
+                res._backpressure = dict(hint)
+            except AttributeError:
+                pass
+            out.append(("result", res))
+        return out
+    except Exception as e:  # noqa: BLE001
+        return [("error", f"solve failed: {e}")] * len(batch)
+
+
+def handle_batch(payloads: List[bytes], conn_ids=None,
+                 backlog: int = 0) -> List[bytes]:
+    """One C++ window's worth of frames.  `conn_ids` (parallel to
+    `payloads`) carries the daemon's per-connection identities for the
+    default-tenant derivation; `backlog` is the window queue depth
+    BEHIND this batch, folded into every backpressure hint.  Both are
+    optional so in-process callers (tests, FakePySolverd) keep working
+    with bare payload lists."""
     global _shed_count
     from karpenter_tpu.scheduling import ScheduleInput
 
@@ -179,12 +340,21 @@ def handle_batch(payloads: List[bytes]) -> List[bytes]:
             # split, retraces, and flight-recorder tail reach the
             # operator's GET /debug/dashboard without the daemon
             # exposing its own HTTP surface (utils/telemetry.py merges
-            # it alongside the supervisor's and the operator's own)
+            # it alongside the supervisor's and the operator's own).
+            # The per-tenant scheduler section is how "one solver,
+            # many clusters" stays operable: queue depth, fairness
+            # share, shed and fusion counters per tenant.
             from karpenter_tpu.utils import telemetry
-            responses[i] = ("result", {"batch_sizes": list(_batch_log),
+            with _state_lock:
+                batch_sizes = list(_batch_log)
+                shed = _shed_count
+                sched = _scheduler
+            responses[i] = ("result", {"batch_sizes": batch_sizes,
                                        "catalogs": len(_catalogs),
-                                       "shed": _shed_count,
+                                       "shed": shed,
                                        "mesh": mesh_info,
+                                       "scheduler":
+                                           sched.stats() if sched else None,
                                        "telemetry":
                                            telemetry.local_snapshot()})
         elif kind == "warmup":
@@ -200,9 +370,10 @@ def handle_batch(payloads: List[bytes]) -> List[bytes]:
                 # whose caller already gave up would hold the single
                 # batcher thread through minutes of compile while real
                 # schedule requests wait behind it
-                _shed_count += 1
-                responses[i] = ("error",
-                                "deadline exceeded before warmup (shed)")
+                with _state_lock:
+                    _shed_count += 1
+                responses[i] = _get_scheduler().shed_inline(
+                    _tenant_of(body, conn_ids, i), "deadline")
                 continue
             fp = body.get("fingerprint")
             if fp not in _catalogs:
@@ -225,10 +396,12 @@ def handle_batch(payloads: List[bytes]) -> List[bytes]:
             except Exception as e:  # noqa: BLE001
                 responses[i] = ("error", f"warmup failed: {e}")
 
-    # schedule requests grouped by (catalog fingerprint, max_nodes) → one
-    # device batch per group (the coalescing the C++ window exists to
-    # enable; max_nodes is a static kernel shape, so it's a grouping key)
-    by_fp: Dict[tuple, List[int]] = {}
+    # schedule requests flow through the tenant scheduler: bounded
+    # per-tenant queues → weighted-DRR planning → cross-tenant
+    # bucket-fused device dispatches (_dispatch_fused is the inner
+    # stage the old inline (fingerprint, max_nodes) grouping became)
+    sched = None
+    items = []
     for i, req in enumerate(requests):
         if req is None or responses[i] is not None:
             continue
@@ -240,6 +413,7 @@ def handle_batch(payloads: List[bytes]) -> List[bytes]:
         if "pods" not in body:
             responses[i] = ("error", "schedule body missing pods")
             continue
+        tenant = _tenant_of(body, conn_ids, i)
         deadline = body.get("deadline")
         if deadline is not None and time.time() >= deadline:
             # the caller's deadline already passed (it timed out, fell
@@ -247,60 +421,33 @@ def handle_batch(payloads: List[bytes]) -> List[bytes]:
             # burns the device for a result nobody reads, and behind a
             # restart backlog it keeps the daemon permanently late —
             # shed instead (peers share this host's clock)
-            _shed_count += 1
-            responses[i] = ("error", "deadline exceeded before solve "
-                                     "(shed)")
+            with _state_lock:
+                _shed_count += 1
+            responses[i] = _get_scheduler().shed_inline(tenant, "deadline")
             continue
         if fp not in _catalogs:
             responses[i] = ("need_catalog", None)
             continue
-        by_fp.setdefault((fp, body.get("max_nodes")), []).append(i)
+        if sched is None:
+            sched = _get_scheduler()
+            sched.note_backlog(backlog)
 
-    for (fp, max_nodes), idxs in by_fp.items():
-        _batch_log.append(len(idxs))
-        nodepools, instance_types = _catalogs[fp]
-        inps = []
-        for i in idxs:
-            body = requests[i][1]
-            inps.append(ScheduleInput(
-                pods=body["pods"],
-                nodepools=nodepools,
-                instance_types=instance_types,
-                existing_nodes=body.get("existing_nodes") or [],
-                daemon_overhead=body.get("daemon_overhead") or {},
-                remaining_limits=body.get("remaining_limits") or {},
-                price_cap=body.get("price_cap"),
-            ))
-        # stitch the fused solve into the CALLER's trace: extract the
-        # first traceparent in the group (a fused batch normally comes
-        # from one operator client), run the solve as its child, and ship
-        # the recorded spans back on each matching response — the spans
-        # belong to the caller's ring buffer, not this daemon's
-        from karpenter_tpu.utils import tracing
-        tp = next((requests[i][1].get("traceparent") for i in idxs
-                   if requests[i][1].get("traceparent")), None)
-        ctx = tracing.extract(tp)
-        try:
-            with ctx:
-                with tracing.span("solverd.solve_batch", requests=len(idxs)):
-                    results = _solve_group(inps, max_nodes=max_nodes)
-            _flight_record_batch(fp, inps, results, max_nodes)
-            spans = [s.to_dict() for s in ctx.spans]
-            for i, res in zip(idxs, results):
-                responses[i] = ("result", res)
-                if spans and requests[i][1].get("traceparent") == tp:
-                    try:
-                        # exactly ONE response carries the group's spans: a
-                        # fused 60-sim batch attaching (and the client
-                        # adopting) the same list per result would
-                        # duplicate every span ~60x in the caller's trace
-                        res._remote_spans = spans
-                        spans = []
-                    except AttributeError:
-                        pass  # a slotted result type: spans are best-effort
-        except Exception as e:  # noqa: BLE001
-            for i in idxs:
-                responses[i] = ("error", f"solve failed: {e}")
+        def _respond(resp, i=i):
+            if resp[0] == "shed":
+                global _shed_count
+                with _state_lock:
+                    _shed_count += 1
+            responses[i] = resp
+
+        items.append(sched.submit(
+            key=_bucket_key(fp, body.get("max_nodes"), body),
+            tenant=tenant,
+            priority=int(body.get("priority") or 0),
+            deadline=deadline,
+            payload=(i, body),
+            respond=_respond))
+    if items:
+        sched.pump(items, _dispatch_fused)
 
     return [pickle.dumps(r, protocol=pickle.HIGHEST_PROTOCOL)
             for r in responses]

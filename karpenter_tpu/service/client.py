@@ -28,6 +28,16 @@ Transport failures (connect/send/receive/timeout) raise
 errors from a live daemon raise plain :class:`SolverServiceError` and
 are not (the daemon answering is proof the transport works).
 
+Multi-tenant (ISSUE 11): `tenant` and `priority` ride every schedule
+frame; the daemon's fair scheduler (service/scheduler.py) queues each
+tenant separately, sheds lowest-priority-first under pressure, and
+fuses bucket-compatible requests ACROSS tenants into one device call.
+A shed comes back as :class:`SolverServiceShed` — transport-class (so
+fallbacks engage) but breaker-neutral (the daemon answering is proof of
+life) — carrying the server's queue ETA, which `RetryPolicy.backoff`
+uses as the retry pace instead of the blind exponential ladder.  The
+latest backpressure hint is kept on `client.last_backpressure`.
+
 Mesh: the daemon owns the devices, so its mesh story is configured in
 ITS environment — `SOLVER_MESH` selects (backend._get_solver), and the
 `KARPENTER_TPU_MESH=off/auto/N` rollback knob overrides inside the
@@ -69,16 +79,65 @@ class SolverServiceUnavailable(SolverServiceError):
     """Fail-fast signal while the circuit breaker is open."""
 
 
+class SolverServiceShed(SolverServiceTransportError):
+    """The daemon ANSWERED but refused the request — admission control
+    (tenant queue full, lowest priority loses) or a deadline that passed
+    at ingest/while queued (ISSUE 11).
+
+    Transport-class so every existing fallback path (GatedSolver's
+    degraded mode, the provisioner's re-batch-next-pass discipline)
+    engages unchanged, but deliberately BREAKER-NEUTRAL: a daemon that
+    sheds is alive and load-managing, not down, so `_with_retries`
+    counts it a breaker success.  Carries the server's backpressure hint
+    (`retry_after` seconds, plus the raw `backpressure` dict) so the
+    retry pacing follows the daemon's own queue ETA instead of blind
+    exponential backoff."""
+
+    def __init__(self, msg: str, reason: str = "",
+                 retry_after: Optional[float] = None,
+                 backpressure: Optional[dict] = None):
+        super().__init__(msg)
+        self.reason = reason
+        self.retry_after = retry_after
+        self.backpressure = backpressure or {}
+
+    @classmethod
+    def from_body(cls, body) -> "SolverServiceShed":
+        if not isinstance(body, dict):
+            return cls(f"request shed by solver service: {body}")
+        reason = str(body.get("reason", ""))
+        ra = body.get("retry_after_ms")
+        return cls(
+            f"request shed by solver service (reason={reason or '?'}, "
+            f"queue_depth={body.get('queue_depth')}, "
+            f"eta_ms={body.get('eta_ms')})",
+            reason=reason,
+            retry_after=(float(ra) / 1e3) if ra else None,
+            backpressure=dict(body))
+
+
 class SolverServiceClient:
     def __init__(self, socket_path: str, timeout: float = 60.0,
                  retry: Optional[RetryPolicy] = None,
-                 breaker: Optional[CircuitBreaker] = None):
+                 breaker: Optional[CircuitBreaker] = None,
+                 tenant: Optional[str] = None, priority: int = 0):
         self.socket_path = socket_path
         self.timeout = timeout
         # the retry policy's deadline defaults to the legacy `timeout`
         # knob so existing constructors keep their wait bound
         self.retry = retry or RetryPolicy(deadline=timeout)
         self.breaker = breaker if breaker is not None else CircuitBreaker()
+        # multi-tenant identity (ISSUE 11): `tenant` rides every schedule
+        # frame so the daemon's fair scheduler queues this control plane
+        # under its own name (unset = the daemon derives a per-connection
+        # tenant); `priority` is the admission-control rank — when a
+        # tenant's queue is full the LOWEST priority is shed first
+        self.tenant = tenant
+        self.priority = int(priority)
+        # last backpressure hint the daemon shipped (on a result or a
+        # shed): {queue_depth, eta_ms, retry_after_ms} — callers can
+        # inspect it to pace their own submission rate
+        self.last_backpressure: Optional[dict] = None
         self._sock: Optional[socket.socket] = None
         self._wlock = threading.Lock()
         self._lock = threading.Lock()
@@ -272,6 +331,25 @@ class SolverServiceClient:
         while True:
             try:
                 out = fn(deadline)
+            except SolverServiceShed as e:
+                # the daemon answered: it is ALIVE and load-shedding, so
+                # the breaker records success (tripping it would demote
+                # the control plane to degraded mode exactly when the
+                # shared fleet is asking clients to pace themselves)
+                if br is not None:
+                    br.record_success()
+                remaining = deadline - time.time()
+                if e.reason == "deadline" or \
+                        attempt >= self.retry.attempts or remaining <= 0:
+                    # a deadline shed is not retryable — the budget this
+                    # request rode in on has already passed
+                    raise
+                metrics.SERVICE_RETRIES.inc()
+                # pace to the server's queue ETA, not the blind ladder
+                time.sleep(min(self.retry.backoff(
+                    attempt, retry_after=e.retry_after), remaining))
+                attempt += 1
+                continue
             except SolverServiceTransportError:
                 if br is not None:
                     br.record_failure()
@@ -401,31 +479,76 @@ class SolverServiceClient:
                     "service lost the catalog again after re-upload")
             return self._warmup_once(inp, fp, payload, shapes, batch_sizes,
                                      deadline, _catalog_retry=False)
+        if kind == "shed":
+            self.last_backpressure = body if isinstance(body, dict) else None
+            raise SolverServiceShed.from_body(body)
         if kind != "result":
             raise SolverServiceError(f"warmup failed: {body}")
         return int(body.get("warmed", 0))
 
     # -- the solver seam ---------------------------------------------------
-    def solve(self, inp: ScheduleInput,
-              max_nodes: Optional[int] = None) -> ScheduleResult:
-        return self.solve_batch([inp], max_nodes=max_nodes)[0]
+    def solve(self, inp: ScheduleInput, max_nodes: Optional[int] = None,
+              priority: Optional[int] = None) -> ScheduleResult:
+        return self.solve_batch([inp], max_nodes=max_nodes,
+                                priority=priority)[0]
 
     def solve_batch(self, inps: List[ScheduleInput],
-                    max_nodes: Optional[int] = None) -> List[ScheduleResult]:
+                    max_nodes: Optional[int] = None,
+                    priority: Optional[int] = None) -> List[ScheduleResult]:
         """`max_nodes` rides the schedule request so the disruption
         simulator's tiny-kernel cap survives the solverd deployment — the
-        shared-TPU shape the cap matters most for."""
+        shared-TPU shape the cap matters most for.  `priority` overrides
+        the client default for THIS call (a provisioning pass can outrank
+        this tenant's own background consolidation sims).
+
+        Shed handling is PARTIAL: results that arrived before/alongside
+        a shed are kept, and the retry re-sends only the still-missing
+        inputs — a 64-sim batch with one admission-shed member must not
+        double the offered load exactly when the daemon asked for
+        pacing.  (Schedule requests are stateless, so a transport-level
+        retry re-solving a kept input would also be harmless — this is
+        a load question, not a correctness one.)"""
         if not inps:
             return []
+        done: Dict[int, ScheduleResult] = {}
+
+        def once(deadline):
+            todo = [i for i in range(len(inps)) if i not in done]
+            partial: Dict[int, ScheduleResult] = {}
+            try:
+                got = self._solve_batch_once(
+                    [inps[i] for i in todo], max_nodes, deadline,
+                    priority=priority, partial=partial)
+            except SolverServiceShed:
+                for j, r in partial.items():
+                    done[todo[j]] = r
+                raise
+            for j, r in enumerate(got):
+                done[todo[j]] = r
+            return [done[i] for i in range(len(inps))]
+
         with tracing.span("service.solve_batch", requests=len(inps)):
-            return self._with_retries(
-                lambda deadline: self._solve_batch_once(
-                    inps, max_nodes, deadline))
+            return self._with_retries(once)
+
+    @staticmethod
+    def _groups_hint(inp: ScheduleInput) -> Optional[int]:
+        """Pod-class count computed CLIENT-side so the daemon's single
+        batcher thread doesn't pay a second O(pods) grouping pass per
+        frame just to derive the fusion-bucket key (the solve re-groups
+        authoritatively anyway; a wrong hint only costs fusion
+        efficiency, never correctness)."""
+        try:
+            from karpenter_tpu.solver.encode import group_pods
+            return len(group_pods(inp.pods))
+        except Exception:  # noqa: BLE001 — hint only
+            return None
 
     def _solve_batch_once(self, inps: List[ScheduleInput],
                           max_nodes: Optional[int],
                           deadline: float,
-                          _catalog_retry: bool = True
+                          _catalog_retry: bool = True,
+                          priority: Optional[int] = None,
+                          partial: Optional[Dict[int, ScheduleResult]] = None
                           ) -> List[ScheduleResult]:
         fp, payload = self._fingerprint(inps[0])
         self._ensure_catalog(fp, payload, deadline)
@@ -437,7 +560,7 @@ class SolverServiceClient:
         for inp in inps:
             f, p = self._fingerprint(inp)
             self._ensure_catalog(f, p, deadline)
-            rids.append(self._send("schedule", {
+            body = {
                 "fingerprint": f,
                 "pods": inp.pods,
                 "existing_nodes": inp.existing_nodes,
@@ -449,12 +572,23 @@ class SolverServiceClient:
                 # the daemon sheds a request whose caller's deadline has
                 # already passed (peers share this host's clock)
                 "deadline": deadline,
-            }))
-        out: List[ScheduleResult] = []
+                # tenant/priority ride every frame so the daemon's fair
+                # scheduler queues this cluster under its own identity
+                "priority": self.priority if priority is None
+                else int(priority),
+                "groups_hint": self._groups_hint(inp),
+            }
+            if self.tenant is not None:
+                body["tenant"] = self.tenant
+            rids.append(self._send("schedule", body))
+        results_pos: Dict[int, ScheduleResult] = {}
+        shed_exc: Optional[SolverServiceShed] = None
         lost_catalog = False
+        waited = 0
         try:
-            for rid in rids:
+            for pos, rid in enumerate(rids):
                 kind, body = self._wait(rid, deadline)
+                waited = pos + 1
                 if kind == "result":
                     remote_spans = getattr(body, "_remote_spans", None)
                     if remote_spans:
@@ -463,18 +597,38 @@ class SolverServiceClient:
                             del body._remote_spans
                         except AttributeError:
                             pass
-                    out.append(body)
+                    bp = getattr(body, "_backpressure", None)
+                    if bp is not None:
+                        # the daemon's queue estimate rides every result:
+                        # keep the latest hint for retry pacing and for
+                        # callers that adapt their own submission rate
+                        self.last_backpressure = bp
+                        try:
+                            del body._backpressure
+                        except AttributeError:
+                            pass
+                    results_pos[pos] = body
                 elif kind == "need_catalog":
                     lost_catalog = True
                     break
+                elif kind == "shed":
+                    # keep DRAINING: the other frames were already sent
+                    # and (mostly) answered — abandoning them would turn
+                    # one shed into a whole-batch retry, doubling the
+                    # offered load exactly when the daemon asked for
+                    # pacing.  The first shed's hint is what we raise.
+                    self.last_backpressure = body \
+                        if isinstance(body, dict) else None
+                    if shed_exc is None:
+                        shed_exc = SolverServiceShed.from_body(body)
                 else:
                     raise SolverServiceError(f"solver service error: {body}")
         finally:
             # on early exit, abandon the remaining rids so their pending
             # events and later-arriving responses don't accumulate forever
-            if len(out) < len(rids):
+            if waited < len(rids):
                 with self._lock:
-                    for rid in rids[len(out):]:
+                    for rid in rids[waited:]:
                         self._pending.pop(rid, None)
                         self._responses.pop(rid, None)
         if lost_catalog:
@@ -489,5 +643,11 @@ class SolverServiceClient:
                 raise SolverServiceError(
                     "service lost the catalog again after re-upload")
             return self._solve_batch_once(inps, max_nodes, deadline,
-                                          _catalog_retry=False)
-        return out
+                                          _catalog_retry=False,
+                                          priority=priority,
+                                          partial=partial)
+        if shed_exc is not None:
+            if partial is not None:
+                partial.update(results_pos)
+            raise shed_exc
+        return [results_pos[i] for i in range(len(rids))]

@@ -54,10 +54,25 @@ class RetryPolicy:
     jitter: float = 0.2
     deadline: float = 60.0
 
-    def backoff(self, attempt: int) -> float:
-        """Sleep before retry number `attempt` (1-based)."""
-        raw = min(self.base_backoff * (self.multiplier ** (attempt - 1)),
-                  self.max_backoff)
+    def backoff(self, attempt: int,
+                retry_after: "float | None" = None) -> float:
+        """Sleep before retry number `attempt` (1-based).
+
+        `retry_after` (seconds) is the server's backpressure hint — the
+        queue-ETA the solverd scheduler ships on every shed response
+        (ISSUE 11).  When present it REPLACES the exponential ladder:
+        the server knows its own line length, so the client paces to
+        that estimate (clamped to `max_backoff`, floored at
+        `base_backoff` so a zero/cold hint cannot busy-spin) instead of
+        blindly doubling.  Jitter still applies either way — a fleet of
+        shed clients pacing to one shared ETA would otherwise stampede
+        back in lockstep."""
+        if retry_after is not None and retry_after > 0:
+            raw = min(max(float(retry_after), self.base_backoff),
+                      self.max_backoff)
+        else:
+            raw = min(self.base_backoff * (self.multiplier ** (attempt - 1)),
+                      self.max_backoff)
         if self.jitter <= 0:
             return raw
         span = raw * self.jitter

@@ -78,6 +78,15 @@ class Counter(_Metric):
     def value(self, **labels) -> float:
         return self._values.get(self._key(labels), 0.0)
 
+    def remove(self, **labels) -> None:
+        """Drop one series — for label values whose identity is
+        process-ephemeral and can never recur (the tenant scheduler's
+        connection-derived tenants), keeping the series would grow the
+        exposition unboundedly; the reference deletes vanished
+        per-type series the same way."""
+        with self._lock:
+            self._values.pop(self._key(labels), None)
+
     def render(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} {self.kind}"]
@@ -93,14 +102,9 @@ class Gauge(Counter):
     def set(self, value: float, **labels) -> None:
         with self._lock:
             self._values[self._key(labels)] = value
-
-    def remove(self, **labels) -> None:
-        """Drop one series — catalog gauges delete series for vanished
-        types/offerings on rebuild (the reference deletes per-type series
-        the same way), or a removed offering keeps reporting stale
-        values forever."""
-        with self._lock:
-            self._values.pop(self._key(labels), None)
+    # remove() inherited: catalog gauges delete series for vanished
+    # types/offerings on rebuild, or a removed offering keeps reporting
+    # stale values forever
 
 
 class Histogram(_Metric):
@@ -343,6 +347,39 @@ SERVICE_WORKER_RESTARTS = _c(
     "Supervised kt_solverd worker processes restarted after an "
     "unexpected exit (crash containment; a climbing series means a "
     "crash loop the backoff is absorbing).")
+# -- multi-tenant solverd dispatch (ISSUE 11): the tenant-aware
+# -- scheduler's observable half — per-tenant demand/fairness/shedding
+# -- and the cross-tenant fusion the shared fleet's throughput rides on
+SERVICE_TENANT_REQUESTS = _c(
+    "karpenter_tpu_service_tenant_requests_total",
+    "Schedule requests admitted to the solverd tenant scheduler, by "
+    "tenant (the client-declared tenant field; connection-derived when "
+    "absent). Per-tenant share of this family is the fairness "
+    "denominator.", ("tenant",))
+SERVICE_TENANT_SHED = _c(
+    "karpenter_tpu_service_tenant_shed_total",
+    "Requests the tenant scheduler shed, counted never silent: "
+    "reason=admission (queue at its bound, lowest priority loses), "
+    "reason=deadline (the caller's deadline passed at ingest or while "
+    "queued). Every shed is answered with an explicit shed response "
+    "carrying the backpressure hint.", ("tenant", "reason"))
+SERVICE_TENANT_QUEUE_DEPTH = _g(
+    "karpenter_tpu_service_tenant_queue_depth",
+    "Requests currently waiting in one tenant's scheduler queue "
+    "(excludes the C++ window backlog, which rides the backpressure "
+    "hints instead).", ("tenant",))
+SERVICE_FUSED_BATCHES = _c(
+    "karpenter_tpu_service_fused_batches_total",
+    "Fused device dispatches by whether the batch mixed tenants "
+    "(cross_tenant=yes/no). A healthy shared fleet under concurrent "
+    "compatible traffic runs mostly yes; all-no under multi-tenant "
+    "load means buckets aren't aligning (check catalog fingerprints "
+    "and the warmup lattice).", ("cross_tenant",))
+SERVICE_FUSED_BATCH_SIZE = REGISTRY.histogram(
+    "karpenter_tpu_service_fused_batch_size",
+    "Requests per fused solverd device dispatch (the occupancy the "
+    "saturation bench gates on).",
+    buckets=(1, 2, 4, 8, 16, 32, 64))
 SOLVER_RESIDUE_PODS = _c(
     "karpenter_tpu_solver_residue_pods_total",
     "Pods solved host-side as split-solve residue.")

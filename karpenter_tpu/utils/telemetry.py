@@ -128,6 +128,16 @@ def local_snapshot(flight_tail: int = 16) -> dict:
             "breaker_state": metrics.SERVICE_BREAKER_STATE.value(),
             "worker_restarts": metrics.SERVICE_WORKER_RESTARTS.value(),
         },
+        # multi-tenant dispatch (ISSUE 11): per-tenant demand/queues/
+        # sheds and the cross-tenant fusion counters — in the solverd
+        # worker these are the live series; in other processes they stay
+        # empty dicts and merge() skips them
+        "tenants": {
+            "queue_depth": _series(metrics.SERVICE_TENANT_QUEUE_DEPTH),
+            "requests": _series(metrics.SERVICE_TENANT_REQUESTS),
+            "shed": _series(metrics.SERVICE_TENANT_SHED),
+            "fused_batches": _series(metrics.SERVICE_FUSED_BATCHES),
+        },
         "retraces": sum(_series(metrics.SOLVER_RETRACES).values()),
         "device_memory_peak_bytes":
             metrics.SOLVER_DEVICE_MEMORY_PEAK.value(),
@@ -205,6 +215,35 @@ def merge(snapshots: Dict[str, dict]) -> dict:
             for k, v in passes.items():
                 fleet["delta_passes"][k] = \
                     fleet["delta_passes"].get(k, 0) + v
+    # per-tenant rollup (the shared-fleet first-glance questions: who is
+    # queued, who is being shed, what share of service each tenant got):
+    # requests/sheds sum across processes; the fairness share normalizes
+    # against the fleet total
+    tenants: Dict[str, dict] = {}
+    for s in snapshots.values():
+        sect = s.get("tenants")
+        if not isinstance(sect, dict):
+            continue
+        for t, v in (sect.get("requests") or {}).items():
+            tenants.setdefault(t, {"requests": 0, "shed": 0,
+                                   "queue_depth": 0})
+            tenants[t]["requests"] += v
+        for t, v in (sect.get("queue_depth") or {}).items():
+            tenants.setdefault(t, {"requests": 0, "shed": 0,
+                                   "queue_depth": 0})
+            tenants[t]["queue_depth"] += v
+        for key, v in (sect.get("shed") or {}).items():
+            # label key is "tenant/reason" — reason never contains "/"
+            t = key.rsplit("/", 1)[0]
+            tenants.setdefault(t, {"requests": 0, "shed": 0,
+                                   "queue_depth": 0})
+            tenants[t]["shed"] += v
+    total_req = sum(v["requests"] for v in tenants.values())
+    for v in tenants.values():
+        v["share"] = round(v["requests"] / total_req, 4) if total_req \
+            else 0.0
+    if tenants:
+        fleet["tenants"] = tenants
     return {"generated_at": time.time(),
             "processes": snapshots,
             "fleet": fleet}

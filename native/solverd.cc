@@ -55,6 +55,10 @@ struct Conn {
   int fd;
   std::mutex write_mu;
   std::atomic<bool> open{true};
+  // per-connection identity, forwarded to the Python backend so the
+  // tenant scheduler can default a frame with no client-declared tenant
+  // to "this connection" (one control-plane replica = one tenant)
+  uint64_t id = 0;
 };
 
 struct Request {
@@ -77,6 +81,7 @@ struct Batcher {
 
 Batcher g_batcher;
 std::atomic<bool> g_stop{false};
+std::atomic<uint64_t> g_conn_seq{0};
 int g_listen_fd = -1;
 
 bool read_exact(int fd, void* buf, size_t n) {
@@ -177,20 +182,33 @@ std::vector<Request> collect_batch() {
   return batch;
 }
 
-// One embedded-Python call per batch: handle_batch(list[bytes]) -> list[bytes]
+// One embedded-Python call per batch:
+//   handle_batch(list[bytes], list[int] conn_ids, int backlog) -> list[bytes]
+// conn_ids parallels the payload list (the tenant scheduler's default
+// per-connection tenant identity); backlog is the window queue depth
+// BEHIND this batch, which the scheduler folds into its backpressure
+// hints so clients see the whole line, not just the Python-side slice.
 // Caller must hold the GIL (the batcher thread's PERSISTENT thread state —
 // see the batcher thread body for why per-batch PyGILState_Ensure/Release
 // cycling deadlocked the second MLIR lowering).
-void dispatch_batch(PyObject* handler, std::vector<Request>& batch) {
+void dispatch_batch(PyObject* handler, std::vector<Request>& batch,
+                    size_t backlog) {
   PyObject* payloads = PyList_New(static_cast<Py_ssize_t>(batch.size()));
+  PyObject* conn_ids = PyList_New(static_cast<Py_ssize_t>(batch.size()));
   for (size_t i = 0; i < batch.size(); ++i) {
     PyList_SET_ITEM(
         payloads, static_cast<Py_ssize_t>(i),
         PyBytes_FromStringAndSize(batch[i].payload.data(),
                                   static_cast<Py_ssize_t>(batch[i].payload.size())));
+    PyList_SET_ITEM(
+        conn_ids, static_cast<Py_ssize_t>(i),
+        PyLong_FromUnsignedLongLong(batch[i].conn->id));
   }
-  PyObject* out = PyObject_CallOneArg(handler, payloads);
+  PyObject* out = PyObject_CallFunction(
+      handler, "(OOn)", payloads, conn_ids,
+      static_cast<Py_ssize_t>(backlog));
   Py_DECREF(payloads);
+  Py_DECREF(conn_ids);
   if (out == nullptr) {
     PyErr_Print();
     const char kErr[] = "\x80\x04N.";  // pickled None = internal error marker
@@ -259,6 +277,18 @@ int main(int argc, char** argv) {
     PyErr_Print();
     return 1;
   }
+  // a fresh worker must never report a predecessor's dispatch history:
+  // let the backend clear its logical-worker state (batch log, shed
+  // counters, tenant queues) before the first batch. Optional — an
+  // older/minimal backend without the hook still serves.
+  PyObject* reset = PyObject_GetAttrString(module, "reset_worker_state");
+  if (reset != nullptr && PyCallable_Check(reset)) {
+    PyObject* r = PyObject_CallNoArgs(reset);
+    if (r == nullptr) PyErr_Print();
+    Py_XDECREF(r);
+  }
+  PyErr_Clear();
+  Py_XDECREF(reset);
   PyObject* handler = PyObject_GetAttrString(module, "handle_batch");
   Py_DECREF(module);
   if (handler == nullptr || !PyCallable_Check(handler)) {
@@ -305,8 +335,16 @@ int main(int argc, char** argv) {
     while (!g_stop.load()) {
       std::vector<Request> batch = collect_batch();
       if (batch.empty()) continue;
+      size_t backlog = 0;
+      {
+        // requests still queued behind this window: the scheduler's
+        // backpressure hints count them so a client's retry pacing
+        // sees the real line length
+        std::lock_guard<std::mutex> lock(g_batcher.mu);
+        backlog = g_batcher.queue.size();
+      }
       PyEval_RestoreThread(self_state);
-      dispatch_batch(handler, batch);
+      dispatch_batch(handler, batch, backlog);
       self_state = PyEval_SaveThread();
     }
     PyEval_RestoreThread(self_state);
@@ -321,6 +359,7 @@ int main(int argc, char** argv) {
     }
     auto conn = std::make_shared<Conn>();
     conn->fd = cfd;
+    conn->id = ++g_conn_seq;
     // detach immediately: each reader owns its connection and exits on
     // disconnect; keeping joinable handles would accumulate one zombie
     // thread per reconnecting replica for the daemon's lifetime

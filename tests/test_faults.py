@@ -187,8 +187,11 @@ class TestDeadlineShedding:
             "fingerprint": "nope", "pods": [],
             "deadline": time.time() - 5.0}))
         (resp,) = backend.handle_batch([req])
-        kind, msg = pickle.loads(resp)
-        assert kind == "error" and "deadline" in msg
+        kind, body = pickle.loads(resp)
+        # ISSUE 11: sheds are an explicit response kind carrying the
+        # scheduler's backpressure hint, not a bare error string
+        assert kind == "shed" and body["reason"] == "deadline"
+        assert "queue_depth" in body and "retry_after_ms" in body
         assert backend._shed_count == before + 1
 
     def test_live_deadline_not_shed(self):
@@ -508,7 +511,11 @@ class TestCrashLoopProvisioning:
             sock, binary=worker,
             env=dict(os.environ, FAKE_WORKER_MODE="exit"),
             backoff_base=0.05, backoff_max=0.3, max_restarts=50)
-        sup.start(wait_for_socket=True, timeout=15)
+        # wait_ready returns the moment the supervisor gives up; 50
+        # fake-worker incarnations cost ~0.65 s each on a slow host
+        # (python startup + backoff), so the bound must cover the WHOLE
+        # crash loop, not an optimistic 15 s slice of it
+        sup.start(wait_for_socket=True, timeout=60)
         opts = Options(batch_idle_duration=0,
                        solver_endpoint=sock,
                        service_request_timeout=1.0,

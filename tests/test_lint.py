@@ -422,6 +422,70 @@ def test_lock_discipline_negatives(tmp_path):
     assert findings == []
 
 
+# the tenant scheduler's lock split (service/scheduler.py, ISSUE 11):
+# the QUEUE lock must never be held across a device dispatch — plan
+# under the lock, dispatch outside it.  These fixtures encode the
+# positive (dispatch's blocking tail under the queue lock) and negative
+# (the module's actual snapshot-then-dispatch shape) variants so the
+# rule keeps guarding the new queue module's pattern.
+_QUEUE_LOCK_BAD = """
+    import threading
+    import time
+
+
+    class BadScheduler:
+        def __init__(self):
+            self._queue_lock = threading.Lock()
+            self.items = []
+
+        def drain(self, solve):
+            with self._queue_lock:
+                batch = list(self.items)
+                out = solve(batch)
+                out.block_until_ready()
+                time.sleep(0.01)
+            return out
+"""
+
+_QUEUE_LOCK_GOOD = """
+    import threading
+
+
+    class GoodScheduler:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._done_cv = threading.Condition()
+            self.items = []
+
+        def drain(self, solve):
+            with self._lock:
+                batch = list(self.items)
+                del self.items[:]
+            out = solve(batch)          # device call OUTSIDE the lock
+            out.block_until_ready()
+            with self._done_cv:
+                self._done_cv.notify_all()
+            return out
+
+        def pump_wait(self):
+            with self._done_cv:
+                self._done_cv.wait(0.05)
+"""
+
+
+def test_lock_discipline_flags_dispatch_under_queue_lock(tmp_path):
+    findings, _ = _check(tmp_path, _QUEUE_LOCK_BAD, lock_discipline)
+    msgs = " | ".join(f.message for f in findings)
+    assert ".block_until_ready()" in msgs
+    assert "time.sleep" in msgs
+    assert len(findings) == 2
+
+
+def test_lock_discipline_accepts_snapshot_then_dispatch(tmp_path):
+    findings, _ = _check(tmp_path, _QUEUE_LOCK_GOOD, lock_discipline)
+    assert findings == []
+
+
 def test_lock_discipline_flock(tmp_path):
     findings, _ = _check(tmp_path, """
         import fcntl

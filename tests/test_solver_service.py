@@ -213,6 +213,44 @@ class TestSolverService:
             res = client.solve(inp)
             assert not res.unschedulable, f"lowering #{classes} wedged"
 
+    def test_cross_tenant_requests_fuse_in_one_batch(self, daemon, client):
+        """ISSUE 11: two DIFFERENT tenants (separate clients/connections)
+        issuing bucket-compatible solves concurrently must fuse into a
+        cross-tenant device batch, with per-tenant accounting in the
+        stats RPC and a backpressure hint on every result."""
+        client.solve(mkinp("xwarm"))  # catalog + compile out of the way
+        a = SolverServiceClient(daemon, timeout=120, tenant="cluster-a")
+        b = SolverServiceClient(daemon, timeout=120, tenant="cluster-b")
+        try:
+            before = a.stats()["scheduler"] or {}
+            cross0 = before.get("cross_tenant_batches", 0)
+            outs = {}
+            start = threading.Barrier(2)
+
+            def call(c, tag):
+                # solve_batch ships its frames back-to-back, so the two
+                # tenants' requests land inside one batching window
+                start.wait()
+                outs[tag] = c.solve_batch(
+                    [mkinp(f"{tag}{i}", n=10 + i) for i in range(2)])
+
+            ts = [threading.Thread(target=call, args=(a, "ta")),
+                  threading.Thread(target=call, args=(b, "tb"))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert all(not r.unschedulable
+                       for rs in outs.values() for r in rs)
+            st = a.stats()["scheduler"]
+            assert {"cluster-a", "cluster-b"} <= set(st["tenants"])
+            assert st["tenants"]["cluster-a"]["dispatched"] >= 2
+            assert st["cross_tenant_batches"] >= cross0 + 1
+            assert a.last_backpressure is not None
+        finally:
+            a.close()
+            b.close()
+
     def test_error_response_on_garbage(self, daemon):
         s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         s.connect(daemon)
