@@ -3,7 +3,8 @@
 
 PY ?= python
 
-.PHONY: test tier1 multichip lint native asan repro-crash saturation-smoke
+.PHONY: test tier1 multichip lint analyze analyze-fast native asan tsan \
+	repro-crash repro-crash-tsan saturation-smoke
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
@@ -43,14 +44,29 @@ multichip:
 saturation-smoke:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/config8_saturation.py --smoke
 
-lint:
+# `lint` is the historical name; `analyze` is canonical — one recipe.
+lint: analyze
+
+# The full static-analysis suite (ISSUE 12): per-file rules PLUS the
+# whole-program families — interprocedural lock-order, env-knob grammar
+# ownership, and the Python<->C++ wire-protocol cross-check.  `analyze`
+# is the tier-1 gate invocation; `analyze-fast` skips the
+# interprocedural pass for pre-commit latency (~1 s vs ~4 s).
+# Runbook for reading a lock-order finding: docs/static-analysis.md.
+analyze:
 	$(PY) -m hack.analyze
+
+analyze-fast:
+	$(PY) -m hack.analyze --fast
 
 native:
 	$(MAKE) -C native
 
 asan:
 	$(MAKE) -C native asan
+
+tsan:
+	$(MAKE) -C native tsan
 
 # Drive the ASan-instrumented solverd through the historical
 # second-MLIR-lowering crash sequence (hack/repro_mlir_crash.py: three
@@ -67,3 +83,27 @@ repro-crash: asan
 	$(PY) hack/repro_mlir_crash.py --rounds 3 \
 		> native/build/asan/repro-report.txt 2>&1; \
 	rc=$$?; cat native/build/asan/repro-report.txt; exit $$rc
+
+# The same regression harness under ThreadSanitizer (ISSUE 12): drives
+# the TSan daemon through the 3-round distinct-bucket compile sequence
+# and fails on (a) the harness reproducing the wedge, or (b) ANY
+# unsuppressed TSan report — native/tsan.supp pins the known-benign
+# CPython/XLA/libgcc noise, so a new WARNING here is a new cross-thread
+# bug in solverd.cc (this gate caught the detached-reader vs
+# ~Batcher-at-exit race).  Reports archive under native/build/tsan/.
+repro-crash-tsan: tsan
+	mkdir -p native/build/tsan
+	rm -f native/build/tsan/tsan-report.*
+	TSAN_OPTIONS="suppressions=$(CURDIR)/native/tsan.supp:log_path=$(CURDIR)/native/build/tsan/tsan-report" \
+	KT_SOLVERD=native/build/tsan/kt_solverd \
+	JAX_PLATFORMS=cpu KARPENTER_TPU_FORCE_CPU=1 \
+	$(PY) hack/repro_mlir_crash.py --rounds 3 \
+		> native/build/tsan/repro-report.txt 2>&1; \
+	rc=$$?; cat native/build/tsan/repro-report.txt; \
+	if [ $$rc -ne 0 ]; then exit $$rc; fi; \
+	if grep -l "WARNING: ThreadSanitizer" native/build/tsan/tsan-report.* 2>/dev/null; then \
+		echo "UNSUPPRESSED TSAN REPORT(S):"; \
+		grep -A20 "WARNING: ThreadSanitizer" native/build/tsan/tsan-report.*; \
+		exit 1; \
+	fi; \
+	echo "repro-crash-tsan: clean (zero unsuppressed TSan reports)"

@@ -11,6 +11,8 @@ Options:
                         (the documented workflow for adopting a rule on
                         legacy code — see docs/static-analysis.md)
   --skip-metrics-docs   skip the import-based metrics-docs check
+  --fast                skip interprocedural program rules (lock-order)
+                        — the pre-commit profile; `make analyze-fast`
   --list-rules          print rule names and exit
 """
 
@@ -25,7 +27,7 @@ from typing import List
 
 from hack.analyze import core
 from hack.analyze.core import Finding
-from hack.analyze.rules import ALL_RULES, RULE_NAMES
+from hack.analyze.rules import ALL_RULES, PROGRAM_RULES, RULE_NAMES
 
 
 def _metrics_docs_findings() -> List[Finding]:
@@ -65,6 +67,8 @@ def main(argv=None) -> int:
     ap.add_argument("--no-baseline", action="store_true")
     ap.add_argument("--write-baseline", action="store_true")
     ap.add_argument("--skip-metrics-docs", action="store_true")
+    ap.add_argument("--fast", action="store_true",
+                    help="skip interprocedural program rules")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -75,7 +79,10 @@ def main(argv=None) -> int:
     paths = args.paths or ["karpenter_tpu"]
     baseline = [] if (args.no_baseline or args.write_baseline) \
         else core.load_baseline(args.baseline)
-    report = core.run(paths, baseline=baseline, rules=list(ALL_RULES))
+    program = [r for r in PROGRAM_RULES
+               if not (args.fast and getattr(r, "INTERPROCEDURAL", False))]
+    report = core.run(paths, baseline=baseline,
+                      rules=list(ALL_RULES) + program)
     if not args.skip_metrics_docs:
         report.findings.extend(_metrics_docs_findings())
 

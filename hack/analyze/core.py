@@ -202,12 +202,37 @@ def run(paths: Iterable[str], root: str = REPO,
         rules: Optional[list] = None) -> Report:
     """Analyze every .py under `paths`; partition findings against the
     suppressions and the baseline. `rules` overrides the registry (tests
-    exercise one family at a time)."""
-    from hack.analyze.rules import ALL_RULES
-    active = ALL_RULES if rules is None else rules
+    exercise one family at a time).
+
+    Two rule shapes coexist in one list (ISSUE 12): a module exporting
+    `check(ctx)` runs per file; one exporting `check_program(ctxs, root)`
+    runs ONCE over every parsed file — the whole-program families
+    (lock-order, env-knob ownership, wire-protocol conformance) need the
+    complete picture before they can say anything.  Suppressions and the
+    baseline apply identically to both; a program finding in a file we
+    did not parse (docs, native/*.cc) simply has no suppression site."""
+    from hack.analyze.rules import ALL_RULES, PROGRAM_RULES
+    active = list(ALL_RULES) + list(PROGRAM_RULES) if rules is None \
+        else list(rules)
+    file_rules = [r for r in active if hasattr(r, "check")]
+    program_rules = [r for r in active if hasattr(r, "check_program")]
     baseline = load_baseline() if baseline is None else baseline
     report = Report()
     matched_entries: Set[int] = set()
+    contexts: List[FileContext] = []
+    by_rel: Dict[str, FileContext] = {}
+
+    def _partition(f: Finding, ctx: Optional[FileContext]) -> None:
+        if ctx is not None and ctx.is_suppressed(f.rule, f.line):
+            report.suppressed.append(f)
+            return
+        hit = [i for i, e in enumerate(baseline) if baseline_matches(e, f)]
+        if hit:
+            matched_entries.update(hit)
+            report.baselined.append(f)
+        else:
+            report.findings.append(f)
+
     for path in iter_py_files(paths, root=root):
         try:
             ctx = FileContext(path, root=root)
@@ -218,18 +243,20 @@ def run(paths: Iterable[str], root: str = REPO,
                 message=f"file does not parse: {e}", snippet=""))
             continue
         report.files += 1
-        for rule in active:
+        contexts.append(ctx)
+        by_rel[ctx.rel] = ctx
+        for rule in file_rules:
             for f in rule.check(ctx):
-                if ctx.is_suppressed(f.rule, f.line):
-                    report.suppressed.append(f)
-                    continue
-                hit = [i for i, e in enumerate(baseline)
-                       if baseline_matches(e, f)]
-                if hit:
-                    matched_entries.update(hit)
-                    report.baselined.append(f)
-                else:
-                    report.findings.append(f)
+                _partition(f, ctx)
+    for rule in program_rules:
+        for f in rule.check_program(contexts, root=root):
+            _partition(f, by_rel.get(f.path))
+    # staleness is judged only against rule families that RAN: a
+    # baselined lock-order entry must not read as stale under --fast
+    # (which deliberately skips the interprocedural family)
+    active_names = {getattr(r, "RULE_NAME", None)
+                    for r in file_rules + program_rules}
     report.stale_baseline = [e for i, e in enumerate(baseline)
-                             if i not in matched_entries]
+                             if i not in matched_entries
+                             and e.get("rule") in active_names]
     return report

@@ -1,0 +1,120 @@
+"""The `KARPENTER_TPU_*` knob registry (ISSUE 12): one row per knob,
+naming its single parsing owner, its grammar kind, and (implicitly, via
+the env-knob rule) its documentation obligation in docs/operations.md.
+
+This file is the source of truth the `env-knob` rule
+(hack/analyze/rules/env_knobs.py) enforces mechanically:
+
+  * every knob READ in `karpenter_tpu/` must have a row here — an
+    unregistered knob is a finding;
+  * every knob is parsed in exactly ONE module — the `owner` below; a
+    read anywhere else is the "two drifting parsers" failure the PR 6
+    KARPENTER_TPU_MESH incident taught us (options.py and solve.py each
+    grew a grammar; they disagreed);
+  * `kind == "bool"` knobs must parse through
+    `karpenter_tpu.utils.knobs.env_bool` — symmetric `1/true/yes/on` vs
+    `0/false/no/off` synonyms by construction (before ISSUE 12,
+    `KARPENTER_TPU_FORCE_CPU=0` *forced CPU*);
+  * every knob must have a table row in docs/operations.md;
+  * a registry row whose knob is no longer read anywhere is stale and
+    fails, exactly like a stale baseline entry.
+
+`kind` values: "bool" (env_bool grammar), "spec" (a mini-grammar owned
+by one function — on/off/auto/N, a fault plan, a path-or-1), "value"
+(string/number read verbatim, malformed values degrade per owner).
+"""
+
+# knob name -> {"owner": repo-relative module, "kind": bool|spec|value}
+KNOBS = {
+    "KARPENTER_TPU_BIND_HOST": {
+        "owner": "karpenter_tpu/utils/knobs.py", "kind": "value"},
+    "KARPENTER_TPU_COALESCE": {
+        "owner": "karpenter_tpu/solver/solve.py", "kind": "spec"},
+    "KARPENTER_TPU_DELTA": {
+        "owner": "karpenter_tpu/solver/solve.py", "kind": "spec"},
+    "KARPENTER_TPU_FAULTS": {
+        "owner": "karpenter_tpu/utils/faults.py", "kind": "spec"},
+    "KARPENTER_TPU_FLIGHT": {
+        "owner": "karpenter_tpu/utils/flightrecorder.py", "kind": "bool"},
+    "KARPENTER_TPU_FLIGHT_BUFFER": {
+        "owner": "karpenter_tpu/utils/flightrecorder.py", "kind": "value"},
+    "KARPENTER_TPU_FLIGHT_CAPTURE": {
+        "owner": "karpenter_tpu/utils/flightrecorder.py", "kind": "bool"},
+    "KARPENTER_TPU_FLIGHT_DIR": {
+        "owner": "karpenter_tpu/utils/flightrecorder.py", "kind": "value"},
+    "KARPENTER_TPU_FORCE_CPU": {
+        "owner": "karpenter_tpu/utils/platform.py", "kind": "bool"},
+    "KARPENTER_TPU_HEALTH_PORT": {
+        "owner": "karpenter_tpu/operator/operator.py", "kind": "value"},
+    "KARPENTER_TPU_LEASE_FILE": {
+        "owner": "karpenter_tpu/operator/operator.py", "kind": "value"},
+    "KARPENTER_TPU_LOCK_OBSERVER": {
+        "owner": "karpenter_tpu/utils/lockwatch.py", "kind": "bool"},
+    "KARPENTER_TPU_MASK_BITS": {
+        "owner": "karpenter_tpu/solver/solve.py", "kind": "spec"},
+    "KARPENTER_TPU_MAX_NODES": {
+        "owner": "karpenter_tpu/service/backend.py", "kind": "value"},
+    "KARPENTER_TPU_MESH": {
+        "owner": "karpenter_tpu/solver/solve.py", "kind": "spec"},
+    "KARPENTER_TPU_METRICS_PORT": {
+        "owner": "karpenter_tpu/operator/operator.py", "kind": "value"},
+    "KARPENTER_TPU_NEW_TOPK": {
+        "owner": "karpenter_tpu/solver/solve.py", "kind": "value"},
+    "KARPENTER_TPU_NO_COMPILE_CACHE": {
+        "owner": "karpenter_tpu/utils/platform.py", "kind": "bool"},
+    "KARPENTER_TPU_NO_NATIVE": {
+        "owner": "karpenter_tpu/native/__init__.py", "kind": "bool"},
+    "KARPENTER_TPU_PIPELINE": {
+        "owner": "karpenter_tpu/solver/pipeline.py", "kind": "spec"},
+    "KARPENTER_TPU_PLATFORM": {
+        "owner": "karpenter_tpu/utils/platform.py", "kind": "value"},
+    "KARPENTER_TPU_PRIORITY": {
+        "owner": "karpenter_tpu/operator/options.py", "kind": "value"},
+    "KARPENTER_TPU_PROBE_TIMEOUT": {
+        "owner": "karpenter_tpu/utils/platform.py", "kind": "value"},
+    "KARPENTER_TPU_PROFILE": {
+        "owner": "karpenter_tpu/utils/profiling.py", "kind": "spec"},
+    "KARPENTER_TPU_PROFILE_DIR": {
+        "owner": "karpenter_tpu/utils/profiling.py", "kind": "value"},
+    "KARPENTER_TPU_PROFILE_PORT": {
+        "owner": "karpenter_tpu/utils/profiling.py", "kind": "value"},
+    "KARPENTER_TPU_RELAX_BUDGET": {
+        "owner": "karpenter_tpu/solver/solve.py", "kind": "spec"},
+    "KARPENTER_TPU_REPLICA_ID": {
+        "owner": "karpenter_tpu/operator/operator.py", "kind": "value"},
+    "KARPENTER_TPU_SERVICE_BREAKER_COOLDOWN": {
+        "owner": "karpenter_tpu/operator/options.py", "kind": "value"},
+    "KARPENTER_TPU_SERVICE_BREAKER_THRESHOLD": {
+        "owner": "karpenter_tpu/operator/options.py", "kind": "value"},
+    "KARPENTER_TPU_SERVICE_LOCAL_FALLBACK": {
+        "owner": "karpenter_tpu/operator/options.py", "kind": "bool"},
+    "KARPENTER_TPU_SERVICE_RETRIES": {
+        "owner": "karpenter_tpu/operator/options.py", "kind": "value"},
+    "KARPENTER_TPU_SERVICE_TIMEOUT": {
+        "owner": "karpenter_tpu/operator/options.py", "kind": "value"},
+    "KARPENTER_TPU_STORE_BACKEND": {
+        "owner": "karpenter_tpu/env.py", "kind": "value"},
+    "KARPENTER_TPU_STORE_SOCKET": {
+        "owner": "karpenter_tpu/operator/operator.py", "kind": "value"},
+    "KARPENTER_TPU_SWEEP_TOPK": {
+        "owner": "karpenter_tpu/solver/solve.py", "kind": "value"},
+    "KARPENTER_TPU_TENANT": {
+        "owner": "karpenter_tpu/operator/options.py", "kind": "value"},
+    "KARPENTER_TPU_TENANT_FUSE": {
+        "owner": "karpenter_tpu/service/scheduler.py", "kind": "bool"},
+    "KARPENTER_TPU_TENANT_MAX_FUSE": {
+        "owner": "karpenter_tpu/service/scheduler.py", "kind": "value"},
+    "KARPENTER_TPU_TENANT_QUANTUM": {
+        "owner": "karpenter_tpu/service/scheduler.py", "kind": "value"},
+    "KARPENTER_TPU_TENANT_QUEUE": {
+        "owner": "karpenter_tpu/service/scheduler.py", "kind": "value"},
+    "KARPENTER_TPU_TENANT_WEIGHTS": {
+        "owner": "karpenter_tpu/service/scheduler.py", "kind": "value"},
+    "KARPENTER_TPU_TRACE": {
+        "owner": "karpenter_tpu/utils/tracing.py", "kind": "bool"},
+    "KARPENTER_TPU_TRACE_BUFFER": {
+        "owner": "karpenter_tpu/utils/tracing.py", "kind": "value"},
+    "KARPENTER_TPU_WARMUP": {
+        "owner": "karpenter_tpu/controllers/provisioning.py",
+        "kind": "bool"},
+}

@@ -1,15 +1,23 @@
-"""kt-lint rule registry. Each rule module exports RULE_NAME and
-`check(ctx: FileContext) -> Iterator[Finding]`."""
+"""kt-lint rule registry.  Per-file rules export RULE_NAME and
+`check(ctx: FileContext) -> Iterator[Finding]`; whole-program rules
+(ISSUE 12) export RULE_NAME and `check_program(ctxs, root) ->
+Iterator[Finding]` (plus INTERPROCEDURAL = True when `--fast` should
+skip them)."""
 
 from hack.analyze.rules import (
+    env_knobs,
     exception_hygiene,
     jit_purity,
     lock_discipline,
+    lock_order,
     observability,
     socket_discipline,
+    wire_protocol,
 )
 
 ALL_RULES = (jit_purity, lock_discipline, exception_hygiene, observability,
              socket_discipline)
 
-RULE_NAMES = tuple(r.RULE_NAME for r in ALL_RULES)
+PROGRAM_RULES = (lock_order, env_knobs, wire_protocol)
+
+RULE_NAMES = tuple(r.RULE_NAME for r in ALL_RULES + PROGRAM_RULES)

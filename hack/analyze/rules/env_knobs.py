@@ -1,0 +1,232 @@
+"""env-knob: grammar ownership for every `KARPENTER_TPU_*` knob.
+
+Whole-program rule (ISSUE 12).  The failure class: PR 6 found TWO
+parsers of `KARPENTER_TPU_MESH` drifting apart (options.py accepted
+specs the solver rejected), and before ISSUE 12 `FORCE_CPU=0` *forced
+CPU* because the gate was bare truthiness.  The registry
+(hack/analyze/knob_registry.py) names one owner and one grammar kind
+per knob; this rule enforces:
+
+  * every knob read in the tree has a registry row (unregistered →
+    finding);
+  * all reads of a knob live in its owner module (a second parser →
+    finding at the offending site);
+  * `kind == "bool"` knobs parse ONLY through
+    `karpenter_tpu.utils.knobs.env_bool` (symmetric on/off synonyms by
+    construction);
+  * every knob has a backticked table row in docs/operations.md;
+  * registry rows whose knob is read nowhere are stale.
+
+"Read" detection covers the idioms the tree actually uses: direct
+`os.environ.get/[]/pop`, `"K" in os.environ` membership, `os.getenv`,
+`env = os.environ` aliases, module-level name constants
+(`_ENV_GATE = "KARPENTER_TPU_TRACE"`), and calls into env-reader
+helpers — any function whose body reads the environment through one of
+its own parameters (`env_bool`, solve.py's `_link_knob`) counts its
+call sites, with the knob literal resolved at the call site."""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from hack.analyze.core import FileContext, Finding
+
+RULE_NAME = "env-knob"
+
+_PREFIX = "KARPENTER_TPU_"
+
+
+def _is_environ_expr(expr: ast.AST, aliases: Set[str]) -> bool:
+    if isinstance(expr, ast.Attribute) and expr.attr == "environ":
+        return True
+    return isinstance(expr, ast.Name) and expr.id in aliases
+
+
+def _collect_env_aliases(tree: ast.AST) -> Set[str]:
+    """Names bound to an expression involving `*.environ` anywhere in
+    the file: `env = os.environ` in a constructor, and knobs.py's
+    `env = os.environ if environ is None else environ` — either way
+    `env.get(...)` is a read."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                any(isinstance(sub, ast.Attribute) and
+                    sub.attr == "environ"
+                    for sub in ast.walk(node.value)):
+            out.add(node.targets[0].id)
+    return out
+
+
+def _module_consts(tree: ast.AST) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _literal(expr: ast.AST, consts: Dict[str, str]) -> Optional[str]:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value
+    if isinstance(expr, ast.Name):
+        return consts.get(expr.id)
+    return None
+
+
+def _reader_helpers(ctxs: List[FileContext]) -> Set[str]:
+    """Function names whose body reads the environment keyed by one of
+    their OWN parameters — their call sites are knob reads.  `env_bool`
+    is seeded unconditionally: it is the canonical boolean parser
+    (utils/knobs.py) and a path-restricted run that excludes knobs.py
+    must still count its call sites as reads, or every env_bool-owned
+    knob false-positives as stale on subset runs."""
+    helpers: Set[str] = {"env_bool"}
+    for ctx in ctxs:
+        aliases = _collect_env_aliases(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            params = {a.arg for a in node.args.args
+                      + node.args.kwonlyargs + node.args.posonlyargs}
+            for sub in ast.walk(node):
+                key: Optional[ast.AST] = None
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Attribute) and \
+                        sub.func.attr in ("get", "pop") and \
+                        _is_environ_expr(sub.func.value, aliases) and \
+                        sub.args:
+                    key = sub.args[0]
+                elif isinstance(sub, ast.Subscript) and \
+                        _is_environ_expr(sub.value, aliases):
+                    key = sub.slice
+                if isinstance(key, ast.Name) and key.id in params:
+                    helpers.add(node.name)
+                    break
+    return helpers
+
+
+def _iter_reads(ctx: FileContext, helpers: Set[str]) \
+        -> Iterator[Tuple[str, ast.AST, str]]:
+    """(knob, node, via) for every env read in one file.  `via` is
+    "env_bool" for the canonical boolean helper, the helper name for
+    other reader helpers, "environ" otherwise."""
+    aliases = _collect_env_aliases(ctx.tree)
+    consts = _module_consts(ctx.tree)
+
+    def knob_of(expr: ast.AST) -> Optional[str]:
+        lit = _literal(expr, consts)
+        if lit and lit.startswith(_PREFIX) and lit != _PREFIX:
+            return lit
+        return None
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else \
+                (fn.id if isinstance(fn, ast.Name) else "")
+            # `.get` only: `.pop` on an env dict is a scrub (building a
+            # child process environment), not a parse
+            if name == "get" and isinstance(fn, ast.Attribute) \
+                    and _is_environ_expr(fn.value, aliases) and node.args:
+                knob = knob_of(node.args[0])
+                if knob:
+                    yield knob, node, "environ"
+            elif name == "getenv" and node.args:
+                knob = knob_of(node.args[0])
+                if knob:
+                    yield knob, node, "environ"
+            elif name in helpers:
+                for arg in list(node.args) + \
+                        [kw.value for kw in node.keywords]:
+                    knob = knob_of(arg)
+                    if knob:
+                        yield knob, node, \
+                            "env_bool" if name == "env_bool" else name
+        elif isinstance(node, ast.Subscript) and \
+                _is_environ_expr(node.value, aliases):
+            knob = knob_of(node.slice)
+            if knob:
+                yield knob, node, "environ"
+        elif isinstance(node, ast.Compare) and \
+                len(node.ops) == 1 and \
+                isinstance(node.ops[0], (ast.In, ast.NotIn)) and \
+                _is_environ_expr(node.comparators[0], aliases):
+            knob = knob_of(node.left)
+            if knob:
+                yield knob, node, "membership"
+
+
+def _documented_knobs(root: str) -> Optional[Set[str]]:
+    path = os.path.join(root, "docs", "operations.md")
+    if not os.path.exists(path):
+        return None  # fixture tree without docs: skip the doc check
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    return set(re.findall(r"^\|\s*`(KARPENTER_TPU_[A-Z0-9_]+)`",
+                          text, flags=re.MULTILINE))
+
+
+def check_program(ctxs: List[FileContext], root: str = "") \
+        -> Iterator[Finding]:
+    from hack.analyze.knob_registry import KNOBS
+    helpers = _reader_helpers(ctxs)
+    reads: Dict[str, List[Tuple[FileContext, ast.AST, str]]] = {}
+    for ctx in ctxs:
+        for knob, node, via in _iter_reads(ctx, helpers):
+            reads.setdefault(knob, []).append((ctx, node, via))
+
+    docs = _documented_knobs(root)
+    for knob in sorted(reads):
+        sites = reads[knob]
+        entry = KNOBS.get(knob)
+        if entry is None:
+            ctx, node, _via = sites[0]
+            yield ctx.finding(
+                RULE_NAME, node,
+                f"`{knob}` is read here but has no row in "
+                "hack/analyze/knob_registry.py — register its owner, "
+                "kind, and document it in docs/operations.md")
+            continue
+        owner = entry["owner"]
+        for ctx, node, via in sites:
+            if ctx.rel != owner:
+                yield ctx.finding(
+                    RULE_NAME, node,
+                    f"`{knob}` parsed outside its owner ({owner}) — two "
+                    "drifting grammars is the PR 6 MESH failure; route "
+                    "this read through the owner module")
+            if entry["kind"] == "bool" and via != "env_bool":
+                yield ctx.finding(
+                    RULE_NAME, node,
+                    f"boolean knob `{knob}` parsed without "
+                    "utils.knobs.env_bool — hand-rolled truthiness is "
+                    "how FORCE_CPU=0 forced CPU; use env_bool for "
+                    "symmetric on/off synonyms")
+        if docs is not None and knob not in docs:
+            yield Finding(
+                rule=RULE_NAME, path="docs/operations.md", line=1,
+                symbol="<doc>",
+                message=f"`{knob}` is read in karpenter_tpu/ but has no "
+                        "table row here — every knob gets a documented "
+                        "default and rollback story",
+                snippet="")
+    # stale registry rows: a row is stale only when its OWNER module was
+    # part of this run and still produced no read — fixture trees that
+    # lack the owners entirely stay quiet
+    analyzed = {ctx.rel for ctx in ctxs}
+    for knob in sorted(set(KNOBS) - set(reads)):
+        if KNOBS[knob]["owner"] in analyzed:
+            yield Finding(
+                rule=RULE_NAME, path="hack/analyze/knob_registry.py",
+                line=1, symbol="<registry>",
+                message=f"registry row for `{knob}` matches no read in "
+                        "the analyzed tree — the knob was removed; "
+                        "delete its row (and its docs table row)",
+                snippet="")

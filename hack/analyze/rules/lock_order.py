@@ -1,0 +1,523 @@
+"""lock-order: whole-program, interprocedural lock-acquisition analysis.
+
+`lock-discipline` (per-function) catches a blocking call textually under
+a `with lock:`.  What it cannot see is everything PR 7 and PR 10 taught
+us to fear: a lock acquired while another is held *three calls away*, a
+pair of locks taken in opposite orders by two different subsystems, a
+`Thread.join` waiting on a thread that needs the lock the joiner holds.
+This rule builds the program-wide picture:
+
+  * **lock definitions** — every `threading.Lock()/RLock()/Condition()`
+    assigned to `self.X` in a class or to a module-level name.
+    `Condition(self.other)` aliases to the lock it wraps (acquiring the
+    condition IS acquiring that lock — utils/batcher.py's `_wake`).
+  * **acquisition graph** — `with <lock>:` blocks and
+    `acquire()/release()` pairs, with calls resolved interprocedurally
+    (self-methods, module functions, `from karpenter_tpu.x import y`
+    module aliases, and a unique-global-method fallback for everything
+    else), so "holds A, eventually acquires B" edges survive any number
+    of helper hops.
+  * **order inversions** — both A→B and B→A present in the graph: the
+    classic two-thread deadlock, reported once per pair with both
+    witness chains.
+  * **double-acquire across call chains** — a non-reentrant lock
+    re-acquired through ≥1 call while held (the direct `with`-inside-
+    `with` form stays lock-discipline's).
+  * **held across join/queue-get/device** — `Thread.join`,
+    `Queue.get`-style waits, or device dispatch
+    (`block_until_ready`/`device_put`/`device_get`) reached through a
+    call chain while a lock is held (direct device-under-lock is
+    lock-discipline's; direct join/get is ours).
+  * **condition-wait without a predicate loop** — a bare `.wait()` on a
+    known Condition (or a `*cv`/`*cond` receiver) with no enclosing
+    `while`/`for`: wakeups are spurious by contract; `wait_for`
+    carries its own predicate and is always fine.
+
+The dynamic half lives in `karpenter_tpu/utils/lockwatch.py`:
+`build_model()` below exports the edge set plus a construction-site →
+lock-id map, and the conftest-armed observer fails the suite when a
+REAL acquisition edge contradicts this graph — the graph is validated
+by execution, not trusted.
+
+Heuristics are deliberately conservative: an unresolvable call (a
+callback parameter, a non-unique method name) contributes no edges.
+The scheduler's designed exception — `_dispatch_fn_lock` held across
+the device dispatch, with the queue lock never held there — survives
+this rule because the dispatch callback is exactly such a parameter;
+the queue-lock half is enforced by lock-discipline's fixtures.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from hack.analyze.core import FileContext, Finding
+
+RULE_NAME = "lock-order"
+INTERPROCEDURAL = True  # `make analyze-fast` skips this family
+
+_LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "condition"}
+# method names too generic to trust the unique-global-method fallback
+_GENERIC = {"get", "put", "set", "add", "pop", "close", "run", "start",
+            "stop", "send", "recv", "wait", "notify", "notify_all",
+            "acquire", "release", "items", "keys", "values", "append",
+            "clear", "update", "copy", "join", "read", "write", "load",
+            "list", "flush", "submit", "next", "push", "insert", "remove",
+            "fire", "record", "observe", "inc", "collect", "connect"}
+_THREADISH = ("thread", "worker", "monitor", "reader", "proc", "process",
+              "batcher")
+_QUEUEISH = ("queue", "q", "jobs", "inbox")
+_DEVICE_OPS = {"block_until_ready", "device_put", "device_get"}
+
+
+@dataclass
+class LockDef:
+    lock_id: str          # "<rel>::Class.attr" | "<rel>::name"
+    display: str          # "Class.attr" | "module name"
+    site: str             # "<rel>:<line>" — matches lockwatch's identity
+    kind: str             # lock | rlock | condition
+    alias_of: Optional[str] = None
+
+
+@dataclass
+class FuncInfo:
+    key: Tuple[str, str]              # (rel, qualname)
+    node: ast.AST
+    ctx: FileContext
+    class_name: Optional[str]
+    direct_acquires: List[Tuple[str, ast.AST]] = field(default_factory=list)
+    # (callee_key, held lock-ids, call node)
+    calls: List[Tuple[Tuple[str, str], Tuple[str, ...], ast.AST]] = \
+        field(default_factory=list)
+    # (reason, call node, held lock-ids)
+    blocking: List[Tuple[str, ast.AST, Tuple[str, ...]]] = \
+        field(default_factory=list)
+
+
+class Model:
+    def __init__(self) -> None:
+        self.locks: Dict[str, LockDef] = {}
+        # (rel, class_name or "", attr/name) -> lock_id
+        self.by_owner: Dict[Tuple[str, str, str], str] = {}
+        self.funcs: Dict[Tuple[str, str], FuncInfo] = {}
+        self.method_index: Dict[str, List[Tuple[str, str]]] = {}
+        # edges: (held_id, acquired_id) -> (FuncInfo, node, chain)
+        self.edges: Dict[Tuple[str, str],
+                         Tuple[FuncInfo, ast.AST, List[str]]] = {}
+        self.findings: List[Finding] = []
+
+    def canon(self, lock_id: str) -> str:
+        seen = set()
+        while True:
+            d = self.locks.get(lock_id)
+            if d is None or d.alias_of is None or lock_id in seen:
+                return lock_id
+            seen.add(lock_id)
+            lock_id = d.alias_of
+
+    def site_to_id(self) -> Dict[str, str]:
+        return {d.site: self.canon(d.lock_id) for d in self.locks.values()}
+
+
+def _ctor_kind(call: ast.AST) -> Optional[str]:
+    """threading.Lock()/RLock()/Condition() (or the bare imported
+    names) -> kind."""
+    if not isinstance(call, ast.Call):
+        return None
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name) \
+            and fn.value.id == "threading":
+        return _LOCK_CTORS.get(fn.attr)
+    if isinstance(fn, ast.Name):
+        return _LOCK_CTORS.get(fn.id)
+    return None
+
+
+def _enclosing_class(ctx: FileContext, node: ast.AST) -> Optional[str]:
+    cur = ctx.parent(node)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur.name
+        cur = ctx.parent(cur)
+    return None
+
+
+def _collect_locks(model: Model, ctx: FileContext) -> None:
+    pending_alias: List[Tuple[str, ast.AST]] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        kind = _ctor_kind(node.value)
+        if kind is None:
+            continue
+        tgt = node.targets[0]
+        if isinstance(tgt, ast.Attribute) and \
+                isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+            cls = _enclosing_class(ctx, node) or ""
+            owner = (ctx.rel, cls, tgt.attr)
+            display = f"{cls}.{tgt.attr}" if cls else tgt.attr
+        elif isinstance(tgt, ast.Name) and \
+                not isinstance(ctx.parent(node), (ast.FunctionDef,
+                                                  ast.AsyncFunctionDef)):
+            # module-level lock (only when not a function local)
+            fn_scope = False
+            cur = ctx.parent(node)
+            while cur is not None:
+                if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fn_scope = True
+                    break
+                cur = ctx.parent(cur)
+            if fn_scope:
+                continue
+            owner = (ctx.rel, "", tgt.id)
+            display = f"{ctx.rel}:{tgt.id}"
+        else:
+            continue
+        lock_id = f"{ctx.rel}::{owner[1]}.{owner[2]}" if owner[1] \
+            else f"{ctx.rel}::{owner[2]}"
+        model.locks[lock_id] = LockDef(
+            lock_id=lock_id, display=display,
+            site=f"{ctx.rel}:{node.value.lineno}", kind=kind)
+        model.by_owner[owner] = lock_id
+        if kind == "condition" and node.value.args:
+            pending_alias.append((lock_id, node.value.args[0]))
+    for lock_id, arg in pending_alias:
+        target = _resolve_lock_expr_raw(model, ctx, arg)
+        if target is not None and target != lock_id:
+            model.locks[lock_id].alias_of = target
+
+
+def _resolve_lock_expr_raw(model: Model, ctx: FileContext,
+                           expr: ast.AST) -> Optional[str]:
+    cls = _enclosing_class(ctx, expr)
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == "self":
+        # the enclosing class first, then any single class in this file
+        # defining the attr (helper objects share modules, not classes)
+        lid = model.by_owner.get((ctx.rel, cls or "", expr.attr))
+        if lid:
+            return lid
+        hits = [v for (rel, c, a), v in model.by_owner.items()
+                if rel == ctx.rel and a == expr.attr and c]
+        return hits[0] if len(hits) == 1 else None
+    if isinstance(expr, ast.Name):
+        return model.by_owner.get((ctx.rel, "", expr.id))
+    return None
+
+
+def _resolve_lock_expr(model: Model, ctx: FileContext,
+                       expr: ast.AST) -> Optional[str]:
+    lid = _resolve_lock_expr_raw(model, ctx, expr)
+    return model.canon(lid) if lid else None
+
+
+def _module_aliases(ctx: FileContext) -> Dict[str, str]:
+    """import alias -> candidate repo-relative module path (without
+    checking existence; resolution happens against parsed files)."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                dotted = f"{node.module}.{a.name}"
+                out[a.asname or a.name] = dotted.replace(".", "/")
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = \
+                    a.name.replace(".", "/")
+    return out
+
+
+def _index_functions(model: Model, ctx: FileContext) -> None:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qn = ctx.qualname(node)
+            key = (ctx.rel, qn)
+            fi = FuncInfo(key=key, node=node, ctx=ctx,
+                          class_name=_enclosing_class(ctx, node))
+            model.funcs[key] = fi
+            model.method_index.setdefault(node.name, []).append(key)
+
+
+def _resolve_call(model: Model, fi: FuncInfo, call: ast.Call,
+                  aliases: Dict[str, str]) -> Optional[Tuple[str, str]]:
+    fn = call.func
+    ctx = fi.ctx
+    if isinstance(fn, ast.Name):
+        key = (ctx.rel, fn.id)
+        if key in model.funcs:
+            return key
+        return None
+    if not isinstance(fn, ast.Attribute):
+        return None
+    name = fn.attr
+    base = fn.value
+    if isinstance(base, ast.Name) and base.id == "self" and fi.class_name:
+        key = (ctx.rel, f"{fi.class_name}.{name}")
+        if key in model.funcs:
+            return key
+    if isinstance(base, ast.Name) and base.id in aliases:
+        mod = aliases[base.id]
+        for rel in (f"{mod}.py", f"{mod}/__init__.py"):
+            key = (rel, name)
+            if key in model.funcs:
+                return key
+    # unique-global-method fallback — only for distinctive names
+    if name in _GENERIC or name.startswith("__"):
+        return None
+    hits = model.method_index.get(name, [])
+    if len(hits) == 1:
+        return hits[0]
+    return None
+
+
+def _in_loop(ctx: FileContext, node: ast.AST,
+             func_node: ast.AST) -> bool:
+    cur = ctx.parent(node)
+    while cur is not None and cur is not func_node:
+        if isinstance(cur, (ast.While, ast.For)):
+            return True
+        cur = ctx.parent(cur)
+    return False
+
+
+def _blocking_reason(model: Model, ctx: FileContext,
+                     call: ast.Call) -> Optional[str]:
+    fn = call.func
+    if not isinstance(fn, ast.Attribute):
+        return None
+    recv = ""
+    if isinstance(fn.value, ast.Attribute):
+        recv = fn.value.attr
+    elif isinstance(fn.value, ast.Name):
+        recv = fn.value.id
+    recv_l = recv.lstrip("_").lower()
+    if fn.attr in _DEVICE_OPS:
+        return f".{fn.attr}() (device dispatch)"
+    if fn.attr == "join" and \
+            any(recv_l.endswith(t) for t in _THREADISH):
+        return f"{recv}.join() (thread join)"
+    if fn.attr == "get" and \
+            any(recv_l == t or recv_l.endswith("_" + t) for t in _QUEUEISH) \
+            and not any(isinstance(a, ast.Constant) and isinstance(a.value,
+                                                                   str)
+                        for a in call.args):
+        return f"{recv}.get() (queue wait)"
+    return None
+
+
+def _analyze_function(model: Model, fi: FuncInfo,
+                      aliases: Dict[str, str]) -> None:
+    ctx = fi.ctx
+    func_node = fi.node
+
+    def visit(stmts: List[ast.stmt], held: Tuple[str, ...]) -> None:
+        for stmt in stmts:
+            _visit_node(stmt, held)
+
+    def _walk_expr(node: ast.AST, held: Tuple[str, ...]) -> None:
+        for child in ast.iter_child_nodes(node):
+            _visit_node(child, held)
+
+    def _note_acquire(lid: str, node: ast.AST,
+                      held: Tuple[str, ...]) -> Tuple[str, ...]:
+        fi.direct_acquires.append((lid, node))
+        for h in tuple(held) + tuple(_acquired_open):
+            if h != lid:
+                model.edges.setdefault((h, lid), (fi, node, []))
+        return held + (lid,)
+
+    def _visit_node(node: ast.AST, held: Tuple[str, ...]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # deferred execution: not under this lock
+        if isinstance(node, ast.With):
+            inner_held = held
+            for item in node.items:
+                lid = _resolve_lock_expr(model, ctx, item.context_expr)
+                if lid is not None:
+                    inner_held = _note_acquire(lid, node, inner_held)
+                else:
+                    _walk_expr(item.context_expr, held)
+            visit(node.body, inner_held)
+            return
+        if isinstance(node, ast.Call):
+            fn = node.func
+            # explicit acquire()/release(): approximate the held region
+            # as "from the acquire to the end of this function or the
+            # matching release" by tracking through the statement walk
+            if isinstance(fn, ast.Attribute) and fn.attr in ("acquire",
+                                                             "release"):
+                lid = _resolve_lock_expr(model, ctx, fn.value)
+                if lid is not None:
+                    if fn.attr == "acquire":
+                        _note_acquire(lid, node, held)
+                        _acquired_open.append(lid)
+                    else:
+                        if lid in _acquired_open:
+                            _acquired_open.remove(lid)
+                    return
+            # condition-wait discipline
+            if isinstance(fn, ast.Attribute) and fn.attr == "wait":
+                lid = _resolve_lock_expr(model, ctx, fn.value)
+                recv = fn.value.attr if isinstance(fn.value, ast.Attribute) \
+                    else (fn.value.id if isinstance(fn.value, ast.Name)
+                          else "")
+                recv_l = recv.lstrip("_").lower()
+                is_cond = (lid is not None
+                           and model.locks.get(lid) is not None
+                           and model.locks[lid].kind == "condition") \
+                    or recv_l.endswith("cv") or recv_l.endswith("cond")
+                if is_cond and not _in_loop(ctx, node, func_node):
+                    model.findings.append(ctx.finding(
+                        RULE_NAME, node,
+                        f"condition `{ast.unparse(fn.value)}`.wait() "
+                        "outside any predicate loop — wakeups are "
+                        "spurious by contract; re-check the predicate "
+                        "in a while loop (or use wait_for)"))
+            reason = _blocking_reason(model, ctx, node)
+            if reason is not None:
+                fi.blocking.append(
+                    (reason, node, tuple(held) + tuple(_acquired_open)))
+            callee = _resolve_call(model, fi, node, aliases)
+            if callee is not None:
+                fi.calls.append(
+                    (callee, tuple(held) + tuple(_acquired_open), node))
+            _walk_expr(node, held)
+            return
+        _walk_expr(node, held)
+
+    _acquired_open: List[str] = []
+    body = getattr(func_node, "body", [])
+    visit(body, ())
+
+
+def _closures(model: Model):
+    """acquires_closure[key] -> {lock_id: chain}, blocking_closure[key]
+    -> {reason: chain} via memoized DFS over the call graph."""
+    acq: Dict[Tuple[str, str], Dict[str, List[str]]] = {}
+    blk: Dict[Tuple[str, str], Dict[str, List[str]]] = {}
+
+    def qn(key: Tuple[str, str]) -> str:
+        return f"{key[0].rsplit('/', 1)[-1]}:{key[1]}"
+
+    def walk(key: Tuple[str, str], stack: Set[Tuple[str, str]]):
+        if key in acq:
+            return acq[key], blk[key]
+        if key in stack:
+            return {}, {}
+        stack.add(key)
+        fi = model.funcs[key]
+        a: Dict[str, List[str]] = {}
+        b: Dict[str, List[str]] = {}
+        for lid, _node in fi.direct_acquires:
+            a.setdefault(lid, [qn(key)])
+        for reason, _node, _held in fi.blocking:
+            b.setdefault(reason, [qn(key)])
+        for callee, _held, _node in fi.calls:
+            ca, cb = walk(callee, stack)
+            for lid, chain in ca.items():
+                a.setdefault(lid, [qn(key)] + chain)
+            for reason, chain in cb.items():
+                b.setdefault(reason, [qn(key)] + chain)
+        stack.discard(key)
+        acq[key] = a
+        blk[key] = b
+        return a, b
+
+    for key in list(model.funcs):
+        walk(key, set())
+    return acq, blk
+
+
+def build_model(ctxs: List[FileContext]) -> Model:
+    model = Model()
+    for ctx in ctxs:
+        _collect_locks(model, ctx)
+    for ctx in ctxs:
+        _index_functions(model, ctx)
+    alias_cache: Dict[str, Dict[str, str]] = {}
+    for fi in model.funcs.values():
+        aliases = alias_cache.get(fi.ctx.rel)
+        if aliases is None:
+            aliases = alias_cache[fi.ctx.rel] = _module_aliases(fi.ctx)
+        _analyze_function(model, fi, aliases)
+
+    acq, blk = _closures(model)
+
+    def disp(lid: str) -> str:
+        d = model.locks.get(lid)
+        return d.display if d else lid
+
+    seen: Set[tuple] = set()
+    # call-mediated edges, cross-chain re-acquires, held-across-blocking
+    for fi in model.funcs.values():
+        for callee, held, node in fi.calls:
+            if not held:
+                continue
+            for lid, chain in acq.get(callee, {}).items():
+                for h in held:
+                    if lid == h:
+                        d = model.locks.get(lid)
+                        if d is not None and d.kind == "rlock":
+                            continue
+                        key = ("reacquire", lid, fi.key)
+                        if key not in seen:
+                            seen.add(key)
+                            model.findings.append(fi.ctx.finding(
+                                RULE_NAME, node,
+                                f"`{disp(lid)}` re-acquired through call "
+                                f"chain {' -> '.join(chain)} while already "
+                                "held — non-reentrant Lock self-deadlocks"))
+                        continue
+                    model.edges.setdefault((h, lid), (fi, node, chain))
+            for reason, chain in blk.get(callee, {}).items():
+                key = ("blocked", reason, held, fi.key)
+                if key not in seen:
+                    seen.add(key)
+                    model.findings.append(fi.ctx.finding(
+                        RULE_NAME, node,
+                        f"lock(s) {', '.join(disp(h) for h in held)} held "
+                        f"across {reason} via {' -> '.join(chain)} — a "
+                        "blocked wait under a shared lock stalls every "
+                        "peer of that lock"))
+        for reason, node, held in fi.blocking:
+            if not held or "(device dispatch)" in reason:
+                continue  # direct device-under-lock is lock-discipline's
+            key = ("blocked-direct", reason, held, fi.key)
+            if key not in seen:
+                seen.add(key)
+                model.findings.append(fi.ctx.finding(
+                    RULE_NAME, node,
+                    f"lock(s) {', '.join(disp(h) for h in held)} held "
+                    f"across {reason} — the joined/waited-on worker may "
+                    "need that lock to make progress"))
+
+    # order inversions: both directions present.  Anchor the finding at
+    # the witness with the SHORTER call chain (a direct nested `with`
+    # beats an interprocedural hop) — that is where a reader can see
+    # both locks, and where a suppression naturally lives.
+    for (a, b), (fi, node, chain) in sorted(model.edges.items()):
+        if a >= b or (b, a) not in model.edges:
+            continue
+        rfi, rnode, rchain = model.edges[(b, a)]
+        if len(rchain) < len(chain):
+            (a, b) = (b, a)
+            (fi, node, chain), (rfi, rnode, rchain) = \
+                (rfi, rnode, rchain), (fi, node, chain)
+        model.findings.append(fi.ctx.finding(
+            RULE_NAME, node,
+            f"lock-order inversion: {disp(a)} -> {disp(b)} here"
+            f"{' via ' + ' -> '.join(chain) if chain else ''}, but "
+            f"{disp(b)} -> {disp(a)} in "
+            f"{rfi.ctx.rel}:{rfi.ctx.qualname(rfi.node)}"
+            f"{' via ' + ' -> '.join(rchain) if rchain else ''} — two "
+            "threads taking these in opposite orders deadlock"))
+    return model
+
+
+def check_program(ctxs: List[FileContext], root: str = "") \
+        -> Iterator[Finding]:
+    model = build_model(ctxs)
+    yield from model.findings

@@ -1,0 +1,357 @@
+"""wire-protocol: the Python framing layer vs native/solverd.cc.
+
+Whole-program rule (ISSUE 12).  The solver service protocol lives in
+two languages: C++ owns the socket runtime (native/solverd.cc) and
+Python owns both ends of the payloads (service/client.py speaks to it,
+service/backend.py runs inside it, service/loopback.py re-implements
+the C++ window for tests).  Nothing type-checks across that boundary —
+a renamed frame field, a drifted frame cap, or a changed
+`handle_batch` arity fails at runtime in a daemon, which is the most
+expensive possible place.  This rule cross-checks the mirrors
+mechanically:
+
+  * `kMaxFrame` (C++) == `_MAX_FRAME` (client.py, loopback.py);
+  * the 12-byte little-endian `u32 len | u64 rid` header: C++
+    `char header[12]` vs the Python `struct` format set (`"<IQ"`);
+  * `handle_batch`'s arity vs the C++ `PyObject_CallFunction` format
+    (`"(OOn)"` → payloads, conn_ids, backlog), and loopback's call;
+  * every attribute the C++ looks up on the backend module
+    (`PyObject_GetAttrString`) exists as a top-level definition;
+  * frame BODY field names: the union of keys the client sends per
+    request kind vs the keys the backend reads — drift in either
+    direction is a finding;
+  * the stats-RPC key set: the backend's response dict vs the
+    `_STATS_KEYS` contract below (stats consumers — telemetry merge,
+    the dashboard, the multichip bench — key on these; extending the
+    RPC means extending the contract here AND its docs);
+  * the loopback window defaults (idle/max/batch) vs the C++ batcher
+    defaults — the test harness must model the daemon it stands for.
+
+The C++ side is parsed with targeted regexes (no C++ parser in the
+toolchain); each pattern anchors on an identifier this rule would
+rather fail loudly on (a vanished `kMaxFrame` is itself a finding)."""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import struct
+from typing import Dict, Iterator, List, Optional, Set
+
+from hack.analyze.core import FileContext, Finding
+
+RULE_NAME = "wire-protocol"
+
+# the stats-RPC response contract (backend.py "stats" handler).
+# Consumers: utils/telemetry.py merge, GET /debug/dashboard,
+# bench.py --multichip's residency block, tests/test_solver_service.py.
+_STATS_KEYS = frozenset({"batch_sizes", "catalogs", "shed", "mesh",
+                         "scheduler", "telemetry"})
+
+
+def _find_ctx(ctxs: List[FileContext], suffix: str) \
+        -> Optional[FileContext]:
+    for ctx in ctxs:
+        if ctx.rel.endswith(suffix):
+            return ctx
+    return None
+
+
+def _int_expr(expr: ast.AST) -> Optional[int]:
+    """Evaluate a constant integer expression (handles `256 << 20`)."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+        return expr.value
+    if isinstance(expr, ast.BinOp):
+        left, right = _int_expr(expr.left), _int_expr(expr.right)
+        if left is None or right is None:
+            return None
+        if isinstance(expr.op, ast.LShift):
+            return left << right
+        if isinstance(expr.op, ast.Mult):
+            return left * right
+        if isinstance(expr.op, ast.Add):
+            return left + right
+    return None
+
+
+def _module_int(ctx: FileContext, name: str) \
+        -> Optional[tuple]:
+    for node in ast.iter_child_nodes(ctx.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == name:
+            return _int_expr(node.value), node
+    return None
+
+
+def _struct_formats(ctx: FileContext) -> Set[str]:
+    """Format strings passed to struct.pack/unpack/Struct in the file."""
+    out: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("pack", "unpack", "Struct") and \
+                node.args and isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            out.add(node.args[0].value)
+    return out
+
+
+def _sent_keys(ctx: FileContext) -> Dict[str, Set[str]]:
+    """request kind -> body keys the client sends.  Covers the literal
+    dict form (`self._send("stats", {})`), the named-dict form (`body =
+    {...}` + `body["tenant"] = ...` + `self._send("schedule", body)`),
+    keyword additions in any enclosing function."""
+    out: Dict[str, Set[str]] = {}
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "_send" and len(node.args) >= 2
+                and isinstance(node.args[0], ast.Constant)):
+            continue
+        kind = node.args[0].value
+        body = node.args[1]
+        keys = out.setdefault(kind, set())
+        dicts: List[ast.Dict] = []
+        if isinstance(body, ast.Dict):
+            dicts.append(body)
+        elif isinstance(body, ast.Name):
+            # resolve `body = {...}` and `body["k"] = ...` in the
+            # enclosing function
+            fn = node
+            while fn is not None and not isinstance(
+                    fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn = ctx.parent(fn)
+            if fn is not None:
+                for sub in ast.walk(fn):
+                    if isinstance(sub, ast.Assign) and \
+                            len(sub.targets) == 1:
+                        tgt = sub.targets[0]
+                        if isinstance(tgt, ast.Name) and \
+                                tgt.id == body.id and \
+                                isinstance(sub.value, ast.Dict):
+                            dicts.append(sub.value)
+                        elif isinstance(tgt, ast.Subscript) and \
+                                isinstance(tgt.value, ast.Name) and \
+                                tgt.value.id == body.id and \
+                                isinstance(tgt.slice, ast.Constant):
+                            keys.add(tgt.slice.value)
+        for d in dicts:
+            for k in d.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    keys.add(k.value)
+    return out
+
+
+def _read_keys(ctx: FileContext, var: str = "body") -> Set[str]:
+    """String keys read off dicts named `var` anywhere in the module:
+    .get("k"), ["k"], and `"k" in var` membership."""
+    out: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "get" and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str) and \
+                ((isinstance(node.func.value, ast.Name)
+                  and node.func.value.id == var)
+                 # `item.payload[1].get("traceparent")`: the fused-batch
+                 # payload tuple carries the body at index 1 — ONLY
+                 # payload-subscript receivers count, or any unrelated
+                 # `x[...].get("k")` in the module would read as a
+                 # frame field
+                 or (isinstance(node.func.value, ast.Subscript)
+                     and isinstance(node.func.value.value, ast.Attribute)
+                     and node.func.value.value.attr == "payload")):
+            out.add(node.args[0].value)
+        elif isinstance(node, ast.Subscript) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == var and \
+                isinstance(node.slice, ast.Constant) and \
+                isinstance(node.slice.value, str):
+            out.add(node.slice.value)
+        elif isinstance(node, ast.Compare) and len(node.ops) == 1 and \
+                isinstance(node.ops[0], (ast.In, ast.NotIn)) and \
+                isinstance(node.comparators[0], ast.Name) and \
+                node.comparators[0].id == var and \
+                isinstance(node.left, ast.Constant) and \
+                isinstance(node.left.value, str):
+            out.add(node.left.value)
+    return out
+
+
+def _stats_dict_keys(ctx: FileContext) -> Optional[Set[str]]:
+    """The stats response dict: the literal containing "batch_sizes"."""
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Dict):
+            keys = {k.value for k in node.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+            if "batch_sizes" in keys:
+                return keys
+    return None
+
+
+def check_program(ctxs: List[FileContext], root: str = "") \
+        -> Iterator[Finding]:
+    cc_path = os.path.join(root, "native", "solverd.cc")
+    if not os.path.exists(cc_path):
+        return  # no native half in this tree (fixtures): nothing to mirror
+    with open(cc_path, encoding="utf-8") as f:
+        cc = f.read()
+    client = _find_ctx(ctxs, "service/client.py")
+    backend = _find_ctx(ctxs, "service/backend.py")
+    loopback = _find_ctx(ctxs, "service/loopback.py")
+
+    # -- kMaxFrame mirror --------------------------------------------------
+    m = re.search(r"kMaxFrame\s*=\s*(\d+)u?\s*<<\s*(\d+)", cc)
+    cc_max = (int(m.group(1)) << int(m.group(2))) if m else None
+    if cc_max is None and (client or loopback):
+        yield Finding(rule=RULE_NAME, path="native/solverd.cc", line=1,
+                      symbol="<cc>", snippet="",
+                      message="kMaxFrame constant not found — the frame "
+                              "cap the Python mirrors anchor on is gone")
+    header_m = re.search(r"char\s+header\[(\d+)\]", cc)
+    cc_header = int(header_m.group(1)) if header_m else None
+    for ctx in (client, loopback):
+        if ctx is None:
+            continue
+        got = _module_int(ctx, "_MAX_FRAME")
+        if got is None:
+            yield Finding(rule=RULE_NAME, path=ctx.rel, line=1,
+                          symbol="<module>", snippet="",
+                          message="no _MAX_FRAME mirror of the daemon's "
+                                  "kMaxFrame — an oversized length prefix "
+                                  "must kill the connection on BOTH sides")
+        elif cc_max is not None and got[0] != cc_max:
+            yield ctx.finding(
+                RULE_NAME, got[1],
+                f"_MAX_FRAME ({got[0]}) != native kMaxFrame ({cc_max}) — "
+                "the two halves now disagree on what a torn frame is")
+        fmts = _struct_formats(ctx)
+        if fmts and fmts != {"<IQ"}:
+            yield Finding(
+                rule=RULE_NAME, path=ctx.rel, line=1, symbol="<module>",
+                snippet="",
+                message=f"frame struct formats {sorted(fmts)} != the "
+                        "daemon's little-endian u32|u64 header "
+                        "(struct '<IQ')")
+        elif fmts and cc_header is not None and \
+                struct.calcsize("<IQ") != cc_header:
+            yield Finding(
+                rule=RULE_NAME, path=ctx.rel, line=1, symbol="<module>",
+                snippet="",
+                message=f"struct '<IQ' is {struct.calcsize('<IQ')} bytes "
+                        f"but the daemon reads a {cc_header}-byte header")
+
+    # -- backend attribute + arity mirrors ---------------------------------
+    if backend is not None:
+        top_names = {n.name for n in ast.iter_child_nodes(backend.tree)
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef, ast.ClassDef))}
+        top_names |= {t.id for n in ast.iter_child_nodes(backend.tree)
+                      if isinstance(n, ast.Assign)
+                      for t in n.targets if isinstance(t, ast.Name)}
+        for attr in re.findall(
+                r'PyObject_GetAttrString\(\s*module\s*,\s*"(\w+)"\s*\)', cc):
+            if attr not in top_names:
+                yield Finding(
+                    rule=RULE_NAME, path=backend.rel, line=1,
+                    symbol="<module>", snippet="",
+                    message=f"the daemon looks up `{attr}` on this module "
+                            "(PyObject_GetAttrString) but no top-level "
+                            "definition exists — the daemon degrades or "
+                            "dies at boot")
+        call_m = re.search(
+            r'PyObject_CallFunction\(\s*handler\s*,\s*"\(([A-Za-z]+)\)"', cc)
+        hb = next((n for n in ast.iter_child_nodes(backend.tree)
+                   if isinstance(n, ast.FunctionDef)
+                   and n.name == "handle_batch"), None)
+        if call_m and hb is not None:
+            cc_arity = len(call_m.group(1))
+            params = len(hb.args.args) + len(hb.args.posonlyargs)
+            required = params - len(hb.args.defaults)
+            if not (required <= cc_arity <= params):
+                yield backend.finding(
+                    RULE_NAME, hb,
+                    f"handle_batch takes {required}..{params} positional "
+                    f"args but the daemon calls it with {cc_arity} "
+                    f"(format '({call_m.group(1)})')")
+        # loopback must call the same three-argument seam
+        if loopback is not None and call_m:
+            lb_calls = [n for n in ast.walk(loopback.tree)
+                        if isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "handle_batch"]
+            for n in lb_calls:
+                if len(n.args) != len(call_m.group(1)):
+                    yield loopback.finding(
+                        RULE_NAME, n,
+                        f"loopback calls handle_batch with {len(n.args)} "
+                        f"args; the daemon passes {len(call_m.group(1))} "
+                        "(payloads, conn_ids, backlog) — the stand-in "
+                        "must exercise the real seam")
+
+        # -- frame body field names ---------------------------------------
+        if client is not None:
+            sent = _sent_keys(client)
+            body_sent: Set[str] = set()
+            for kind in ("schedule", "warmup", "catalog"):
+                body_sent |= sent.get(kind, set())
+            body_read = _read_keys(backend, "body")
+            for key in sorted(body_sent - body_read):
+                yield Finding(
+                    rule=RULE_NAME, path=client.rel, line=1,
+                    symbol="<module>", snippet="",
+                    message=f"client ships frame field `{key}` the "
+                            "backend never reads — dead field or a "
+                            "renamed half of the protocol")
+            for key in sorted(body_read - body_sent):
+                yield Finding(
+                    rule=RULE_NAME, path=backend.rel, line=1,
+                    symbol="<module>", snippet="",
+                    message=f"backend reads frame field `{key}` the "
+                            "client never sends — it is always absent "
+                            "on the wire")
+
+        # -- stats-RPC key set --------------------------------------------
+        stats = _stats_dict_keys(backend)
+        if stats is not None and stats != _STATS_KEYS:
+            added = sorted(stats - _STATS_KEYS)
+            removed = sorted(_STATS_KEYS - stats)
+            yield Finding(
+                rule=RULE_NAME, path=backend.rel, line=1,
+                symbol="<module>", snippet="",
+                message="stats RPC key set drifted from the contract in "
+                        f"hack/analyze/rules/wire_protocol.py (added: "
+                        f"{added}, removed: {removed}) — update the "
+                        "contract and the dashboard/telemetry consumers "
+                        "together")
+
+    # -- loopback window defaults ------------------------------------------
+    if loopback is not None:
+        cc_defaults = {}
+        for name, pat in (("idle_ms", r"int\s+idle_ms\s*=\s*(\d+)"),
+                          ("max_ms", r"int\s+max_ms\s*=\s*(\d+)"),
+                          ("max_batch", r"size_t\s+max_batch\s*=\s*(\d+)")):
+            mm = re.search(pat, cc)
+            if mm:
+                cc_defaults[name] = int(mm.group(1))
+        for node in ast.walk(loopback.tree):
+            if not (isinstance(node, ast.FunctionDef)
+                    and node.name == "__init__"):
+                continue
+            args = node.args
+            names = [a.arg for a in args.args]
+            for name, default in zip(names[len(names)
+                                           - len(args.defaults):],
+                                     args.defaults):
+                want = cc_defaults.get(name)
+                got = _int_expr(default)
+                if want is not None and got is not None and got != want:
+                    yield loopback.finding(
+                        RULE_NAME, node,
+                        f"loopback window default {name}={got} != the "
+                        f"daemon's {want} — the harness no longer models "
+                        "the batcher it stands in for")

@@ -65,9 +65,8 @@ class Provisioner:
         sizes up to the 50k headline class."""
         if self._warmup_started:
             return
-        import os
-        raw = os.environ.get("KARPENTER_TPU_WARMUP", "").strip().lower()
-        if raw in ("", "0", "off", "false"):
+        from karpenter_tpu.utils.knobs import env_bool
+        if not env_bool("KARPENTER_TPU_WARMUP"):
             self._warmup_started = True
             return
         if not self.cluster.nodepools.list(lambda p: not p.meta.deleting):

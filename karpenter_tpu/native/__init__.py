@@ -55,7 +55,8 @@ def hostops() -> Optional[object]:
     global _hostops, _attempted
     if _hostops is not None:
         return _hostops
-    if os.environ.get("KARPENTER_TPU_NO_NATIVE"):
+    from karpenter_tpu.utils.knobs import env_bool
+    if env_bool("KARPENTER_TPU_NO_NATIVE"):
         return None
     with _build_lock:
         if _attempted:

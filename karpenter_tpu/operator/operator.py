@@ -208,8 +208,8 @@ class Operator:
             # loopback by default; a containerized replica sets
             # KARPENTER_TPU_BIND_HOST=0.0.0.0 so published ports and
             # healthchecks actually reach the server (deploy/)
-            import os as _os
-            host = _os.environ.get("KARPENTER_TPU_BIND_HOST", "127.0.0.1")
+            from karpenter_tpu.utils.knobs import bind_host
+            host = bind_host()
             srv = ThreadingHTTPServer((host, port), handler)
             ports.append(srv.server_address[1])  # resolves port 0 → actual
             t = threading.Thread(target=srv.serve_forever, daemon=True,

@@ -113,14 +113,13 @@ class Options:
         if "KARPENTER_TPU_SERVICE_BREAKER_COOLDOWN" in os.environ:
             opts.service_breaker_cooldown = float(
                 os.environ["KARPENTER_TPU_SERVICE_BREAKER_COOLDOWN"])
-        if "KARPENTER_TPU_SERVICE_LOCAL_FALLBACK" in os.environ:
-            # "on" included: the sibling knobs (PIPELINE, MESH) use
-            # on/off grammar and the docs table shows this default as
-            # `on` — an operator following that convention must not
-            # silently disable the fallback
-            opts.service_local_fallback = (
-                os.environ["KARPENTER_TPU_SERVICE_LOCAL_FALLBACK"]
-                .strip().lower() in ("1", "true", "yes", "on"))
+        # canonical symmetric on/off grammar (utils/knobs.py); malformed
+        # values degrade to the default (on) — an operator must opt OUT
+        # of the fallback explicitly, never via a typo
+        from karpenter_tpu.utils.knobs import env_bool
+        opts.service_local_fallback = env_bool(
+            "KARPENTER_TPU_SERVICE_LOCAL_FALLBACK",
+            default=opts.service_local_fallback)
         opts.service_tenant = os.environ.get(
             "KARPENTER_TPU_TENANT", opts.service_tenant)
         if "KARPENTER_TPU_PRIORITY" in os.environ:

@@ -377,8 +377,13 @@ class MaskRowRegistry:
                 # whole-table upload, pre-partitioned.  Shape change ⇒
                 # the solve programs recompile at the new C_pad —
                 # bucketed so this is rare, and warmup()'s real encoding
-                # sizes the steady-state tier.
-                self._realloc()
+                # sizes the steady-state tier.  Holding _lock across the
+                # upload is the DESIGN: a racing ensure() must observe
+                # either the old table or the fully-shipped new one —
+                # releasing mid-upload reintroduces the PR 5
+                # _catalog_encoding torn-publication race this lock
+                # exists to close.
+                self._realloc()  # kt-lint: disable=lock-order
             else:
                 self._flush(start=filled)
             return idx, self.table

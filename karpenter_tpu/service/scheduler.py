@@ -100,8 +100,8 @@ PAD_KEEP_FRACTION = 0.75
 def fuse_enabled() -> bool:
     """KARPENTER_TPU_TENANT_FUSE rollback knob (default on).  Re-read
     per planning round so in-process harnesses can flip it live."""
-    return os.environ.get("KARPENTER_TPU_TENANT_FUSE", "on").strip().lower() \
-        not in ("off", "0", "false", "no")
+    from karpenter_tpu.utils.knobs import env_bool
+    return env_bool("KARPENTER_TPU_TENANT_FUSE", default=True)
 
 
 def parse_weights(spec: Optional[str]) -> Dict[str, float]:

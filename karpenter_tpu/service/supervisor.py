@@ -363,8 +363,8 @@ def _serve_metrics(port: int):
         def log_message(self, *a):  # scrapes are not log events
             pass
 
-    host = os.environ.get("KARPENTER_TPU_BIND_HOST", "127.0.0.1")
-    srv = ThreadingHTTPServer((host, port), Handler)
+    from karpenter_tpu.utils.knobs import bind_host
+    srv = ThreadingHTTPServer((bind_host(), port), Handler)
     threading.Thread(target=srv.serve_forever, daemon=True,
                      name="supervisor-metrics").start()
     return srv

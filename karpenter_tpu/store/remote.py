@@ -11,11 +11,20 @@ environment.
 
 Wire format: 4-byte big-endian length + pickle. Messages are dicts:
   {op: "hello", client: id}                      → {ok}
-  {op: "list", kind}                             → {items: {name: bytes}}
-  {op: "put", kind, name, data, verb}            → {ok}
-  {op: "delete", kind, name}                     → {ok}
-  {op: "watch", client: id}                      → stream of
-      {op: "event", kind, verb, name, data|None, origin}
+  {op: "list", kind, rid}                        → {items: {name: bytes}, rid}
+  {op: "put", kind, name, data, verb, rid}       → {ok, rid}
+  {op: "delete", kind, name, rid}                → {ok, rid}
+  {op: "watch", client: id}                      → {ok} registration ack,
+      then a stream of {op: "event", kind, verb, name, data|None, origin}
+
+RPCs carry a client-assigned request id the daemon echoes (`rid`), so a
+response can be paired with — and verified against — its request
+without holding the RPC lock across the round trip (ISSUE 12: the
+lock-order fix that retired the PR 2 grandfathered lock-discipline
+findings here).  The watch registration is ACKED under the daemon's
+watcher lock: once the constructor returns, every subsequent peer write
+is guaranteed to reach this backend's event buffer — the
+registration-vs-first-write race was the `test_peer_events_flow` flake.
 
 Pickle is safe here the same way it is for solverd: the socket is a
 file-permission-guarded unix socket owned by the operator deployment,
@@ -35,6 +44,9 @@ from typing import Dict, List, Optional, Tuple
 from karpenter_tpu.utils import faults
 
 _LEN = struct.Struct(">I")
+
+# RemoteBackend: idle RPC connections kept for reuse (per backend)
+_IDLE_POOL_CAP = 4
 
 
 def _send(sock: socket.socket, msg: dict) -> None:
@@ -88,6 +100,14 @@ class StoreDaemon:
 
     def _serve(self, conn: socket.socket) -> None:
         client = "?"
+
+        def reply(payload: dict) -> None:
+            # echo the client's request id so the response pairs with
+            # (and is verified against) exactly one request
+            rid = msg.get("rid")
+            _send(conn, dict(payload, rid=rid) if rid is not None
+                  else payload)
+
         try:
             while True:
                 msg = _recv(conn)
@@ -96,11 +116,11 @@ class StoreDaemon:
                 op = msg.get("op")
                 if op == "hello":
                     client = msg.get("client", "?")
-                    _send(conn, {"ok": True})
+                    reply({"ok": True})
                 elif op == "list":
                     with self._lock:
                         items = dict(self._data.get(msg["kind"], {}))
-                    _send(conn, {"items": items})
+                    reply({"items": items})
                 elif op == "put":
                     verb = msg.get("verb", "modified")
                     with self._lock:
@@ -125,27 +145,36 @@ class StoreDaemon:
                             conflict = False
                             kind_map[msg["name"]] = msg["data"]
                     if conflict:
-                        _send(conn, {"ok": False, "conflict": True})
+                        reply({"ok": False, "conflict": True})
                     else:
                         self._broadcast(msg.get("origin", client), {
                             "op": "event", "kind": msg["kind"],
                             "verb": verb,
                             "name": msg["name"], "data": msg["data"]})
-                        _send(conn, {"ok": True})
+                        reply({"ok": True})
                 elif op == "delete":
                     with self._lock:
                         self._data.get(msg["kind"], {}).pop(msg["name"], None)
                     self._broadcast(msg.get("origin", client), {
                         "op": "event", "kind": msg["kind"], "verb": "deleted",
                         "name": msg["name"], "data": None})
-                    _send(conn, {"ok": True})
+                    reply({"ok": True})
                 elif op == "watch":
                     with self._lock:
                         self._watchers.append((msg.get("client", client),
                                                conn))
+                        # ack UNDER the watcher lock: a concurrent
+                        # broadcast either snapshotted before the append
+                        # (event not for us) or blocks on the lock until
+                        # the ack is on the wire — so registration is
+                        # strictly ordered before every event this
+                        # watcher will ever receive, and a constructor
+                        # that saw the ack can never miss a peer write
+                        # (the test_peer_events_flow flake)
+                        _send(conn, {"ok": True})  # kt-lint: disable=lock-discipline
                     return  # connection now belongs to the broadcast side
                 else:
-                    _send(conn, {"error": f"unknown op {op!r}"})
+                    reply({"error": f"unknown op {op!r}"})
         except OSError:
             return
 
@@ -171,6 +200,17 @@ class StoreDaemon:
             self._srv.close()
         except OSError:
             pass
+        # tear down the watch streams too: a watcher blocked in recv
+        # must see EOF and mark its stream dead, or every replica's
+        # wait_events() sleeps out its timeout against a daemon that
+        # will never broadcast again
+        with self._lock:
+            watchers, self._watchers = list(self._watchers), []
+        for _client, sock in watchers:
+            try:
+                sock.close()
+            except OSError:
+                pass
         try:
             os.unlink(self.path)
         except OSError:
@@ -186,36 +226,60 @@ class RemoteBackend:
         self.client_id = uuid.uuid4().hex
         self._path = path
         self._timeout = timeout
+        self._closed = False
         self._rpc_lock = threading.Lock()
-        self._rpc: Optional[socket.socket] = self._rpc_connect()
+        self._rid = 0
+        # small idle-connection pool (bounded): overlapping _call()s
+        # each check out (or mint) their own socket, and up to
+        # _IDLE_POOL_CAP come back for reuse — one slot would pay a
+        # connect+hello handshake per overlapping RPC
+        self._idle: List[socket.socket] = [self._rpc_connect()]
         self._watch_sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._watch_sock.settimeout(timeout)
         self._watch_sock.connect(self._path)
         _send(self._watch_sock, {"op": "watch", "client": self.client_id})
+        # registration ack (bounded by the connect timeout): once this
+        # returns, the daemon has the watcher registered, so no peer
+        # write after this constructor can be missed
+        ack = _recv(self._watch_sock)
+        if not (isinstance(ack, dict) and ack.get("ok")):
+            self._watch_sock.close()
+            raise ConnectionError(
+                f"store daemon rejected watch registration: {ack!r}")
         # the watch STREAM blocks indefinitely by design: events arrive
         # whenever peers write, and close() unblocks the reader — an idle
         # timeout here would tear down a healthy quiet stream
         self._watch_sock.settimeout(None)  # kt-lint: disable=socket-discipline
         self._events: List[Tuple[str, str, str, Optional[object]]] = []
-        self._events_lock = threading.Lock()
-        self._closed = False
+        self._events_cv = threading.Condition()
+        self._watch_dead = False
         self._reader = threading.Thread(target=self._watch_loop, daemon=True,
                                         name="store-watch")
         self._reader.start()
 
     def _watch_loop(self) -> None:
-        while not self._closed:
-            try:
-                msg = _recv(self._watch_sock)
-            except OSError:
-                return
-            if msg is None:
-                return
-            obj = (pickle.loads(msg["data"])
-                   if msg.get("data") is not None else None)
-            with self._events_lock:
-                self._events.append(
-                    (msg["kind"], msg["verb"], msg["name"], obj))
+        try:
+            while not self._closed:
+                try:
+                    msg = _recv(self._watch_sock)
+                except OSError:
+                    return
+                if msg is None:
+                    return
+                obj = (pickle.loads(msg["data"])
+                       if msg.get("data") is not None else None)
+                with self._events_cv:
+                    self._events.append(
+                        (msg["kind"], msg["verb"], msg["name"], obj))
+                    self._events_cv.notify_all()
+        finally:
+            # stream death must wake wait_events() callers — and the
+            # flag (not just the notify) is what makes a LATER waiter
+            # fail fast instead of sleeping out its timeout against a
+            # stream that will never deliver
+            with self._events_cv:
+                self._watch_dead = True
+                self._events_cv.notify_all()
 
     def _rpc_connect(self) -> socket.socket:
         # every RPC is bounded: a wedged store daemon demotes this
@@ -232,20 +296,16 @@ class RemoteBackend:
             raise
         return s
 
-    def _drop_rpc(self) -> None:
-        # caller holds _rpc_lock. The protocol has no request ids: a
-        # timeout or partial read leaves response bytes in flight, and
-        # reusing the socket would pair the NEXT request with the
-        # PREVIOUS response — the connection must die with the failure;
-        # the next _call reconnects fresh
-        if self._rpc is not None:
-            try:
-                self._rpc.close()
-            except OSError:
-                pass
-            self._rpc = None
-
     def _call(self, msg: dict) -> dict:
+        """One bounded RPC round trip.  The cached connection is CHECKED
+        OUT under `_rpc_lock` (set to None while in use) and the wire
+        I/O runs outside the lock — each in-flight call owns a private
+        socket, so request/response pairing holds per-socket and a
+        wedged daemon stalls only the caller, never every thread queued
+        behind the lock (the PR 2 grandfathered lock-discipline pair,
+        now fixed).  The daemon echoes the request id; a mismatched
+        echo means the connection desynchronized (a stale response from
+        a timed-out predecessor) and the connection dies with it."""
         try:
             faults.fire("store.remote.rpc")
         except faults.FaultInjected as e:
@@ -254,19 +314,44 @@ class RemoteBackend:
             # is what the fault exercises
             raise ConnectionError(str(e)) from e
         with self._rpc_lock:
+            sock = self._idle.pop() if self._idle else None
+            self._rid += 1
+            rid = self._rid
+        try:
+            if sock is None:
+                sock = self._rpc_connect()
+            _send(sock, dict(msg, origin=self.client_id, rid=rid))
+            out = _recv(sock)
+        except OSError as e:
+            # includes a failed RECONNECT: callers' outage handling is
+            # keyed on ConnectionError, never raw OSError subtypes.  A
+            # timeout or partial read leaves response bytes in flight —
+            # the connection dies with the failure; the next _call
+            # reconnects fresh.
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            raise ConnectionError(f"store rpc failed: {e}") from e
+        if out is None:
+            sock.close()
+            raise ConnectionError("store daemon closed the connection")
+        if out.get("rid") != rid:
+            sock.close()
+            raise ConnectionError(
+                f"store rpc desynchronized (sent rid {rid}, got "
+                f"{out.get('rid')!r}) — dropping the connection")
+        # return the connection to the idle pool (bounded; extras close)
+        with self._rpc_lock:
+            if not self._closed and len(self._idle) < _IDLE_POOL_CAP:
+                self._idle.append(sock)
+                sock = None
+        if sock is not None:
             try:
-                if self._rpc is None:
-                    self._rpc = self._rpc_connect()
-                _send(self._rpc, dict(msg, origin=self.client_id))
-                out = _recv(self._rpc)
-            except OSError as e:
-                # includes a failed RECONNECT: callers' outage handling
-                # is keyed on ConnectionError, never raw OSError subtypes
-                self._drop_rpc()
-                raise ConnectionError(f"store rpc failed: {e}") from e
-            if out is None:
-                self._drop_rpc()
-                raise ConnectionError("store daemon closed the connection")
+                sock.close()
+            except OSError:
+                pass
         return out
 
     # -- StoreBackend interface -------------------------------------------
@@ -291,16 +376,31 @@ class RemoteBackend:
         self._call({"op": "delete", "kind": kind, "name": name})
 
     def events(self) -> List[Tuple[str, str, str, Optional[object]]]:
-        with self._events_lock:
+        with self._events_cv:
             out = self._events
             self._events = []
         return out
 
+    def wait_events(self, count: int = 1, timeout: float = 5.0) -> bool:
+        """Block until at least `count` events are buffered (without
+        draining them) or `timeout` elapses.  Event-DRIVEN waiting for
+        tests and consumers that would otherwise poll events() in a
+        sleep loop; returns False on timeout or a dead watch stream."""
+        import time
+        deadline = time.monotonic() + timeout
+        with self._events_cv:
+            while len(self._events) < count:
+                left = deadline - time.monotonic()
+                if left <= 0 or self._closed or self._watch_dead:
+                    return len(self._events) >= count
+                self._events_cv.wait(left)
+            return True
+
     def close(self) -> None:
         self._closed = True
-        for s in (self._rpc, self._watch_sock):
-            if s is None:
-                continue  # the RPC socket may be down awaiting reconnect
+        with self._rpc_lock:
+            idle, self._idle = list(self._idle), []
+        for s in idle + [self._watch_sock]:
             try:
                 s.close()
             except OSError:

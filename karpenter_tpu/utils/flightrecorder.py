@@ -58,8 +58,8 @@ def recording_enabled() -> bool:
     """On unless explicitly disabled — the recorder is the always-on
     black box, and its default path must stay cheap enough to leave on
     (`bench.py --flight` asserts <1% of the headline p50)."""
-    return os.environ.get(_ENV_GATE, "").strip().lower() not in (
-        "off", "0", "false", "no")
+    from karpenter_tpu.utils.knobs import env_bool
+    return env_bool(_ENV_GATE, default=True)
 
 
 def _sha16(*chunks) -> str:
@@ -163,9 +163,9 @@ class FlightRecorder:
         """Full problem capture: opt-in, needs a spill directory, and
         requires the recorder itself on — a capture no record ever
         references is an orphan artifact, not a repro."""
+        from karpenter_tpu.utils.knobs import env_bool
         return (self.enabled
-                and os.environ.get(_ENV_CAPTURE, "").strip().lower()
-                in ("1", "true", "yes", "on")
+                and env_bool(_ENV_CAPTURE)
                 and bool(os.environ.get(_ENV_DIR)))
 
     def record(self, **fields) -> Optional[FlightRecord]:

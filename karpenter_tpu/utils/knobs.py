@@ -1,0 +1,52 @@
+"""Canonical `KARPENTER_TPU_*` knob grammar (ISSUE 12).
+
+Every boolean knob in this codebase is parsed HERE, through
+:func:`env_bool`, so on/off synonyms are symmetric by construction:
+``1/true/yes/on`` enable, ``0/false/no/off`` disable, anything else —
+including the empty string — degrades to the knob's documented default
+(the MESH/DELTA discipline: a typo is a no-op, never a crash and never
+a silent enable).  Before this module, four gates parsed truthiness by
+hand and disagreed: ``KARPENTER_TPU_FORCE_CPU=0`` *forced CPU* (bare
+truthiness), ``KARPENTER_TPU_TRACE=on`` did nothing (on-set missing
+``on``), ``KARPENTER_TPU_WARMUP=off`` worked but ``=no`` enabled a
+compile storm.  kt-lint's `env-knob` rule now fails any boolean knob
+read that bypasses this function (hack/analyze/rules/env_knobs.py).
+
+Non-boolean shared knobs with more than one consumer live here too
+(:func:`bind_host`), so each knob keeps exactly one parsing owner.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Mapping, Optional
+
+# the symmetric synonym sets — the contract docs/operations.md documents
+ON_WORDS = ("1", "true", "yes", "on")
+OFF_WORDS = ("0", "false", "no", "off")
+
+
+def env_bool(name: str, default: bool = False,
+             environ: Optional[Mapping[str, str]] = None) -> bool:
+    """Parse a boolean `KARPENTER_TPU_*` knob with the canonical
+    symmetric grammar.  Unset, empty, or malformed values return
+    `default` — rollback knobs must degrade to the configured behavior,
+    never flip it on a typo."""
+    env = os.environ if environ is None else environ
+    raw = env.get(name)
+    if raw is None:
+        return default
+    val = raw.strip().lower()
+    if val in ON_WORDS:
+        return True
+    if val in OFF_WORDS:
+        return False
+    return default
+
+
+def bind_host() -> str:
+    """`KARPENTER_TPU_BIND_HOST`: the metrics/health/probe bind address
+    (default loopback; `0.0.0.0` in containers).  Shared by the
+    operator's debug server and the supervisor's probe listener — one
+    parsing owner so the two can never read different defaults."""
+    return os.environ.get("KARPENTER_TPU_BIND_HOST", "127.0.0.1")

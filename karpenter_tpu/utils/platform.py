@@ -43,7 +43,8 @@ def _env_platform() -> Optional[str]:
     val = os.environ.get("KARPENTER_TPU_PLATFORM")
     if val:
         return val
-    if os.environ.get("KARPENTER_TPU_FORCE_CPU"):
+    from karpenter_tpu.utils.knobs import env_bool
+    if env_bool("KARPENTER_TPU_FORCE_CPU"):
         return "cpu"
     return os.environ.get("JAX_PLATFORMS") or None
 
@@ -136,7 +137,8 @@ def enable_compile_cache() -> None:
     costs 20-40 s — paying it once per shape per MACHINE instead of once
     per process keeps the 5-config bench artifact inside its wall-clock
     budget. Opt out with KARPENTER_TPU_NO_COMPILE_CACHE=1."""
-    if os.environ.get("KARPENTER_TPU_NO_COMPILE_CACHE"):
+    from karpenter_tpu.utils.knobs import env_bool
+    if env_bool("KARPENTER_TPU_NO_COMPILE_CACHE"):
         return
     import jax
     cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")

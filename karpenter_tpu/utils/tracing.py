@@ -62,8 +62,8 @@ def _count_dropped(n: int) -> None:
 def tracing_enabled() -> bool:
     if _enabled_override is not None:
         return _enabled_override
-    return os.environ.get(_ENV_GATE, "").strip().lower() in (
-        "1", "true", "yes")
+    from karpenter_tpu.utils.knobs import env_bool
+    return env_bool(_ENV_GATE)
 
 
 def set_enabled(value: Optional[bool]) -> None:

@@ -79,7 +79,14 @@ struct Batcher {
   size_t max_batch = 64;
 };
 
-Batcher g_batcher;
+// Immortal on purpose (ISSUE 12, TSan-caught): reader threads are
+// DETACHED and may still push/notify during process exit — a static
+// Batcher's atexit destructor tore down the condition variable while a
+// reader was signaling it (data race on the destroyed cv;
+// native/build/tsan runbook in docs/static-analysis.md).  A global
+// shared with detached threads must never run a destructor; leaking
+// one heap object at exit is the fix, not a workaround.
+Batcher& g_batcher = *new Batcher;
 std::atomic<bool> g_stop{false};
 std::atomic<uint64_t> g_conn_seq{0};
 int g_listen_fd = -1;

@@ -20,6 +20,22 @@ import pytest
 # imports anywhere (utils/faults.py arms from the environment at import).
 os.environ.pop("KARPENTER_TPU_FAULTS", None)
 
+# Dynamic lock-order observer (ISSUE 12, opt-in): under
+# KARPENTER_TPU_LOCK_OBSERVER=1 every threading.Lock/RLock/Condition a
+# karpenter_tpu module constructs from here on is wrapped, real
+# acquisition edges are recorded for the whole suite, and
+# pytest_sessionfinish fails the run on any edge the static lock graph
+# (hack/analyze/rules/lock_order.py) calls inverted.  Armed BEFORE jax
+# and the package import below so instance locks (schedulers, clients,
+# stores, solvers) are all observed; the handful of module-level locks
+# inside lockwatch's own import chain (metrics/tracing primitives) are
+# leaf locks and stay unobserved by construction.
+from karpenter_tpu.utils import lockwatch  # noqa: E402
+
+_LOCKWATCH_ARMED = lockwatch.armed_from_env()
+if _LOCKWATCH_ARMED:
+    lockwatch.install()
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -39,6 +55,37 @@ _CACHE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), ".jax_cache")
 jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Lock-observer verdict: compare every REALLY-observed acquisition
+    edge against the static lock-order graph.  Zero inversions is the
+    acceptance gate; an inversion fails the session even when every
+    test passed — a deadlock witnessed is a deadlock shipped."""
+    if not _LOCKWATCH_ARMED or not lockwatch.installed():
+        return
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    import sys
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from hack.analyze import core
+    from hack.analyze.rules import lock_order
+    ctxs = []
+    for p in core.iter_py_files([os.path.join(repo, "karpenter_tpu")]):
+        try:
+            ctxs.append(core.FileContext(p, root=repo))
+        except (SyntaxError, UnicodeDecodeError):
+            pass
+    model = lock_order.build_model(ctxs)
+    rep = lockwatch.verify(set(model.edges), model.site_to_id())
+    print(f"\n[lockwatch] {rep['edges']} acquisition edge(s) observed, "
+          f"{len(rep['inversions'])} inversion(s), "
+          f"{len(rep['self_pairs'])} same-site pair(s), "
+          f"{rep['unmodeled']} unmodeled")
+    if rep["inversions"]:
+        for inv in rep["inversions"]:
+            print(f"[lockwatch] {inv['kind']}: {inv['detail']}")
+        session.exitstatus = 1
 
 
 @pytest.fixture(autouse=True)

@@ -797,3 +797,663 @@ def test_stale_baseline_entry_is_an_error():
                       baseline=core.load_baseline() + bogus)
     assert bogus[0] in report.stale_baseline
     assert not report.clean
+
+
+# -- lock-order (whole-program, ISSUE 12) -----------------------------------
+from hack.analyze.rules import env_knobs, lock_order, wire_protocol  # noqa: E402
+
+_INVERSION = """
+    import threading
+
+
+    class C:
+        def __init__(self):
+            self._a_lock = threading.Lock()
+            self._b_lock = threading.Lock()
+
+        def one(self):
+            with self._a_lock:
+                self._take_b()
+
+        def _take_b(self):
+            with self._b_lock:
+                return 1
+
+        def other(self):
+            with self._b_lock:
+                with self._a_lock:
+                    return 2
+"""
+
+
+def test_lock_order_flags_inversion_across_call_chain(tmp_path):
+    findings, _ = _check(tmp_path, _INVERSION, lock_order)
+    msgs = " | ".join(f.message for f in findings)
+    assert "lock-order inversion" in msgs
+    assert "_take_b" in msgs  # the witness chain names the helper hop
+
+
+def test_lock_order_consistent_order_is_clean(tmp_path):
+    findings, _ = _check(tmp_path, """
+        import threading
+
+
+        class C:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def one(self):
+                with self._a_lock:
+                    self._take_b()
+
+            def _take_b(self):
+                with self._b_lock:
+                    return 1
+
+            def two(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        return 2
+    """, lock_order)
+    assert findings == []
+
+
+def test_lock_order_double_acquire_through_call_chain(tmp_path):
+    findings, _ = _check(tmp_path, """
+        import threading
+
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self._helper()
+
+            def _helper(self):
+                with self._lock:
+                    return 1
+    """, lock_order)
+    assert any("re-acquired through call chain" in f.message
+               for f in findings)
+
+
+def test_lock_order_rlock_reacquire_is_fine(tmp_path):
+    findings, _ = _check(tmp_path, """
+        import threading
+
+
+        class C:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def outer(self):
+                with self._lock:
+                    self._helper()
+
+            def _helper(self):
+                with self._lock:
+                    return 1
+    """, lock_order)
+    assert findings == []
+
+
+def test_lock_order_held_across_thread_join(tmp_path):
+    findings, _ = _check(tmp_path, """
+        import threading
+
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._worker = None
+
+            def stop(self):
+                with self._lock:
+                    self._worker.join(timeout=1.0)
+    """, lock_order)
+    assert any("join" in f.message for f in findings)
+    # join AFTER the critical section is the fix shape
+    findings, _ = _check(tmp_path, """
+        import threading
+
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._worker = None
+
+            def stop(self):
+                with self._lock:
+                    worker = self._worker
+                worker.join(timeout=1.0)
+    """, lock_order)
+    assert findings == []
+
+
+def test_lock_order_condition_wait_needs_predicate_loop(tmp_path):
+    findings, _ = _check(tmp_path, """
+        import threading
+
+
+        class C:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def bad_wait(self):
+                with self._cv:
+                    self._cv.wait(0.1)
+
+            def good_wait(self, pred):
+                with self._cv:
+                    while not pred():
+                        self._cv.wait(0.1)
+
+            def also_good(self, pred):
+                with self._cv:
+                    self._cv.wait_for(pred, timeout=0.1)
+    """, lock_order)
+    assert len(findings) == 1
+    assert "predicate loop" in findings[0].message
+    assert findings[0].symbol == "C.bad_wait"
+
+
+def test_lock_order_condition_alias_sees_through_wrapping(tmp_path):
+    # utils/batcher.py's `_wake = threading.Condition(self._lock)`:
+    # acquiring the condition IS acquiring the wrapped lock, so a
+    # with-on-both is a self-deadlock even though the names differ
+    findings, _ = _check(tmp_path, """
+        import threading
+
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._wake = threading.Condition(self._lock)
+
+            def bad(self):
+                with self._lock:
+                    self._nested()
+
+            def _nested(self):
+                with self._wake:
+                    return 1
+    """, lock_order)
+    assert any("re-acquired through call chain" in f.message
+               for f in findings)
+
+
+def test_lock_order_suppression(tmp_path):
+    _, report = _check(tmp_path, """
+        import threading
+
+
+        class C:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def one(self):
+                with self._a_lock:
+                    self._take_b()
+
+            def _take_b(self):
+                with self._b_lock:
+                    return 1
+
+            def other(self):
+                with self._b_lock:
+                    # ordering proven safe by an external gate
+                    with self._a_lock:  # kt-lint: disable=lock-order
+                        return 2
+    """, lock_order)
+    assert report.findings == []
+    assert len(report.suppressed) == 1
+
+
+# -- env-knob (whole-program, ISSUE 12) -------------------------------------
+def test_env_knob_unregistered_knob_is_flagged(tmp_path):
+    findings, _ = _check(tmp_path, """
+        import os
+
+        VALUE = os.environ.get("KARPENTER_TPU_BOGUS_KNOB", "x")
+    """, env_knobs)
+    assert len(findings) == 1
+    assert "no row in" in findings[0].message
+
+
+def test_env_knob_second_parser_is_flagged(tmp_path):
+    # KARPENTER_TPU_MESH's registered owner is solver/solve.py; a read
+    # anywhere else is the PR 6 two-drifting-parsers failure
+    findings, _ = _check(tmp_path, """
+        import os
+
+        MESH = os.environ.get("KARPENTER_TPU_MESH", "auto")
+    """, env_knobs, relname="karpenter_tpu/operator/other.py")
+    assert len(findings) == 1
+    assert "outside its owner" in findings[0].message
+
+
+def test_env_knob_bool_requires_env_bool(tmp_path):
+    # right module (the registered owner — provisioning.py owns exactly
+    # the one knob, so no sibling stale-row noise), wrong grammar:
+    # hand-rolled truthiness on a boolean knob
+    findings, _ = _check(tmp_path, """
+        import os
+
+        def warmup_enabled():
+            return bool(os.environ.get("KARPENTER_TPU_WARMUP"))
+    """, env_knobs, relname="karpenter_tpu/controllers/provisioning.py")
+    assert len(findings) == 1
+    assert "env_bool" in findings[0].message
+
+
+def test_env_knob_env_bool_and_helpers_are_reads(tmp_path):
+    # the canonical form is clean, resolves module-name constants, and
+    # helper functions that read env through a parameter count their
+    # call sites as reads (solve.py's _link_knob idiom)
+    findings, _ = _check(tmp_path, """
+        import os
+
+        _GATE = "KARPENTER_TPU_WARMUP"
+
+
+        def env_bool(name, default=False):
+            env = os.environ
+            raw = env.get(name)
+            return raw == "1" if raw is not None else default
+
+
+        def warmup_enabled():
+            return env_bool(_GATE)
+    """, env_knobs, relname="karpenter_tpu/controllers/provisioning.py")
+    assert findings == []
+
+
+def test_env_knob_missing_doc_row(tmp_path):
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "operations.md").write_text(
+        "| `KARPENTER_TPU_MESH` | unset | mesh knob |\n")
+    findings, _ = _check(tmp_path, """
+        import os
+
+        raw = os.environ.get("KARPENTER_TPU_PIPELINE", "auto")
+    """, env_knobs, relname="karpenter_tpu/solver/pipeline.py")
+    assert len(findings) == 1
+    assert findings[0].path == "docs/operations.md"
+    assert "KARPENTER_TPU_PIPELINE" in findings[0].message
+
+
+def test_env_knob_suppression(tmp_path):
+    _, report = _check(tmp_path, """
+        import os
+
+        X = os.environ.get("KARPENTER_TPU_BOGUS_KNOB")  # kt-lint: disable=env-knob
+    """, env_knobs)
+    assert report.findings == []
+    assert len(report.suppressed) == 1
+
+
+# -- wire-protocol (whole-program, ISSUE 12) --------------------------------
+_MINI_CC = """
+constexpr uint32_t kMaxFrame = 256u << 20;
+char header[12];
+PyObject* reset = PyObject_GetAttrString(module, "reset_worker_state");
+PyObject* handler = PyObject_GetAttrString(module, "handle_batch");
+PyObject* out = PyObject_CallFunction(handler, "(OOn)", payloads, conn_ids, backlog);
+int idle_ms = 5;
+int max_ms = 100;
+size_t max_batch = 64;
+"""
+
+_MINI_BACKEND = """
+    def reset_worker_state():
+        pass
+
+
+    def handle_batch(payloads, conn_ids=None, backlog=0):
+        for raw in payloads:
+            kind, body = raw
+            fp = body.get("fingerprint")
+            dl = body.get("deadline")
+        return []
+"""
+
+
+def _wire_tree(tmp_path, cc=_MINI_CC, client=None, backend=_MINI_BACKEND):
+    (tmp_path / "native").mkdir(exist_ok=True)
+    (tmp_path / "native" / "solverd.cc").write_text(cc)
+    paths = []
+    svc = tmp_path / "karpenter_tpu" / "service"
+    svc.mkdir(parents=True, exist_ok=True)
+    if client is not None:
+        (svc / "client.py").write_text(textwrap.dedent(client))
+        paths.append(str(svc / "client.py"))
+    if backend is not None:
+        (svc / "backend.py").write_text(textwrap.dedent(backend))
+        paths.append(str(svc / "backend.py"))
+    return core.run(paths, root=str(tmp_path), baseline=[],
+                    rules=[wire_protocol])
+
+
+def test_wire_protocol_max_frame_mismatch(tmp_path):
+    report = _wire_tree(tmp_path, client="""
+        import struct
+
+        _MAX_FRAME = 128 << 20
+
+        class C:
+            def _send(self, kind, body):
+                return struct.pack("<IQ", 0, 0)
+    """)
+    msgs = " | ".join(f.message for f in report.findings)
+    assert "_MAX_FRAME (134217728) != native kMaxFrame (268435456)" in msgs
+
+
+def test_wire_protocol_matching_mirrors_are_clean(tmp_path):
+    report = _wire_tree(tmp_path, client="""
+        import struct
+
+        _MAX_FRAME = 256 << 20
+
+        class C:
+            def _send(self, kind, body):
+                return struct.pack("<IQ", 0, 0)
+
+            def schedule(self):
+                self._send("schedule", {"fingerprint": "x",
+                                        "deadline": 1.0})
+    """)
+    assert report.findings == []
+
+
+def test_wire_protocol_missing_backend_attr(tmp_path):
+    report = _wire_tree(tmp_path, backend="""
+        def handle_batch(payloads, conn_ids=None, backlog=0):
+            return []
+    """)
+    msgs = " | ".join(f.message for f in report.findings)
+    assert "reset_worker_state" in msgs
+
+
+def test_wire_protocol_arity_drift(tmp_path):
+    report = _wire_tree(tmp_path, backend="""
+        def reset_worker_state():
+            pass
+
+
+        def handle_batch(payloads, conn_ids, backlog, extra_required):
+            return []
+    """)
+    msgs = " | ".join(f.message for f in report.findings)
+    assert "handle_batch takes" in msgs
+
+
+def test_wire_protocol_body_field_drift(tmp_path):
+    report = _wire_tree(tmp_path, client="""
+        import struct
+
+        _MAX_FRAME = 256 << 20
+
+        class C:
+            def _send(self, kind, body):
+                return struct.pack("<IQ", 0, 0)
+
+            def schedule(self):
+                self._send("schedule", {"fingerprint": "x",
+                                        "renamed_field": 1})
+    """)
+    msgs = " | ".join(f.message for f in report.findings)
+    assert "`renamed_field` the backend never reads" in msgs
+    assert "`deadline` the client never sends" in msgs
+
+
+def test_wire_protocol_suppression(tmp_path):
+    report = _wire_tree(tmp_path, client="""
+        import struct
+
+        # intentionally smaller cap while a migration is staged
+        _MAX_FRAME = 128 << 20  # kt-lint: disable=wire-protocol
+
+        class C:
+            def _send(self, kind, body):
+                return struct.pack("<IQ", 0, 0)
+
+            def schedule(self):
+                self._send("schedule", {"fingerprint": "x",
+                                        "deadline": 1.0})
+    """)
+    assert report.findings == []
+    assert len(report.suppressed) == 1
+
+
+# -- dynamic lock observer (utils/lockwatch.py, ISSUE 12) -------------------
+def test_lockwatch_catches_inverted_two_lock_toy(monkeypatch):
+    import threading
+
+    from karpenter_tpu.utils import lockwatch as lw
+
+    # isolate the edge store: an armed tier-1 session must not lose (or
+    # inherit) the real suite's edges through this toy
+    monkeypatch.setattr(lw, "_EDGES", {})
+    a = lw._ObservedLock(threading.Lock(), "karpenter_tpu/toy.py:1")
+    b = lw._ObservedLock(threading.Lock(), "karpenter_tpu/toy.py:2")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    rep = lw.verify()
+    assert len(rep["inversions"]) == 1
+    assert rep["inversions"][0]["kind"] == "dynamic-inversion"
+    assert rep["edges"] == 2
+
+
+def test_lockwatch_consistent_order_is_clean(monkeypatch):
+    import threading
+
+    from karpenter_tpu.utils import lockwatch as lw
+
+    monkeypatch.setattr(lw, "_EDGES", {})
+    a = lw._ObservedLock(threading.Lock(), "karpenter_tpu/toy.py:1")
+    b = lw._ObservedLock(threading.Lock(), "karpenter_tpu/toy.py:2")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    rep = lw.verify()
+    assert rep["inversions"] == []
+    assert rep["edges"] == 1
+
+
+def test_lockwatch_fails_edge_the_static_graph_calls_inverted(monkeypatch):
+    import threading
+
+    from karpenter_tpu.utils import lockwatch as lw
+
+    monkeypatch.setattr(lw, "_EDGES", {})
+    a = lw._ObservedLock(threading.Lock(), "karpenter_tpu/toy.py:1")
+    b = lw._ObservedLock(threading.Lock(), "karpenter_tpu/toy.py:2")
+    with b:
+        with a:  # observed b -> a, but the static graph orders a -> b
+            pass
+    site_to_id = {"karpenter_tpu/toy.py:1": "C._a_lock",
+                  "karpenter_tpu/toy.py:2": "C._b_lock"}
+    rep = lw.verify(static_edges={("C._a_lock", "C._b_lock")},
+                    site_to_id=site_to_id)
+    assert len(rep["inversions"]) == 1
+    assert rep["inversions"][0]["kind"] == "contradicts-static"
+    # the same observation against a static graph that agrees is clean
+    rep = lw.verify(static_edges={("C._b_lock", "C._a_lock")},
+                    site_to_id=site_to_id)
+    assert rep["inversions"] == []
+
+
+def test_lockwatch_condition_wait_releases_the_held_set(monkeypatch):
+    import threading
+
+    from karpenter_tpu.utils import lockwatch as lw
+
+    monkeypatch.setattr(lw, "_EDGES", {})
+    inner = lw._ObservedLock(threading.Lock(), "karpenter_tpu/toy.py:9")
+    cv = lw._RAW_CONDITION(inner)
+    done = []
+
+    def waiter():
+        with cv:
+            while not done:
+                cv.wait(0.5)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    import time
+    time.sleep(0.05)
+    # while the waiter sleeps in wait() the lock is RELEASED — this
+    # acquire must not record an edge from the waiter's held set
+    other = lw._ObservedLock(threading.Lock(), "karpenter_tpu/toy.py:10")
+    with cv:
+        done.append(1)
+        cv.notify_all()
+    t.join(timeout=5)
+    with other:
+        pass
+    assert lw.verify()["inversions"] == []
+
+
+def test_lockwatch_install_scopes_to_the_package(monkeypatch):
+    from karpenter_tpu.utils import lockwatch as lw
+
+    was_installed = lw.installed()
+    lw.install()
+    try:
+        ns = {}
+        code = compile("import threading\nL = threading.Lock()\n",
+                       "/somewhere/karpenter_tpu/toy_mod.py", "exec")
+        exec(code, ns)
+        assert isinstance(ns["L"], lw._ObservedLock)
+        assert ns["L"]._site == "karpenter_tpu/toy_mod.py:2"
+        ns2 = {}
+        code2 = compile("import threading\nL = threading.Lock()\n",
+                        "/somewhere/else/toy_mod.py", "exec")
+        exec(code2, ns2)
+        assert not isinstance(ns2["L"], lw._ObservedLock)
+    finally:
+        if not was_installed:
+            lw.uninstall()
+
+
+def test_static_model_exports_sites_for_the_dynamic_check(tmp_path):
+    # the conftest seam: build_model's site map keys match lockwatch's
+    # construction-site identity format (path:line of the ctor call)
+    p = tmp_path / "karpenter_tpu" / "mod.py"
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent("""
+        import threading
+
+
+        class C:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+    """))
+    ctx = core.FileContext(str(p), root=str(tmp_path))
+    from hack.analyze.rules import lock_order as lo
+    model = lo.build_model([ctx])
+    assert model.site_to_id() == {
+        "karpenter_tpu/mod.py:7": "karpenter_tpu/mod.py::C._a_lock"}
+
+
+def test_lockwatch_condition_over_observed_rlock(monkeypatch):
+    # threading.Condition(<observed RLock>) must wait/notify correctly:
+    # the proxy forwards _release_save/_acquire_restore/_is_owned for
+    # reentrant inners (the Condition fallback _is_owned is wrong for
+    # RLocks), with held-set bookkeeping truthful across the wait
+    import threading
+
+    from karpenter_tpu.utils import lockwatch as lw
+
+    monkeypatch.setattr(lw, "_EDGES", {})
+    rl = lw._ObservedLock(lw._RAW_RLOCK(), "karpenter_tpu/toy.py:20",
+                          reentrant=True)
+    cv = lw._RAW_CONDITION(rl)
+    done = []
+
+    def waiter():
+        with cv:
+            with rl:  # recursive hold across the wait
+                while not done:
+                    cv.wait(5.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    import time
+    time.sleep(0.05)
+    with cv:
+        done.append(1)
+        cv.notify_all()
+    t.join(timeout=10)
+    assert not t.is_alive(), "Condition(<observed RLock>) wedged"
+    assert lw.verify()["inversions"] == []
+    # a plain-Lock proxy still refuses the protocol attrs (the tested
+    # Condition fallback path stays in force)
+    plain = lw._ObservedLock(lw._RAW_LOCK(), "karpenter_tpu/toy.py:21")
+    import pytest
+    with pytest.raises(AttributeError):
+        plain._release_save
+
+
+# -- review-regression tests (ISSUE 12 post-review) -------------------------
+def test_env_knob_subset_run_sees_env_bool_reads(tmp_path):
+    # a path-restricted run that excludes utils/knobs.py must still
+    # count env_bool call sites as reads — the owner module alone must
+    # never produce a bogus stale-registry finding
+    findings, _ = _check(tmp_path, """
+        from karpenter_tpu.utils.knobs import env_bool
+
+
+        def warmup_enabled():
+            return env_bool("KARPENTER_TPU_WARMUP")
+    """, env_knobs, relname="karpenter_tpu/controllers/provisioning.py")
+    assert findings == []
+
+
+def test_wire_protocol_unrelated_subscript_get_is_not_a_frame_read(tmp_path):
+    # only `*.payload[...]` subscript receivers count as body reads;
+    # an unrelated dict-of-dicts .get() in the backend must not read as
+    # a frame field the client "never sends"
+    report = _wire_tree(tmp_path, client="""
+        import struct
+
+        _MAX_FRAME = 256 << 20
+
+        class C:
+            def _send(self, kind, body):
+                return struct.pack("<IQ", 0, 0)
+
+            def schedule(self):
+                self._send("schedule", {"fingerprint": "x",
+                                        "deadline": 1.0})
+    """, backend=_MINI_BACKEND + """
+
+    def summarize(stats):
+        return stats[0].get("zzz_unrelated")
+    """)
+    assert report.findings == []
+
+
+def test_fast_profile_does_not_stale_skipped_family_baselines():
+    # --fast skips lock-order; a baselined lock-order entry must read
+    # as out-of-scope, not stale (the pre-commit profile would
+    # otherwise hard-fail on a legitimately grandfathered finding)
+    from hack.analyze.rules import lock_discipline as ld
+    entry = {"rule": "lock-order", "path": "karpenter_tpu/x.py",
+             "symbol": "X.y", "contains": "whatever", "reason": "deferred"}
+    report = core.run(["karpenter_tpu/utils/knobs.py"], root=REPO,
+                      baseline=[entry], rules=[ld])
+    assert report.stale_baseline == []
+    # ...while a full run still treats a non-matching entry as stale
+    report = core.run(["karpenter_tpu/utils/knobs.py"], root=REPO,
+                      baseline=[entry], rules=[lock_order])
+    assert report.stale_baseline == [entry]

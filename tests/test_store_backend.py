@@ -60,16 +60,18 @@ class TestRemoteBackendProtocol:
         be.close()
 
     def test_peer_events_flow(self, daemon):
+        # event-driven, not sleep-polled (ISSUE 12): the constructor's
+        # watch-registration ack guarantees b sees writes made after it
+        # returns, and wait_events blocks on the watch stream's
+        # condition instead of burning a poll loop — the load-timing
+        # flake was b's registration racing a's first broadcast
         a = RemoteBackend(daemon.path)
         b = RemoteBackend(daemon.path)
         a.put("nodes", "n1", mkpod("n1"), verb="added")
         a.delete("nodes", "n1")
-        import time
-        deadline = time.time() + 5
-        evs = []
-        while len(evs) < 2 and time.time() < deadline:
-            evs += b.events()
-            time.sleep(0.01)
+        assert b.wait_events(2, timeout=10.0), \
+            f"peer events never arrived: {b.events()}"
+        evs = b.events()
         assert [(k, v, n) for k, v, n, _ in evs] == [
             ("nodes", "added", "n1"), ("nodes", "deleted", "n1")]
         a.close()
@@ -191,3 +193,20 @@ class TestEnvironmentOnRemoteBackend:
         # and a LOCAL stale update (cache already dropped it) is a no-op
         a.pods.update(stale)
         assert a.pods.get("z1") is None
+
+
+def test_wait_events_fails_fast_on_dead_stream(daemon):
+    """A dead watch stream must wake (and fail) wait_events promptly —
+    both a waiter already blocked and one arriving after the death —
+    instead of sleeping out the full timeout."""
+    import time
+    b = RemoteBackend(daemon.path)
+    daemon.close()
+    # give the reader a moment to observe EOF and mark the stream dead
+    deadline = time.time() + 5
+    while not b._watch_dead and time.time() < deadline:
+        time.sleep(0.01)
+    t0 = time.monotonic()
+    assert b.wait_events(1, timeout=30.0) is False
+    assert time.monotonic() - t0 < 5.0, "late waiter slept against a dead stream"
+    b.close()
