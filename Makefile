@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: test tier1 multichip lint analyze analyze-fast native asan tsan \
-	repro-crash repro-crash-tsan saturation-smoke
+	repro-crash repro-crash-tsan saturation-smoke explain-smoke
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
@@ -43,6 +43,15 @@ multichip:
 # `python benchmarks/config8_saturation.py` -> BENCH_r09.json.
 saturation-smoke:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/config8_saturation.py --smoke
+
+# The capture -> kt_explain loop end to end (ISSUE 13): solve a workload
+# with a deliberately stranded pod class under the flight recorder's
+# full-capture mode, then run the real tools/kt_explain.py CLI against
+# the spilled record and assert registry-coded verdicts with
+# constraint-elimination trees come back.  The overhead bench is
+# `python bench.py --explain` -> BENCH_r10.json.
+explain-smoke:
+	JAX_PLATFORMS=cpu $(PY) hack/explain_smoke.py
 
 # `lint` is the historical name; `analyze` is canonical — one recipe.
 lint: analyze

@@ -464,6 +464,32 @@ def multichip_main(n_devices: int = 8, reps: int = 16) -> None:
           f"{len(steady_transfers)}", file=sys.stderr)
 
 
+def _ab_stats(ts):
+    """min/p10/p50 of one arm's run times — p10 filters host noise like
+    min but survives a single lucky outlier rep (the A/B benches' shared
+    percentile discipline)."""
+    srt = sorted(ts)
+    return {"min": round(srt[0], 2),
+            "p10": round(srt[max(0, int(round(0.10 * len(srt)))
+                                 - 1)], 2),
+            "p50": round(statistics.median(srt), 2)}
+
+
+def _ab_interleave(reps: int, arms, run_arm):
+    """Interleaved A/B pairs with the order ALTERNATING each pair: this
+    host runs the second solve of any back-to-back pair systematically
+    slower regardless of arm (measured ~+15%), so a fixed order would
+    charge that position tax to one arm.  `run_arm(arm)` performs one
+    timed solve and returns milliseconds; returns {arm: [ms, ...]}."""
+    arms = tuple(arms)
+    times = {a: [] for a in arms}
+    for i in range(reps):
+        order = arms if i % 2 == 0 else tuple(reversed(arms))
+        for arm in order:
+            times[arm].append(run_arm(arm))
+    return times
+
+
 def flight_overhead_main(reps: int = 24) -> None:
     """`bench.py --flight`: the flight recorder's acceptance bench — the
     always-on fingerprint-only record must add <1% of the 50k headline
@@ -510,15 +536,15 @@ def flight_overhead_main(reps: int = 24) -> None:
             record_ms.append((time.perf_counter() - t0) * 1000.0)
         return out
     TPUSolver._flight_record = timed_record
+
+    def run_arm(arm):
+        os.environ["KARPENTER_TPU_FLIGHT"] = arm
+        t0 = time.perf_counter()
+        solver.solve(inp)
+        return (time.perf_counter() - t0) * 1000.0
+
     try:
-        times = {"off": [], "on": []}
-        for i in range(reps):
-            order = ("off", "on") if i % 2 == 0 else ("on", "off")
-            for arm in order:
-                os.environ["KARPENTER_TPU_FLIGHT"] = arm
-                t0 = time.perf_counter()
-                solver.solve(inp)
-                times[arm].append((time.perf_counter() - t0) * 1000.0)
+        times = _ab_interleave(reps, ("off", "on"), run_arm)
     finally:
         TPUSolver._flight_record = orig_record
         os.environ.pop("KARPENTER_TPU_FLIGHT", None)
@@ -526,13 +552,7 @@ def flight_overhead_main(reps: int = 24) -> None:
         "recorder-on arm produced no flight records"
     assert record_ms, "the recorder seam never fired on the on-arm"
 
-    def stats(ts):
-        srt = sorted(ts)
-        return {"min": round(srt[0], 2),
-                "p10": round(srt[max(0, int(round(0.10 * len(srt)))
-                                     - 1)], 2),
-                "p50": round(statistics.median(srt), 2)}
-    s_off, s_on = stats(times["off"]), stats(times["on"])
+    s_off, s_on = _ab_stats(times["off"]), _ab_stats(times["on"])
     overhead_ms = s_on["p10"] - s_off["p10"]
     overhead_pct = 100.0 * overhead_ms / s_off["p50"]
     rec_p50 = statistics.median(record_ms)
@@ -563,6 +583,99 @@ def flight_overhead_main(reps: int = 24) -> None:
           f"({overhead_pct:+.2f}% of off p50 {s_off['p50']}ms); "
           f"recorder seam itself {rec_p50:.3f}ms/solve "
           f"({rec_share_pct:.3f}%) pass={ok}", file=sys.stderr)
+    if not ok:
+        raise SystemExit(1)
+
+
+def explain_overhead_main(reps: int = 24,
+                          out_path: str = "BENCH_r10.json") -> None:
+    """`bench.py --explain`: the placement-provenance acceptance bench
+    (ISSUE 13) — the default counts-mode kernel aux must add <1% of the
+    50k headline solve's p50, with bit-exact solver parity (nodes +
+    IEEE-hex price unchanged vs explain=off).  Methodology is the
+    flight bench's, per the host-noise discipline: interleaved off/on
+    PAIRS with ALTERNATING order (this host runs the second solve of a
+    back-to-back pair systematically slower), p10-vs-p10 A/B gate.
+
+    Unlike the flight knob (read per record), the explain mode pins at
+    solver construction (`_explain_resolved` — a restart-time operator
+    lever), so each arm runs its OWN solver instance; the two arms
+    compile different programs by design (the aux rows are new outputs)
+    and each is warmed before the timed window.  Exits 1 past the 1%
+    gate or on any parity mismatch; stamps the result into
+    `BENCH_r10.json`."""
+    # the repeat loop re-solves one input: full solves only (the same
+    # pinning discipline as the headline)
+    os.environ["KARPENTER_TPU_DELTA"] = "off"
+    from karpenter_tpu.utils.platform import initialize
+    platform = initialize(attempt_log=log_attempt)
+    from karpenter_tpu.solver import TPUSolver
+
+    inp = build_input(50_000)
+    solvers, digests = {}, {}
+    for arm in ("off", "counts"):
+        os.environ["KARPENTER_TPU_EXPLAIN"] = arm
+        solver = TPUSolver(max_nodes=2048)
+        if not solvers:
+            solver, res, platform = first_solve_with_retry(
+                solver, inp, platform)
+        else:
+            res = solver.solve(inp)
+        assert not res.unschedulable
+        solver.solve(inp)  # settle the adaptive node bucket
+        solvers[arm] = solver
+        digests[arm] = (res.node_count(),
+                        float(res.total_price()).hex())
+    parity = digests["off"] == digests["counts"]
+
+    def run_arm(arm):
+        os.environ["KARPENTER_TPU_EXPLAIN"] = arm
+        t0 = time.perf_counter()
+        solvers[arm].solve(inp)
+        return (time.perf_counter() - t0) * 1000.0
+
+    try:
+        times = _ab_interleave(reps, ("off", "counts"), run_arm)
+    finally:
+        os.environ.pop("KARPENTER_TPU_EXPLAIN", None)
+    counts_summary = solvers["counts"].last_explain
+    assert counts_summary and counts_summary.get("kernel_aux"), \
+        "the counts arm never produced kernel aux"
+
+    s_off, s_on = _ab_stats(times["off"]), _ab_stats(times["counts"])
+    overhead_ms = s_on["p10"] - s_off["p10"]
+    overhead_pct = 100.0 * overhead_ms / s_off["p50"]
+    ok = overhead_pct < 1.0 and parity
+    from benchmarks.common import env_fingerprint
+    result = {
+        "metric": "explain=counts overhead on the 50k headline solve",
+        "value": round(overhead_pct, 3),
+        "unit": "% of p50 (p10-counts minus p10-off)",
+        "pass": ok,
+        "threshold_pct": 1.0,
+        "reps_per_arm": reps,
+        "parity": parity,
+        "digest_off": digests["off"],
+        "digest_counts": digests["counts"],
+        "off_ms": s_off, "counts_ms": s_on,
+        "overhead_ms_p10": round(overhead_ms, 2),
+        "overhead_pct_of_p50": round(overhead_pct, 3),
+        "counts_summary": counts_summary,
+        "runs_off_ms": [round(t, 1) for t in times["off"]],
+        "runs_counts_ms": [round(t, 1) for t in times["counts"]],
+        "platform": platform,
+        "env": env_fingerprint(platform, reps=reps,
+                               times_ms=times["counts"]),
+    }
+    log_attempt({"stage": "explain-overhead", **result,
+                 "ts": time.time()})
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+    print(json.dumps(result))
+    print(f"explain overhead: p10-vs-p10 {overhead_ms:+.1f}ms "
+          f"({overhead_pct:+.2f}% of off p50 {s_off['p50']}ms); "
+          f"parity={parity} pass={ok} -> {out_path}", file=sys.stderr)
     if not ok:
         raise SystemExit(1)
 
@@ -728,5 +841,9 @@ if __name__ == "__main__":
         argv = sys.argv[1:]
         flight_overhead_main(reps=_int_opt(
             argv, "--reps", 24, "bench.py --flight [--reps R]"))
+    elif "--explain" in sys.argv[1:]:
+        argv = sys.argv[1:]
+        explain_overhead_main(reps=_int_opt(
+            argv, "--reps", 24, "bench.py --explain [--reps R]"))
     else:
         main()

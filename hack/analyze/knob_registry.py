@@ -32,6 +32,8 @@ KNOBS = {
         "owner": "karpenter_tpu/solver/solve.py", "kind": "spec"},
     "KARPENTER_TPU_DELTA": {
         "owner": "karpenter_tpu/solver/solve.py", "kind": "spec"},
+    "KARPENTER_TPU_EXPLAIN": {
+        "owner": "karpenter_tpu/solver/explain.py", "kind": "spec"},
     "KARPENTER_TPU_FAULTS": {
         "owner": "karpenter_tpu/utils/faults.py", "kind": "spec"},
     "KARPENTER_TPU_FLIGHT": {

@@ -22,6 +22,12 @@ Checks, over string-literal registrations anywhere in the tree:
   * span names (`tracing.span(...)`, `tracing.child_span(...)`,
     `tracing.record_span(...)` and the bare imported forms): lowercase
     dotted segments `seg(.seg)*`, each `[a-z0-9_]+`
+  * reason literals (ISSUE 13): any ``<x>.unschedulable[...] =
+    "<string literal>"`` (or f-string / literal concatenation) outside
+    the reason-code registry module (`karpenter_tpu/solver/explain.py`)
+    is a finding — unschedulability verdicts must be structured
+    `explain.make(CODE, detail)` Reasons, never ad-hoc strings (the
+    substring-discrimination hazard the registry retired).
 """
 
 from __future__ import annotations
@@ -69,8 +75,50 @@ def _span_name_arg(call: ast.Call) -> Optional[ast.Constant]:
     return None
 
 
+# the one module allowed to spell reason strings next to their codes
+_REASON_REGISTRY_MODULE = "karpenter_tpu/solver/explain.py"
+
+
+def _contains_str_literal(expr: ast.AST) -> bool:
+    """A direct string-literal value: plain constant, f-string, or a
+    literal concatenation chain.  A *variable* assignment is not
+    flagged (provenance untraceable statically) — the registry's
+    `make()` calls return Reason objects, never bare literals."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return True
+    if isinstance(expr, ast.JoinedStr):
+        return True
+    if isinstance(expr, ast.BinOp):
+        return (_contains_str_literal(expr.left)
+                or _contains_str_literal(expr.right))
+    return False
+
+
+def _reason_literal_findings(ctx: FileContext,
+                             node: ast.Assign) -> Iterator[Finding]:
+    if ctx.rel.endswith(_REASON_REGISTRY_MODULE):
+        return
+    for target in node.targets:
+        if not isinstance(target, ast.Subscript):
+            continue
+        base = target.value
+        named = (isinstance(base, ast.Attribute)
+                 and base.attr == "unschedulable") or (
+            isinstance(base, ast.Name) and base.id == "unschedulable")
+        if named and _contains_str_literal(node.value):
+            yield ctx.finding(
+                RULE_NAME, node,
+                "unschedulable reason assigned as a string literal — "
+                "emit a registry code via "
+                "karpenter_tpu.solver.explain.make(CODE, detail) "
+                "(reason-literal)")
+
+
 def check(ctx: FileContext) -> Iterator[Finding]:
     for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign):
+            yield from _reason_literal_findings(ctx, node)
+            continue
         if not isinstance(node, ast.Call):
             continue
         reg = _registration(node)

@@ -242,6 +242,16 @@ class Cluster:
         reconcile pass can't grow it without limit). The dedup window
         covers more candidates than the largest supported consolidation
         sweep so per-pass repeats collapse."""
+        # structured reasons (solver/explain.py Reason) upgrade to
+        # code+detail: the registry code leads the message so operators
+        # and log pipelines can match on it, while the legacy
+        # human-readable string stays intact after it.  The format has
+        # ONE owner (explain.event_message); the duck-typed attribute
+        # check keeps the import off the plain-string fast path (the
+        # registry module is jax-free, so the lazy import is cheap).
+        if getattr(message, "code", None) is not None:
+            from karpenter_tpu.solver.explain import event_message
+            message = event_message(message)
         # message participates in the key: a node's reason label (e.g.
         # Unconsolidatable) can stay the same while the CAUSE changes —
         # the refreshed message must land, only true repeats drop

@@ -14,7 +14,7 @@ from __future__ import annotations
 from karpenter_tpu.cloudprovider import TPUCloudProvider
 from karpenter_tpu.cluster import Cluster
 from karpenter_tpu.providers.fake_cloud import INSTANCE_RUNNING
-from karpenter_tpu.utils import errors, metrics
+from karpenter_tpu.utils import errors, metrics, tracing
 from karpenter_tpu.utils.logging import get_logger
 
 
@@ -27,7 +27,11 @@ class GarbageCollection:
 
     def reconcile(self) -> None:
         try:
-            self._reconcile()
+            # one trace per sweep: record_event stamps the active trace
+            # id, so reclaim/orphan events cross-reference their pass
+            # exactly like provisioning's FailedScheduling events do
+            with tracing.span("gc.pass"):
+                self._reconcile()
         except Exception as e:  # noqa: BLE001
             # GC is cloud-read-heavy; a transient outage just means this
             # sweep is skipped (pkg/errors taxonomy — retry next round).

@@ -25,7 +25,7 @@ from karpenter_tpu.models.objects import (
     NodeClaim,
 )
 from karpenter_tpu.operator.options import Options
-from karpenter_tpu.utils import errors, metrics
+from karpenter_tpu.utils import errors, metrics, tracing
 from karpenter_tpu.utils.clock import Clock
 
 
@@ -45,15 +45,19 @@ class NodeClaimLifecycle:
         self.clock = clock or cluster.clock
 
     def reconcile(self) -> None:
-        for claim in self.cluster.nodeclaims.list():
-            if claim.meta.deleting:
-                continue
-            if not claim.is_(COND_LAUNCHED):
-                self._launch(claim)
-            elif not claim.is_(COND_REGISTERED):
-                self._register(claim)
-            elif not claim.is_(COND_INITIALIZED):
-                self._initialize(claim)
+        # one trace per lifecycle pass: Launched/LaunchRetryable/
+        # Registered events stamp the pass's trace id (same
+        # cross-referencing contract as provisioning.pass)
+        with tracing.span("lifecycle.pass"):
+            for claim in self.cluster.nodeclaims.list():
+                if claim.meta.deleting:
+                    continue
+                if not claim.is_(COND_LAUNCHED):
+                    self._launch(claim)
+                elif not claim.is_(COND_REGISTERED):
+                    self._register(claim)
+                elif not claim.is_(COND_INITIALIZED):
+                    self._initialize(claim)
 
     # -- launch -----------------------------------------------------------
     def _launch(self, claim: NodeClaim) -> None:

@@ -188,6 +188,21 @@ class Provisioner:
                     live.meta.annotations[NOMINATED_ANNOTATION] = claim.name
                     self.cluster.pods.update(live)
 
+        if result.unschedulable:
+            # placement provenance (ISSUE 13): this is the authoritative
+            # "pod is unschedulable" surface — every solver path (device,
+            # split, rescue, degraded, remote: the reason tree rides the
+            # pickled Reason) lands here, so the per-reason counter and
+            # the explain store are fed here, not inside the solver
+            # (whose solve() also serves counterfactual simulations)
+            from karpenter_tpu.solver import explain as explainmod
+            explainmod.STORE.register(
+                result.unschedulable,
+                trace_id=tracing.current_trace_id(),
+                source="provisioning")
+            for reason in result.unschedulable.values():
+                metrics.UNSCHEDULABLE_PODS.inc(
+                    reason=explainmod.code_of(reason))
         for pod_name, reason in result.unschedulable.items():
             self.cluster.record_event(
                 "Pod", pod_name, "FailedScheduling", reason)

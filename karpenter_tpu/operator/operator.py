@@ -165,6 +165,42 @@ class Operator:
                                     .tail(limit, trace_id=tid)},
                                    default=str) + "\n",
                         "application/json; charset=utf-8")
+                elif path == "/debug/explain":
+                    # placement provenance (ISSUE 13): the per-pod
+                    # constraint-elimination tree behind a FailedScheduling
+                    # verdict.  ?pod= looks one pod up (?trace_id= pins a
+                    # specific pass), no pod lists the recent stranded
+                    # pods; ?format=html renders the no-tooling view.
+                    from karpenter_tpu.solver import explain as explainm
+                    q = parse_qs(url.query)
+                    pod = (q.get("pod") or [None])[0]
+                    tid = (q.get("trace_id") or [None])[0]
+                    try:
+                        limit = int((q.get("limit") or ["32"])[0])
+                    except ValueError:
+                        limit = 32
+                    if pod:
+                        entry = explainm.STORE.lookup(pod, trace_id=tid)
+                        code = 200 if entry is not None else 404
+                        doc = entry if entry is not None else {
+                            "error": f"no explain record for pod {pod!r}"
+                                     + (f" on trace {tid}" if tid else ""),
+                            "hint": "the store holds recent provisioning "
+                                    "verdicts; for a past solve, replay "
+                                    "its flight record with "
+                                    "tools/kt_explain.py"}
+                    else:
+                        code = 200
+                        doc = {"pods": explainm.STORE.recent(limit),
+                               "reason_codes": explainm.reason_table()}
+                    fmt = (q.get("format") or ["json"])[0]
+                    if fmt == "html":
+                        self._respond(code, op._explain_html(doc),
+                                      "text/html; charset=utf-8")
+                    else:
+                        self._respond(
+                            code, json.dumps(doc, default=str) + "\n",
+                            "application/json; charset=utf-8")
                 elif path == "/debug/state":
                     c = op.env.cluster
                     self._respond(200, json.dumps({
@@ -177,6 +213,22 @@ class Operator:
                     self._respond(404, "not found\n")
 
         return Handler
+
+    @staticmethod
+    def _explain_html(doc: dict) -> str:
+        """The no-tooling rendering of one explain document (same
+        monospace styling as the dashboard page)."""
+        import html as _html
+        body = _html.escape(json.dumps(doc, indent=2, default=str))
+        title = doc.get("pod", "placement explainability")
+        return (
+            "<!doctype html><html><head><meta charset='utf-8'>"
+            "<title>karpenter-tpu explain</title>"
+            "<style>body{font-family:monospace;margin:1.5em}"
+            "pre{background:#f6f6f6;padding:8px;overflow-x:auto}"
+            "</style></head><body>"
+            f"<h1>explain: {_html.escape(str(title))}</h1>"
+            f"<pre>{body}</pre></body></html>")
 
     def _worker_snapshot(self):
         """The solverd worker's section of the dashboard merge: its

@@ -182,14 +182,14 @@ class MeshExecutor:
 
     # -- the resident solve program --------------------------------------
     def _program(self, layout, max_nodes: int, zc: int, sparse_n: int,
-                 donate: bool):
-        key = (layout, max_nodes, zc, sparse_n, donate)
+                 donate: bool, explain: int = 0):
+        key = (layout, max_nodes, zc, sparse_n, donate, explain)
         prog = self._progs.get(key)
         if prog is None:
             ax = self.axis
             body = partial(ffd._solve_ffd_resident_impl, layout=layout,
                            max_nodes=max_nodes, zc=zc, sparse_n=sparse_n,
-                           axis_name=ax)
+                           axis_name=ax, explain=explain)
             sm = shard_map(
                 body, mesh=self.mesh,
                 in_specs=(P(),            # problem buffer (replicated)
@@ -209,18 +209,19 @@ class MeshExecutor:
             self._progs[key] = prog
         return prog
 
-    def _delta_program(self, layout, max_nodes: int, zc: int):
+    def _delta_program(self, layout, max_nodes: int, zc: int,
+                       explain: int = 0):
         """The seeded delta kernel under shard_map: the replicated
         suffix buffer plus the column-sharded seed masks and the
         resident mask table/catalog shards.  Cached by statics like the
         resident program (never a fresh jit cache per call)."""
-        key = ("delta", layout, max_nodes, zc)
+        key = ("delta", layout, max_nodes, zc, explain)
         prog = self._progs.get(key)
         if prog is None:
             ax = self.axis
             body = partial(ffd._solve_ffd_delta_resident_impl,
                            layout=layout, max_nodes=max_nodes, zc=zc,
-                           axis_name=ax)
+                           axis_name=ax, explain=explain)
             sm = shard_map(
                 body, mesh=self.mesh,
                 in_specs=(P(),            # suffix problem buffer
@@ -239,19 +240,20 @@ class MeshExecutor:
         return prog
 
     def solve_delta(self, buf, seed_colmask, mask_table, dev: dict,
-                    layout, max_nodes: int):
+                    layout, max_nodes: int, explain: int = 0):
         """Dispatch one seeded delta solve (solver/delta.py): the
         suffix problem buffer replicates, the seed column masks arrive
         column-sharded (the caller committed them via put_sharded, so
         the transfer is logged), everything else is resident."""
-        prog = self._delta_program(layout, max_nodes, dev["ZC"])
+        prog = self._delta_program(layout, max_nodes, dev["ZC"],
+                                   explain=explain)
         return prog(buf, seed_colmask, mask_table,
                     dev["col_alloc"], dev["col_daemon"],
                     dev["pt_alloc"], dev["col_pool"],
                     dev["pool_daemon"], dev["col_zone"], dev["col_ct"])
 
     def solve(self, buf, mask_table, dev: dict, layout, max_nodes: int,
-              sparse_n: int, donate: bool):
+              sparse_n: int, donate: bool, explain: int = 0):
         """Dispatch one resident-path solve.  `buf` is the coalesced
         replicated problem buffer (committed — possibly through a
         donated DeviceSlots rotation — or host numpy, which jit commits
@@ -260,7 +262,7 @@ class MeshExecutor:
         concurrent capacity cycle may have replaced it); everything with
         a column axis is already resident."""
         prog = self._program(layout, max_nodes, dev["ZC"], sparse_n,
-                             donate)
+                             donate, explain=explain)
         return prog(buf, mask_table,
                     dev["col_alloc"], dev["col_daemon"], dev["pt_alloc"],
                     dev["col_pool"], dev["pool_daemon"],

@@ -44,6 +44,11 @@ from karpenter_tpu.scheduling.types import (
     effective_request,
     min_values_violation,
 )
+# the reason-code registry (jax-free: the solver package resolves its
+# heavy exports lazily) — every oracle verdict carries a structured code
+# so the solver's oracle-vs-kernel discrimination is a code comparison,
+# never a substring match
+from karpenter_tpu.solver import explain as explainmod
 
 _sim_counter = itertools.count(1)
 
@@ -453,26 +458,36 @@ class Scheduler:
 
     # -- opening a new node ----------------------------------------------
     def _open_new(self, pod: Pod, req: Resources) -> Optional[str]:
-        reasons: List[str] = []
+        # per-pool (cause, pool name, text) verdicts: the text keeps the
+        # legacy log line; the cause + pool name feed the structured
+        # reason tree and decide the overall code (a binding limit
+        # anywhere ⇒ PoolLimitExceeded, the verdict the solver's oracle
+        # backstop keys on)
+        reasons: List[Tuple[str, str, str]] = []
         pools = sorted(self.inp.nodepools,
                        key=lambda np: (-np.weight, np.meta.name))
         for pool in pools:
             types = self.inp.instance_types.get(pool.name, [])
             if not types:
-                reasons.append(f"nodepool {pool.name}: no instance types")
+                reasons.append((explainmod.CAUSE_NO_TYPES, pool.name,
+                                f"nodepool {pool.name}: no instance types"))
                 continue
             if not tolerates_all(pool.taints, pod.tolerations):
-                reasons.append(f"nodepool {pool.name}: taints not tolerated")
+                reasons.append((explainmod.CAUSE_TAINTS, pool.name,
+                                f"nodepool {pool.name}: taints not tolerated"))
                 continue
             template = pool.template_requirements()
             unknown = self._unknown_required_key(pod, template)
             if unknown is not None:
-                reasons.append(
-                    f"nodepool {pool.name}: label {unknown} has no known values")
+                reasons.append((
+                    explainmod.CAUSE_UNKNOWN_LABEL, pool.name,
+                    f"nodepool {pool.name}: label {unknown} has no known values"))
                 continue
             if not template.compatible(pod.requirements):
                 key = template.conflict_key(pod.requirements)
-                reasons.append(f"nodepool {pool.name}: incompatible on {key}")
+                reasons.append((
+                    explainmod.CAUSE_INCOMPATIBLE, pool.name,
+                    f"nodepool {pool.name}: incompatible on {key}"))
                 continue
             merged = template.intersection(pod.requirements)
             daemon = self.inp.daemon_overhead.get(pool.name, Resources())
@@ -480,17 +495,21 @@ class Scheduler:
             limit = self._remaining_limits.get(pool.name)
             # a new node charges pod + daemonset overhead against the limit
             if limit is not None and not total.fits(limit):
-                reasons.append(f"nodepool {pool.name}: limits exceeded")
+                reasons.append((explainmod.CAUSE_LIMITS, pool.name,
+                                f"nodepool {pool.name}: limits exceeded"))
                 continue
             survivors = self._filter_types(types, merged, total)
             if not survivors:
-                reasons.append(
-                    f"nodepool {pool.name}: no instance type fits/compatible")
+                reasons.append((
+                    explainmod.CAUSE_NO_FIT, pool.name,
+                    f"nodepool {pool.name}: no instance type fits/compatible"))
                 continue
             sim = _NewSim(pool, merged, survivors, daemon)
             narrowed = self._resolve_topology(pod, sim, merged, survivors)
             if narrowed is None:
-                reasons.append(f"nodepool {pool.name}: topology unsatisfiable")
+                reasons.append((
+                    explainmod.CAUSE_TOPOLOGY, pool.name,
+                    f"nodepool {pool.name}: topology unsatisfiable"))
                 continue
             sim.requirements, sim.candidates = narrowed
             sim.requests = total
@@ -501,8 +520,17 @@ class Scheduler:
             if limit is not None:
                 self._remaining_limits[pool.name] = limit - total
             return None
-        detail = "; ".join(reasons) if reasons else "no nodepools configured"
-        return f"no nodepool can schedule pod: {detail}"
+        detail = ("; ".join(t for _, _, t in reasons) if reasons
+                  else "no nodepools configured")
+        code = (explainmod.POOL_LIMIT
+                if any(c == explainmod.CAUSE_LIMITS for c, _, _ in reasons)
+                else explainmod.NO_NODEPOOL)
+        tree = {"code": code,
+                "constraint": explainmod.constraint_of(code),
+                "pools": [{"nodepool": name, "cause": c, "detail": t}
+                          for c, name, t in reasons]}
+        return explainmod.make(
+            code, f"no nodepool can schedule pod: {detail}", tree)
 
     # -- shared filters ---------------------------------------------------
     @staticmethod
@@ -532,8 +560,9 @@ class Scheduler:
             )
             violation = min_values_violation(reqs, ranked)
             if violation is not None:
+                reason = explainmod.make(explainmod.MIN_VALUES, violation)
                 for pod in sim.pods:
-                    self.result.unschedulable[pod.meta.name] = violation
+                    self.result.unschedulable[pod.meta.name] = reason
                 continue
             cheapest = ranked[0].cheapest_offering(reqs)
             self.result.new_claims.append(NewNodeClaim(

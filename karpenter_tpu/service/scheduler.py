@@ -73,6 +73,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from karpenter_tpu.solver.explain import SHED_ADMISSION, SHED_DEADLINE
 from karpenter_tpu.utils import metrics
 
 # per-tenant queue bound: past it, admission sheds lowest-priority first
@@ -278,7 +279,7 @@ class TenantScheduler:
                 metrics.SERVICE_TENANT_REQUESTS.inc(tenant=tenant)
             shed_resp = None
             if victim is not None:
-                shed_resp = self._shed_locked(victim, "admission")
+                shed_resp = self._shed_locked(victim, SHED_ADMISSION)
             self._gc_tenants_locked()
             self._set_depth_gauges_locked()
         if victim is not None:
@@ -382,7 +383,7 @@ class TenantScheduler:
             kept = []
             for item in tq.items:
                 if item.deadline is not None and now >= item.deadline:
-                    sheds.append((item, self._shed_locked(item, "deadline")))
+                    sheds.append((item, self._shed_locked(item, SHED_DEADLINE)))
                 else:
                     kept.append(item)
             tq.items = kept
@@ -421,7 +422,7 @@ class TenantScheduler:
                         break
                     if item.deadline is not None and now >= item.deadline:
                         sheds.append(
-                            (item, self._shed_locked(item, "deadline")))
+                            (item, self._shed_locked(item, SHED_DEADLINE)))
                         continue
                     batch.append(item)
             else:
@@ -434,7 +435,7 @@ class TenantScheduler:
                         if item.deadline is not None \
                                 and now >= item.deadline:
                             sheds.append(
-                                (item, self._shed_locked(item, "deadline")))
+                                (item, self._shed_locked(item, SHED_DEADLINE)))
                             continue  # shedding is not service: no charge
                         batch.append(item)
                         tq.deficit -= 1.0

@@ -28,8 +28,21 @@ co-location seeding, selectors coupling pending groups — raise
 `UnsupportedPods` and the provisioner falls
 back to the CPU oracle (solver-unavailable ⇒ fall back, never fail —
 SURVEY §5).
-"""
 
-from karpenter_tpu.solver.solve import TPUSolver, UnsupportedPods
+The package exports resolve LAZILY (PEP 562): `TPUSolver` lives in
+`solve.py`, which imports jax at module import time — but the jax-free
+submodules (`encode`, `explain`, the reason-code registry the oracle and
+the event plumbing draw from) must stay importable without pulling a
+multi-second jax import into every process that touches a scheduling
+verdict (the store daemon, the CPU-oracle fallback path, the lint
+tooling)."""
 
 __all__ = ["TPUSolver", "UnsupportedPods"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        from karpenter_tpu.solver import solve as _solve
+        return getattr(_solve, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

@@ -106,6 +106,12 @@ class DeltaRecord:
     num_active: int
     node_fps: List[_NodeFP]
     res_anti_any: bool
+    # placement-provenance prefix attribution (ISSUE 13): the solve's
+    # kernel aux counts rows ([G, EXPLAIN_C], KERNEL_CONSTRAINTS order)
+    # — a delta pass reuses the prefix rows and stitches the suffix's
+    # fresh aux after them, exactly like the take rows.  None when the
+    # record was built with explain off.
+    explain_counts: Optional[np.ndarray] = None
     # lazy caches, carried forward across delta passes while the catalog
     # and node set hold: the per-call existing-node label matrices and
     # the per-class opener feasibility rows
@@ -548,12 +554,39 @@ def merge(plan_: DeltaPlan, sp: SuffixProblem, cat, inp,
         node_ct=np.asarray(node_ct, dtype=np.int32),
         num_active=num_active,
     )
+    # prefix-attribution reuse (ISSUE 13): stitch the record's cached
+    # aux rows with the suffix solve's fresh ones, like the take rows —
+    # present only when BOTH sides carried aux (a mode flip mid-cache
+    # simply drops the merged attribution for one pass)
+    kc_prev = rec.explain_counts
+    kc_suf = out_s.get("explain_counts") if out_s is not None else None
+    if kc_prev is not None and (out_s is None or kc_suf is not None):
+        prefix_rows = np.asarray(kc_prev)[:m]
+        if out_s is None:
+            out_m["explain_counts"] = prefix_rows.copy()
+        else:
+            out_m["explain_counts"] = np.concatenate(
+                [prefix_rows, np.asarray(kc_suf)[:Gd]], axis=0)
 
     def cc(a, b):
         return np.concatenate([a, b], axis=0) if Gd else a.copy()
 
     inert_i = np.zeros(Gd, dtype=np.int32)
     groups_m = list(plan_.suffix)
+    # host-side attribution stitches the same way (price column is 0 by
+    # contract: the delta path falls back on any price cap)
+    eh_prev = getattr(enc_p, "explain_host", None)
+    explain_host = None
+    if eh_prev is not None:
+        if Gd:
+            suf_host = np.stack(
+                [len(cat.columns)
+                 - sp.group_mask.sum(axis=1, dtype=np.int64),
+                 np.zeros(Gd, dtype=np.int64)], axis=1)
+            explain_host = np.concatenate(
+                [np.asarray(eh_prev)[:m], suf_host], axis=0)
+        else:
+            explain_host = np.asarray(eh_prev)[:m].copy()
     enc_m = EncodedProblem(
         group_req=cc(enc_p.group_req[:m], sp.group_req),
         group_count=cc(enc_p.group_count[:m], sp.group_count),
@@ -592,6 +625,7 @@ def merge(plan_: DeltaPlan, sp: SuffixProblem, cat, inp,
                             wellknown.CAPACITY_TYPE_LABEL: None}
                            for _ in range(Gd)]),
         residue=[],
+        explain_host=explain_host,
         groups=list(plan_.new_prefix) + groups_m,
         columns=cat.columns,
         existing=list(inp.existing_nodes),
@@ -654,8 +688,12 @@ def make_record(cat, enc: EncodedProblem, out: dict, inp
     gkeys = [(g[0].scheduling_group_id(),
               tuple(p.meta.name for p in g)) for g in enc.groups]
     node_fps = [_fingerprint(en) for en in enc.existing]
+    kc = out.get("explain_counts")
+    explain_counts = (np.ascontiguousarray(np.asarray(kc)[:G])
+                      if kc is not None else None)
     return DeltaRecord(
         cat=cat, enc=enc, groups=list(enc.groups), gkeys=gkeys,
         out_te=te, out_tn=tn, node_pool=node_pool, num_active=na,
         node_fps=node_fps,
-        res_anti_any=any(fp.res_anti for fp in node_fps))
+        res_anti_any=any(fp.res_anti for fp in node_fps),
+        explain_counts=explain_counts)

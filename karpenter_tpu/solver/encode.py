@@ -105,6 +105,15 @@ class EncodedProblem:
     # encoding can't express, with the reason — solved host-side AFTER the
     # device solve instead of abandoning the whole batch (VERDICT r1 #4)
     residue: List[Tuple[List[Pod], str]] = field(default_factory=list)
+    # placement provenance (solver/explain.py HOST_CONSTRAINTS): per
+    # group, columns eliminated by [compat mask, price cap] — filled by
+    # the solver's _encode_checked when KARPENTER_TPU_EXPLAIN is armed
+    # (the cap is folded into group_mask before the kernel ever sees it,
+    # so the split must be taken host-side)
+    explain_host: Optional[np.ndarray] = None   # [G, 2] i64
+    # the price cap that was folded into group_mask (None = uncapped) —
+    # the explainer's price nearest-miss needs the value back out
+    explain_price_cap: Optional[float] = None
     # host metadata for decode
     groups: List[List[Pod]] = field(default_factory=list)
     columns: List[Column] = field(default_factory=list)

@@ -51,7 +51,14 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from karpenter_tpu.solver.explain import KERNEL_CONSTRAINTS
+
 EPS = 1e-3
+
+# placement-provenance aux (ISSUE 13): the kernel's per-group elimination
+# counts use KERNEL_CONSTRAINTS order (fit, limit, topology, whole_node,
+# slots) — explain.py is the enum owner, this is its device-side width
+EXPLAIN_C = len(KERNEL_CONSTRAINTS)
 
 # -- trace/compile telemetry ---------------------------------------------
 # A jit cache miss re-executes the traced Python body (exactly once per
@@ -292,6 +299,22 @@ def _solve_ffd_impl(
                                   # single-device program, lowered
                                   # exactly as before this parameter
                                   # existed.
+    explain: int = 0,             # static: placement-provenance aux
+                                  # (ISSUE 13).  1 ("counts") appends
+                                  # per-group elimination counts per
+                                  # constraint class (KERNEL_CONSTRAINTS
+                                  # order, [G, EXPLAIN_C]) + a reason
+                                  # bitset [G], computed AFTER the scan
+                                  # from the final state — purely
+                                  # additive, the main outputs are
+                                  # bit-identical to explain=0.  2
+                                  # ("full") additionally appends the
+                                  # [G, O] per-column eliminating-class
+                                  # map (single-device only — the map is
+                                  # column-sharded under a mesh and has
+                                  # no replicated form).  Under a mesh,
+                                  # counts combine via one psum over the
+                                  # column shards.
 ):
     G, RDIM = group_req.shape
     E = exist_remaining.shape[0]
@@ -301,7 +324,12 @@ def _solve_ffd_impl(
     _note_trace(G=G, E=E, O=O, N=max_nodes, D=group_dbase.shape[1],
                 with_topology=with_topology, sparse_k=sparse_k,
                 sparse_n=sparse_n, mask_packed=mask_packed,
-                axis_name=axis_name, seeded=seed_used is not None)
+                axis_name=axis_name, seeded=seed_used is not None,
+                explain=explain)
+    if explain >= 2:
+        # the [G, O] class map is column-sharded under a mesh and the
+        # shard_map out-spec is replicated — counts-only there
+        assert axis_name is None, "explain=full has no sharded form"
     if mask_packed:
         # a bit-packed mask cannot arrive as a mesh shard: the byte axis
         # packs 8 columns and a shard boundary may split a byte
@@ -804,6 +832,116 @@ def _solve_ffd_impl(
                nzn.sum(-1).astype(jnp.float32)]              # G (nnz row)
     else:
         mid = [outs["take_new"].astype(jnp.float32).reshape(-1)]  # G*N
+    aux = []
+    if explain:
+        # -- placement-provenance aux (ISSUE 13): per-group elimination
+        # counts per constraint class, judged against the FINAL solve
+        # state (the explain question is "why can't this group take more
+        # columns NOW").  Purely additive — appended after the base
+        # block so every existing unpack offset is untouched, and the
+        # main outputs are bit-identical to explain=0.  All column-axis
+        # math runs at PT granularity (capacity varies only per
+        # (pool,type) block) and combines under a mesh via ONE psum.
+        pt_daemon = col_daemon.reshape(PT, zc, RDIM)[:, 0]     # [PT, R]
+        pt_pool = col_pool.reshape(PT, zc)[:, 0]               # [PT]
+        gmask_pt = group_mask.reshape(G, PT, zc)
+        cols_per_block = gmask_pt.sum(-1).astype(jnp.int32)    # [G, PT]
+        # fit: one pod of the group cannot land on an EMPTY node of the
+        # column (static infeasibility — the encode-time mask admits
+        # the column for labels, but the resources never fit)
+        fits_pt = jnp.all(
+            pt_alloc[None, :, :] - pt_daemon[None, :, :]
+            - group_req[:, None, :] >= -EPS, axis=-1)          # [G, PT]
+        elim_fit = jnp.where(~fits_pt, cols_per_block, 0).sum(-1)
+        # limit: the pool's FINAL remaining budget cannot fund one more
+        # pod plus the per-node daemon charge
+        lim_ok = jnp.all(
+            final["limits"][None, :, :] - pool_daemon[None, :, :]
+            - group_req[:, None, :] >= -EPS, axis=-1)          # [G, P]
+        lim_ok_pt = lim_ok[:, pt_pool]                         # [G, PT]
+        elim_limit = jnp.where(fits_pt & ~lim_ok_pt,
+                               cols_per_block, 0).sum(-1)
+        # topology: columns whose domain is ineligible or at the skew
+        # ceiling (the same floor arithmetic as _water_fill's minDomains
+        # handling); domain-of-slot via the zc grid, exactly the heavy
+        # branch's zc_dom discipline.  dom_placed is each group's OWN
+        # step output — final for that group's constraint by the
+        # kernel's self-selecting invariant (module docstring: only
+        # self-match spread reaches the kernel, so no later group's
+        # placements count toward this group's selector)
+        big_i = jnp.int32(2 ** 29)
+        f_dom = group_dbase + outs["dom_placed"]               # [G, D]
+        m_elig = jnp.where(group_delig, f_dom, big_i).min(-1)  # [G]
+        pop = (jnp.where(group_delig, f_dom, 0) > 0).sum(-1)
+        m_floor = jnp.where((group_mindom > 0) & (pop < group_mindom),
+                            0, m_elig)
+        ceiling = m_floor + group_skew                         # [G]
+        blocked_dom = (~group_delig) | (f_dom >= ceiling[:, None])
+        zc_zone, zc_ct = col_zone[:zc], col_ct[:zc]
+        if axis_name is not None:
+            # shard 0 owns the global leading block (same reason the
+            # heavy branch all_gathers its zc_dom)
+            zc_zone = jax.lax.all_gather(zc_zone, axis_name)[0]
+            zc_ct = jax.lax.all_gather(zc_ct, axis_name)[0]
+        slot_dom = jnp.where((group_dsel == 1)[:, None],
+                             zc_zone[None, :], zc_ct[None, :])  # [G, ZC]
+        slot_blocked = jnp.take_along_axis(
+            blocked_dom, jnp.clip(slot_dom, 0, D - 1), axis=1)  # [G, ZC]
+        # the classes PARTITION the eliminated columns with the same
+        # precedence as the full-mode map (fit > limit > topology >
+        # whole) — overlapping counts would sum past columns_total and
+        # contradict the map's per-column verdicts in the same tree
+        ok_pt = fits_pt & lim_ok_pt                             # [G, PT]
+        elim_topo = jnp.where(
+            (group_dsel > 0)[:, None, None] & slot_blocked[:, None, :]
+            & ok_pt[:, :, None],
+            gmask_pt.astype(jnp.int32), 0).sum((1, 2))
+        # whole-node gating: a stranded all-or-nothing group failed
+        # atomically on every admitted column no other class claims
+        # (whole + dynamic spread is Unsupported at encode, so topology
+        # never overlaps)
+        stranded = outs["unsched"] > 0
+        elim_whole = jnp.where(
+            group_whole & stranded,
+            jnp.where(ok_pt, cols_per_block, 0).sum(-1), 0)
+        local = jnp.stack(
+            [elim_fit, elim_limit, elim_topo, elim_whole],
+            axis=1).astype(jnp.int32)                           # [G, 4]
+        if axis_name is not None:
+            local = jax.lax.psum(local, axis_name)
+        # slots: node-axis exhaustion — replicated scalar state, so it
+        # joins AFTER the psum (a psum would multiply it by the mesh)
+        slots_exhausted = (stranded
+                           & (final["num_active"] >= N)).astype(jnp.int32)
+        counts = jnp.concatenate([local, slots_exhausted[:, None]],
+                                 axis=1)                        # [G, C]
+        weights = jnp.asarray([1 << i for i in range(EXPLAIN_C)],
+                              jnp.int32)
+        bits = ((counts > 0).astype(jnp.int32)
+                * weights[None, :]).sum(-1)                     # [G]
+        aux = [counts.astype(jnp.float32).reshape(-1),          # G*C
+               bits.astype(jnp.float32)]                        # G
+        if explain >= 2:
+            # per-column eliminating class (1-based into
+            # KERNEL_CONSTRAINTS; 0 = not eliminated on device):
+            # precedence fit > limit > topology > whole — the first
+            # constraint that strikes a column is the one named
+            fits_col = jnp.repeat(fits_pt, zc, axis=1)          # [G, O]
+            lim_col = jnp.repeat(lim_ok_pt, zc, axis=1)
+            col_dom = jnp.where((group_dsel == 1)[:, None],
+                                col_zone[None, :], col_ct[None, :])
+            col_blocked = jnp.take_along_axis(
+                blocked_dom, jnp.clip(col_dom, 0, D - 1), axis=1)
+            cls_map = jnp.where(group_mask & ~fits_col, 1, 0)
+            cls_map = jnp.where(group_mask & fits_col & ~lim_col,
+                                2, cls_map)
+            cls_map = jnp.where(
+                group_mask & (group_dsel > 0)[:, None] & col_blocked
+                & (cls_map == 0), 3, cls_map)
+            cls_map = jnp.where(
+                group_mask & (group_whole & stranded)[:, None]
+                & (cls_map == 0), 4, cls_map)
+            aux.append(cls_map.astype(jnp.float32).reshape(-1))  # G*O
     packed = jnp.concatenate(head + mid + [
         outs["unsched"].astype(jnp.float32).reshape(-1),     # G
         outs["dom_placed"].astype(jnp.float32).reshape(-1),  # G*D
@@ -812,13 +950,13 @@ def _solve_ffd_impl(
         final["node_zone"].astype(jnp.float32),               # N
         final["node_ct"].astype(jnp.float32),                 # N
         final["num_active"][None].astype(jnp.float32),        # 1
-    ])
+    ] + aux)
     return packed
 
 
 solve_ffd = partial(jax.jit, static_argnames=(
     "max_nodes", "zc", "with_topology", "sparse_k", "sparse_n",
-    "mask_packed"))(_solve_ffd_impl)
+    "mask_packed", "explain"))(_solve_ffd_impl)
 
 
 def pack_problem(prob):
@@ -875,7 +1013,8 @@ def _solve_ffd_coalesced_impl(buf, col_alloc, col_daemon, pt_alloc,
                               layout=None, max_nodes: int = 1024,
                               zc: int = 1, with_topology: bool = True,
                               sparse_k: int = 0, sparse_n: int = 0,
-                              mask_packed: bool = False):
+                              mask_packed: bool = False,
+                              explain: int = 0):
     """solve_ffd fed from one coalesced problem buffer (see
     pack_problem).  Catalog args stay separate — they are
     device-resident across solves and never travel."""
@@ -890,11 +1029,12 @@ def _solve_ffd_coalesced_impl(buf, col_alloc, col_daemon, pt_alloc,
         group_skew, group_mindom, group_delig, group_whole,
         col_zone, col_ct, exist_zone, exist_ct,
         max_nodes=max_nodes, zc=zc, with_topology=with_topology,
-        sparse_k=sparse_k, sparse_n=sparse_n, mask_packed=mask_packed)
+        sparse_k=sparse_k, sparse_n=sparse_n, mask_packed=mask_packed,
+        explain=explain)
 
 
 _COALESCED_STATICS = ("layout", "max_nodes", "zc", "with_topology",
-                      "sparse_k", "sparse_n", "mask_packed")
+                      "sparse_k", "sparse_n", "mask_packed", "explain")
 solve_ffd_coalesced = partial(
     jax.jit, static_argnames=_COALESCED_STATICS)(_solve_ffd_coalesced_impl)
 # The pipelined executor's variant: the problem buffer (arg 0) is DONATED
@@ -911,7 +1051,7 @@ def _solve_ffd_resident_impl(buf, mask_table, col_alloc, col_daemon,
                              pt_alloc, col_pool, pool_daemon, col_zone,
                              col_ct, layout=None, max_nodes: int = 1024,
                              zc: int = 1, sparse_n: int = 0,
-                             axis_name=None):
+                             axis_name=None, explain: int = 0):
     """The mesh executor's kernel body (parallel/mesh.py wraps this in
     `shard_map` + jit): one coalesced REPLICATED problem buffer, the
     device-RESIDENT sharded catalog args, and a device-resident sharded
@@ -932,13 +1072,13 @@ def _solve_ffd_resident_impl(buf, mask_table, col_alloc, col_daemon,
         group_skew, group_mindom, group_delig, group_whole,
         col_zone, col_ct, exist_zone, exist_ct,
         max_nodes=max_nodes, zc=zc, sparse_n=sparse_n,
-        axis_name=axis_name)
+        axis_name=axis_name, explain=explain)
 
 def _solve_ffd_delta_impl(buf, col_alloc, col_daemon, pt_alloc, col_pool,
                           pool_daemon, col_zone, col_ct, layout=None,
                           max_nodes: int = 1024, zc: int = 1,
                           sparse_n: int = 0, mask_packed: bool = False,
-                          seed_packed: bool = False):
+                          seed_packed: bool = False, explain: int = 0):
     """The delta path's seeded kernel (single-device): one coalesced
     buffer carrying the restricted SUFFIX problem (the changed groups
     only) PLUS the prefix seed state — used/pool/active for the node
@@ -966,11 +1106,11 @@ def _solve_ffd_delta_impl(buf, col_alloc, col_daemon, pt_alloc, col_pool,
         seed_used=seed_used, seed_colmask=seed_colmask,
         seed_pool=seed_pool, seed_active=seed_active,
         max_nodes=max_nodes, zc=zc, with_topology=False,
-        sparse_n=sparse_n, mask_packed=mask_packed)
+        sparse_n=sparse_n, mask_packed=mask_packed, explain=explain)
 
 
 _DELTA_STATICS = ("layout", "max_nodes", "zc", "sparse_n", "mask_packed",
-                  "seed_packed")
+                  "seed_packed", "explain")
 solve_ffd_delta = partial(
     jax.jit, static_argnames=_DELTA_STATICS)(_solve_ffd_delta_impl)
 
@@ -980,7 +1120,7 @@ def _solve_ffd_delta_resident_impl(buf, seed_colmask, mask_table,
                                    col_pool, pool_daemon, col_zone,
                                    col_ct, layout=None,
                                    max_nodes: int = 1024, zc: int = 1,
-                                   axis_name=None):
+                                   axis_name=None, explain: int = 0):
     """Mesh variant of the delta kernel (parallel/mesh.py wraps it in
     shard_map): the suffix problem's slot 2 carries row indices into the
     resident mask table (exactly like _solve_ffd_resident_impl), and the
@@ -1002,7 +1142,7 @@ def _solve_ffd_delta_resident_impl(buf, seed_colmask, mask_table,
         seed_used=seed_used, seed_colmask=seed_colmask,
         seed_pool=seed_pool, seed_active=seed_active,
         max_nodes=max_nodes, zc=zc, with_topology=False,
-        axis_name=axis_name)
+        axis_name=axis_name, explain=explain)
 
 
 # The consolidation simulator's batch axis (SURVEY §7 step 6): many
@@ -1019,14 +1159,19 @@ _BATCH_AXES = (0, 0, 0, 0, 0,          # group_req..exist_remaining
 
 def _solve_ffd_batch_impl(*args, max_nodes: int = 1024, zc: int = 1,
                           sparse_k: int = 0, sparse_n: int = 0,
-                          mask_packed: bool = False):
+                          mask_packed: bool = False, explain: int = 0):
+    # explain is armed (counts) only for UNCAPPED batches — the fused
+    # solverd lane's real provisioning requests; capped consolidation
+    # sims keep explain=0 (counterfactuals must not pay or pollute)
     return jax.vmap(partial(_solve_ffd_impl, max_nodes=max_nodes, zc=zc,
                             sparse_k=sparse_k, sparse_n=sparse_n,
-                            mask_packed=mask_packed),
+                            mask_packed=mask_packed,
+                            explain=min(explain, 1)),
                     in_axes=_BATCH_AXES)(*args)
 
 
-_BATCH_STATICS = ("max_nodes", "zc", "sparse_k", "sparse_n", "mask_packed")
+_BATCH_STATICS = ("max_nodes", "zc", "sparse_k", "sparse_n",
+                  "mask_packed", "explain")
 solve_ffd_batch = partial(
     jax.jit, static_argnames=_BATCH_STATICS)(_solve_ffd_batch_impl)
 # pipelined variant: the per-problem stacked tensors (batch axis 0 in
@@ -1189,7 +1334,8 @@ solve_ffd_sweep_topo_donated = partial(
 
 
 def unpack(packed, G: int, E: int, N: int, RDIM: int, D: int,
-           sparse_k: int = 0, sparse_n: int = 0):
+           sparse_k: int = 0, sparse_n: int = 0, explain: int = 0,
+           explain_o: int = 0):
     """Split the flat result buffer back into named host arrays.  With
     sparse_k > 0 the buffer's head carries top-K (count, index) pairs per
     group (see _solve_ffd_impl) and the dense [G, E] take_exist row is
@@ -1198,7 +1344,11 @@ def unpack(packed, G: int, E: int, N: int, RDIM: int, D: int,
     sparse_n > 0 rebuilds take_new the same way; its K is only a
     warm-start estimate, so the kernel's per-group nonzero-count row is
     checked here and ``new_overflow`` reports a lossy compaction (the
-    caller re-runs dense)."""
+    caller re-runs dense).  explain > 0 parses the provenance aux tail
+    (``explain_counts`` [G, EXPLAIN_C] + ``explain_bits`` [G]; explain
+    >= 2 also ``explain_map`` [G, explain_o]) — the tail is purely
+    additive, so an explain-armed buffer unpacks fine without these
+    parameters (the aux simply stays unread)."""
     import numpy as np
     # writable host array: device buffers surface as read-only views, and
     # the topology repair pass (solve.py) mutates these arrays in place.
@@ -1236,7 +1386,7 @@ def unpack(packed, G: int, E: int, N: int, RDIM: int, D: int,
         take_new[np.nonzero(mn_)[0], idxn[mn_]] = cntn[mn_]
     else:
         take_new = flat[offs[1]:offs[2]].reshape(G, N)
-    return dict(
+    out = dict(
         take_exist=take_exist,
         take_new=take_new,
         new_overflow=new_overflow,
@@ -1248,3 +1398,15 @@ def unpack(packed, G: int, E: int, N: int, RDIM: int, D: int,
         node_ct=flat[offs[7]:offs[8]].astype(np.int32),
         num_active=flat[offs[8]],
     )
+    if explain:
+        off = int(offs[-1])
+        C = EXPLAIN_C
+        out["explain_counts"] = \
+            flat[off:off + G * C].reshape(G, C).astype(np.int64)
+        off += G * C
+        out["explain_bits"] = flat[off:off + G].astype(np.int64)
+        off += G
+        if explain >= 2 and explain_o:
+            out["explain_map"] = flat[off:off + G * explain_o] \
+                .reshape(G, explain_o).astype(np.int8)
+    return out

@@ -23,6 +23,7 @@ from karpenter_tpu.scheduling.types import (
     effective_request,
     min_values_violation,
 )
+from karpenter_tpu.solver import explain as explainmod
 from karpenter_tpu.solver import ffd
 from karpenter_tpu.solver import pipeline as pipelining
 from karpenter_tpu.solver.encode import (
@@ -143,6 +144,15 @@ class TPUSolver:
         # _solve_attempt — the observability the north-star budget needs
         # (encode+decode host share must stay well under the solve time)
         self.last_phase_ms: Dict[str, float] = {}
+        # placement provenance (solver/explain.py): the explain mode is
+        # resolved lazily once (KARPENTER_TPU_EXPLAIN, default counts —
+        # restart-time lever, same discipline as MESH/DELTA); trees are
+        # built only for REAL solves (max_nodes is None — consolidation
+        # sims strand by design and must not pay per-strand tree cost)
+        self._explain_resolved = None
+        self._explain_trees = False
+        # per-solve provenance summary (kt_explain / stats introspection)
+        self.last_explain: Optional[Dict] = None
 
     @property
     def mesh(self):
@@ -232,6 +242,24 @@ class TPUSolver:
             else:
                 self._delta_resolved = ("auto",)
         return self._delta_resolved[0]
+
+    def _explain_mode(self) -> int:
+        """The resolved KARPENTER_TPU_EXPLAIN mode (0/1/2) — explain.py
+        owns the grammar; resolved once per solver, a restart-time
+        operator lever like the mesh/delta knobs."""
+        if self._explain_resolved is None:
+            self._explain_resolved = (explainmod.mode(),)
+        return self._explain_resolved[0]
+
+    def _explain_kernel_mode(self) -> int:
+        """The explain level the KERNEL dispatch runs at: the resolved
+        mode, clamped to counts under a mesh (the [G, O] full map is
+        column-sharded and has no replicated out-spec form) — ffd
+        asserts the same invariant."""
+        exc = self._explain_mode()
+        if exc >= 2 and self._resolve_mesh() is not None:
+            return 1
+        return exc
 
     def delta_invalidate(self, pods=(), nodes=(),
                          flood: bool = False) -> None:
@@ -385,10 +413,24 @@ class TPUSolver:
             enc = encode(inp, cat, exist_shared=exist_shared, groups=groups)
         except Unsupported as e:
             raise UnsupportedPods(str(e)) from e
+        # host-owned provenance classes (explain.HOST_CONSTRAINTS): the
+        # label/taint compat mask and the price cap are folded into
+        # group_mask BEFORE the kernel sees it, so their elimination
+        # counts must be taken here — one [G] bool-sum per side of the
+        # cap AND, sub-ms at the headline shape
+        exc = self._explain_mode()
+        pre = (enc.group_mask.sum(axis=1, dtype=np.int64)
+               if exc else None)
         if inp.price_cap is not None:
             # consolidation price cap as a column mask — the cached catalog
             # encoding stays untouched (see ScheduleInput.price_cap)
             enc.group_mask &= (cat.col_price < inp.price_cap)[None, :]
+        if exc:
+            post = (enc.group_mask.sum(axis=1, dtype=np.int64)
+                    if inp.price_cap is not None else pre)
+            enc.explain_host = np.stack(
+                [enc.n_columns - pre, pre - post], axis=1)
+            enc.explain_price_cap = inp.price_cap
         return enc
 
     def _mask_packed(self) -> bool:
@@ -565,10 +607,12 @@ class TPUSolver:
         if not any(lim is not None
                    for lim in (inp.remaining_limits or {}).values()):
             return res
-        # the ORACLE's binding-limit reason, specifically — the kernel's
-        # generic strand reason ("...exhausted or over limits") must not
-        # fire a full O(pods) oracle solve on plain capacity exhaustion
-        if not any("limits exceeded" in reason
+        # the ORACLE's binding-limit verdict, specifically — a reason-CODE
+        # comparison (the kernel's generic CapacityExhausted strand must
+        # not fire a full O(pods) oracle solve on plain capacity
+        # exhaustion; this used to be a "limits exceeded" substring
+        # match, the discrimination hazard ISSUE 13 retires)
+        if not any(explainmod.code_of(reason) == explainmod.POOL_LIMIT
                    for reason in res.unschedulable.values()):
             return res
         from karpenter_tpu.scheduling import Scheduler
@@ -627,10 +671,32 @@ class TPUSolver:
         aug = self._augment_with_claims(inp, stranded, placed, dev_res)
         orc_res = Scheduler(aug).solve()
         # the oracle's verdict replaces the kernel's for the RESCUED set;
-        # already-judged pods keep their existing verdicts
+        # already-judged pods keep their existing verdicts.  The KERNEL's
+        # constraint-elimination tree is preserved under "kernel" — the
+        # oracle names the authoritative verdict, the kernel aux names
+        # which constraint classes eliminated which catalog columns, and
+        # an operator debugging a strand wants both halves.
+        kernel_trees = {
+            p.meta.name: getattr(
+                dev_res.unschedulable.get(p.meta.name), "tree", None)
+            for p in stranded}
         for p in stranded:
             dev_res.unschedulable.pop(p.meta.name, None)
-        return self._merge_split(inp, dev_res, orc_res, stranded)
+        merged = self._merge_split(inp, dev_res, orc_res, stranded)
+        for name, kt in kernel_trees.items():
+            r = merged.unschedulable.get(name)
+            if r is None or kt is None:
+                continue
+            code = explainmod.code_of(r)
+            if code == explainmod.LEGACY:
+                continue
+            tree = dict(getattr(r, "tree", None)
+                        or {"code": code,
+                            "constraint": explainmod.constraint_of(code)})
+            tree.setdefault("kernel", kt)
+            merged.unschedulable[name] = explainmod.make(
+                code, str(r), tree)
+        return merged
 
     def _attempt_or_split(self, inp: ScheduleInput,
                           max_nodes: Optional[int] = None,
@@ -770,6 +836,7 @@ class TPUSolver:
         re-uploads from the live host copy, because the donated slot dies
         with the program it fed (retries — slot exhaustion, compaction
         overflow — re-dispatch)."""
+        exc = self._explain_kernel_mode()
         if self._resolve_mesh() is not None:
             # mesh resident path: ONE coalesced replicated buffer through
             # the donated two-slot rotation; the mask table and catalog
@@ -782,7 +849,7 @@ class TPUSolver:
                 b = (self._upload_slots.put(buf, ex.rep) if pipe
                      else buf)
                 out = ex.solve(b, mesh_table, dev, layout, n, kn,
-                               donate=pipe)
+                               donate=pipe, explain=exc)
                 if pipe and not b.is_deleted():
                     # donate_argnums marks the slot for reuse, but a
                     # backend that can't alias the replicated buffer into
@@ -808,14 +875,48 @@ class TPUSolver:
                           dev["pt_alloc"], dev["col_pool"],
                           dev["pool_daemon"], dev["col_zone"],
                           dev["col_ct"], layout=layout, max_nodes=n,
-                          zc=dev["ZC"], sparse_n=kn, mask_packed=mbits)
+                          zc=dev["ZC"], sparse_n=kn, mask_packed=mbits,
+                          explain=exc)
         else:
             args = self._assemble(dev, self._put_problem(prob))
 
             def run(n, kn):
                 return ffd.solve_ffd(*args, max_nodes=n, zc=dev["ZC"],
-                                     sparse_n=kn, mask_packed=mbits)
+                                     sparse_n=kn, mask_packed=mbits,
+                                     explain=exc)
         return run
+
+    # -- placement provenance (solver/explain.py) -------------------------
+    def _note_explain(self, enc, out: Dict) -> None:
+        """Fold one solve's elimination attribution into the
+        per-constraint counter family and the per-solve summary
+        (`last_explain`) — the fleet-countable half of the provenance
+        story (the per-pod trees are decode's half)."""
+        exc = self._explain_mode()
+        if not exc:
+            self.last_explain = None
+            return
+        G = enc.n_groups
+        totals: Dict[str, int] = {}
+        host = getattr(enc, "explain_host", None)
+        if host is not None:
+            hs = np.asarray(host[:G]).sum(axis=0)
+            for i, name in enumerate(explainmod.HOST_CONSTRAINTS):
+                totals[name] = int(hs[i])
+        kc = out.get("explain_counts")
+        if kc is not None:
+            ks = np.asarray(kc[:G]).sum(axis=0)
+            for i, name in enumerate(explainmod.KERNEL_CONSTRAINTS):
+                totals[name] = int(ks[i])
+        for name, n in totals.items():
+            if n:
+                metrics.SOLVER_CONSTRAINT_ELIM.inc(n, constraint=name)
+        self.last_explain = {
+            "mode": explainmod.mode_name(exc),
+            "groups": G,
+            "eliminations": totals,
+            "kernel_aux": kc is not None,
+        }
 
     # -- flight recorder (utils/flightrecorder.py) ------------------------
     def _flight_record(self, inp: ScheduleInput, cat, enc,
@@ -857,6 +958,7 @@ class TPUSolver:
                 "delta": delta_mode if delta_mode else "off",
                 "pipeline": pipelining.pipeline_enabled(),
                 "topk_segments": self._last_new_segments,
+                "explain": explainmod.mode_name(self._explain_mode()),
             },
             phase_ms={k: round(v, 3)
                       for k, v in self.last_phase_ms.items()},
@@ -873,7 +975,11 @@ class TPUSolver:
     def _delta_fallback(self, reason: str) -> None:
         """Count one non-engaged pass.  Every pass through the delta
         seam is either outcome="delta" or outcome="fallback" — no
-        silent fallbacks (the bench's win condition reads this)."""
+        silent fallbacks (the bench's win condition reads this).  The
+        reason vocabulary is owned by the registry (explain.py): a
+        fallback naming an unregistered reason is a programming error,
+        not a new string."""
+        assert reason in explainmod.DELTA_FALLBACK_REASONS, reason
         cache = self._delta_cache
         cache.last_outcome, cache.last_reason = "fallback", reason
         metrics.SOLVER_DELTA_PASSES.inc(outcome="fallback")
@@ -916,6 +1022,10 @@ class TPUSolver:
         no-drift discipline as _make_run.  `prob16` carries the DENSE
         group mask (slot 2); packing happens here so the mesh branch
         can feed the registry the dense rows."""
+        # delta aux is clamped to counts: the suffix's [G, O] full map
+        # would stitch against prefix rows that never had one (and the
+        # mesh form is counts-only anyway)
+        exc = min(self._explain_kernel_mode(), 1)
         if self._resolve_mesh() is not None:
             from jax.sharding import PartitionSpec as _P
             ex = self._mesh_exec
@@ -927,7 +1037,8 @@ class TPUSolver:
             # "delta-seed") so the residency accounting stays honest
             cm = ex.put_sharded(seed_colmask, _P(None, ex.axis),
                                 "delta-seed")
-            return ex.solve_delta(buf, cm, table, dev, layout, mn)
+            return ex.solve_delta(buf, cm, table, dev, layout, mn,
+                                  explain=exc)
         if mbits:
             prob16 = prob16[:2] + (np.packbits(
                 prob16[2], axis=-1, bitorder="little"),) + prob16[3:]
@@ -939,7 +1050,7 @@ class TPUSolver:
             buf, dev["col_alloc"], dev["col_daemon"], dev["pt_alloc"],
             dev["col_pool"], dev["pool_daemon"], dev["col_zone"],
             dev["col_ct"], layout=layout, max_nodes=mn, zc=dev["ZC"],
-            mask_packed=mbits, seed_packed=mbits)
+            mask_packed=mbits, seed_packed=mbits, explain=exc)
 
     def _try_delta(self, inp: ScheduleInput, cat,
                    groups) -> Optional[ScheduleResult]:
@@ -1004,7 +1115,9 @@ class TPUSolver:
             except AttributeError:
                 pass
             t_c = _time.perf_counter()
-            out_s = ffd.unpack(np.array(packed), Gp, E, mn, R, Db)
+            out_s = ffd.unpack(np.array(packed), Gp, E, mn, R, Db,
+                               explain=min(self._explain_kernel_mode(),
+                                           1))
             t_d = _time.perf_counter()
             disp_s, dev_s, pull_s = t_b - t_a, t_c - t_b, t_d - t_c
             if out_s["unsched"][:Gd].sum() > 0:
@@ -1018,7 +1131,9 @@ class TPUSolver:
         enc_m, out_m = deltam.merge(plan, sp, cat, inp, out_s, Gd)
         self._repair_whole_node(enc_m, out_m)
         self._repair_topology(enc_m, out_m)
+        self._explain_trees = bool(self._explain_mode())
         res = self._decode(enc_m, out_m)
+        self._note_explain(enc_m, out_m)
         t3 = _time.perf_counter()
         # warm-start continuity: the next (full or delta) solve adapts
         # exactly as if this had been a full pass
@@ -1149,6 +1264,11 @@ class TPUSolver:
             mesh_table = None
         pipe = pipelining.pipeline_enabled()
         run = self._make_run(prob, dev, mbits, pipe, mesh_table)
+        exc = self._explain_kernel_mode()
+        # per-pod reason trees only for REAL solves: a consolidation sim
+        # (explicit max_nodes cap) strands by design, and per-strand tree
+        # construction would put host numpy into the sweep's hot loop
+        self._explain_trees = bool(exc) and max_nodes is None
         t2 = _time.perf_counter()
         kn = self._pick_sparse_n(mn)
         disp_s = dev_s = pull_s = 0.0
@@ -1188,7 +1308,9 @@ class TPUSolver:
             except AttributeError:
                 pass  # already a host array
             t_c = _time.perf_counter()
-            out_ = ffd.unpack(np.array(packed), G, E, n, R, Db, sparse_n=k)
+            out_ = ffd.unpack(np.array(packed), G, E, n, R, Db,
+                              sparse_n=k, explain=exc,
+                              explain_o=dev["O"])
             t_d = _time.perf_counter()
             disp_s += t_b - t_a
             dev_s += t_c - t_b
@@ -1229,6 +1351,13 @@ class TPUSolver:
         t4 = _time.perf_counter()
         res = self._decode(enc, out)
         t5 = _time.perf_counter()
+        if max_nodes is None:
+            # REAL solves only: a capped consolidation sim is a
+            # counterfactual and must not pollute the fleet's
+            # per-constraint elimination counter or last_explain (the
+            # same gate _explain_trees and the provisioning-side
+            # UNSCHEDULABLE_PODS counting apply)
+            self._note_explain(enc, out)
         if max_nodes is None and groups is not None:
             # a finished full solve is the next pass's delta base
             self._delta_store(inp, cat, enc, out, groups)
@@ -1390,19 +1519,28 @@ class TPUSolver:
             sk = self._pick_sparse_k(max_cnt, baseE)
             prob0 = tuple(zeros_at(i, a, baseG, baseE)
                           for i, a in enumerate(proto_b))
-            stacked = self._put_problem(
-                tuple(np.zeros((B,) + a.shape, a.dtype) for a in prob0),
-                batched=True)
             fn = (ffd.solve_ffd_batch_donated if pipe
                   else ffd.solve_ffd_batch)
-            packed = fn(*self._assemble(dev, stacked),
-                        max_nodes=self.max_nodes, zc=dev["ZC"],
-                        sparse_k=sk, mask_packed=mbits)
-            try:
-                packed.block_until_ready()
-            except AttributeError:
-                pass
-            warmed += 1
+            # both explain variants the batch lane dispatches: capped
+            # sims run explain=0, UNCAPPED fused provisioning requests
+            # run counts — an unwarmed variant would put the compile
+            # cliff inside the daemon's first real fused solve.  The
+            # stacked buffers are rebuilt per variant: the pipelined fn
+            # DONATES them, so the first run's are dead after dispatch.
+            exc_b = min(self._explain_kernel_mode(), 1)
+            for exb in sorted({0, exc_b}):
+                stacked = self._put_problem(
+                    tuple(np.zeros((B,) + a.shape, a.dtype)
+                          for a in prob0),
+                    batched=True)
+                packed = fn(*self._assemble(dev, stacked),
+                            max_nodes=self.max_nodes, zc=dev["ZC"],
+                            sparse_k=sk, mask_packed=mbits, explain=exb)
+                try:
+                    packed.block_until_ready()
+                except AttributeError:
+                    pass
+                warmed += 1
         if delta_shapes and self._resolve_delta():
             from karpenter_tpu.solver import delta as deltam
             P = max(len(cat.pools), 1)
@@ -1486,7 +1624,8 @@ class TPUSolver:
         has_limit = any(lim is not None
                         for lim in (inp.remaining_limits or {}).values())
         if supported_pods and has_limit and any(
-                n in residue_names and "limit" in r
+                n in residue_names
+                and explainmod.code_of(r) == explainmod.POOL_LIMIT
                 for n, r in orc_res.unschedulable.items()):
             reserve = Resources()
             for p in residue_pods:
@@ -1967,6 +2106,9 @@ class TPUSolver:
             # _decode cache its name list while this chunk decodes
             # (the cache itself is released when the sweep returns)
             self._in_sweep_decode = True
+            # sims strand by design: never pay per-strand explain trees
+            # (codes still attach — they are constant-cost)
+            self._explain_trees = False
             try:
                 for bi, i in enumerate(idxs):
                     groups, cls_i, greq_i, gcount_i = sims[i]
@@ -2328,6 +2470,12 @@ class TPUSolver:
 
             mbits = self._mask_packed()
             pipe = pipelining.pipeline_enabled()
+            # provenance aux (counts) for UNCAPPED batches only: the
+            # fused solverd lane's real provisioning requests must feed
+            # the worker's elimination series (the stats-RPC surface the
+            # dashboard merges); capped consolidation sims stay aux-free
+            exc_b = (min(self._explain_kernel_mode(), 1)
+                     if max_nodes is None else 0)
             batch_fn = (ffd.solve_ffd_batch_donated if pipe
                         else ffd.solve_ffd_batch)
             chunk_size = B_BUCKETS[-1]
@@ -2360,7 +2508,8 @@ class TPUSolver:
                 pad_s += t_dev0 - t_pad0
                 packed = batch_fn(
                     *self._assemble(dev, stacked), max_nodes=mn,
-                    zc=dev["ZC"], sparse_k=sparse_k, mask_packed=mbits)
+                    zc=dev["ZC"], sparse_k=sparse_k, mask_packed=mbits,
+                    explain=exc_b)
                 device_s += _time.perf_counter() - t_dev0
                 return packed
 
@@ -2372,10 +2521,22 @@ class TPUSolver:
                 t_pull0 = _time.perf_counter()
                 packed = np.array(packed)
                 device_s += _time.perf_counter() - t_pull0
+                # capped sims (consolidation): codes without trees, same
+                # as _try_sweep.  An UNCAPPED batch entry is a real
+                # provisioning request riding the fused solverd lane —
+                # its stranded pods get trees via the explainer's
+                # host-side recompute (the batch kernel carries no aux),
+                # bounded by the stranded-GROUP count
+                self._explain_trees = (bool(self._explain_mode())
+                                       and max_nodes is None)
                 for bi, (i, enc) in enumerate(chunk):
                     t_dec0 = _time.perf_counter()
                     out = ffd.unpack(packed[bi], G, E, mn, R, Db,
-                                     sparse_k=sparse_k)
+                                     sparse_k=sparse_k, explain=exc_b)
+                    if exc_b:
+                        # real fused requests feed the elimination
+                        # series exactly like the single-problem path
+                        self._note_explain(enc, out)
                     # judged BEFORE topology repair: repair-stranded pods
                     # are exactly the estimate-miss class the rescue is
                     # for (solve() computes its flag pre-repair too)
@@ -2443,7 +2604,9 @@ class TPUSolver:
                 remaining[ei] -= k * req
                 cursor += k
             for pod in pods[cursor:]:
-                res.unschedulable[pod.meta.name] = "no instance types available"
+                res.unschedulable[pod.meta.name] = explainmod.make(
+                    explainmod.NO_INSTANCE_TYPES,
+                    "no instance types available")
         return res
 
     # -- topology repair --------------------------------------------------
@@ -2595,7 +2758,7 @@ class TPUSolver:
             # PodSegments so decode touches ~800 node rows, not 50k pods
             pod_wrap = PodSegments
             for gi, pods in unsched_by_group.items():
-                reason = self._unsched_reason(enc, gi)
+                reason = self._unsched_reason(enc, gi, out)
                 for pod in pods:
                     res.unschedulable[pod.meta.name] = reason
         else:
@@ -2629,7 +2792,7 @@ class TPUSolver:
                     cursor += k
                 for pod in pods[cursor:cursor + unsched[gi]]:
                     res.unschedulable[pod.meta.name] = \
-                        self._unsched_reason(enc, gi)
+                        self._unsched_reason(enc, gi, out)
 
         # claim metadata (requirements + ranked type list) depends only on
         # (pool, resident groups, used vector, pinned domains) — hundreds of
@@ -2745,7 +2908,9 @@ class TPUSolver:
                 keep = keep & enc.group_mask[gi][bporder]
             idxs = bporder[keep]  # price-ascending survivors
             if len(idxs) == 0:
-                return ("no surviving instance type", None)
+                return (explainmod.make(explainmod.NO_SURVIVING_TYPE,
+                                        "no surviving instance type"),
+                        None)
             reqs = pool.template_requirements()
             for gi in gis:
                 merged = enc.merged_reqs[gi][pidx]
@@ -2785,7 +2950,8 @@ class TPUSolver:
             violation = min_values_violation(
                 reqs, [tid_types[t] for t in ulist])
             if violation is not None:
-                return (violation, None)
+                return (explainmod.make(explainmod.MIN_VALUES, violation),
+                        None)
             requests = req_cache.get(uid)
             if requests is None:
                 requests = Resources(used_f[ni].tolist())
@@ -2866,8 +3032,13 @@ class TPUSolver:
             new_claims_append(claim)
         return res
 
-    @staticmethod
-    def _unsched_reason(enc: EncodedProblem, gi: int) -> str:
+    def _unsched_reason(self, enc: EncodedProblem, gi: int,
+                        out: Optional[Dict] = None) -> str:
+        """One stranded group's verdict as a registry `Reason`
+        (solver/explain.py): structured code + the legacy human-readable
+        string as the detail (existing logs and assertions keep
+        working), with the constraint-elimination tree attached when
+        explain is armed on a REAL solve (`_explain_trees`)."""
         if not enc.group_mask[gi].any() and not (enc.exist_cap[gi] > 0).any():
             details = []
             for pidx, pool in enumerate(enc.pools):
@@ -2875,13 +3046,21 @@ class TPUSolver:
                     details.append(f"nodepool {pool.name}: incompatible or taints")
                 else:
                     details.append(f"nodepool {pool.name}: no instance type fits/compatible")
-            return "no nodepool can schedule pod: " + "; ".join(details)
+            code = explainmod.NO_NODEPOOL
+            detail = "no nodepool can schedule pod: " + "; ".join(details)
         # attribute to topology only when the encoder actually enforced a
         # constraint for this group (ScheduleAnyway spread and preferred
         # affinity are ignored and must not be blamed)
-        if (enc.group_dsel[gi] > 0 or enc.group_ncap[gi] < BIG
+        elif (enc.group_dsel[gi] > 0 or enc.group_ncap[gi] < BIG
                 or any(v is not None for v in enc.static_allowed[gi].values())):
-            return ("topology constraints unsatisfiable: every allowed "
-                    "domain is at its skew ceiling or out of capacity")
-        return ("no capacity: every compatible node/instance-type " +
-                "combination is exhausted or over limits")
+            code = explainmod.TOPOLOGY
+            detail = ("topology constraints unsatisfiable: every allowed "
+                      "domain is at its skew ceiling or out of capacity")
+        else:
+            code = explainmod.CAPACITY
+            detail = ("no capacity: every compatible node/instance-type " +
+                      "combination is exhausted or over limits")
+        tree = None
+        if self._explain_trees:
+            tree = explainmod.build_tree(enc, out or {}, gi, code)
+        return explainmod.make(code, detail, tree)

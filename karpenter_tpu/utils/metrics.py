@@ -383,6 +383,23 @@ SERVICE_FUSED_BATCH_SIZE = REGISTRY.histogram(
 SOLVER_RESIDUE_PODS = _c(
     "karpenter_tpu_solver_residue_pods_total",
     "Pods solved host-side as split-solve residue.")
+# -- placement provenance (ISSUE 13): the decision-observability layer —
+# -- every final unschedulable verdict carries a registry reason code
+# -- (solver/explain.py, the one enum owner), and the kernel's explain
+# -- aux attributes candidate eliminations to constraint classes
+UNSCHEDULABLE_PODS = _c(
+    "karpenter_tpu_unschedulable_pods_total",
+    "Pods reported unschedulable by the provisioning pass, by registry "
+    "reason code (solver/explain.py). reason=Legacy marks a plain-string "
+    "reason from an unregistered producer — kt-lint's reason-literal "
+    "check keeps this at zero.", ("reason",))
+SOLVER_CONSTRAINT_ELIM = _c(
+    "karpenter_tpu_solver_constraint_eliminations_total",
+    "Catalog-column eliminations attributed per constraint class by the "
+    "solver's explain aux (KARPENTER_TPU_EXPLAIN): compat/price are the "
+    "host encode-side classes, fit/limit/topology/whole_node/slots the "
+    "kernel-side ones. The fleet-level 'which constraint is binding' "
+    "signal.", ("constraint",))
 # -- observability substrate (ISSUE 9): the flight recorder, the
 # -- device-runtime telemetry, and the trace ring's drop accounting
 FLIGHT_RECORDS = _c(

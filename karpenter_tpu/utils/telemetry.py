@@ -96,6 +96,16 @@ def phase_latency_summary() -> dict:
     return out
 
 
+def _explain_store_size() -> int:
+    """Pods currently held by the explain store — guarded: telemetry
+    must render even if the solver package is unimportable here."""
+    try:
+        from karpenter_tpu.solver import explain
+        return explain.STORE.size()
+    except Exception:  # noqa: BLE001 — best-effort, never the data path
+        return 0
+
+
 def local_snapshot(flight_tail: int = 16) -> dict:
     """This process's observable state: the compact dict every process
     role (operator, solverd backend, supervisor CLI) can produce and the
@@ -137,6 +147,17 @@ def local_snapshot(flight_tail: int = 16) -> dict:
             "requests": _series(metrics.SERVICE_TENANT_REQUESTS),
             "shed": _series(metrics.SERVICE_TENANT_SHED),
             "fused_batches": _series(metrics.SERVICE_FUSED_BATCHES),
+        },
+        # placement provenance (ISSUE 13): per-reason unschedulable
+        # verdicts, per-constraint elimination attribution, and the
+        # explain store's reach — in the solverd worker the elimination
+        # series is the live one (it rides the stats RPC to the
+        # operator's dashboard merge); the verdict counter lives where
+        # provisioning runs
+        "placement": {
+            "unschedulable": _series(metrics.UNSCHEDULABLE_PODS),
+            "eliminations": _series(metrics.SOLVER_CONSTRAINT_ELIM),
+            "explained_pods": _explain_store_size(),
         },
         "retraces": sum(_series(metrics.SOLVER_RETRACES).values()),
         "device_memory_peak_bytes":
@@ -215,6 +236,19 @@ def merge(snapshots: Dict[str, dict]) -> dict:
             for k, v in passes.items():
                 fleet["delta_passes"][k] = \
                     fleet["delta_passes"].get(k, 0) + v
+    # placement rollup: per-reason unschedulable verdicts and the
+    # per-constraint elimination attribution summed across processes
+    # (the solverd worker's eliminations arrive via the stats RPC)
+    placement = {"unschedulable": {}, "eliminations": {}}
+    for s in snapshots.values():
+        sect = s.get("placement")
+        if not isinstance(sect, dict):
+            continue
+        for field in ("unschedulable", "eliminations"):
+            for k, v in (sect.get(field) or {}).items():
+                placement[field][k] = placement[field].get(k, 0) + v
+    if placement["unschedulable"] or placement["eliminations"]:
+        fleet["placement"] = placement
     # per-tenant rollup (the shared-fleet first-glance questions: who is
     # queued, who is being shed, what share of service each tenant got):
     # requests/sheds sum across processes; the fairness share normalizes

@@ -20,6 +20,12 @@ import pytest
 # imports anywhere (utils/faults.py arms from the environment at import).
 os.environ.pop("KARPENTER_TPU_FAULTS", None)
 
+# Tier-1 runs at the explain DEFAULT (counts): an inherited
+# KARPENTER_TPU_EXPLAIN=off/full from a shell that just drove the
+# explain bench would flip every solver's kernel programs and hide the
+# reason-tree assertions (solvers resolve the mode at construction).
+os.environ.pop("KARPENTER_TPU_EXPLAIN", None)
+
 # Dynamic lock-order observer (ISSUE 12, opt-in): under
 # KARPENTER_TPU_LOCK_OBSERVER=1 every threading.Lock/RLock/Condition a
 # karpenter_tpu module constructs from here on is wrapped, real

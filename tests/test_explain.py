@@ -1,0 +1,797 @@
+"""Placement provenance suite (ISSUE 13): the reason-code registry, the
+kernel's constraint-elimination aux, per-pod reason trees, the explain
+store + `GET /debug/explain`, the provisioning integration (events,
+`karpenter_tpu_unschedulable_pods_total`), delta prefix-attribution
+reuse, the kt_explain CLI, and the wire story (code + tree surviving the
+pickled result through the real supervised solverd).
+
+Layers, cheapest first:
+
+  * registry units — codes, Reason str-compat + pickle, mode grammar,
+    the kernel-constant alignment with ffd.EXPLAIN_C
+  * kernel aux — bit parity off/counts/full, per-class counts for
+    limit/fit/price strands, bitset consistency, full-mode [G, O] map
+  * reason sites — oracle POOL_LIMIT trees, backstop code
+    discrimination, minValues
+  * store + API — bounds, trace pinning, operator HTTP e2e through a
+    real provisioning pass
+  * delta — stitched prefix+suffix counts on an engaged pass
+  * post-mortem — capture → tools/kt_explain.py subprocess → trees
+  * fleet — code + tree across the solverd wire under a supervisor
+"""
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.env import Environment
+from karpenter_tpu.models import NodePool, ObjectMeta, Pod, Resources
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.providers import generate_catalog
+from karpenter_tpu.providers.catalog import CatalogSpec
+from karpenter_tpu.scheduling import ScheduleInput, Scheduler
+from karpenter_tpu.solver import TPUSolver, explain, ffd
+from karpenter_tpu.utils import metrics, telemetry, tracing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CATALOG = generate_catalog(CatalogSpec(max_types=10, include_gpu=False))
+POOL = NodePool(meta=ObjectMeta(name="default"))
+
+
+def mkinp(tag, n=12, cpu="500m", mem="1Gi", **kw):
+    pods = [Pod(meta=ObjectMeta(name=f"{tag}-p{i}"),
+                requests=Resources.parse({"cpu": cpu, "memory": mem}))
+            for i in range(n)]
+    return ScheduleInput(pods=pods, nodepools=[POOL],
+                         instance_types={"default": CATALOG}, **kw)
+
+
+def mksolver(**kw):
+    kw.setdefault("max_nodes", 64)
+    kw.setdefault("mesh", "off")
+    kw.setdefault("delta", "off")
+    return TPUSolver(**kw)
+
+
+def digest(res):
+    return (res.node_count(), float(res.total_price()).hex(),
+            sorted(res.existing_assignments.items()),
+            sorted(res.unschedulable))
+
+
+@pytest.fixture(autouse=True)
+def clean_store():
+    explain.STORE.reset()
+    yield
+    explain.STORE.reset()
+
+
+# --------------------------------------------------------------------------
+# registry units
+# --------------------------------------------------------------------------
+class TestRegistry:
+    def test_constraint_order_is_the_kernel_contract(self):
+        # ffd's aux row width and column order ARE the registry's
+        # KERNEL_CONSTRAINTS — a drift here silently misattributes
+        assert ffd.EXPLAIN_C == len(explain.KERNEL_CONSTRAINTS)
+        assert explain.CONSTRAINTS == (explain.HOST_CONSTRAINTS
+                                       + explain.KERNEL_CONSTRAINTS)
+        for code, spec in explain.REGISTRY.items():
+            assert spec.code == code
+            assert spec.constraint in explain.CONSTRAINTS + ("none",)
+
+    def test_reason_is_a_str_with_code_and_tree(self):
+        r = explain.make(explain.CAPACITY, "no capacity: xyz", {"k": 1})
+        assert isinstance(r, str) and "no capacity" in r
+        assert r.code == explain.CAPACITY
+        assert r.tree == {"k": 1}
+        # legacy substring assertions on the detail keep working
+        assert "xyz" in r
+
+    def test_reason_pickles_with_attributes(self):
+        r = explain.make(explain.POOL_LIMIT, "limits exceeded",
+                         {"pools": [{"nodepool": "a"}]})
+        r2 = pickle.loads(pickle.dumps(r))
+        assert r2 == r
+        assert r2.code == explain.POOL_LIMIT
+        assert r2.tree == r.tree
+
+    def test_make_rejects_unregistered_codes(self):
+        with pytest.raises(ValueError):
+            explain.make("NotARealCode", "detail")
+
+    def test_code_of_legacy_strings(self):
+        assert explain.code_of("some ad-hoc string") == explain.LEGACY
+        assert explain.constraint_of(explain.LEGACY) == "none"
+
+    def test_event_message_leads_with_the_code(self):
+        r = explain.make(explain.CAPACITY, "no capacity")
+        assert explain.event_message(r) == \
+            f"[{explain.CAPACITY}] no capacity"
+        assert explain.event_message("plain") == "plain"
+
+    def test_mode_grammar(self, monkeypatch):
+        for raw, want in (("off", 0), ("0", 0), ("false", 0), ("no", 0),
+                          ("counts", 1), ("on", 1), ("", 1),
+                          ("garbage", 1), ("full", 2), ("FULL", 2)):
+            monkeypatch.setenv("KARPENTER_TPU_EXPLAIN", raw)
+            assert explain.mode() == want, raw
+        monkeypatch.delenv("KARPENTER_TPU_EXPLAIN")
+        assert explain.mode() == explain.MODE_COUNTS  # the default
+
+    def test_delta_and_shed_vocabularies(self):
+        # the other namespaces the registry owns (one enum owner)
+        assert "cold" in explain.DELTA_FALLBACK_REASONS
+        assert "stranded" in explain.DELTA_FALLBACK_REASONS
+        assert explain.SHED_ADMISSION in explain.SHED_REASONS
+        assert explain.SHED_DEADLINE in explain.SHED_REASONS
+        from karpenter_tpu.service import scheduler as tenant_sched
+        assert tenant_sched.SHED_ADMISSION is explain.SHED_ADMISSION
+
+
+# --------------------------------------------------------------------------
+# kernel aux
+# --------------------------------------------------------------------------
+class TestKernelAux:
+    def test_bit_parity_across_modes(self, monkeypatch):
+        results = {}
+        for mode in ("off", "counts", "full"):
+            monkeypatch.setenv("KARPENTER_TPU_EXPLAIN", mode)
+            results[mode] = digest(mksolver().solve(mkinp("par", n=40)))
+        assert results["off"] == results["counts"] == results["full"]
+
+    def test_limit_strand_attributes_to_limit(self):
+        s = mksolver()
+        res = s.solve(mkinp("lim", n=30, cpu="2",
+                            remaining_limits={
+                                "default": Resources.parse({"cpu": "1"})}))
+        assert res.unschedulable
+        elim = s.last_explain["eliminations"]
+        assert s.last_explain["kernel_aux"]
+        assert elim["limit"] > 0, elim
+        r = next(iter(res.unschedulable.values()))
+        # oracle authority names the verdict; the kernel half survives
+        assert r.code == explain.POOL_LIMIT
+        kern = r.tree.get("kernel") or r.tree
+        assert kern["eliminations"]["limit"] > 0
+        assert "suggestion" in kern
+
+    def test_fit_strand_attributes_to_fit_with_nearest_miss(self):
+        s = mksolver()
+        res = s.solve(mkinp("fit", n=3, cpu="9999"))
+        assert res.unschedulable
+        r = next(iter(res.unschedulable.values()))
+        assert r.code in (explain.NO_NODEPOOL, explain.CAPACITY)
+        kern = r.tree.get("kernel") or r.tree
+        elim = kern["eliminations"]
+        assert elim["fit"] == kern["columns_total"], elim
+        miss = kern["nearest_miss"]
+        assert miss["constraint"] == "fit" and miss["deficit"]
+
+    def test_price_cap_attributes_host_side(self):
+        s = mksolver()
+        res = s.solve(mkinp("cap", n=6, price_cap=1e-9))
+        assert res.unschedulable
+        elim = s.last_explain["eliminations"]
+        assert elim["price"] > 0, elim
+        # the price nearest-miss: the cheapest FITTING column above the
+        # cap, and the suggestion names the cap to raise to
+        r = next(iter(res.unschedulable.values()))
+        kern = (r.tree or {}).get("kernel") or r.tree
+        if kern:  # the oracle may own the verdict; the kernel half has it
+            miss = kern.get("nearest_miss")
+            assert miss and miss["constraint"] == "price", kern
+            assert miss["price"] >= miss["price_cap"]
+            assert "raise the price cap to >=" in kern["suggestion"]
+
+    def test_counts_partition_the_columns(self, monkeypatch):
+        # precedence-disjoint classes: the per-class counts plus the
+        # host classes never exceed the catalog width
+        captured = {}
+        orig = ffd.unpack
+
+        def spy(*a, **kw):
+            out = orig(*a, **kw)
+            if kw.get("explain"):
+                captured.update(out)
+            return out
+        monkeypatch.setattr(ffd, "unpack", spy)
+        s = mksolver()
+        res = s.solve(mkinp("part", n=30, cpu="2",
+                            remaining_limits={
+                                "default": Resources.parse({"cpu": "1"})}))
+        assert res.unschedulable
+        counts = captured["explain_counts"]
+        O = len(CATALOG) * 6  # zones x capacity types per type (grid)
+        # kernel classes partition the masked-in columns: row sums
+        # (minus the slots flag) stay within the catalog width
+        kernel_sum = counts[:, :4].sum(axis=1)
+        assert (kernel_sum <= O).all(), (kernel_sum.max(), O)
+
+    def test_counts_and_bits_consistent(self, monkeypatch):
+        captured = {}
+        orig = ffd.unpack
+
+        def spy(*a, **kw):
+            out = orig(*a, **kw)
+            if kw.get("explain"):
+                captured.update(out)
+            return out
+        monkeypatch.setattr(ffd, "unpack", spy)
+        s = mksolver()
+        s.solve(mkinp("bits", n=30, cpu="2",
+                      remaining_limits={
+                          "default": Resources.parse({"cpu": "1"})}))
+        counts = captured["explain_counts"]
+        bits = captured["explain_bits"]
+        want = ((counts > 0).astype(np.int64)
+                * (1 << np.arange(ffd.EXPLAIN_C))).sum(axis=1)
+        assert (bits == want).all()
+
+    def test_full_mode_materializes_the_column_map(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_EXPLAIN", "full")
+        captured = {}
+        orig = ffd.unpack
+
+        def spy(*a, **kw):
+            out = orig(*a, **kw)
+            if kw.get("explain"):
+                captured.update(out)
+            return out
+        monkeypatch.setattr(ffd, "unpack", spy)
+        s = mksolver()
+        res = s.solve(mkinp("map", n=3, cpu="9999"))
+        m = captured["explain_map"]
+        counts = captured["explain_counts"]
+        # class 1 (fit) strikes every masked-in column of the giant group
+        assert (m == 1).sum() >= counts[0][0] > 0
+        # and the map is CONSUMED: full-mode trees name the eliminated
+        # columns, not just count them
+        r = next(iter(res.unschedulable.values()))
+        kern = r.tree.get("kernel") or r.tree
+        cols = kern["eliminated_columns"]["fit"]
+        assert cols and "instance_type" in cols[0]
+
+    def test_uncapped_batch_lane_feeds_the_elimination_series(self):
+        # the fused solverd lane: real provisioning requests ride
+        # solve_batch with max_nodes=None — the worker's elimination
+        # series must move exactly like the single-problem path's
+        s = mksolver()
+        before = metrics.SOLVER_CONSTRAINT_ELIM.value(constraint="limit")
+        out = s.solve_batch([mkinp(
+            "blane", n=30, cpu="2",
+            remaining_limits={"default": Resources.parse({"cpu": "1"})})])
+        assert out[0].unschedulable
+        assert s.last_explain is not None and \
+            s.last_explain["kernel_aux"]
+        assert metrics.SOLVER_CONSTRAINT_ELIM.value(
+            constraint="limit") > before
+        # and a CAPPED batch (a consolidation sim) does NOT pollute
+        last = s.last_explain
+        mark = metrics.SOLVER_CONSTRAINT_ELIM.value(constraint="limit")
+        s.solve_batch([mkinp(
+            "bsim", n=30, cpu="2",
+            remaining_limits={"default": Resources.parse({"cpu": "1"})})],
+            max_nodes=8)
+        assert s.last_explain is last
+        assert metrics.SOLVER_CONSTRAINT_ELIM.value(
+            constraint="limit") == mark
+
+    def test_off_mode_skips_aux_and_trees(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_EXPLAIN", "off")
+        s = mksolver()
+        res = s.solve(mkinp("off", n=3, cpu="9999"))
+        assert s.last_explain is None
+        r = next(iter(res.unschedulable.values()))
+        # codes still attach (constant cost); trees do not
+        assert r.code in explain.REGISTRY
+        assert (r.tree or {}).get("kernel") is None
+        assert "eliminations" not in (r.tree or {})
+
+    def test_elimination_counter_exported(self):
+        before = metrics.SOLVER_CONSTRAINT_ELIM.value(constraint="fit")
+        mksolver().solve(mkinp("ctr", n=3, cpu="9999"))
+        assert metrics.SOLVER_CONSTRAINT_ELIM.value(
+            constraint="fit") > before
+        assert ("karpenter_tpu_solver_constraint_eliminations_total"
+                in metrics.REGISTRY.render())
+
+
+# --------------------------------------------------------------------------
+# reason sites
+# --------------------------------------------------------------------------
+class TestReasonSites:
+    def test_oracle_limit_verdict_is_pool_limit_with_pool_tree(self):
+        inp = mkinp("orc", n=4, cpu="2",
+                    remaining_limits={
+                        "default": Resources.parse({"cpu": "1"})})
+        res = Scheduler(inp).solve()
+        assert res.unschedulable
+        r = next(iter(res.unschedulable.values()))
+        assert r.code == explain.POOL_LIMIT
+        assert "limits exceeded" in r  # legacy detail intact
+        causes = {p["cause"] for p in r.tree["pools"]}
+        assert explain.CAUSE_LIMITS in causes
+
+    def test_oracle_incompat_verdict_is_no_nodepool(self):
+        pod = Pod(meta=ObjectMeta(name="pick"),
+                  requests=Resources.parse({"cpu": "1"}))
+        from karpenter_tpu.models.requirements import (Requirement,
+                                                       Requirements)
+        pod.requirements = Requirements(
+            Requirement.make("no.such/label", "In", "x"))
+        inp = ScheduleInput(pods=[pod], nodepools=[POOL],
+                            instance_types={"default": CATALOG})
+        res = Scheduler(inp).solve()
+        r = res.unschedulable["pick"]
+        assert r.code == explain.NO_NODEPOOL
+        assert all(p["cause"] in explain.POOL_CAUSES
+                   for p in r.tree["pools"])
+
+    def test_backstop_discrimination_is_code_not_substring(self):
+        # a reason whose DETAIL mentions "limits exceeded" but whose code
+        # is the kernel's generic strand must NOT read as oracle-limit
+        fake = explain.make(explain.CAPACITY,
+                            "weird detail: limits exceeded elsewhere")
+        assert explain.code_of(fake) != explain.POOL_LIMIT
+        real = explain.make(explain.POOL_LIMIT, "whatever text")
+        assert explain.code_of(real) == explain.POOL_LIMIT
+
+    def test_every_strand_in_a_mixed_solve_carries_a_registry_code(self):
+        pods = [Pod(meta=ObjectMeta(name=f"ok-{i}"),
+                    requests=Resources.parse({"cpu": "500m",
+                                              "memory": "1Gi"}))
+                for i in range(6)]
+        pods += [Pod(meta=ObjectMeta(name=f"giant-{i}"),
+                     requests=Resources.parse({"cpu": "9999"}))
+                 for i in range(2)]
+        inp = ScheduleInput(pods=pods, nodepools=[POOL],
+                            instance_types={"default": CATALOG})
+        res = mksolver().solve(inp)
+        assert len(res.unschedulable) == 2
+        for r in res.unschedulable.values():
+            assert explain.code_of(r) in explain.REGISTRY, r
+
+
+# --------------------------------------------------------------------------
+# store + host engine
+# --------------------------------------------------------------------------
+class TestExplainStore:
+    def test_register_lookup_and_trace_pinning(self):
+        store = explain.ExplainStore()
+        r1 = explain.make(explain.CAPACITY, "one", {"a": 1})
+        r2 = explain.make(explain.POOL_LIMIT, "two", {"b": 2})
+        store.register({"pod-x": r1}, trace_id="t1")
+        store.register({"pod-x": r2}, trace_id="t2")
+        latest = store.lookup("pod-x")
+        assert latest["code"] == explain.POOL_LIMIT
+        pinned = store.lookup("pod-x", trace_id="t1")
+        assert pinned["code"] == explain.CAPACITY
+        assert pinned["tree"] == {"a": 1}
+        assert store.lookup("pod-x", trace_id="t-none") is None
+        assert store.lookup("other") is None
+
+    def test_bounds(self):
+        store = explain.ExplainStore(capacity=4, per_pod=2)
+        for i in range(10):
+            store.register({f"p{i}": explain.make(explain.CAPACITY, "x")})
+        assert store.size() == 4
+        assert store.lookup("p0") is None and store.lookup("p9")
+        for i in range(5):
+            store.register({"p9": explain.make(explain.CAPACITY, str(i))})
+        assert len(store._by_pod["p9"]) == 2
+
+    def test_recent_lists_newest_first(self):
+        store = explain.ExplainStore()
+        for name in ("a", "b", "c"):
+            store.register({name: explain.make(explain.CAPACITY, "x")})
+        recent = store.recent(2)
+        assert [e["pod"] for e in recent] == ["c", "b"]
+        # ?limit=0 means NONE ([-0:] would be the whole list)
+        assert store.recent(0) == []
+        assert store.recent(-1) == []
+
+    def test_host_counts_fallback_without_kernel_aux(self):
+        # the batched/sweep/replay paths carry no kernel aux: the
+        # explainer's numpy mirror must still attribute
+        s = mksolver()
+        from karpenter_tpu.solver.encode import encode, encode_catalog
+        inp = mkinp("host", n=3, cpu="9999")
+        cat = encode_catalog(inp)
+        enc = encode(inp, cat)
+        counts = explain.host_counts(enc, {}, 0)
+        assert counts["fit"] == enc.n_columns
+        tree = explain.build_tree(enc, {}, 0, explain.CAPACITY)
+        assert tree["eliminations"]["fit"] == enc.n_columns
+
+
+# --------------------------------------------------------------------------
+# provisioning integration + the operator API
+# --------------------------------------------------------------------------
+class TestProvisioningIntegration:
+    def _env(self):
+        env = Environment(options=Options(batch_idle_duration=0))
+        env.add_default_nodeclass()
+        env.cluster.nodepools.create(
+            NodePool(meta=ObjectMeta(name="default")))
+        return env
+
+    def test_verdict_feeds_event_metric_and_store(self):
+        tracing.set_enabled(True)
+        try:
+            tracing.reset()
+            env = self._env()
+            env.cluster.pods.create(Pod(
+                meta=ObjectMeta(name="huge"),
+                requests=Resources.parse({"cpu": "10000",
+                                          "memory": "1Ti"})))
+            before = {k: v for k, v in telemetry._series(
+                metrics.UNSCHEDULABLE_PODS).items()}
+            env.provisioner.reconcile()
+            # event message upgraded to [Code] detail
+            ev = [(r, m) for _, _, _, r, m in env.cluster.events
+                  if r == "FailedScheduling"]
+            assert ev and ev[0][1].startswith("["), ev
+            code = ev[0][1][1:].split("]", 1)[0]
+            assert code in explain.REGISTRY
+            # the per-reason counter moved for exactly that code
+            after = telemetry._series(metrics.UNSCHEDULABLE_PODS)
+            assert after.get(code, 0) > before.get(code, 0)
+            assert after.get(explain.LEGACY, 0) == \
+                before.get(explain.LEGACY, 0)
+            # the store holds the tree, stamped with the pass's trace
+            entry = explain.STORE.lookup("huge")
+            assert entry is not None
+            assert entry["code"] == code
+            assert entry["tree"], entry
+            assert entry["trace_id"] is not None
+        finally:
+            tracing.set_enabled(None)
+            tracing.reset()
+
+    def test_placement_section_rides_telemetry_and_dashboard_merge(self):
+        env = self._env()
+        env.cluster.pods.create(Pod(
+            meta=ObjectMeta(name="nope"),
+            requests=Resources.parse({"cpu": "10000", "memory": "1Ti"})))
+        env.provisioner.reconcile()
+        snap = telemetry.local_snapshot()
+        assert "placement" in snap
+        assert snap["placement"]["unschedulable"], snap["placement"]
+        assert snap["placement"]["explained_pods"] >= 1
+        doc = telemetry.merge({"operator": snap})
+        assert doc["fleet"]["placement"]["unschedulable"]
+
+    def test_operator_debug_explain_http(self):
+        from karpenter_tpu.operator.operator import Operator
+        env = self._env()
+        op = Operator(options=env.options, metrics_port=0, health_port=0,
+                      env=env)
+        op.serve()
+        try:
+            env.cluster.pods.create(Pod(
+                meta=ObjectMeta(name="stuck-pod"),
+                requests=Resources.parse({"cpu": "10000",
+                                          "memory": "1Ti"})))
+            env.provisioner.reconcile()
+            base = f"http://127.0.0.1:{op.metrics_port}"
+            with urllib.request.urlopen(
+                    base + "/debug/explain?pod=stuck-pod",
+                    timeout=30) as r:
+                assert r.status == 200
+                doc = json.loads(r.read().decode())
+            assert doc["pod"] == "stuck-pod"
+            assert doc["code"] in explain.REGISTRY
+            assert doc["tree"]
+            # the listing form carries the reason-code table
+            with urllib.request.urlopen(
+                    base + "/debug/explain", timeout=30) as r:
+                listing = json.loads(r.read().decode())
+            assert any(e["pod"] == "stuck-pod"
+                       for e in listing["pods"])
+            assert any(row["code"] == explain.POOL_LIMIT
+                       for row in listing["reason_codes"])
+            # html rendering
+            with urllib.request.urlopen(
+                    base + "/debug/explain?pod=stuck-pod&format=html",
+                    timeout=30) as r:
+                assert r.headers["Content-Type"].startswith("text/html")
+                assert b"stuck-pod" in r.read()
+            # unknown pod → 404 with a replay hint
+            try:
+                urllib.request.urlopen(
+                    base + "/debug/explain?pod=ghost", timeout=30)
+                raise AssertionError("expected 404")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+                body = json.loads(e.read().decode())
+                assert "kt_explain" in body["hint"]
+        finally:
+            op.stop()
+
+
+# --------------------------------------------------------------------------
+# record_event trace-id stamping across the other controllers
+# --------------------------------------------------------------------------
+class TestEventTraceStamping:
+    """The provisioning path's stamping was asserted in PR 1
+    (test_tracing); the disruption/gc/lifecycle controllers emit
+    operator-facing events too and must cross-reference their passes."""
+
+    def _env(self):
+        env = Environment(options=Options(batch_idle_duration=0))
+        env.add_default_nodeclass()
+        env.cluster.nodepools.create(
+            NodePool(meta=ObjectMeta(name="default")))
+        return env
+
+    def _stamped(self, env, reason):
+        return [tid for (_, _, _, r, _), tid
+                in zip(env.cluster.events, env.cluster.event_trace_ids)
+                if r == reason]
+
+    def test_lifecycle_events_stamp_their_pass(self):
+        tracing.set_enabled(True)
+        try:
+            tracing.reset()
+            env = self._env()
+            env.cluster.pods.create(Pod(
+                meta=ObjectMeta(name="w"),
+                requests=Resources.parse({"cpu": "500m",
+                                          "memory": "1Gi"})))
+            env.settle()
+            stamped = self._stamped(env, "Launched")
+            assert stamped and stamped[0] is not None
+            traces = {t[0]: {s.name for s in t[1]}
+                      for t in tracing.finished_traces()}
+            assert "lifecycle.pass" in traces.get(stamped[0], set())
+        finally:
+            tracing.set_enabled(None)
+            tracing.reset()
+
+    def test_gc_events_stamp_their_pass(self):
+        tracing.set_enabled(True)
+        try:
+            tracing.reset()
+            env = self._env()
+            from karpenter_tpu.providers.fake_cloud import FleetCandidate
+            env.cloud.create_fleet(
+                [FleetCandidate("m5.large", "tpu-west-1a", "on-demand",
+                                0.1)],
+                tags={"karpenter.sh/discovery":
+                      env.options.cluster_name})
+            env.gc.reconcile()
+            stamped = self._stamped(env, "LeakedInstanceReclaimed")
+            assert stamped and stamped[0] is not None
+            traces = {t[0]: {s.name for s in t[1]}
+                      for t in tracing.finished_traces()}
+            assert "gc.pass" in traces.get(stamped[0], set())
+        finally:
+            tracing.set_enabled(None)
+            tracing.reset()
+
+    def test_disruption_events_stamp_their_pass(self):
+        tracing.set_enabled(True)
+        try:
+            tracing.reset()
+            env = self._env()
+            env.cluster.pods.create(Pod(
+                meta=ObjectMeta(name="d"),
+                requests=Resources.parse({"cpu": "500m",
+                                          "memory": "1Gi"})))
+            env.settle()
+            pod = env.cluster.pods.get("d")
+            pod.node_name = None
+            env.cluster.pods.delete("d")
+            env.settle()
+            stamped = [tid for (_, _, _, r, _), tid
+                       in zip(env.cluster.events,
+                              env.cluster.event_trace_ids)
+                       if r.startswith("Disrupted")]
+            assert stamped and stamped[0] is not None
+            traces = {t[0]: {s.name for s in t[1]}
+                      for t in tracing.finished_traces()}
+            assert "disruption.pass" in traces.get(stamped[0], set())
+        finally:
+            tracing.set_enabled(None)
+            tracing.reset()
+
+
+# --------------------------------------------------------------------------
+# delta prefix-attribution reuse
+# --------------------------------------------------------------------------
+class TestDeltaAux:
+    def test_engaged_delta_pass_stitches_counts(self):
+        s = mksolver(delta="on")
+        inp = mkinp("delta", n=40)
+        s.solve(inp)  # full pass → record with aux
+        before = metrics.SOLVER_DELTA_PASSES.value(outcome="delta")
+        res = s.solve(inp)  # pure-reuse delta pass
+        assert metrics.SOLVER_DELTA_PASSES.value(
+            outcome="delta") == before + 1
+        assert not res.unschedulable
+        # the merged pass still attributed (prefix rows from the cache)
+        assert s.last_explain is not None
+        assert s.last_explain["kernel_aux"], s.last_explain
+
+    def test_record_carries_the_aux_rows(self):
+        s = mksolver(delta="on")
+        inp = mkinp("rec", n=40)
+        s.solve(inp)
+        from karpenter_tpu.solver.encode import encode_catalog
+        rec = s._delta_cache.get(s._catalog_encoding(inp))
+        assert rec is not None
+        assert rec.explain_counts is not None
+        assert rec.explain_counts.shape == (rec.n_groups, ffd.EXPLAIN_C)
+
+    def test_delta_fallback_reasons_are_registry_members(self):
+        s = mksolver(delta="on")
+        s.solve(mkinp("fb", n=4, price_cap=1e9))  # price-cap → fallback
+        assert s._delta_cache.last_outcome == "fallback"
+        assert s._delta_cache.last_reason in \
+            explain.DELTA_FALLBACK_REASONS
+
+
+# --------------------------------------------------------------------------
+# post-mortem: capture → kt_explain CLI
+# --------------------------------------------------------------------------
+class TestFleetExplain:
+    """The acceptance topology: a REAL supervised kt_solverd behind the
+    operator — the stranded pod's code + constraint tree must survive
+    the pickled result across the wire, feed the operator-side store,
+    and come back through GET /debug/explain."""
+
+    @pytest.fixture(scope="class")
+    def supervised(self, tmp_path_factory):
+        from karpenter_tpu.service import SolverdSupervisor
+        from tests.test_faults import worker_env
+        from tests.test_solver_service import build_daemon
+        build_daemon()
+        tmp = tmp_path_factory.mktemp("explain_fleet")
+        sock = str(tmp / "kt.sock")
+        sup = SolverdSupervisor(
+            sock, env=worker_env(),
+            extra_args=["--idle-ms", "10", "--max-ms", "100"],
+            stderr_path=str(tmp / "worker.stderr"))
+        sup.start(wait_for_socket=True, timeout=60)
+        yield sup, sock
+        sup.stop()
+
+    def test_code_and_tree_cross_the_wire_to_debug_explain(
+            self, supervised):
+        from karpenter_tpu.operator.operator import Operator
+        from karpenter_tpu.service import SolverServiceError
+        sup, sock = supervised
+        opts = Options(batch_idle_duration=0,
+                       solver_endpoint=sock,
+                       service_request_timeout=120.0,
+                       service_retry_attempts=3,
+                       service_breaker_threshold=50,
+                       service_local_fallback=False,
+                       solver_max_nodes=128)
+        op = Operator(options=opts, metrics_port=0, health_port=0)
+        op.serve()
+        client = op.env.solver.tpu
+        try:
+            env = op.env
+            env.add_default_nodeclass()
+            env.cluster.nodepools.create(
+                NodePool(meta=ObjectMeta(name="default")))
+            # prime the worker (jax import + catalog handshake) with a
+            # direct solve so the provisioning pass below is one RPC
+            deadline = time.time() + 120
+            primed = None
+            while time.time() < deadline:
+                try:
+                    primed = client.solve(mkinp("prime", 4))
+                    break
+                except SolverServiceError:
+                    time.sleep(0.5)
+            assert primed is not None and not primed.unschedulable
+            # a pod no instance type can hold, through the REAL
+            # provisioning controller and the REAL daemon
+            env.cluster.pods.create(Pod(
+                meta=ObjectMeta(name="fleet-stuck"),
+                requests=Resources.parse({"cpu": "10000",
+                                          "memory": "1Ti"})))
+            env.provisioner.reconcile()
+            entry = explain.STORE.lookup("fleet-stuck")
+            assert entry is not None, \
+                "the remote verdict never reached the store"
+            assert entry["code"] in explain.REGISTRY
+            assert entry["code"] != explain.LEGACY, \
+                "the code was lost crossing the solverd wire"
+            assert entry["tree"], \
+                "the tree was lost crossing the solverd wire"
+            # and out through the operator's HTTP surface
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{op.metrics_port}"
+                    "/debug/explain?pod=fleet-stuck", timeout=30) as r:
+                assert r.status == 200
+                doc = json.loads(r.read().decode())
+            assert doc["code"] == entry["code"]
+            assert doc["tree"]
+            # the event log upgraded to [Code] detail as well
+            ev = [m for _, _, _, r_, m in op.env.cluster.events
+                  if r_ == "FailedScheduling"]
+            assert ev and ev[0].startswith(f"[{entry['code']}]")
+        finally:
+            client.close()
+            op.stop()
+
+
+class TestKtExplainCLI:
+    def test_cli_explains_a_captured_record(self, tmp_path, monkeypatch):
+        from karpenter_tpu.utils import flightrecorder
+        flightrecorder.RECORDER.reset()
+        monkeypatch.setenv("KARPENTER_TPU_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("KARPENTER_TPU_FLIGHT_CAPTURE", "1")
+        try:
+            s = mksolver()
+            res = s.solve(mkinp("cli", n=3, cpu="9999"))
+            assert res.unschedulable
+        finally:
+            flightrecorder.RECORDER.reset()
+        spill = tmp_path / f"flight-{os.getpid()}.jsonl"
+        assert spill.exists()
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "kt_explain.py"), str(spill)],
+            capture_output=True, text=True, timeout=570,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        doc = json.loads(proc.stdout)
+        assert doc["unschedulable"]
+        for entry in doc["unschedulable"].values():
+            assert entry["code"] in explain.REGISTRY
+            tree = entry["tree"]
+            elim = (tree.get("eliminations")
+                    or tree.get("kernel", {}).get("eliminations"))
+            assert elim and any(v > 0 for v in elim.values())
+        # the replay ran with full-mode aux armed, and the [G, O] map
+        # surfaced as named eliminated columns in the trees
+        assert doc["explain"]["mode"] == "full"
+        any_cols = any(
+            "eliminated_columns" in ((e["tree"] or {}).get("kernel")
+                                     or e["tree"] or {})
+            for e in doc["unschedulable"].values())
+        assert any_cols, "full-mode map never reached a tree"
+
+    def test_url_mode_survives_a_dead_operator(self):
+        from tools.kt_explain import explain_url
+        doc = explain_url("http://127.0.0.1:9", "web-42")
+        assert "error" in doc and "unreachable" in doc["error"]
+
+    def test_cli_pod_filter_exit_codes(self, tmp_path, monkeypatch):
+        from karpenter_tpu.utils import flightrecorder
+        flightrecorder.RECORDER.reset()
+        monkeypatch.setenv("KARPENTER_TPU_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("KARPENTER_TPU_FLIGHT_CAPTURE", "1")
+        try:
+            mksolver().solve(mkinp("podf", n=2, cpu="9999"))
+        finally:
+            flightrecorder.RECORDER.reset()
+        spill = str(tmp_path / f"flight-{os.getpid()}.jsonl")
+        envp = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        hit = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "kt_explain.py"), spill,
+             "--pod", "podf-p0"],
+            capture_output=True, text=True, timeout=570, env=envp)
+        assert hit.returncode == 0, hit.stderr[-2000:]
+        assert json.loads(hit.stdout)["pod"] == "podf-p0"
+        miss = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "kt_explain.py"), spill,
+             "--pod", "ghost"],
+            capture_output=True, text=True, timeout=570, env=envp)
+        assert miss.returncode == 2

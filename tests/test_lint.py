@@ -623,6 +623,53 @@ def test_observability_shape_checks(tmp_path):
                    for f in findings)
 
 
+def test_reason_literal_flags_adhoc_strings(tmp_path):
+    findings, _ = _check(tmp_path, """
+        def decode(res, pod, name):
+            res.unschedulable[pod.meta.name] = "no capacity left"
+            res.unschedulable[name] = f"nodepool {name}: busted"
+            res.unschedulable[name] = ("no nodepool can schedule: "
+                                       + name)
+    """, observability, relname="karpenter_tpu/solver/demo.py")
+    msgs = [f.message for f in findings]
+    assert len(findings) == 3, msgs
+    assert all("reason-literal" in m for m in msgs)
+
+
+def test_reason_literal_negatives(tmp_path):
+    # registry-made Reasons, variable assignments, and unrelated
+    # subscripts are all clean
+    findings, _ = _check(tmp_path, """
+        from karpenter_tpu.solver import explain as explainmod
+
+
+        def decode(res, pod, reason, table):
+            res.unschedulable[pod.meta.name] = explainmod.make(
+                explainmod.CAPACITY, "no capacity left")
+            res.unschedulable[pod.meta.name] = reason
+            table["unschedulable"] = "a value keyed by that word is fine"
+            res.other[pod.meta.name] = "not the verdict dict"
+    """, observability, relname="karpenter_tpu/solver/demo.py")
+    assert findings == []
+
+
+def test_reason_literal_exempts_the_registry_module(tmp_path):
+    findings, _ = _check(tmp_path, """
+        def demo(res, name):
+            res.unschedulable[name] = "registry-internal literal"
+    """, observability, relname="karpenter_tpu/solver/explain.py")
+    assert findings == []
+
+
+def test_reason_literal_suppression(tmp_path):
+    _, report = _check(tmp_path, """
+        def decode(res, name):
+            res.unschedulable[name] = "grandfathered"  # kt-lint: disable=observability-conformance
+    """, observability, relname="karpenter_tpu/solver/demo.py")
+    assert report.findings == []
+    assert len(report.suppressed) == 1
+
+
 def test_observability_span_names(tmp_path):
     findings, _ = _check(tmp_path, """
         from karpenter_tpu.utils import tracing
