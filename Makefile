@@ -4,7 +4,8 @@
 PY ?= python
 
 .PHONY: test tier1 multichip lint analyze analyze-fast native asan tsan \
-	repro-crash repro-crash-tsan saturation-smoke explain-smoke
+	repro-crash repro-crash-tsan saturation-smoke explain-smoke \
+	ledger-smoke bench-regress
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
@@ -52,6 +53,22 @@ saturation-smoke:
 # `python bench.py --explain` -> BENCH_r10.json.
 explain-smoke:
 	JAX_PLATFORMS=cpu $(PY) hack/explain_smoke.py
+
+# The decision-ledger loop end to end (ISSUE 14): a real Environment
+# provisions, consolidates, and terminates capacity with the ledger
+# spilling to disk, then the real tools/kt_ledger.py CLI reads the
+# spill back and the report must reconcile (sources present, savings
+# positive, before/after fleet $/hr chain exact).  The overhead bench
+# is `python bench.py --ledger`.
+ledger-smoke:
+	JAX_PLATFORMS=cpu $(PY) hack/ledger_smoke.py
+
+# Gate the BENCH_r*.json trajectory: the newest recording must not
+# regress >15% on its same-metric predecessor's headline latency nor
+# flip any parity/acceptance flag false.  Documented in
+# docs/operations.md §Development gates.
+bench-regress:
+	$(PY) hack/check_bench_regress.py
 
 # `lint` is the historical name; `analyze` is canonical — one recipe.
 lint: analyze

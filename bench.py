@@ -680,6 +680,119 @@ def explain_overhead_main(reps: int = 24,
         raise SystemExit(1)
 
 
+def ledger_overhead_main(reps: int = 24,
+                         out_path: str = "BENCH_r11.json") -> None:
+    """`bench.py --ledger`: the cost-observability acceptance bench
+    (ISSUE 14) — ledger + audit sampling armed at the default rate must
+    add <1% of the 50k headline solve's p50.  Methodology is the flight
+    bench's, per the host-noise discipline: interleaved off/on PAIRS
+    with ALTERNATING order (this host runs the second solve of a
+    back-to-back pair systematically slower), p10-vs-p10 A/B gate.
+
+    The on-arm arms `KARPENTER_TPU_AUDIT` at the default sampled rate
+    (`audit.DEFAULT_RATE` — what a production deployment that turns the
+    knob on pays per solve: the sampling check itself; the rare sampled
+    solve's oracle re-verify runs on the background thread and is
+    excluded by p10) and writes one ledger record per solve through the
+    REAL record seam, timed directly as the noise-free corroboration —
+    production writes records per controller decision, so one per solve
+    is an upper bound on the seam's share.  Exits 1 past the 1% gate;
+    stamps the result into `BENCH_r11.json`."""
+    # the repeat loop re-solves one input: full solves only (the same
+    # pinning discipline as the headline)
+    os.environ["KARPENTER_TPU_DELTA"] = "off"
+    from karpenter_tpu.utils.platform import initialize
+    platform = initialize(attempt_log=log_attempt)
+    from karpenter_tpu.solver import TPUSolver
+    from karpenter_tpu.solver import audit as auditmod
+    from karpenter_tpu.solver import explain as explainmod
+    from karpenter_tpu.utils import ledger as ledgermod
+
+    inp = build_input(50_000)
+    solver = TPUSolver(max_nodes=2048)
+    solver, res, platform = first_solve_with_retry(solver, inp, platform)
+    assert not res.unschedulable
+    solver.solve(inp)  # settle the adaptive node bucket
+
+    record_ms = []
+
+    def run_arm(arm):
+        if arm == "on":
+            os.environ["KARPENTER_TPU_AUDIT"] = str(auditmod.DEFAULT_RATE)
+            os.environ["KARPENTER_TPU_LEDGER"] = "on"
+        else:
+            os.environ["KARPENTER_TPU_AUDIT"] = "off"
+            os.environ["KARPENTER_TPU_LEDGER"] = "off"
+        t0 = time.perf_counter()
+        r = solver.solve(inp)
+        ms = (time.perf_counter() - t0) * 1000.0
+        if arm == "on":
+            t1 = time.perf_counter()
+            ledgermod.LEDGER.record(
+                "provisioning", "launch",
+                reason_code=explainmod.CAPACITY_LAUNCHED,
+                detail="bench.py --ledger seam probe",
+                pools=["default"], nodes_delta=r.node_count(),
+                pods_affected=len(inp.pods),
+                fleet_cost_before=0.0,
+                cost_delta=r.total_price())
+            record_ms.append((time.perf_counter() - t1) * 1000.0)
+        return ms
+
+    audits_completed = 0
+    try:
+        times = _ab_interleave(reps, ("off", "on"), run_arm)
+    finally:
+        os.environ.pop("KARPENTER_TPU_AUDIT", None)
+        os.environ.pop("KARPENTER_TPU_LEDGER", None)
+        auditmod.SAMPLER.drain(timeout=60.0)
+        audits_completed = auditmod.SAMPLER.audits
+        auditmod.SAMPLER.reset()
+    assert len(ledgermod.LEDGER) > 0, \
+        "ledger-on arm produced no ledger records"
+    assert record_ms, "the ledger record seam never fired on the on-arm"
+
+    s_off, s_on = _ab_stats(times["off"]), _ab_stats(times["on"])
+    overhead_ms = s_on["p10"] - s_off["p10"]
+    overhead_pct = 100.0 * overhead_ms / s_off["p50"]
+    rec_p50 = statistics.median(record_ms)
+    ok = overhead_pct < 1.0
+    from benchmarks.common import env_fingerprint
+    result = {
+        "metric": "ledger+audit-sampling overhead on the 50k headline "
+                  "solve",
+        "value": round(overhead_pct, 3),
+        "unit": "% of p50 (p10-on minus p10-off)",
+        "pass": ok,
+        "threshold_pct": 1.0,
+        "reps_per_arm": reps,
+        "audit_rate": auditmod.DEFAULT_RATE,
+        "audits_completed": audits_completed,
+        "off_ms": s_off, "on_ms": s_on,
+        "overhead_ms_p10": round(overhead_ms, 2),
+        "overhead_pct_of_p50": round(overhead_pct, 3),
+        "record_seam_ms_p50": round(rec_p50, 4),
+        "record_seam_pct_of_p50": round(
+            100.0 * rec_p50 / s_off["p50"], 4),
+        "runs_off_ms": [round(t, 1) for t in times["off"]],
+        "runs_on_ms": [round(t, 1) for t in times["on"]],
+        "platform": platform,
+        "env": env_fingerprint(platform, reps=reps,
+                               times_ms=times["on"]),
+    }
+    log_attempt({"stage": "ledger-overhead", **result, "ts": time.time()})
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+    print(json.dumps(result))
+    print(f"ledger overhead: p10-vs-p10 {overhead_ms:+.1f}ms "
+          f"({overhead_pct:+.2f}% of off p50 {s_off['p50']}ms); "
+          f"record seam {rec_p50:.4f}ms/record pass={ok} -> {out_path}",
+          file=sys.stderr)
+    if not ok:
+        raise SystemExit(1)
+
+
 def main() -> None:
     # evict stale chip holders (leftover kt_solverd — the round-1 failure
     # mode) BEFORE the config subprocesses run: they probe with
@@ -845,5 +958,9 @@ if __name__ == "__main__":
         argv = sys.argv[1:]
         explain_overhead_main(reps=_int_opt(
             argv, "--reps", 24, "bench.py --explain [--reps R]"))
+    elif "--ledger" in sys.argv[1:]:
+        argv = sys.argv[1:]
+        ledger_overhead_main(reps=_int_opt(
+            argv, "--reps", 24, "bench.py --ledger [--reps R]"))
     else:
         main()

@@ -110,3 +110,32 @@ def run(metric: str, target_ms: float, make_input, solve=None, repeats: int = 5,
     print(f"runs={[round(t) for t in times]} phases_ms={phases}",
           file=sys.stderr)
     return res
+
+
+def drive_two_anchor_cycle(env):
+    """The shared provision→consolidate drive behind `make ledger-smoke`
+    and config4's ledger-exactness block: two anchored nodes (an anchor
+    pins a node, a small rider makes it worth keeping), then the anchors
+    scale away so consolidation retires capacity.  One copy — pod sizes
+    and settle discipline must not drift between the smoke's assertions
+    and the bench's accounting.  Returns (claims_at_peak,
+    claims_after_scaledown) for callers that gate on fleet shape."""
+    from karpenter_tpu.models import ObjectMeta, Pod, Resources
+
+    def mkpod(name, cpu, mem):
+        return Pod(meta=ObjectMeta(name=name),
+                   requests=Resources.parse({"cpu": cpu, "memory": mem}))
+
+    env.cluster.pods.create(mkpod("anchor-1", "15", "20Gi"))
+    env.cluster.pods.create(mkpod("small-1", "700m", "512Mi"))
+    env.settle()
+    env.cluster.pods.create(mkpod("anchor-2", "15", "20Gi"))
+    env.cluster.pods.create(mkpod("small-2", "700m", "512Mi"))
+    env.settle()
+    peak = len(env.cluster.nodeclaims.list())
+    for name in ("anchor-1", "anchor-2"):
+        p = env.cluster.pods.get(name)
+        p.node_name = None
+        env.cluster.pods.delete(name)
+    env.settle()
+    return peak, len(env.cluster.nodeclaims.list())

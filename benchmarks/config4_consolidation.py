@@ -8,7 +8,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from benchmarks.common import run
+from benchmarks.common import drive_two_anchor_cycle, run
 from karpenter_tpu.models import Node, NodePool, ObjectMeta, Pod, Resources, wellknown
 from karpenter_tpu.providers import generate_catalog
 from karpenter_tpu.scheduling import ExistingNode, ScheduleInput
@@ -68,11 +68,54 @@ def solve(solver, inps):
     return solver.solve_batch(inps, max_nodes=8)
 
 
+def ledger_exactness() -> dict:
+    """ISSUE 14 acceptance arithmetic, through the REAL disruption
+    controller: reported savings must equal (sum of retired candidate
+    prices − replacement price) to IEEE-hex exactness, and the exported
+    fleet $/hr must match an independent sum over the cluster's nodes
+    bit-for-bit.  Runs a small end-to-end consolidation (the
+    test_disruption two-underutilized-nodes idiom) in this config's
+    subprocess — the batched sweep above measures speed; this block
+    pins the accounting."""
+    from karpenter_tpu.env import Environment
+    from karpenter_tpu.operator.options import Options
+    from karpenter_tpu.utils import ledger, metrics, telemetry
+
+    env = Environment(options=Options(batch_idle_duration=0))
+    env.add_default_nodeclass()
+    env.cluster.nodepools.create(NodePool(meta=ObjectMeta(name="default")))
+    ledger.LEDGER.reset()
+    drive_two_anchor_cycle(env)
+
+    recs = [r for r in ledger.LEDGER.tail(64)
+            if r["source"] == "disruption"]
+    assert recs, "consolidation wrote no ledger records"
+    saved = sum(metrics.DISRUPTION_SAVINGS.value(method=m)
+                for m in ("emptiness", "multi_node", "single_node"))
+    expected = -sum(r["cost_delta"] for r in recs)
+    assert float(saved).hex() == float(expected).hex(), \
+        (float(saved).hex(), float(expected).hex())
+
+    ledger.update_fleet_metrics(env.cluster, env.cloud_provider)
+    gauge_total = sum(
+        telemetry._series(metrics.FLEET_HOURLY_COST).values())
+    manual = sum(
+        env.pricing.price(n.instance_type, n.zone, n.capacity_type)
+        or 0.0 for n in env.cluster.nodes.list())
+    assert float(gauge_total).hex() == float(manual).hex(), \
+        (float(gauge_total).hex(), float(manual).hex())
+    return {"ledger_savings_exact": True,
+            "ledger_savings_dollars_hr": round(saved, 6),
+            "fleet_cost_matches_node_sum": True}
+
+
 if __name__ == "__main__":
+    ledger_block = ledger_exactness()
     results = run(
         "config#4 consolidation: 2k candidate simulations (batched)",
         5000.0, make_input, solve=solve, repeats=3,
         extra=lambda rs: {
             "feasible_deletes": sum(
-                1 for r in rs if not r.unschedulable and not r.new_claims)})
+                1 for r in rs if not r.unschedulable and not r.new_claims),
+            **ledger_block})
     assert all(not r.unschedulable for r in results)

@@ -26,6 +26,8 @@ by one function — on/off/auto/N, a fault plan, a path-or-1), "value"
 
 # knob name -> {"owner": repo-relative module, "kind": bool|spec|value}
 KNOBS = {
+    "KARPENTER_TPU_AUDIT": {
+        "owner": "karpenter_tpu/solver/audit.py", "kind": "spec"},
     "KARPENTER_TPU_BIND_HOST": {
         "owner": "karpenter_tpu/utils/knobs.py", "kind": "value"},
     "KARPENTER_TPU_COALESCE": {
@@ -50,6 +52,12 @@ KNOBS = {
         "owner": "karpenter_tpu/operator/operator.py", "kind": "value"},
     "KARPENTER_TPU_LEASE_FILE": {
         "owner": "karpenter_tpu/operator/operator.py", "kind": "value"},
+    "KARPENTER_TPU_LEDGER": {
+        "owner": "karpenter_tpu/utils/ledger.py", "kind": "bool"},
+    "KARPENTER_TPU_LEDGER_BUFFER": {
+        "owner": "karpenter_tpu/utils/ledger.py", "kind": "value"},
+    "KARPENTER_TPU_LEDGER_DIR": {
+        "owner": "karpenter_tpu/utils/ledger.py", "kind": "value"},
     "KARPENTER_TPU_LOCK_OBSERVER": {
         "owner": "karpenter_tpu/utils/lockwatch.py", "kind": "bool"},
     "KARPENTER_TPU_MASK_BITS": {

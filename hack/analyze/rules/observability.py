@@ -28,6 +28,12 @@ Checks, over string-literal registrations anywhere in the tree:
     is a finding — unschedulability verdicts must be structured
     `explain.make(CODE, detail)` Reasons, never ad-hoc strings (the
     substring-discrimination hazard the registry retired).
+  * decision-reason literals (ISSUE 14): in the decision-emitting
+    controller modules (`controllers/disruption.py`), a function whose
+    name ends in ``_reason`` must not ``return`` a bare string literal
+    (constant, f-string, or literal concatenation) — the decision
+    ledger stores registry CODES, and a literal return is exactly how
+    an uncoded verdict sneaks past the registry into the ledger.
 """
 
 from __future__ import annotations
@@ -78,6 +84,10 @@ def _span_name_arg(call: ast.Call) -> Optional[ast.Constant]:
 # the one module allowed to spell reason strings next to their codes
 _REASON_REGISTRY_MODULE = "karpenter_tpu/solver/explain.py"
 
+# decision-emitting controllers: *_reason functions here feed the
+# decision ledger and must return registry-coded Reasons, not literals
+_REASON_RETURN_MODULES = ("karpenter_tpu/controllers/disruption.py",)
+
 
 def _contains_str_literal(expr: ast.AST) -> bool:
     """A direct string-literal value: plain constant, f-string, or a
@@ -114,8 +124,27 @@ def _reason_literal_findings(ctx: FileContext,
                 "(reason-literal)")
 
 
+def _reason_return_findings(ctx: FileContext,
+                            node: ast.FunctionDef) -> Iterator[Finding]:
+    if not any(ctx.rel.endswith(m) for m in _REASON_RETURN_MODULES):
+        return
+    if not node.name.endswith("_reason"):
+        return
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Return) and sub.value is not None \
+                and _contains_str_literal(sub.value):
+            yield ctx.finding(
+                RULE_NAME, sub,
+                f"{node.name} returns a bare string literal — decision "
+                "verdicts feed the ledger and must be registry codes: "
+                "return karpenter_tpu.solver.explain.make(CODE, detail) "
+                "(reason-literal)")
+
+
 def check(ctx: FileContext) -> Iterator[Finding]:
     for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield from _reason_return_findings(ctx, node)
         if isinstance(node, ast.Assign):
             yield from _reason_literal_findings(ctx, node)
             continue

@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""bench-regress: gate the BENCH_r*.json trajectory against silent decay.
+
+The repo accumulates one BENCH_rNN.json per recorded bench run — a
+heterogeneous trajectory (headline p50s, overhead A/B gates, saturation
+runs).  Nothing re-read them: a 2x p50 regression or a parity flag
+flipping false would land invisibly as "just another artifact".  This
+gate parses the whole trajectory and fails when the NEWEST record
+decays against its predecessor:
+
+  * **latency**: for a record whose unit is milliseconds, the headline
+    `value` (and `p50_ms` when present) must not exceed its same-metric
+    predecessor by more than --max-regress (default 15%).  Records are
+    compared only within the same `metric` string — an overhead bench's
+    percentage is not comparable to a headline p50.
+  * **parity**: any boolean parity/acceptance field
+    (`parity`, `pass`, `nodes_le_oracle*`, `price_le_oracle_50k`,
+    `fairness_ok`) that was true in the predecessor must not be false
+    now; and the newest record's own `pass`/`parity` must not be false
+    regardless of history.
+
+Records wrapped by the driver ({"parsed": {...}, "rc": N}) are
+unwrapped; unparseable or empty records are skipped with a note (they
+are failure evidence, not comparisons).  A newest record with no
+same-metric predecessor passes with a note — the gate bites from the
+second recording of any metric onward.
+
+`make bench-regress`; documented under docs/operations.md
+§Development gates.  Exit 0 = no regression; exit 1 lists what decayed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PARITY_KEYS = ("parity", "pass", "nodes_le_oracle",
+                "nodes_le_oracle_50k", "price_le_oracle_50k",
+                "fairness_ok")
+_NAME_RE = re.compile(r"^BENCH_r(\d+)\.json$")
+
+
+def load_trajectory(root: str):
+    """[(n, filename, payload-dict)] sorted by recording number; wrapped
+    driver records are unwrapped, unusable ones carry payload=None."""
+    out = []
+    for fname in os.listdir(root):
+        m = _NAME_RE.match(fname)
+        if not m:
+            continue
+        try:
+            with open(os.path.join(root, fname), encoding="utf-8") as f:
+                raw = json.load(f)
+        except (OSError, ValueError):
+            out.append((int(m.group(1)), fname, None))
+            continue
+        payload = raw.get("parsed") if isinstance(
+            raw.get("parsed"), dict) else raw
+        if not isinstance(payload, dict) or "metric" not in payload:
+            payload = None
+        out.append((int(m.group(1)), fname, payload))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+def _ms_like(payload: dict) -> bool:
+    return str(payload.get("unit", "")).startswith("ms")
+
+
+def compare(newest, prev, max_regress: float):
+    """Failure strings for the newest record vs its same-metric
+    predecessor (prev may be None — parity self-checks still apply)."""
+    fails = []
+    name, payload = newest
+    for key in ("pass", "parity"):
+        if payload.get(key) is False:
+            fails.append(f"{name}: {key}=false — the recording itself "
+                         "failed its acceptance gate")
+    if prev is None:
+        return fails
+    pname, pprev = prev
+    if _ms_like(payload) and _ms_like(pprev):
+        checks = [("value", payload.get("value"), pprev.get("value"))]
+        if "p50_ms" in payload and "p50_ms" in pprev:
+            checks.append(("p50_ms", payload.get("p50_ms"),
+                           pprev.get("p50_ms")))
+        for key, new_v, old_v in checks:
+            if not isinstance(new_v, (int, float)) or \
+                    not isinstance(old_v, (int, float)) or old_v <= 0:
+                continue
+            if new_v > old_v * (1.0 + max_regress):
+                fails.append(
+                    f"{name}: {key} {new_v} regressed "
+                    f"{100.0 * (new_v / old_v - 1.0):.1f}% vs {pname}'s "
+                    f"{old_v} (gate: {100.0 * max_regress:.0f}%)")
+    for key in _PARITY_KEYS:
+        if pprev.get(key) is True and payload.get(key) is False:
+            fails.append(f"{name}: parity field {key} flipped "
+                         f"true->false vs {pname}")
+    return fails
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python hack/check_bench_regress.py",
+        description="Fail on bench-trajectory regression or parity break.")
+    ap.add_argument("--dir", default=REPO,
+                    help="directory holding BENCH_r*.json (default: repo)")
+    ap.add_argument("--max-regress", type=float, default=0.15,
+                    help="allowed fractional latency growth (default 0.15)")
+    args = ap.parse_args(argv)
+
+    traj = load_trajectory(args.dir)
+    if not traj:
+        print("bench-regress: no BENCH_r*.json trajectory — nothing to "
+              "gate", file=sys.stderr)
+        return 0
+    usable = [(f, p) for _n, f, p in traj if p is not None]
+    skipped = [f for _n, f, p in traj if p is None]
+    if skipped:
+        print(f"bench-regress: skipped unusable record(s): "
+              f"{', '.join(skipped)}", file=sys.stderr)
+    if not usable:
+        print("bench-regress: no usable records in the trajectory",
+              file=sys.stderr)
+        return 0
+    newest = usable[-1]
+    prev = None
+    for cand in reversed(usable[:-1]):
+        if cand[1].get("metric") == newest[1].get("metric"):
+            prev = cand
+            break
+    fails = compare(newest, prev, args.max_regress)
+    if prev is None:
+        print(f"bench-regress: {newest[0]} has no same-metric "
+              "predecessor — latency gate idle (parity self-check only)",
+              file=sys.stderr)
+    else:
+        print(f"bench-regress: {newest[0]} vs {prev[0]} "
+              f"({newest[1].get('metric')!r})", file=sys.stderr)
+    if fails:
+        print("bench-regress: REGRESSION", file=sys.stderr)
+        for f in fails:
+            print(f"  {f}", file=sys.stderr)
+        return 1
+    print("bench-regress: clean", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""ledger-smoke: the decision-ledger loop, end to end.
+
+Drives the spend-observability path in under a minute on the CPU parity
+host: a real Environment provisions pods (launch records), scales a
+workload away so consolidation deletes capacity (delete records with
+savings), drains through termination (release records) — all spilled
+via `KARPENTER_TPU_LEDGER_DIR` — then runs the real
+`tools/kt_ledger.py` CLI (subprocess, the operator's invocation) against
+the spill and asserts the report reconciles: every decision source that
+fired is present, savings are positive, and the before/after fleet $/hr
+chain is arithmetically consistent record by record.  `make
+ledger-smoke`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="kt-ledger-smoke-")
+    os.environ["KARPENTER_TPU_LEDGER_DIR"] = tmp
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from benchmarks.common import drive_two_anchor_cycle
+    from karpenter_tpu.env import Environment
+    from karpenter_tpu.models import NodePool, ObjectMeta
+    from karpenter_tpu.operator.options import Options
+    from karpenter_tpu.utils import ledger
+
+    env = Environment(options=Options(batch_idle_duration=0))
+    env.add_default_nodeclass()
+    env.cluster.nodepools.create(NodePool(meta=ObjectMeta(name="default")))
+
+    # two anchored nodes, then the anchors scale away → consolidation
+    # (the drive shared with config4's ledger-exactness block)
+    peak, after = drive_two_anchor_cycle(env)
+    assert peak == 2, f"expected 2 nodes, got {peak}"
+    assert after <= 1, "consolidation did not shrink the fleet"
+
+    records = ledger.LEDGER.tail(512)
+    sources = {r["source"] for r in records}
+    print(f"[ledger-smoke] {len(records)} record(s) from {sorted(sources)}")
+    assert "provisioning" in sources, "no launch record"
+    assert "disruption" in sources, "no consolidation record"
+    assert "termination" in sources, "no termination record"
+
+    # before/after arithmetic: every record's after == before + delta
+    for r in records:
+        if r["fleet_cost_before"] is None:
+            continue
+        want = r["fleet_cost_before"] + r["cost_delta"]
+        assert abs(r["fleet_cost_after"] - want) < 1e-12, r
+
+    # cross-links: post-solve decisions reference a flight record
+    launch = [r for r in records if r["source"] == "provisioning"]
+    assert all(r["flight_seq"] for r in launch), \
+        "launch records missing flight-seq cross-links"
+
+    # the real CLI over the spill must report the same records
+    spill = os.path.join(tmp, f"ledger-{os.getpid()}.jsonl")
+    assert os.path.exists(spill), f"no spill at {spill}"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "kt_ledger.py"),
+         spill, "--json"],
+        capture_output=True, text=True, check=True)
+    doc = json.loads(out.stdout)
+    assert doc["summary"]["records"] == len(records), \
+        (doc["summary"]["records"], len(records))
+    assert doc["summary"]["savings_dollars_per_hr"] > 0, \
+        "consolidation produced no reported savings"
+    print("[ledger-smoke] CLI report: "
+          f"savings ${doc['summary']['savings_dollars_per_hr']}/hr over "
+          f"{doc['summary']['records']} record(s) — OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,8 +44,9 @@ from karpenter_tpu.models.taints import NO_SCHEDULE, Taint
 from karpenter_tpu.operator.options import Options
 from karpenter_tpu.scheduling import ScheduleResult
 from karpenter_tpu.scheduling.types import ScheduleInput
+from karpenter_tpu.solver import explain as explainmod
 from karpenter_tpu.solver.solve import B_BUCKETS as SOLVER_B_BUCKETS
-from karpenter_tpu.utils import cron, errors, metrics, tracing
+from karpenter_tpu.utils import cron, errors, ledger, metrics, tracing
 from karpenter_tpu.utils.clock import Clock
 
 SPOT_TO_SPOT_MIN_TYPES = 15  # disruption.md:123-132
@@ -311,6 +312,12 @@ class Disruption:
             if reason is None:
                 continue
             if self._budget_allows(cand.pool, REASON_DRIFT, 1) < 1:
+                self.cluster.record_event(
+                    "NodeClaim", cand.claim.name, "DisruptionBlocked",
+                    explainmod.make(
+                        explainmod.BUDGET_BLOCKED,
+                        f"drift of {cand.claim.name} blocked by "
+                        f"nodepool {cand.pool.name}'s disruption budget"))
                 continue
             # drifted capacity is replaced in kind: feasibility simulation
             # without the cheaper-price requirement
@@ -318,9 +325,11 @@ class Disruption:
             if sim is None:
                 self.cluster.record_event(
                     "NodeClaim", cand.claim.name, "Undisruptable",
-                    "drifted but pods cannot reschedule")
+                    explainmod.make(
+                        explainmod.CANDIDATE_NOT_RESCHEDULABLE,
+                        "drifted but pods cannot reschedule"))
                 continue
-            self._execute(REASON_DRIFT, [cand], sim)
+            self._execute(REASON_DRIFT, [cand], sim, method="drift")
             return True
         return False
 
@@ -329,7 +338,9 @@ class Disruption:
         stamped = cand.claim.meta.annotations.get(
             wellknown.NODEPOOL_HASH_ANNOTATION)
         if stamped is not None and stamped != pool_hash:
-            return "NodePoolDrift"
+            return explainmod.make(
+                explainmod.NODEPOOL_DRIFT,
+                "NodePoolDrift: stamped hash no longer matches the pool")
         return self.cp.is_drifted(cand.claim)
 
     def _emptiness(self, candidates: List[Candidate]) -> bool:
@@ -345,11 +356,26 @@ class Disruption:
         acted = False
         for pool_name, cands in by_pool.items():
             n = self._budget_allows(cands[0].pool, REASON_EMPTY, len(cands))
-            for cand in cands[:n]:
+            deleted = cands[:n]
+            for cand in deleted:
                 self.cluster.record_event(
                     "NodeClaim", cand.claim.name, "DisruptedEmpty", "")
                 self.cluster.nodeclaims.delete(cand.claim.name)
                 acted = True
+            if deleted:
+                # empty deletes are pure savings: the ledger delta is the
+                # exact sum of retired prices, and the savings counter
+                # carries the same floats (IEEE-exactness contract)
+                retired = sum(c.price for c in deleted)
+                self._ledger_decision(
+                    "disruption", "delete",
+                    explainmod.CONSOLIDATION_DELETE, deleted, (),
+                    cost_delta=-retired,
+                    detail=f"{len(deleted)} empty node(s) in "
+                           f"{pool_name} deleted")
+                if retired > 0:
+                    metrics.DISRUPTION_SAVINGS.inc(
+                        retired, method="emptiness")
         return acted
 
     def _consolidatable(self, candidates: List[Candidate]) -> List[Candidate]:
@@ -391,7 +417,8 @@ class Disruption:
                 chunk, [sum(c.price for c in s) for s in chunk])
             for subset, sim in zip(chunk, sims):
                 if sim is not None and self._acceptable(subset, sim):
-                    self._execute(REASON_UNDERUTILIZED, subset, sim)
+                    self._execute(REASON_UNDERUTILIZED, subset, sim,
+                                  method="multi_node")
                     return True
         return False
 
@@ -403,11 +430,14 @@ class Disruption:
             sims = self._simulate_batch(
                 [[c] for c in chunk], [c.price for c in chunk])
             for cand, sim in zip(chunk, sims):
-                reason = ("pods cannot reschedule onto remaining capacity "
-                          "or a single cheaper node" if sim is None
-                          else self._unacceptable_reason([cand], sim))
+                reason = (explainmod.make(
+                    explainmod.CANDIDATE_NOT_RESCHEDULABLE,
+                    "pods cannot reschedule onto remaining capacity "
+                    "or a single cheaper node") if sim is None
+                    else self._unacceptable_reason([cand], sim))
                 if reason is None:
-                    self._execute(REASON_UNDERUTILIZED, [cand], sim)
+                    self._execute(REASON_UNDERUTILIZED, [cand], sim,
+                                  method="single_node")
                     return True
                 # user-facing reason a node stays up (disruption.md:109-117
                 # Unconsolidatable events; the recorder deduplicates)
@@ -473,16 +503,20 @@ class Disruption:
 
     def _unacceptable_reason(self, cands: List[Candidate],
                              sim: ScheduleResult) -> Optional[str]:
-        """None = acceptable; else the user-facing reason (the accurate
-        message matters: pointing an operator at pricing when the
-        spot-flexibility rule is what blocked the replacement sends the
-        debugging in the wrong direction)."""
+        """None = acceptable; else a registry-coded Reason
+        (solver/explain.py — the ledger stores the code, the event keeps
+        the human detail; the accurate message matters: pointing an
+        operator at pricing when the spot-flexibility rule is what
+        blocked the replacement sends the debugging in the wrong
+        direction)."""
         if not sim.new_claims:
             return None  # pure delete: always saves money
         total_price = sum(c.price for c in cands)
         rep = sim.new_claims[0]
         if rep.price >= total_price:
-            return "replacement would not reduce cost"
+            return explainmod.make(
+                explainmod.REPLACEMENT_NOT_CHEAPER,
+                "replacement would not reduce cost")
         # spot→spot: replacement must keep ≥15 types of flexibility so it
         # lands on reliable spot capacity (disruption.md:123-132)
         all_spot = all(
@@ -493,18 +527,43 @@ class Disruption:
         rep_spot = rep_spot or (rep_ct is None)
         if all_spot and rep_spot:
             if not self.options.feature_gates.spot_to_spot_consolidation:
-                return ("spot-to-spot consolidation is disabled "
-                        "(SpotToSpotConsolidation feature gate)")
+                return explainmod.make(
+                    explainmod.SPOT_TO_SPOT_DISABLED,
+                    "spot-to-spot consolidation is disabled "
+                    "(SpotToSpotConsolidation feature gate)")
             if len(rep.instance_type_names) < SPOT_TO_SPOT_MIN_TYPES:
-                return (f"spot-to-spot replacement keeps only "
-                        f"{len(rep.instance_type_names)} instance types of "
-                        f"the {SPOT_TO_SPOT_MIN_TYPES} required for "
-                        f"reliable spot capacity")
+                return explainmod.make(
+                    explainmod.SPOT_FLEXIBILITY_TOO_LOW,
+                    f"spot-to-spot replacement keeps only "
+                    f"{len(rep.instance_type_names)} instance types of "
+                    f"the {SPOT_TO_SPOT_MIN_TYPES} required for "
+                    f"reliable spot capacity")
         return None
 
     # -- execution --------------------------------------------------------
+    def _ledger_decision(self, source: str, action: str, code: str,
+                         cands: List[Candidate], new_claims,
+                         cost_delta: float, detail: str = "") -> None:
+        """One decision-ledger record for this controller's fleet
+        mutation: the exact price arithmetic the decision compared,
+        before/after fleet $/hr from the independent node sum, and the
+        trace/flight cross-links (utils/ledger.py stamps those)."""
+        if not ledger.LEDGER.enabled:
+            return
+        pricing = getattr(self.cp.instance_types, "pricing", None)
+        before = ledger.fleet_cost(self.cluster, pricing)["total"]
+        ledger.LEDGER.record(
+            source, action, reason_code=code, detail=detail,
+            pools=[c.pool.name for c in cands]
+            + [s.nodepool for s in new_claims],
+            capacity_types=[ct for c in cands
+                            if (ct := c.node.capacity_type)],
+            nodes_delta=len(new_claims) - len(cands),
+            pods_affected=sum(len(c.reschedulable) for c in cands),
+            fleet_cost_before=before, cost_delta=cost_delta)
+
     def _execute(self, reason: str, cands: List[Candidate],
-                 sim: ScheduleResult) -> None:
+                 sim: ScheduleResult, method: str = "single_node") -> None:
         for cand in cands:
             if not any(t.key == wellknown.DISRUPTION_TAINT_KEY
                        for t in cand.node.taints):
@@ -518,6 +577,28 @@ class Disruption:
                 f"{spec.nodepool}-replace-{self._replacement_seq}")
             replacements.append(claim.name)
             self._protected[claim.name] = self.clock.now()
+        # decision ledger + savings: recorded at DECISION time with the
+        # exact floats this method compared — savings is (sum of retired
+        # candidate prices − replacement price), the IEEE-exactness
+        # contract the config4 bench asserts.  Drift replaces in kind
+        # (no cheaper-price rule), so it writes a ledger record but
+        # never claims savings.
+        retired = sum(c.price for c in cands)
+        added = sum(s.price for s in sim.new_claims)
+        if reason == REASON_DRIFT:
+            source, code = "drift", explainmod.DRIFT_REPLACED
+        elif sim.new_claims:
+            source, code = "disruption", explainmod.CONSOLIDATION_REPLACE
+        else:
+            source, code = "disruption", explainmod.CONSOLIDATION_DELETE
+        self._ledger_decision(
+            source, "replace" if sim.new_claims else "delete", code,
+            cands, sim.new_claims, cost_delta=added - retired,
+            detail=f"{method}: {len(cands)} candidate(s) -> "
+                   f"{len(replacements)} replacement(s)")
+        savings = retired - added
+        if reason != REASON_DRIFT and savings > 0:
+            metrics.DISRUPTION_SAVINGS.inc(savings, method=method)
         self.commands.append(Command(
             reason=reason,
             candidate_names=[c.claim.name for c in cands],

@@ -20,7 +20,7 @@ from karpenter_tpu.models.objects import NodeClaim, ObjectMeta, Pod
 from karpenter_tpu.operator.options import Options
 from karpenter_tpu.scheduling import ScheduleInput
 from karpenter_tpu.scheduling.types import NewNodeClaim, ScheduleResult
-from karpenter_tpu.utils import errors, metrics, tracing
+from karpenter_tpu.utils import errors, ledger, metrics, tracing
 from karpenter_tpu.utils.clock import Clock
 
 NOMINATED_ANNOTATION = "karpenter.sh/nominated-claim"
@@ -28,6 +28,10 @@ NOMINATED_ANNOTATION = "karpenter.sh/nominated-claim"
 
 class Provisioner:
     name = "provisioning"
+    # fleet-metric staleness bound when the generation is quiet (the
+    # price book can change out-of-band): one O(nodes+pods+types)
+    # sweep per this many seconds, worst case
+    FLEET_METRICS_TTL = 30.0
 
     def __init__(
         self,
@@ -131,6 +135,29 @@ class Provisioner:
         metrics.PROVISIONER_BACKLOG_AGE.set(
             max((now - t for t in self._first_pending.values()),
                 default=0.0))
+        # fleet spend/packing gauges (ISSUE 14): refreshed whenever the
+        # cluster actually changed (generation-gated — the sweep is
+        # O(nodes + pods + types) of pure Python, and an idle 1 s
+        # reconcile tick must not pay it to recompute identical
+        # values), plus a TTL fallback: the price book can move WITHOUT
+        # a store mutation (PricingRefresh updates the provider, never
+        # the generation), and an idle fleet must not export stale $/hr
+        # forever.  Best-effort — a pricing/discovery hiccup degrades
+        # the gauges, never the loop
+        gen = self.cluster.generation
+        last = getattr(self, "_fleet_metrics_at", None)
+        if (gen != getattr(self, "_fleet_metrics_gen", None)
+                or last is None
+                or now - last >= self.FLEET_METRICS_TTL):
+            try:
+                ledger.update_fleet_metrics(self.cluster, self.cp)
+                self._fleet_metrics_gen = gen
+                self._fleet_metrics_at = now
+            except Exception as e:  # noqa: BLE001 — advisory telemetry
+                from karpenter_tpu.utils.logging import get_logger
+                get_logger(self.name).warn(
+                    "fleet cost metrics refresh failed",
+                    error=str(e)[:200])
         if not self._batch_ready(pending):
             return
         self._batch_first = self._batch_sig = self._batch_last_change = None
@@ -187,6 +214,26 @@ class Provisioner:
                 if live is not None:
                     live.meta.annotations[NOMINATED_ANNOTATION] = claim.name
                     self.cluster.pods.update(live)
+        if result.new_claims and ledger.LEDGER.enabled:
+            # decision ledger (ISSUE 14): one launch record per pass —
+            # cost delta is the exact sum of the planned claims' prices
+            # (the same floats the solver minimized), fleet-before is the
+            # independent sum over live nodes
+            from karpenter_tpu.solver import explain as explainmod
+            pricing = getattr(self.cp.instance_types, "pricing", None)
+            ledger.LEDGER.record(
+                "provisioning", "launch",
+                reason_code=explainmod.CAPACITY_LAUNCHED,
+                detail=f"{len(result.new_claims)} claim(s) for "
+                       f"{sum(len(s.pods) for s in result.new_claims)} "
+                       "pod(s)",
+                pools=[s.nodepool for s in result.new_claims],
+                nodes_delta=len(result.new_claims),
+                pods_affected=sum(len(s.pods) for s in result.new_claims)
+                + len(result.existing_assignments),
+                fleet_cost_before=ledger.fleet_cost(
+                    self.cluster, pricing)["total"],
+                cost_delta=sum(s.price for s in result.new_claims))
 
         if result.unschedulable:
             # placement provenance (ISSUE 13): this is the authoritative

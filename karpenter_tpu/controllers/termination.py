@@ -18,7 +18,7 @@ from karpenter_tpu.controllers.provisioning import NOMINATED_ANNOTATION
 from karpenter_tpu.models import wellknown
 from karpenter_tpu.models.objects import NodeClaim
 from karpenter_tpu.models.taints import NO_SCHEDULE, Taint
-from karpenter_tpu.utils import errors, metrics
+from karpenter_tpu.utils import errors, ledger, metrics
 
 DISRUPTED_TAINT = Taint(wellknown.DISRUPTED_TAINT_KEY, "", NO_SCHEDULE)
 
@@ -29,8 +29,14 @@ class Termination:
     def __init__(self, cluster: Cluster, cloud_provider: TPUCloudProvider):
         self.cluster = cluster
         self.cp = cloud_provider
+        # per-reconcile running fleet $/hr for the ledger: a mass
+        # settlement (spot drain, pool expiry sweep) releases many
+        # claims in ONE pass, and a per-claim fleet_cost walk would be
+        # O(settled × fleet) — interruption's drain-scoped discipline
+        self._pass_fleet_cost = None
 
     def reconcile(self) -> None:
+        self._pass_fleet_cost = None
         for claim in list(self.cluster.nodeclaims.list(
                 lambda c: c.meta.deleting)):
             self._terminate(claim)
@@ -54,7 +60,20 @@ class Termination:
         # NotFound is success (the instance is already gone); transient cloud
         # errors keep the finalizer for a retry next round
         # (pkg/errors/errors.go taxonomy)
-        try:
+        # ledger inputs BEFORE the release mutates anything: this is the
+        # point the fleet's $/hr actually falls for whatever earlier
+        # decision (consolidation/expiry/interruption) deleted the claim
+        price = fleet_before = None
+        if ledger.LEDGER.enabled:
+            pricing = getattr(getattr(self.cp, "instance_types", None),
+                              "pricing", None)
+            price = (ledger.node_price(node, pricing)
+                     if node is not None else 0.0)
+            if self._pass_fleet_cost is None:
+                self._pass_fleet_cost = ledger.fleet_cost(
+                    self.cluster, pricing)["total"]
+            fleet_before = self._pass_fleet_cost
+        try:  # noqa: E501 — see taxonomy note below
             self.cp.delete(claim)
         except Exception as e:  # noqa: BLE001
             if errors.is_retryable(e):
@@ -70,6 +89,19 @@ class Termination:
         metrics.NODECLAIMS_TERMINATED.inc(nodepool=claim.nodepool)
         self.cluster.record_event(
             "NodeClaim", claim.name, "Terminated", "instance released")
+        if fleet_before is not None:
+            from karpenter_tpu.solver import explain as explainmod
+            # pods_affected=0: the node was drained before release, so
+            # no non-daemonset pod is displaced by this settlement
+            rec = ledger.record_claim_delete(
+                self.cluster, self.cp, claim,
+                source="termination",
+                reason_code=explainmod.NODE_TERMINATED,
+                detail=f"{claim.name} drained and released",
+                node=node, price=price, fleet_before=fleet_before,
+                pods_affected=0)
+            if rec is not None:
+                self._pass_fleet_cost += rec.cost_delta
 
     def _grace_expired(self, claim: NodeClaim) -> bool:
         # stamped on the claim at creation; live-pool fallback covers

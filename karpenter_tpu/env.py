@@ -156,9 +156,10 @@ class Environment:
         self.binder = PodBinder(self.cluster)
         self.termination = Termination(self.cluster, self.cloud_provider)
         self.interruption = Interruption(
-            self.cluster, self.queue, self.unavailable)
+            self.cluster, self.queue, self.unavailable,
+            cloud_provider=self.cloud_provider)
         self.gc = GarbageCollection(self.cluster, self.cloud_provider)
-        self.expiration = Expiration(self.cluster)
+        self.expiration = Expiration(self.cluster, self.cloud_provider)
         self.nodeclass_hash = NodeClassHash(self.cluster)
         self.nodeclass_status = NodeClassStatus(
             self.cluster, self.subnets, self.security_groups, self.images,

@@ -143,7 +143,47 @@ class Operator:
                            or ["json"])[0]
                     if fmt == "html":
                         self._respond(200, telemetry.render_html(doc),
-                                      "text/html; charset=utf-8")
+                                      telemetry.HTML_CONTENT_TYPE)
+                    else:
+                        self._respond(
+                            200, json.dumps(doc, default=str) + "\n",
+                            "application/json; charset=utf-8")
+                elif path == "/debug/ledger":
+                    # the decision ledger (utils/ledger.py): every
+                    # fleet-mutating decision with before/after $/hr,
+                    # reason code, and trace/flight cross-links.
+                    # ?pool= narrows to one nodepool, ?since=<unix ts>
+                    # to a window, ?limit= caps the count (default 64);
+                    # ?format=html renders the no-tooling view.  The
+                    # summary block is ledger.summarize over EXACTLY the
+                    # returned records, the same rollup tools/
+                    # kt_ledger.py prints — the two surfaces cannot
+                    # disagree.
+                    from karpenter_tpu.utils import ledger as ledgerm
+                    from karpenter_tpu.utils import telemetry
+                    q = parse_qs(url.query)
+                    pool = (q.get("pool") or [None])[0]
+                    try:
+                        limit = int((q.get("limit") or ["64"])[0])
+                    except ValueError:
+                        limit = 64
+                    try:
+                        since = float((q.get("since") or [""])[0])
+                    except ValueError:
+                        since = None
+                    records = ledgerm.LEDGER.tail(limit, pool=pool,
+                                                  since=since)
+                    doc = {"records": records,
+                           "summary": ledgerm.summarize(records)}
+                    fmt = (q.get("format") or ["json"])[0]
+                    if fmt == "html":
+                        self._respond(
+                            200,
+                            telemetry.html_page(
+                                "karpenter-tpu decision ledger",
+                                [("summary", doc["summary"]),
+                                 ("records", records)]),
+                            telemetry.HTML_CONTENT_TYPE)
                     else:
                         self._respond(
                             200, json.dumps(doc, default=str) + "\n",
@@ -195,8 +235,9 @@ class Operator:
                                "reason_codes": explainm.reason_table()}
                     fmt = (q.get("format") or ["json"])[0]
                     if fmt == "html":
+                        from karpenter_tpu.utils import telemetry
                         self._respond(code, op._explain_html(doc),
-                                      "text/html; charset=utf-8")
+                                      telemetry.HTML_CONTENT_TYPE)
                     else:
                         self._respond(
                             code, json.dumps(doc, default=str) + "\n",
@@ -216,19 +257,12 @@ class Operator:
 
     @staticmethod
     def _explain_html(doc: dict) -> str:
-        """The no-tooling rendering of one explain document (same
-        monospace styling as the dashboard page)."""
-        import html as _html
-        body = _html.escape(json.dumps(doc, indent=2, default=str))
+        """The no-tooling rendering of one explain document — through
+        the ONE shared page renderer (utils/telemetry.html_page), the
+        same styling/escaping as the dashboard and ledger pages."""
+        from karpenter_tpu.utils import telemetry
         title = doc.get("pod", "placement explainability")
-        return (
-            "<!doctype html><html><head><meta charset='utf-8'>"
-            "<title>karpenter-tpu explain</title>"
-            "<style>body{font-family:monospace;margin:1.5em}"
-            "pre{background:#f6f6f6;padding:8px;overflow-x:auto}"
-            "</style></head><body>"
-            f"<h1>explain: {_html.escape(str(title))}</h1>"
-            f"<pre>{body}</pre></body></html>")
+        return telemetry.html_page(f"explain: {title}", [(None, doc)])
 
     def _worker_snapshot(self):
         """The solverd worker's section of the dashboard merge: its

@@ -118,6 +118,60 @@ POOL_LIMIT = _register(
     "a binding nodepool limit blocked the placement (oracle authority)")
 LEGACY = "Legacy"  # unregistered plain-string reason (should not occur)
 
+# -- disruption decision vocabulary (ISSUE 14): the controllers'
+# -- fleet-mutating decisions and their rejection verdicts, registered
+# -- here so the decision ledger stores CODES and the kt-lint
+# -- reason-literal gate can hold controllers/disruption.py to the same
+# -- no-bare-strings contract as the unschedulability emitters.
+# -- Constraint "none": these classify decisions, not pod eliminations.
+CAPACITY_LAUNCHED = _register(
+    "CapacityLaunched", "none",
+    "provisioning launched new capacity for pending pods")
+CONSOLIDATION_DELETE = _register(
+    "ConsolidationDelete", "none",
+    "consolidation deleted candidates whose pods fit on the remaining "
+    "fleet (pure delete — always saves money)")
+CONSOLIDATION_REPLACE = _register(
+    "ConsolidationReplace", "none",
+    "consolidation replaced candidates with one strictly cheaper node")
+DRIFT_REPLACED = _register(
+    "DriftReplaced", "none",
+    "drifted capacity was replaced in kind (no cheaper-price "
+    "requirement)")
+NODE_EXPIRED = _register(
+    "NodeExpired", "none",
+    "the claim outlived its NodePool expireAfter and was deleted")
+INTERRUPTION_RECLAIM = _register(
+    "InterruptionReclaim", "none",
+    "a cloud interruption signal (spot reclaim, maintenance, state "
+    "change) deleted the claim ahead of the reclaim")
+NODE_TERMINATED = _register(
+    "NodeTerminated", "none",
+    "the drained instance was released — the point the fleet $/hr "
+    "actually falls for a prior delete/replace decision")
+# rejection verdicts: why a consolidation candidate stayed up
+REPLACEMENT_NOT_CHEAPER = _register(
+    "ReplacementNotCheaper", "none",
+    "the cheapest feasible replacement would not reduce fleet cost")
+SPOT_TO_SPOT_DISABLED = _register(
+    "SpotToSpotDisabled", "none",
+    "spot-to-spot consolidation is behind a disabled feature gate")
+SPOT_FLEXIBILITY_TOO_LOW = _register(
+    "SpotFlexibilityTooLow", "none",
+    "the spot replacement keeps too few instance types for reliable "
+    "spot capacity (the >=15-types rule)")
+CANDIDATE_NOT_RESCHEDULABLE = _register(
+    "CandidateNotReschedulable", "none",
+    "the candidate's pods cannot reschedule onto remaining capacity or "
+    "an admissible replacement")
+BUDGET_BLOCKED = _register(
+    "DisruptionBudgetBlocked", "none",
+    "a NodePool disruption budget (possibly cron-windowed) blocked the "
+    "decision this pass")
+NODEPOOL_DRIFT = _register(
+    "NodePoolDrift", "none",
+    "the claim's stamped NodePool hash no longer matches the live pool")
+
 # delta-seam fallback vocabulary (solver/solve.py _delta_fallback /
 # solver/delta.py plan+build): every non-engaged delta pass names one of
 # these — an unknown reason is a registry violation, not a new string

@@ -580,6 +580,13 @@ class TPUSolver:
             if _sp is not None:
                 _sp.attrs["path"] = path
                 _sp.attrs["unschedulable"] = len(res.unschedulable)
+            # shadow audit (solver/audit.py): sample REAL solves for
+            # background oracle/full-re-solve re-verification.  Disarmed
+            # (the default) this is one env read; capped sims are never
+            # eligible (the oracle does not model the node cap)
+            from karpenter_tpu.solver import audit as auditmod
+            auditmod.SAMPLER.maybe_submit(inp, res, solver=self,
+                                          max_nodes=max_nodes)
         return res
 
     # pods beyond this, the backstop oracle's O(pods) wall-clock isn't

@@ -47,6 +47,11 @@ Registered points (grep for ``faults.fire`` to verify):
   * ``store.remote.rpc``     — RemoteBackend RPC round trip
   * ``solver.dispatch``      — device dispatch of one padded problem
   * ``solverd.handle_batch`` — daemon-side batch entry (crash the worker)
+  * ``solver.audit.digest``  — shadow-audit digest comparison
+                               (solver/audit.py): an armed drop/error
+                               perturbs the sampled solve's live digest,
+                               the injected-divergence lever proving the
+                               diverged -> capture -> kt_replay loop
 """
 
 from __future__ import annotations

@@ -179,12 +179,22 @@ class FlightRecorder:
         self._maybe_spill(rec)
         return rec
 
-    def capture_problem(self, payload) -> Optional[str]:
+    def capture_problem(self, payload, force: bool = False) -> Optional[str]:
         """Pickle the full problem next to the spill file; returns the
         capture path (referenced from the record) or None.  Called by
         the solver BEFORE the solve runs, so a crash mid-solve still
-        leaves the input on disk — the black-box discipline."""
-        if not self.capture_enabled():
+        leaves the input on disk — the black-box discipline.
+
+        ``force=True`` (the shadow-audit divergence path) captures even
+        when the per-solve KARPENTER_TPU_FLIGHT_CAPTURE opt-in is off:
+        a detected divergence is exactly the problem worth a repro
+        artifact, and waiting for the operator to re-arm capture means
+        hoping it recurs.  A spill directory is still required — there
+        is nowhere else to put the artifact."""
+        if force:
+            if not (self.enabled and os.environ.get(_ENV_DIR)):
+                return None
+        elif not self.capture_enabled():
             return None
         import pickle
         d = os.environ.get(_ENV_DIR)
@@ -234,6 +244,13 @@ class FlightRecorder:
     def __len__(self) -> int:
         with self._lock:
             return len(self._ring)
+
+    def last_seq(self) -> Optional[int]:
+        """The newest record's sequence number, or None while empty —
+        the cross-link the decision ledger stamps so a ledger row jumps
+        to the flight record of the solve that backed it."""
+        with self._lock:
+            return self._seq if self._seq else None
 
     def reset(self) -> None:
         """Clear the ring and close any spill handle (tests)."""

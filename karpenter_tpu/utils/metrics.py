@@ -432,6 +432,64 @@ SOLVER_ORACLE_BACKSTOP = _c(
     "karpenter_tpu_solver_oracle_backstop_total",
     "Solves where the full-oracle backstop beat the decomposed paths "
     "under a binding pool limit.")
+# -- cost & efficiency observability (ISSUE 14): the objective itself —
+# -- fleet $/hr, savings realized by disruption, how far packing sits
+# -- from the allocatable envelope, and the live solver-vs-oracle audit
+FLEET_HOURLY_COST = _g(
+    "karpenter_tpu_fleet_hourly_cost",
+    "Fleet spend in $/hr by nodepool and capacity type, summed over the "
+    "cluster's live nodes' offering prices (utils/ledger.py "
+    "update_fleet_metrics; refreshed by the provisioning pass when the "
+    "cluster changed, with a 30 s staleness bound). The "
+    "fleet total is the sum over all series — the exported form of the "
+    "objective the solver minimizes.", ("pool", "capacity_type"))
+DISRUPTION_SAVINGS = _c(
+    "karpenter_tpu_disruption_savings_dollars_total",
+    "Cumulative $/hr of fleet cost removed by disruption decisions, by "
+    "method (emptiness/multi_node/single_node; drift replacements are "
+    "spec-motivated, not cost-motivated, and never count): sum of "
+    "retired "
+    "candidate prices minus the replacement price, counted at decision "
+    "time (the same floats the acceptance check compares to IEEE-hex "
+    "exactness).", ("method",))
+PACKING_EFFICIENCY = _g(
+    "karpenter_tpu_packing_efficiency_ratio",
+    "Per-nodepool packing efficiency by resource: sum of resident pod "
+    "requests over sum of node allocatable (1.0 = perfectly packed; "
+    "only resources with nonzero allocatable export a series).",
+    ("pool", "resource"))
+FLEET_PACKING_EFFICIENCY = _g(
+    "karpenter_tpu_fleet_packing_efficiency_ratio",
+    "Fleet-wide packing efficiency by resource (requested over "
+    "allocatable across every live node).", ("resource",))
+STRANDED_CAPACITY = _g(
+    "karpenter_tpu_stranded_capacity_units",
+    "Allocatable-minus-requested units sitting idle on live nodes, by "
+    "nodepool and resource (solver units: millicores, MiB, counts) — "
+    "the capacity being paid for but not requested, i.e. the "
+    "consolidation opportunity in resource terms.", ("pool", "resource"))
+FLEET_EFFICIENCY_BOUND = _g(
+    "karpenter_tpu_fleet_efficiency_lower_bound_ratio",
+    "Greedy cost lower bound over actual fleet $/hr: total pod requests "
+    "priced at the cheapest feasible $/resource-unit across the "
+    "catalog, divided by the real fleet cost (<= 1.0; 1.0 means spend "
+    "is at the naive bound). Deliberately a CHEAP bound — the seam the "
+    "relaxed-LP scoring from the convex-optimization line of work "
+    "replaces with a tight one (docs/observability.md).")
+LEDGER_RECORDS = _c(
+    "karpenter_tpu_ledger_records_total",
+    "Decision-ledger records written (utils/ledger.py), by decision "
+    "source (provisioning/disruption/drift/expiration/interruption/"
+    "termination).", ("source",))
+SOLVER_AUDIT = _c(
+    "karpenter_tpu_solver_audit_total",
+    "Shadow-audit verdicts over sampled production solves "
+    "(solver/audit.py, KARPENTER_TPU_AUDIT): match = bit-exact oracle "
+    "parity, improved = the solver beat the oracle's cost/placement, "
+    "diverged = the solver answered worse than the oracle or a delta "
+    "pass failed its full re-solve parity (auto-captured for "
+    "kt_replay), dropped = sampler backlog full, error = the "
+    "verification itself failed.", ("verdict",))
 # per-instance-type catalog gauges (reference:
 # pkg/providers/instancetype/instancetype.go:156-161,302-311 + metrics.go)
 INSTANCE_TYPE_CPU = _g(

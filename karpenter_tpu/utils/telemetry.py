@@ -106,6 +106,26 @@ def _explain_store_size() -> int:
         return 0
 
 
+def _cost_section(ledger_tail: int = 8) -> dict:
+    """This process's cost/efficiency observables (utils/ledger.py +
+    the ISSUE 14 metric families), read-only over the registry."""
+    try:
+        from karpenter_tpu.utils import ledger
+        tail = ledger.LEDGER.tail(ledger_tail)
+    except Exception:  # noqa: BLE001 — best-effort, never the data path
+        tail = []
+    return {
+        "fleet_hourly_cost": _series(metrics.FLEET_HOURLY_COST),
+        "savings": _series(metrics.DISRUPTION_SAVINGS),
+        "packing_efficiency": _series(metrics.FLEET_PACKING_EFFICIENCY),
+        "stranded": _series(metrics.STRANDED_CAPACITY),
+        "efficiency_lower_bound": metrics.FLEET_EFFICIENCY_BOUND.value(),
+        "ledger_records": _series(metrics.LEDGER_RECORDS),
+        "audit": _series(metrics.SOLVER_AUDIT),
+        "ledger_tail": tail,
+    }
+
+
 def local_snapshot(flight_tail: int = 16) -> dict:
     """This process's observable state: the compact dict every process
     role (operator, solverd backend, supervisor CLI) can produce and the
@@ -159,6 +179,14 @@ def local_snapshot(flight_tail: int = 16) -> dict:
             "eliminations": _series(metrics.SOLVER_CONSTRAINT_ELIM),
             "explained_pods": _explain_store_size(),
         },
+        # cost & efficiency (ISSUE 14): fleet spend, savings realized,
+        # packing efficiency, the shadow-audit verdicts, and the
+        # decision-ledger tail — the gauges live where the controllers
+        # run (the operator); other process roles carry empty series and
+        # merge() skips them.  Guarded like the explain-store read: a
+        # telemetry snapshot must render even if the ledger module is
+        # unimportable here.
+        "cost": _cost_section(),
         "retraces": sum(_series(metrics.SOLVER_RETRACES).values()),
         "device_memory_peak_bytes":
             metrics.SOLVER_DEVICE_MEMORY_PEAK.value(),
@@ -236,6 +264,14 @@ def merge(snapshots: Dict[str, dict]) -> dict:
             for k, v in passes.items():
                 fleet["delta_passes"][k] = \
                     fleet["delta_passes"].get(k, 0) + v
+    def items_of(sect, field):
+        """A section field's dict items, or nothing — a partially
+        written or foreign-schema snapshot (a worker one version
+        behind) must degrade per FIELD, never raise into the
+        dashboard's HTTP thread."""
+        v = sect.get(field)
+        return v.items() if isinstance(v, dict) else ()
+
     # placement rollup: per-reason unschedulable verdicts and the
     # per-constraint elimination attribution summed across processes
     # (the solverd worker's eliminations arrive via the stats RPC)
@@ -245,8 +281,9 @@ def merge(snapshots: Dict[str, dict]) -> dict:
         if not isinstance(sect, dict):
             continue
         for field in ("unschedulable", "eliminations"):
-            for k, v in (sect.get(field) or {}).items():
-                placement[field][k] = placement[field].get(k, 0) + v
+            for k, v in items_of(sect, field):
+                if isinstance(v, (int, float)):
+                    placement[field][k] = placement[field].get(k, 0) + v
     if placement["unschedulable"] or placement["eliminations"]:
         fleet["placement"] = placement
     # per-tenant rollup (the shared-fleet first-glance questions: who is
@@ -258,15 +295,21 @@ def merge(snapshots: Dict[str, dict]) -> dict:
         sect = s.get("tenants")
         if not isinstance(sect, dict):
             continue
-        for t, v in (sect.get("requests") or {}).items():
+        for t, v in items_of(sect, "requests"):
+            if not isinstance(v, (int, float)):
+                continue
             tenants.setdefault(t, {"requests": 0, "shed": 0,
                                    "queue_depth": 0})
             tenants[t]["requests"] += v
-        for t, v in (sect.get("queue_depth") or {}).items():
+        for t, v in items_of(sect, "queue_depth"):
+            if not isinstance(v, (int, float)):
+                continue
             tenants.setdefault(t, {"requests": 0, "shed": 0,
                                    "queue_depth": 0})
             tenants[t]["queue_depth"] += v
-        for key, v in (sect.get("shed") or {}).items():
+        for key, v in items_of(sect, "shed"):
+            if not isinstance(v, (int, float)):
+                continue
             # label key is "tenant/reason" — reason never contains "/"
             t = key.rsplit("/", 1)[0]
             tenants.setdefault(t, {"requests": 0, "shed": 0,
@@ -278,37 +321,138 @@ def merge(snapshots: Dict[str, dict]) -> dict:
             else 0.0
     if tenants:
         fleet["tenants"] = tenants
+    # cost & efficiency rollup (ISSUE 14): fleet $/hr and savings summed
+    # across processes (only the controller-running operator carries
+    # non-empty series, so the sum IS its view; a worker's empty section
+    # adds nothing), audit verdicts summed, the lower-bound ratio the
+    # max across reporters, packing efficiency the min (worst view).
+    # Every read degrades per-field — a partial
+    # or foreign-schema section must never break the dashboard.
+    cost = {"hourly_total": 0.0, "hourly_by_pool": {}, "savings": {},
+            "audit": {}, "packing_efficiency": {},
+            "efficiency_lower_bound": None}
+    cost_present = False
+    for s in snapshots.values():
+        sect = s.get("cost") if isinstance(s, dict) else None
+        if not isinstance(sect, dict):
+            continue
+        cost_present = True
+        for field, dest in (("fleet_hourly_cost", "hourly_by_pool"),
+                            ("savings", "savings"),
+                            ("audit", "audit")):
+            src = sect.get(field)
+            if not isinstance(src, dict):
+                continue
+            for k, v in src.items():
+                if isinstance(v, (int, float)):
+                    cost[dest][k] = cost[dest].get(k, 0) + v
+        pe = sect.get("packing_efficiency")
+        if isinstance(pe, dict):
+            # ratios can't sum: take the MIN per resource — the
+            # conservative (worst-packing) view, and deterministic when
+            # two snapshots carry the same series (HA pair mid-failover,
+            # stale worker), unlike last-writer-wins over dict order
+            for k, v in pe.items():
+                if isinstance(v, (int, float)):
+                    cur = cost["packing_efficiency"].get(k)
+                    cost["packing_efficiency"][k] = \
+                        v if cur is None else min(cur, v)
+        b = sect.get("efficiency_lower_bound")
+        if isinstance(b, (int, float)) and b > 0:
+            cur = cost["efficiency_lower_bound"]
+            cost["efficiency_lower_bound"] = \
+                b if cur is None else max(cur, b)
+    if cost_present:
+        cost["hourly_total"] = round(
+            sum(cost["hourly_by_pool"].values()), 6)
+        fleet["cost"] = cost
     return {"generated_at": time.time(),
             "processes": snapshots,
             "fleet": fleet}
+
+
+# the ONE Content-Type every html-rendering debug route serves — the
+# hand-rolled renderers used to each spell their own
+HTML_CONTENT_TYPE = "text/html; charset=utf-8"
+
+
+def _html_table(payload) -> str:
+    """One escaped table: a dict renders as key/value rows; a list of
+    flat dicts renders columnar (column set = union of keys in first-
+    appearance order).  Non-scalar cells render as compact JSON —
+    every cell value passes through html.escape (hostile reasons,
+    zones, or pod names must never break the page)."""
+    import html as _html
+    import json as _json
+
+    def cell(v) -> str:
+        if isinstance(v, str):
+            return _html.escape(v)
+        return _html.escape(_json.dumps(v, default=str))
+
+    if isinstance(payload, dict):
+        rows = "".join(
+            f"<tr><td>{cell(str(k))}</td><td>{cell(v)}</td></tr>"
+            for k, v in sorted(payload.items(), key=lambda kv: str(kv[0])))
+        return f"<table>{rows}</table>"
+    cols: list = []
+    for row in payload:
+        for k in row:
+            if k not in cols:
+                cols.append(k)
+    head = "".join(f"<th>{cell(str(c))}</th>" for c in cols)
+    body = "".join(
+        "<tr>" + "".join(f"<td>{cell(row.get(c, ''))}</td>"
+                         for c in cols) + "</tr>"
+        for row in payload)
+    return f"<table><tr>{head}</tr>{body}</table>"
+
+
+def html_page(title: str, sections) -> str:
+    """The ONE debug-page renderer (`/debug/dashboard`, `/debug/explain`,
+    `/debug/ledger` all render through here — they used to hand-roll
+    three separate pages, drifting on charset and escaping).
+
+    `sections` is an iterable of (heading, payload): a dict payload
+    renders as a two-column table, a non-empty list of dicts as a
+    columnar table, anything else as escaped pretty JSON in <pre>; a
+    None heading omits the <h2>.  Serve the result with
+    :data:`HTML_CONTENT_TYPE`."""
+    import html as _html
+    import json as _json
+    parts = []
+    for heading, payload in sections:
+        if heading is not None:
+            parts.append(f"<h2>{_html.escape(str(heading))}</h2>")
+        if isinstance(payload, dict):
+            parts.append(_html_table(payload))
+        elif (isinstance(payload, list) and payload
+              and all(isinstance(r, dict) for r in payload)):
+            parts.append(_html_table(payload))
+        else:
+            body = _html.escape(
+                _json.dumps(payload, indent=2, default=str))
+            parts.append(f"<pre>{body}</pre>")
+    return (
+        "<!doctype html><html><head><meta charset='utf-8'>"
+        f"<title>{_html.escape(title)}</title>"
+        "<style>body{font-family:monospace;margin:1.5em}"
+        "table{border-collapse:collapse}"
+        "td,th{border:1px solid #999;padding:2px 8px;text-align:left}"
+        "pre{background:#f6f6f6;padding:8px;overflow-x:auto}</style>"
+        f"</head><body><h1>{_html.escape(title)}</h1>"
+        + "".join(parts) + "</body></html>")
 
 
 def render_html(doc: dict) -> str:
     """One self-contained HTML page over the merged document — the
     no-tooling view (`GET /debug/dashboard?format=html`); the JSON form
     is the API."""
-    import html as _html
-    import json as _json
-    fleet = doc.get("fleet", {})
-    rows = "".join(
-        f"<tr><td>{_html.escape(str(k))}</td>"
-        f"<td>{_html.escape(_json.dumps(v))}</td></tr>"
-        for k, v in sorted(fleet.items()))
-    sections = []
-    for name, snap in sorted(doc.get("processes", {}).items()):
-        body = _html.escape(_json.dumps(snap, indent=2, default=str))
-        sections.append(
-            f"<h2>{_html.escape(name)}</h2><pre>{body}</pre>")
-    return (
-        "<!doctype html><html><head><meta charset='utf-8'>"
-        "<title>karpenter-tpu dashboard</title>"
-        "<style>body{font-family:monospace;margin:1.5em}"
-        "table{border-collapse:collapse}"
-        "td{border:1px solid #999;padding:2px 8px}"
-        "pre{background:#f6f6f6;padding:8px;overflow-x:auto}</style>"
-        "</head><body><h1>karpenter-tpu operator dashboard</h1>"
-        f"<h2>fleet</h2><table>{rows}</table>"
-        + "".join(sections) + "</body></html>")
+    fleet = {k: v for k, v in sorted(doc.get("fleet", {}).items())}
+    sections = [("fleet", fleet)]
+    sections += [(name, snap)
+                 for name, snap in sorted(doc.get("processes", {}).items())]
+    return html_page("karpenter-tpu operator dashboard", sections)
 
 
 def reset() -> None:

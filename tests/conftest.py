@@ -26,6 +26,14 @@ os.environ.pop("KARPENTER_TPU_FAULTS", None)
 # reason-tree assertions (solvers resolve the mode at construction).
 os.environ.pop("KARPENTER_TPU_EXPLAIN", None)
 
+# The shadow-audit sampler must NEVER run armed in tier-1 except its own
+# tests: an inherited KARPENTER_TPU_AUDIT (from a shell that just drove
+# the ledger bench at rate=1.0) would put an O(pods) oracle re-solve
+# behind every solver test's back.  Same discipline for the ledger spill
+# dir — tier-1 must not scribble JSONL into an operator's ledger trail.
+os.environ.pop("KARPENTER_TPU_AUDIT", None)
+os.environ.pop("KARPENTER_TPU_LEDGER_DIR", None)
+
 # Dynamic lock-order observer (ISSUE 12, opt-in): under
 # KARPENTER_TPU_LOCK_OBSERVER=1 every threading.Lock/RLock/Condition a
 # karpenter_tpu module constructs from here on is wrapped, real
@@ -104,3 +112,20 @@ def _faults_disarmed():
     faults.disarm()
     yield
     faults.disarm()
+
+
+@pytest.fixture(autouse=True)
+def _audit_disarmed():
+    """The same belt-and-braces for the shadow-audit sampler (ISSUE 14):
+    whatever a test armed via monkeypatched KARPENTER_TPU_AUDIT, the
+    worker is stopped and the backlog cleared before AND after — one
+    forgotten reset cannot leave a background oracle solve racing the
+    rest of the suite.  The decision ledger's ring is cleared alongside
+    so per-test record-count assertions never see a neighbor's rows."""
+    from karpenter_tpu.solver import audit
+    from karpenter_tpu.utils import ledger
+    audit.SAMPLER.reset()
+    ledger.LEDGER.reset()
+    yield
+    audit.SAMPLER.reset()
+    ledger.LEDGER.reset()

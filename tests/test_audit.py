@@ -1,0 +1,252 @@
+"""Shadow-audit sampler suite (ISSUE 14): the continuous in-prod
+solver re-verification behind KARPENTER_TPU_AUDIT.
+
+Layers, cheapest first:
+
+  * grammar + sampling units — rate parsing degrades on typos,
+    deterministic accumulator sampling, sim ineligibility, backlog
+    drop accounting
+  * verdict classification — match / improved / diverged over digest
+    pairs, directly
+  * the live loop — real solves at rate 1.0 re-verify to oracle
+    parity (`verdict="match"`); a delta-engaged pass re-solves full
+    and stays clean
+  * the divergence drill — the fault harness perturbs the live digest
+    (`solver.audit.digest`), the verdict trips `diverged`, and the
+    auto-capture replays through the real `tools/kt_replay.py` CLI,
+    reproducing the divergence bit-for-bit
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from karpenter_tpu.models import NodePool, ObjectMeta, Pod, Resources
+from karpenter_tpu.providers import generate_catalog
+from karpenter_tpu.providers.catalog import CatalogSpec
+from karpenter_tpu.scheduling import ScheduleInput
+from karpenter_tpu.solver import TPUSolver, audit
+from karpenter_tpu.utils import faults, flightrecorder, metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CATALOG = generate_catalog(CatalogSpec(max_types=10, include_gpu=False))
+POOL = NodePool(meta=ObjectMeta(name="default"))
+
+
+def mkinp(tag, n=12, cpu="500m", mem="1Gi"):
+    pods = [Pod(meta=ObjectMeta(name=f"{tag}-p{i}"),
+                requests=Resources.parse({"cpu": cpu, "memory": mem}))
+            for i in range(n)]
+    return ScheduleInput(pods=pods, nodepools=[POOL],
+                         instance_types={"default": CATALOG})
+
+
+def verdicts() -> dict:
+    from karpenter_tpu.utils import telemetry
+    return telemetry._series(metrics.SOLVER_AUDIT)
+
+
+@pytest.fixture
+def fresh_recorder():
+    flightrecorder.RECORDER.reset()
+    yield flightrecorder.RECORDER
+    flightrecorder.RECORDER.reset()
+
+
+# --------------------------------------------------------------------------
+# grammar + sampling units
+# --------------------------------------------------------------------------
+class TestGrammar:
+    def test_disabled_spellings(self, monkeypatch):
+        for raw in ("", "off", "0", "false", "no", "none", "bogus",
+                    "-0.5"):
+            monkeypatch.setenv("KARPENTER_TPU_AUDIT", raw)
+            assert audit.sample_rate() == 0.0, raw
+        monkeypatch.delenv("KARPENTER_TPU_AUDIT")
+        assert audit.sample_rate() == 0.0  # tier-1 default: disarmed
+
+    def test_armed_spellings(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_AUDIT", "on")
+        assert audit.sample_rate() == audit.DEFAULT_RATE
+        monkeypatch.setenv("KARPENTER_TPU_AUDIT", "1")
+        assert audit.sample_rate() == 1.0
+        monkeypatch.setenv("KARPENTER_TPU_AUDIT", "0.25")
+        assert audit.sample_rate() == 0.25
+        monkeypatch.setenv("KARPENTER_TPU_AUDIT", "7.0")  # clamps
+        assert audit.sample_rate() == 1.0
+
+
+class TestSampling:
+    def test_deterministic_accumulator(self, monkeypatch):
+        """rate 0.5 samples exactly every second eligible solve — the
+        accumulator, not randomness, decides."""
+        monkeypatch.setenv("KARPENTER_TPU_AUDIT", "0.5")
+        solver = TPUSolver(max_nodes=64, mesh="off")
+        res = solver.solve(mkinp("det"))
+        audit.SAMPLER.reset()  # the warm solve itself advanced the acc
+        picked = [audit.SAMPLER.maybe_submit(mkinp("det"), res, solver)
+                  for _ in range(6)]
+        audit.SAMPLER.drain()
+        assert picked == [False, True, False, True, False, True]
+
+    def test_sims_never_eligible(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_AUDIT", "1.0")
+        solver = TPUSolver(max_nodes=64, mesh="off")
+        res = solver.solve(mkinp("sim"))
+        audit.SAMPLER.drain()
+        before = audit.SAMPLER.audits
+        assert not audit.SAMPLER.maybe_submit(
+            mkinp("sim"), res, solver, max_nodes=8)
+        audit.SAMPLER.drain()
+        assert audit.SAMPLER.audits == before
+
+    def test_backlog_overflow_counted_dropped(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_AUDIT", "1.0")
+        monkeypatch.setattr(audit, "_BACKLOG", 0)
+        solver = TPUSolver(max_nodes=64, mesh="off")
+        res = solver.solve(mkinp("drop"))
+        audit.SAMPLER.drain()
+        before = verdicts().get("dropped", 0)
+        assert not audit.SAMPLER.maybe_submit(mkinp("drop"), res, solver)
+        assert verdicts().get("dropped", 0) == before + 1
+
+
+# --------------------------------------------------------------------------
+# verdict classification
+# --------------------------------------------------------------------------
+class TestClassify:
+    def digest(self, nodes=5, price=1.0, unsched=0):
+        return {"nodes": nodes, "price": price,
+                "price_hex": float(price).hex(), "unschedulable": unsched}
+
+    def test_bit_exact_is_match(self):
+        d = self.digest()
+        assert audit.AuditSampler._classify(d, dict(d)) == "match"
+
+    def test_cheaper_is_improved(self):
+        assert audit.AuditSampler._classify(
+            self.digest(price=0.9), self.digest(price=1.0)) == "improved"
+
+    def test_fewer_strands_is_improved(self):
+        assert audit.AuditSampler._classify(
+            self.digest(unsched=0), self.digest(unsched=2)) == "improved"
+
+    def test_worse_price_is_diverged(self):
+        assert audit.AuditSampler._classify(
+            self.digest(price=1.1), self.digest(price=1.0)) == "diverged"
+
+    def test_sub_rounding_divergence_is_diverged(self):
+        """A price worse by less than the digest's display rounding
+        (round(price, 4)) must still classify diverged — the compare
+        runs over the exact IEEE-hex form, never the rounded field."""
+        live = self.digest(price=100.00004)
+        oracle = self.digest(price=100.00001)
+        live["price"] = oracle["price"] = 100.0  # what the digest shows
+        assert audit.AuditSampler._classify(live, oracle) == "diverged"
+
+    def test_extra_strands_are_diverged(self):
+        assert audit.AuditSampler._classify(
+            self.digest(unsched=3), self.digest(unsched=0)) == "diverged"
+
+
+# --------------------------------------------------------------------------
+# the live loop
+# --------------------------------------------------------------------------
+class TestLiveAudit:
+    def test_rate_one_reproduces_oracle_parity(self, monkeypatch):
+        """Every solve sampled; the simple workload solves to exact
+        oracle parity from the LIVE path (the acceptance shape scaled
+        to suite size — the 50k/782-node form runs in the bench)."""
+        monkeypatch.setenv("KARPENTER_TPU_AUDIT", "1.0")
+        solver = TPUSolver(max_nodes=64, mesh="off")
+        before = dict(verdicts())
+        res = solver.solve(mkinp("live"))
+        assert not res.unschedulable
+        audit.SAMPLER.drain(timeout=60.0)
+        after = verdicts()
+        assert after.get("match", 0) == before.get("match", 0) + 1
+        assert after.get("diverged", 0) == before.get("diverged", 0)
+
+    def test_delta_pass_full_resolve_parity(self, monkeypatch):
+        """A delta-engaged pass additionally re-solves FULL on the
+        audit thread and must stay clean — the delta contract audited
+        live."""
+        solver = TPUSolver(max_nodes=64, mesh="off", delta="on")
+        inp = mkinp("delta", n=16)
+        solver.solve(inp)  # cold pass fills the delta cache
+        monkeypatch.setenv("KARPENTER_TPU_AUDIT", "1.0")
+        before = dict(verdicts())
+        solver.solve(inp)  # steady-state repeat: the engaged pass
+        assert solver._delta_cache.last_outcome == "delta"
+        audit.SAMPLER.drain(timeout=120.0)
+        after = verdicts()
+        assert after.get("match", 0) == before.get("match", 0) + 1
+        assert after.get("diverged", 0) == before.get("diverged", 0)
+
+
+# --------------------------------------------------------------------------
+# the divergence drill: fault → diverged → capture → kt_replay
+# --------------------------------------------------------------------------
+class TestDivergenceDrill:
+    def test_injected_divergence_leaves_replayable_capture(
+            self, monkeypatch, tmp_path, fresh_recorder):
+        monkeypatch.setenv("KARPENTER_TPU_AUDIT", "1.0")
+        monkeypatch.setenv("KARPENTER_TPU_FLIGHT_DIR", str(tmp_path))
+        faults.arm("solver.audit.digest", "error", times=1)
+        solver = TPUSolver(max_nodes=64, mesh="off")
+        before = dict(verdicts())
+        res = solver.solve(mkinp("div"))
+        audit.SAMPLER.drain(timeout=60.0)
+        after = verdicts()
+        assert after.get("diverged", 0) == before.get("diverged", 0) + 1
+
+        # the audit flight record references a forced capture even
+        # though KARPENTER_TPU_FLIGHT_CAPTURE was never set
+        recs = [r for r in fresh_recorder.tail(16)
+                if r["kind"] == "audit"]
+        assert recs, "no audit flight record"
+        rec = recs[-1]
+        assert rec["capture"] and os.path.exists(rec["capture"])
+        # the recorded digest is the (perturbed) live answer — nodes
+        # off by the injected +1
+        assert rec["result"]["nodes"] == res.node_count() + 1
+
+        # the real replay CLI reproduces the divergence bit-for-bit:
+        # exit 1 with a nodes/price diff against the recorded digest
+        jsonl = str(tmp_path / f"flight-{os.getpid()}.jsonl")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env["KARPENTER_TPU_FORCE_CPU"] = "1"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(REPO,
+                                                        ".jax_cache")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "kt_replay.py"),
+             jsonl, "--seq", str(rec["seq"])],
+            capture_output=True, text=True, timeout=600, env=env)
+        assert proc.returncode == 1, (
+            f"replay should reproduce the divergence:\n{proc.stdout}\n"
+            f"{proc.stderr}")
+        out = json.loads(proc.stdout)
+        assert any("nodes" in d for d in out["diffs"])
+        assert "REPLAY MISMATCH" in proc.stderr
+
+    def test_no_flight_dir_degrades_capture(self, monkeypatch,
+                                            fresh_recorder):
+        monkeypatch.setenv("KARPENTER_TPU_AUDIT", "1.0")
+        monkeypatch.delenv("KARPENTER_TPU_FLIGHT_DIR", raising=False)
+        faults.arm("solver.audit.digest", "error", times=1)
+        solver = TPUSolver(max_nodes=64, mesh="off")
+        before = dict(verdicts())
+        solver.solve(mkinp("nofdir"))
+        audit.SAMPLER.drain(timeout=60.0)
+        assert verdicts().get("diverged", 0) == \
+            before.get("diverged", 0) + 1
+        recs = [r for r in fresh_recorder.tail(16)
+                if r["kind"] == "audit"]
+        assert recs and recs[-1]["capture"] is None

@@ -390,6 +390,80 @@ class TestTelemetryMerge:
         assert "fleet" in html and "operator" in html
 
 
+class TestMergeDegradedInputs:
+    """ISSUE 14 satellite: the fleet merge must degrade PER SECTION on
+    partial, dead, or foreign-schema inputs — it renders into the
+    operator's HTTP thread, and a raise there takes the dashboard down
+    exactly when part of the fleet is broken."""
+
+    def test_dead_worker_error_section(self):
+        doc = telemetry.merge({
+            "operator": telemetry.local_snapshot(),
+            "worker": {"error": "connection refused"},
+        })
+        assert doc["processes"]["worker"]["error"] == "connection refused"
+        # the healthy section still rolled up
+        assert "queue_depth" in doc["fleet"]
+
+    def test_partially_missing_sections(self):
+        # snapshots missing tenants/placement/cost entirely, and one
+        # with the keys present but null/foreign-typed values
+        snaps = {
+            "a": {"queue_depth": 1},
+            "b": {"tenants": None, "placement": 17, "cost": "nope"},
+            "c": {"tenants": {"requests": None, "shed": "x"},
+                  "placement": {"unschedulable": None},
+                  "cost": {"fleet_hourly_cost": None,
+                           "savings": ["not", "a", "dict"],
+                           "efficiency_lower_bound": "high"}},
+        }
+        doc = telemetry.merge(snaps)
+        assert doc["fleet"]["queue_depth"] == 1
+        # no cost rollup keys fabricated from garbage
+        cost = doc["fleet"].get("cost")
+        if cost is not None:
+            assert cost["hourly_total"] == 0.0
+            assert cost["efficiency_lower_bound"] is None
+
+    def test_older_schema_snapshot(self):
+        """A worker still on a pre-ISSUE-14 (even pre-ISSUE-11) schema:
+        no tenants, no placement, no cost, flat stats — merges without
+        raising and contributes what it has."""
+        old = {"queue_depth": 2, "solves_total": 5,
+               "stats": {"shed": 1},
+               "service": {"retries": 1, "breaker_state": 0,
+                           "worker_restarts": 0}}
+        doc = telemetry.merge({"operator": telemetry.local_snapshot(),
+                               "worker": old})
+        assert doc["fleet"]["queue_depth"] >= 2
+        assert doc["fleet"]["shed"] >= 1
+
+    def test_merge_of_only_error_sections_still_renders(self):
+        doc = telemetry.merge({"operator": {"error": "boom"},
+                               "worker": {"error": "also boom"}})
+        assert doc["fleet"]["queue_depth"] == 0
+        assert "cost" not in doc["fleet"]  # nothing reported cost
+        html = telemetry.render_html(doc)
+        assert html.startswith("<!doctype html>")
+
+    def test_cost_rollup_sums_and_maxes(self):
+        a = {"cost": {"fleet_hourly_cost": {"p/spot": 1.5},
+                      "savings": {"single_node": 0.25},
+                      "audit": {"match": 3},
+                      "efficiency_lower_bound": 0.4}}
+        b = {"cost": {"fleet_hourly_cost": {"p/spot": 0.5,
+                                            "q/on-demand": 2.0},
+                      "audit": {"match": 1, "diverged": 1},
+                      "efficiency_lower_bound": 0.6}}
+        cost = telemetry.merge({"a": a, "b": b})["fleet"]["cost"]
+        assert cost["hourly_by_pool"] == {"p/spot": 2.0,
+                                          "q/on-demand": 2.0}
+        assert cost["hourly_total"] == 4.0
+        assert cost["savings"] == {"single_node": 0.25}
+        assert cost["audit"] == {"match": 4, "diverged": 1}
+        assert cost["efficiency_lower_bound"] == 0.6
+
+
 # --------------------------------------------------------------------------
 # bench provenance
 # --------------------------------------------------------------------------

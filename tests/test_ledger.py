@@ -1,0 +1,426 @@
+"""Cost & efficiency observability suite (ISSUE 14): the decision
+ledger, fleet spend/packing telemetry, and the spend surfaces.
+
+Layers, cheapest first:
+
+  * ledger units — ring bound, gate, JSONL spill, pool/since filters,
+    summarize rollup
+  * controller wiring — every decision source writes records with
+    exact before/after $/hr arithmetic and flight/trace cross-links;
+    disruption savings are IEEE-hex exact vs the retired/replacement
+    price arithmetic
+  * fleet telemetry — the hourly-cost gauge reconciles against an
+    independent per-node sum; packing/stranded gauges; the greedy
+    lower bound
+  * surfaces — `GET /debug/ledger` over a live operator and the real
+    `tools/kt_ledger.py` CLI render the SAME records and the same
+    rollup (the e2e acceptance)
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.env import Environment
+from karpenter_tpu.models import NodePool, ObjectMeta, Pod, Resources
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.solver import explain
+from karpenter_tpu.utils import ledger, metrics, telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def mkpod(name, cpu="500m", mem="1Gi"):
+    return Pod(meta=ObjectMeta(name=name),
+               requests=Resources.parse({"cpu": cpu, "memory": mem}))
+
+
+@pytest.fixture
+def env():
+    e = Environment(options=Options(batch_idle_duration=0))
+    e.add_default_nodeclass()
+    e.cluster.nodepools.create(NodePool(meta=ObjectMeta(name="default")))
+    return e
+
+
+def scale_in_two_nodes(env):
+    """Two nodes whose remaining pods jointly fit one cheaper machine
+    (the test_disruption idiom): anchors fill their node, then scale
+    away, leaving two nearly-empty nodes holding one small pod each."""
+    env.cluster.pods.create(mkpod("anchor-1", cpu="15", mem="20Gi"))
+    env.cluster.pods.create(mkpod("small-1", cpu="700m", mem="512Mi"))
+    env.settle()
+    env.cluster.pods.create(mkpod("anchor-2", cpu="15", mem="20Gi"))
+    env.cluster.pods.create(mkpod("small-2", cpu="700m", mem="512Mi"))
+    env.settle()
+    assert len(env.cluster.nodeclaims.list()) == 2
+    for name in ("anchor-1", "anchor-2"):
+        p = env.cluster.pods.get(name)
+        p.node_name = None
+        env.cluster.pods.delete(name)
+
+
+# --------------------------------------------------------------------------
+# ledger units
+# --------------------------------------------------------------------------
+class TestLedgerRing:
+    def test_bounded_ring_and_seq(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_LEDGER_BUFFER", "4")
+        ledger.LEDGER.reset()  # re-read the ring size
+        for i in range(10):
+            ledger.LEDGER.record("provisioning", "launch",
+                                 detail=f"r{i}")
+        assert len(ledger.LEDGER) == 4
+        tail = ledger.LEDGER.tail(32)
+        assert [r["detail"] for r in tail] == ["r6", "r7", "r8", "r9"]
+        assert tail[-1]["seq"] == 10  # seq survives eviction
+
+    def test_gate_off(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_LEDGER", "off")
+        assert ledger.LEDGER.record("provisioning", "launch") is None
+        assert len(ledger.LEDGER) == 0
+
+    def test_tail_filters(self):
+        ledger.LEDGER.record("provisioning", "launch", pools=["a"])
+        time.sleep(0.01)
+        cut = time.time()
+        ledger.LEDGER.record("disruption", "delete", pools=["b"])
+        assert [r["pools"] for r in ledger.LEDGER.tail(8, pool="a")] \
+            == [["a"]]
+        got = ledger.LEDGER.tail(8, since=cut)
+        assert len(got) == 1 and got[0]["pools"] == ["b"]
+        assert ledger.LEDGER.tail(0) == []
+
+    def test_cost_arithmetic_and_hex(self):
+        rec = ledger.LEDGER.record(
+            "disruption", "replace", fleet_cost_before=10.5,
+            cost_delta=-0.3)
+        assert rec.fleet_cost_after == 10.5 + (-0.3)
+        assert rec.cost_delta_hex == float(-0.3).hex()
+
+    def test_jsonl_spill_and_load(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("KARPENTER_TPU_LEDGER_DIR", str(tmp_path))
+        ledger.LEDGER.reset()
+        for i in range(3):
+            ledger.LEDGER.record("expiration", "delete",
+                                 cost_delta=-float(i))
+        path = tmp_path / f"ledger-{os.getpid()}.jsonl"
+        assert path.exists()
+        rows = ledger.load_records(str(path))
+        assert [r["cost_delta"] for r in rows] == [0.0, -1.0, -2.0]
+        with open(path, "a") as f:
+            f.write('{"seq": 99, "trunc')  # torn write from a crash
+        assert len(ledger.load_records(str(path))) == 3
+
+    def test_summarize_rollup(self):
+        recs = [{"source": "provisioning", "cost_delta": 0.5,
+                 "fleet_cost_after": 0.5},
+                {"source": "disruption", "cost_delta": -0.2,
+                 "fleet_cost_after": 0.3},
+                # settlement of the delete above: counted in by_source,
+                # EXCLUDED from the savings headline (it would double
+                # every saved dollar)
+                {"source": "termination", "cost_delta": -0.2,
+                 "fleet_cost_after": 0.3}]
+        s = ledger.summarize(recs)
+        assert s["records"] == 3
+        assert s["by_source"] == {"provisioning": 1, "disruption": 1,
+                                  "termination": 1}
+        assert s["savings_dollars_per_hr"] == 0.2
+        assert s["spend_added_dollars_per_hr"] == 0.5
+        assert s["fleet_cost_after_last_decision"] == 0.3
+
+    def test_unknown_source_rejected(self):
+        with pytest.raises(AssertionError):
+            ledger.LEDGER.record("mystery", "launch")
+
+
+# --------------------------------------------------------------------------
+# controller wiring: the six decision sources
+# --------------------------------------------------------------------------
+class TestDecisionSources:
+    def test_provisioning_launch_record(self, env):
+        env.cluster.pods.create(mkpod("p"))
+        env.settle()
+        recs = [r for r in ledger.LEDGER.tail(64)
+                if r["source"] == "provisioning"]
+        assert recs, "no launch record"
+        rec = recs[0]
+        assert rec["reason_code"] == explain.CAPACITY_LAUNCHED
+        assert rec["nodes_delta"] == 1
+        assert rec["pools"] == ["default"]
+        assert rec["cost_delta"] > 0
+        # launch happens before nodes exist: before-fleet was empty
+        assert rec["fleet_cost_before"] == 0.0
+        assert rec["fleet_cost_after"] == rec["cost_delta"]
+        # cross-links: the pass solved through the recorded flight seam
+        assert rec["flight_seq"] is not None
+        assert metrics.LEDGER_RECORDS.value(source="provisioning") >= 1
+
+    def test_consolidation_savings_exact_to_the_bit(self, env):
+        """The acceptance arithmetic: reported savings == (sum of
+        retired candidate prices − replacement price), IEEE-hex
+        exact — the ledger's cost_delta carries the same floats the
+        savings counter accumulated.  The counter is process-global and
+        other suites' consolidations accumulate into it, so the test
+        zeroes its series first: a float DELTA of a non-zero
+        accumulator would not be bit-comparable."""
+        metrics.DISRUPTION_SAVINGS._values.clear()
+        scale_in_two_nodes(env)
+        env.settle()
+        assert len(env.cluster.nodeclaims.list()) == 1
+        recs = [r for r in ledger.LEDGER.tail(64)
+                if r["source"] == "disruption"]
+        assert recs, "no consolidation record"
+        saved = sum(metrics.DISRUPTION_SAVINGS.value(method=m)
+                    for m in ("emptiness", "multi_node", "single_node"))
+        expected = -sum(r["cost_delta"] for r in recs)
+        assert float(saved).hex() == float(expected).hex()
+        assert saved > 0
+        # each record preserves its delta bit-for-bit
+        for r in recs:
+            assert r["cost_delta_hex"] == float(r["cost_delta"]).hex()
+            assert float(r["fleet_cost_after"]).hex() == float(
+                r["fleet_cost_before"] + r["cost_delta"]).hex()
+
+    def test_emptiness_delete_record(self, env):
+        metrics.DISRUPTION_SAVINGS._values.clear()  # global accumulator
+        env.cluster.pods.create(mkpod("p"))
+        env.settle()
+        pod = env.cluster.pods.get("p")
+        pod.node_name = None
+        env.cluster.pods.delete("p")
+        env.settle()
+        recs = ledger.LEDGER.tail(64)
+        dis = [r for r in recs if r["source"] == "disruption"]
+        assert dis and dis[-1]["reason_code"] == \
+            explain.CONSOLIDATION_DELETE
+        assert dis[-1]["cost_delta"] < 0
+        assert metrics.DISRUPTION_SAVINGS.value(method="emptiness") \
+            == -dis[-1]["cost_delta"]
+        # the drained instance release wrote the termination record
+        term = [r for r in recs if r["source"] == "termination"]
+        assert term and term[-1]["reason_code"] == explain.NODE_TERMINATED
+        assert term[-1]["nodes_delta"] == -1
+
+    def test_expiration_record(self, env):
+        pool = env.cluster.nodepools.get("default")
+        pool.expire_after = 100.0
+        env.cluster.pods.create(mkpod("p"))
+        env.settle()
+        env.clock.step(101)
+        env.settle()
+        recs = [r for r in ledger.LEDGER.tail(64)
+                if r["source"] == "expiration"]
+        assert recs and recs[0]["reason_code"] == explain.NODE_EXPIRED
+        assert recs[0]["cost_delta"] < 0
+        assert recs[0]["pods_affected"] == 1
+
+    def test_interruption_record(self, env):
+        env.cluster.pods.create(mkpod("p"))
+        env.settle()
+        claim = env.cluster.nodeclaims.list()[0]
+        env.cloud.interrupt_spot(claim.provider_id)
+        env.settle()
+        recs = [r for r in ledger.LEDGER.tail(64)
+                if r["source"] == "interruption"]
+        assert recs and recs[0]["reason_code"] == \
+            explain.INTERRUPTION_RECLAIM
+        assert recs[0]["nodes_delta"] == -1
+        assert recs[0]["cost_delta"] < 0
+
+    def test_drift_record_claims_no_savings(self, env):
+        env.cluster.pods.create(mkpod("p"))
+        env.settle()
+        claim = env.cluster.nodeclaims.list()[0]
+        claim.meta.annotations["karpenter.sh/nodepool-hash"] = "stale"
+        env.settle()
+        recs = [r for r in ledger.LEDGER.tail(64)
+                if r["source"] == "drift"]
+        assert recs and recs[0]["reason_code"] == explain.DRIFT_REPLACED
+        assert metrics.DISRUPTION_SAVINGS.value(method="drift") == 0.0
+
+    def test_unconsolidatable_event_carries_code(self, env):
+        env.cluster.pods.create(mkpod("p", cpu="500m"))
+        env.settle()
+        env.settle()  # consolidation pass: replacement can't be cheaper
+        msgs = [m for _, _, _, r, m in env.cluster.events
+                if r == "Unconsolidatable"]
+        assert msgs, "no Unconsolidatable event"
+        assert any(f"[{explain.REPLACEMENT_NOT_CHEAPER}]" in m
+                   or f"[{explain.CANDIDATE_NOT_RESCHEDULABLE}]" in m
+                   for m in msgs), msgs
+
+
+# --------------------------------------------------------------------------
+# fleet spend & efficiency telemetry
+# --------------------------------------------------------------------------
+class TestFleetTelemetry:
+    def test_hourly_cost_matches_independent_sum(self, env):
+        for i in range(3):
+            env.cluster.pods.create(mkpod(f"p{i}", cpu="2", mem="4Gi"))
+        env.settle()
+        ledger.update_fleet_metrics(env.cluster, env.cloud_provider)
+        series = telemetry._series(metrics.FLEET_HOURLY_COST)
+        gauge_total = sum(series.values())
+        # the independent sum: every live node priced by its labels
+        manual = 0.0
+        for node in env.cluster.nodes.list():
+            p = env.pricing.price(node.instance_type, node.zone,
+                                  node.capacity_type)
+            manual += p or 0.0
+        assert manual > 0
+        assert float(gauge_total).hex() == float(manual).hex()
+        assert float(ledger.fleet_cost(
+            env.cluster, env.pricing)["total"]).hex() == \
+            float(manual).hex()
+
+    def test_packing_and_stranded_gauges(self, env):
+        env.cluster.pods.create(mkpod("p", cpu="2", mem="4Gi"))
+        env.settle()
+        ledger.update_fleet_metrics(env.cluster, env.cloud_provider)
+        pe = telemetry._series(metrics.PACKING_EFFICIENCY)
+        assert any(k.startswith("default/cpu") for k in pe)
+        for v in pe.values():
+            assert 0.0 <= v <= 1.0 + 1e-9
+        stranded = telemetry._series(metrics.STRANDED_CAPACITY)
+        assert stranded.get("default/cpu", 0) > 0  # headroom exists
+        fleet_pe = telemetry._series(metrics.FLEET_PACKING_EFFICIENCY)
+        assert "cpu" in fleet_pe
+
+    def test_efficiency_lower_bound_ratio(self, env):
+        env.cluster.pods.create(mkpod("p", cpu="2", mem="4Gi"))
+        env.settle()
+        ledger.update_fleet_metrics(env.cluster, env.cloud_provider)
+        ratio = metrics.FLEET_EFFICIENCY_BOUND.value()
+        assert 0.0 < ratio <= 1.0
+
+    def test_stale_pool_series_removed(self, env):
+        env.cluster.pods.create(mkpod("p"))
+        env.settle()
+        ledger.update_fleet_metrics(env.cluster, env.cloud_provider)
+        assert telemetry._series(metrics.FLEET_HOURLY_COST)
+        # the fleet vanishes: the refresh must drop the series, not
+        # freeze the last value
+        for node in list(env.cluster.nodes.list()):
+            env.cluster.nodes.delete(node.name)
+        ledger.update_fleet_metrics(env.cluster, env.cloud_provider)
+        assert telemetry._series(metrics.FLEET_HOURLY_COST) == {}
+
+    def test_cost_section_in_local_snapshot(self, env):
+        env.cluster.pods.create(mkpod("p"))
+        env.settle()
+        ledger.update_fleet_metrics(env.cluster, env.cloud_provider)
+        snap = telemetry.local_snapshot()
+        cost = snap["cost"]
+        assert cost["fleet_hourly_cost"]
+        assert isinstance(cost["ledger_tail"], list)
+        doc = telemetry.merge({"operator": snap})
+        assert doc["fleet"]["cost"]["hourly_total"] > 0
+
+
+# --------------------------------------------------------------------------
+# surfaces: GET /debug/ledger + tools/kt_ledger.py (the e2e acceptance)
+# --------------------------------------------------------------------------
+class TestLedgerSurfaces:
+    def test_debug_ledger_and_cli_render_same_records(
+            self, tmp_path, monkeypatch):
+        """The e2e: a real Operator (live HTTP, real reconcile thread)
+        provisions and consolidates; `GET /debug/ledger` and the real
+        kt_ledger CLI (subprocess over the JSONL spill) must report the
+        SAME records through the same rollup."""
+        from karpenter_tpu.operator.operator import Operator
+        monkeypatch.setenv("KARPENTER_TPU_LEDGER_DIR", str(tmp_path))
+        ledger.LEDGER.reset()
+        op = Operator(options=Options(batch_idle_duration=0),
+                      metrics_port=0, health_port=0,
+                      reconcile_interval=0.05)
+        op.env.add_default_nodeclass()
+        op.env.cluster.nodepools.create(
+            NodePool(meta=ObjectMeta(name="default")))
+        t = threading.Thread(target=op.run, daemon=True)
+        t.start()
+        try:
+            deadline = time.monotonic() + 10
+            while op.metrics_port == 0 or not op._servers:
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+            for i in range(3):
+                op.env.cluster.pods.create(mkpod(f"p{i}"))
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if ledger.LEDGER.tail(8):
+                    break
+                time.sleep(0.05)
+            base = f"http://127.0.0.1:{op.metrics_port}"
+            with urllib.request.urlopen(base + "/debug/ledger",
+                                        timeout=30) as r:
+                doc = json.loads(r.read().decode())
+            assert doc["records"], "HTTP surface returned no records"
+            assert doc["summary"]["records"] == len(doc["records"])
+            # pool filter narrows; a bogus pool returns nothing
+            with urllib.request.urlopen(
+                    base + "/debug/ledger?pool=ghost", timeout=30) as r:
+                assert json.loads(r.read().decode())["records"] == []
+            # html form renders from the same records, escaped
+            with urllib.request.urlopen(
+                    base + "/debug/ledger?format=html", timeout=30) as r:
+                assert r.headers["Content-Type"].startswith("text/html")
+                body = r.read().decode()
+            assert "decision ledger" in body
+            assert explain.CAPACITY_LAUNCHED in body
+
+            # the CLI over the spill: same records, same rollup
+            spill = tmp_path / f"ledger-{os.getpid()}.jsonl"
+            assert spill.exists()
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO, "tools", "kt_ledger.py"),
+                 str(spill), "--json"],
+                capture_output=True, text=True, check=True)
+            cli = json.loads(out.stdout)
+            http_by_seq = {r["seq"]: r for r in doc["records"]}
+            cli_by_seq = {r["seq"]: r for r in cli["records"]}
+            shared = set(http_by_seq) & set(cli_by_seq)
+            assert shared, "no overlapping records between surfaces"
+            for seq in shared:
+                assert http_by_seq[seq]["cost_delta_hex"] == \
+                    cli_by_seq[seq]["cost_delta_hex"]
+                assert http_by_seq[seq]["reason_code"] == \
+                    cli_by_seq[seq]["reason_code"]
+        finally:
+            op.stop()
+            t.join(timeout=120)
+            assert not t.is_alive(), "operator loop did not stop"
+
+    def test_cli_report_shapes(self, tmp_path):
+        sys.path.insert(0, REPO)
+        from tools import kt_ledger
+        recs = [
+            {"seq": 1, "source": "provisioning", "cost_delta": 1.0,
+             "pools": ["a"], "ts": 10.0},
+            {"seq": 2, "source": "disruption", "cost_delta": -0.25,
+             "pools": ["b"], "ts": 20.0,
+             "fleet_cost_after": 0.75},
+        ]
+        rep = kt_ledger.report(recs)
+        assert rep["sources"]["disruption"]["saved"] == 0.25
+        assert rep["sources"]["provisioning"]["added"] == 1.0
+        text = kt_ledger.render_text(recs, rep)
+        assert "disruption" in text and "-0.2500" in text
+        # filters
+        assert kt_ledger._filter(recs, pool="a") == recs[:1]
+        assert kt_ledger._filter(recs, since=15.0) == recs[1:]
+        assert kt_ledger._filter(recs, limit=1) == recs[1:]
+
+    def test_html_page_escapes_cells(self):
+        html = telemetry.html_page(
+            "t", [("rows", [{"reason": "<script>alert(1)</script>"}])])
+        assert "<script>alert(1)" not in html
+        assert "&lt;script&gt;" in html

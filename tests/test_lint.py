@@ -661,6 +661,58 @@ def test_reason_literal_exempts_the_registry_module(tmp_path):
     assert findings == []
 
 
+def test_reason_return_flags_literals_in_disruption(tmp_path):
+    # ISSUE 14 satellite: *_reason functions in the decision-emitting
+    # controller must return registry codes, never bare literals —
+    # constants, f-strings, and literal concatenations all flagged
+    findings, _ = _check(tmp_path, """
+        def _unacceptable_reason(self, cands, sim):
+            if not sim.new_claims:
+                return None
+            return "replacement would not reduce cost"
+
+
+        def _drift_reason(self, cand):
+            return f"NodePoolDrift: {cand.claim.name}"
+
+
+        def _other_reason(self):
+            return ("spot-to-spot replacement keeps only "
+                    + "a few instance types")
+    """, observability, relname="karpenter_tpu/controllers/disruption.py")
+    msgs = [f.message for f in findings]
+    assert len(findings) == 3, msgs
+    assert all("reason-literal" in m for m in msgs)
+
+
+def test_reason_return_negatives(tmp_path):
+    # coded returns, None, variables, and non-_reason functions stay
+    # clean; other modules are out of scope entirely
+    findings, _ = _check(tmp_path, """
+        from karpenter_tpu.solver import explain as explainmod
+
+
+        def _unacceptable_reason(self, cands, sim):
+            if not sim.new_claims:
+                return None
+            if sim.bad:
+                return explainmod.make(
+                    explainmod.REPLACEMENT_NOT_CHEAPER,
+                    "replacement would not reduce cost")
+            return self.cp.is_drifted(cands[0].claim)
+
+
+        def render_banner(self):
+            return "a literal from a non-reason function is fine"
+    """, observability, relname="karpenter_tpu/controllers/disruption.py")
+    assert findings == []
+    findings, _ = _check(tmp_path, """
+        def _some_reason(self):
+            return "other modules are not in the decision-emitting set"
+    """, observability, relname="karpenter_tpu/controllers/other.py")
+    assert findings == []
+
+
 def test_reason_literal_suppression(tmp_path):
     _, report = _check(tmp_path, """
         def decode(res, name):
