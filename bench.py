@@ -149,7 +149,8 @@ def run_configs(timeout_s: float):
                "config3_topology.py", "config4_consolidation.py",
                "config4b_consolidation_spread.py",
                "config5_burst.py", "config6_interruption.py",
-               "config7_churn.py", "config8_saturation.py"]
+               "config7_churn.py", "config8_saturation.py",
+               "config9_gang.py"]
     env = dict(os.environ)
     # configs share the persistent compile cache (platform bootstrap), so
     # a generous per-probe budget isn't needed — keep failures quick so

@@ -1,0 +1,137 @@
+"""BASELINE config #9: gang scheduling (ISSUE 15) — mixed gang +
+singleton load, gang sizes 2–64, through the kernel's atomic K-node
+gang fill.
+
+Acceptance (boolean fields `make bench-regress` gates):
+  * zero_partial_placements — every gang is fully placed or fully
+    stranded, and every placed gang's members share ONE adjacency
+    domain (the atomicity + rank-adjacency invariant);
+  * gang_parity — the per-gang placed/stranded verdict matches the
+    (gang-aware) CPU oracle on the identical input.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.common import run
+from karpenter_tpu.models import (
+    NodePool, ObjectMeta, Pod, Resources, wellknown,
+)
+from karpenter_tpu.providers import generate_catalog
+from karpenter_tpu.scheduling import ScheduleInput
+
+CATALOG = generate_catalog()
+
+# (gang name, member count, per-pod cpu, per-pod mem, topology-domain)
+# — sizes span the 2–64 range; one gang rides the rack (capacity-type)
+# axis and one is domain-free; the "jumbo" gang is sized to strand
+# whole (its members outstrip any single domain), and the "waiting"
+# gang is declared one member larger than pending so it strands
+# GangIncomplete — the stranding side of the invariant is exercised on
+# every run, not just the happy path.
+GANGS = [
+    ("mpi-a", 2, "2", "4Gi", None),
+    ("mpi-b", 4, "4", "8Gi", None),
+    ("mpi-c", 8, "2", "4Gi", None),
+    ("mpi-d", 12, "1", "2Gi", "rack"),
+    ("mpi-e", 16, "2", "4Gi", None),
+    ("mpi-f", 24, "1", "2Gi", "none"),
+    ("mpi-g", 32, "2", "4Gi", None),
+    ("mpi-h", 48, "1", "2Gi", None),
+    ("mpi-i", 64, "1", "2Gi", None),
+    ("jumbo", 64, "4", "8000Gi", None),
+]
+WAITING = ("waiting", 8)   # declared size 9, only 8 pending
+N_SINGLETONS = 800
+
+_INPUT = [None]
+
+
+def _gang_pod(name, gname, size, cpu, mem, dom):
+    ann = {wellknown.GANG_NAME_ANNOTATION: gname,
+           wellknown.GANG_SIZE_ANNOTATION: str(size)}
+    if dom is not None:
+        ann[wellknown.GANG_TOPOLOGY_ANNOTATION] = dom
+    return Pod(meta=ObjectMeta(name=name, annotations=ann),
+               requests=Resources.parse({"cpu": cpu, "memory": mem}))
+
+
+def make_input():
+    pods = []
+    for gname, size, cpu, mem, dom in GANGS:
+        for i in range(size):
+            pods.append(_gang_pod(f"{gname}-{i}", gname, size, cpu, mem,
+                                  dom))
+    wname, wpending = WAITING
+    for i in range(wpending):
+        pods.append(_gang_pod(f"{wname}-{i}", wname, wpending + 1,
+                              "1", "2Gi", None))
+    for i in range(N_SINGLETONS):
+        pods.append(Pod(
+            meta=ObjectMeta(name=f"s{i}"),
+            requests=Resources.parse(
+                {"cpu": ["250m", "500m", "1"][i % 3],
+                 "memory": ["512Mi", "1Gi", "2Gi"][i % 3]})))
+    pool = NodePool(meta=ObjectMeta(name="default"))
+    inp = ScheduleInput(pods=pods, nodepools=[pool],
+                        instance_types={"default": CATALOG})
+    _INPUT[0] = inp
+    return inp
+
+
+def _gang_checks(res):
+    """The acceptance block: atomicity + adjacency on the solver's
+    result, per-gang verdict parity vs the oracle.  The invariant is
+    computed by the shared scheduling.types.gang_placement_audit — the
+    SAME implementation the gang suite and the fuzz class assert, so
+    the bench gate can't drift from the tests."""
+    from karpenter_tpu.scheduling import Scheduler
+    from karpenter_tpu.scheduling.types import gang_placement_audit
+    inp = _INPUT[0]
+    audit = gang_placement_audit(inp, res)
+    zero_partial = all(a["placed"] in (0, a["total"])
+                       for a in audit.values())
+    # adjacency: every placed gang's members restricted to one domain
+    adjacency_ok = all(
+        not a["unpinned"] and len(a["domains"]) <= 1
+        for a in audit.values()
+        if a["placed"] == a["total"] and a["spec"].domain_key is not None)
+    oaudit = gang_placement_audit(inp, Scheduler(inp).solve())
+    parity = all(
+        (audit[g]["placed"] == audit[g]["total"])
+        == (oaudit[g]["placed"] == oaudit[g]["total"])
+        for g in audit)
+    oz_partial = all(a["placed"] in (0, a["total"])
+                     for a in oaudit.values())
+    placed_gangs = sum(1 for a in audit.values()
+                       if a["placed"] == a["total"])
+    return {
+        "gangs": len(audit),
+        "gangs_placed": placed_gangs,
+        "nodes": res.node_count(),
+        "zero_partial_placements": bool(zero_partial and adjacency_ok
+                                        and oz_partial),
+        "gang_parity": bool(parity),
+        "pass": bool(zero_partial and adjacency_ok and oz_partial
+                     and parity),
+    }
+
+
+if __name__ == "__main__":
+    res = run("config#9 gang: 2-64-member gangs + singletons, atomic "
+              "adjacent placement", 500.0, make_input,
+              extra=_gang_checks)
+    # the jumbo and waiting gangs strand WHOLE by construction; nothing
+    # else may
+    from karpenter_tpu.scheduling.types import gang_of
+    stranded_gangs = set()
+    for p in _INPUT[0].pods:
+        sp = gang_of(p)
+        if sp is not None and p.meta.name in res.unschedulable:
+            stranded_gangs.add(sp.name)
+    assert stranded_gangs == {"jumbo", "waiting"}, stranded_gangs
+    singles_stranded = [n for n in res.unschedulable
+                        if n.startswith("s")]
+    assert not singles_stranded, singles_stranded[:5]

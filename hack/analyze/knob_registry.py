@@ -48,6 +48,8 @@ KNOBS = {
         "owner": "karpenter_tpu/utils/flightrecorder.py", "kind": "value"},
     "KARPENTER_TPU_FORCE_CPU": {
         "owner": "karpenter_tpu/utils/platform.py", "kind": "bool"},
+    "KARPENTER_TPU_GANG": {
+        "owner": "karpenter_tpu/utils/knobs.py", "kind": "bool"},
     "KARPENTER_TPU_HEALTH_PORT": {
         "owner": "karpenter_tpu/operator/operator.py", "kind": "value"},
     "KARPENTER_TPU_LEASE_FILE": {
@@ -119,6 +121,8 @@ KNOBS = {
     "KARPENTER_TPU_TENANT_QUEUE": {
         "owner": "karpenter_tpu/service/scheduler.py", "kind": "value"},
     "KARPENTER_TPU_TENANT_WEIGHTS": {
+        "owner": "karpenter_tpu/service/scheduler.py", "kind": "value"},
+    "KARPENTER_TPU_TENANT_WEIGHTS_FILE": {
         "owner": "karpenter_tpu/service/scheduler.py", "kind": "value"},
     "KARPENTER_TPU_TRACE": {
         "owner": "karpenter_tpu/utils/tracing.py", "kind": "bool"},

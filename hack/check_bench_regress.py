@@ -41,7 +41,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _PARITY_KEYS = ("parity", "pass", "nodes_le_oracle",
                 "nodes_le_oracle_50k", "price_le_oracle_50k",
-                "fairness_ok")
+                "fairness_ok",
+                # config9 (gang scheduling): the atomicity invariant and
+                # the per-gang verdict parity vs the oracle are boolean
+                # acceptance fields of the gang bench's record
+                "zero_partial_placements", "gang_parity")
 _NAME_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 
 

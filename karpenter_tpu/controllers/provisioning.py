@@ -184,7 +184,7 @@ class Provisioner:
                 with tracing.span("provisioning.solve"):
                     result = self._solve(inp)
             with tracing.span("provisioning.apply"):
-                self._apply(result)
+                self._apply(result, pending)
             if _sp is not None:
                 _sp.attrs["new_claims"] = len(result.new_claims)
                 _sp.attrs["unschedulable"] = len(result.unschedulable)
@@ -197,7 +197,24 @@ class Provisioner:
         return self.solver.solve(inp, source="provisioning")
 
     # -- apply -------------------------------------------------------------
-    def _apply(self, result: ScheduleResult) -> None:
+    def _apply(self, result: ScheduleResult,
+               pods: "List[Pod] | None" = None) -> None:
+        if pods:
+            # gang placement outcomes (ISSUE 15): ONE increment per gang
+            # per pass.  By the atomicity invariant a gang is either
+            # fully placed or fully stranded — outcome is derived from
+            # "any member unschedulable", and a partial gang would show
+            # up on the solver's gang-repair counter, never here.
+            from karpenter_tpu.scheduling.types import gang_of
+            gangs: dict = {}
+            for p in pods:
+                sp = gang_of(p)
+                if sp is not None:
+                    placed = p.meta.name not in result.unschedulable
+                    gangs[sp.name] = gangs.get(sp.name, True) and placed
+            for _name, placed in sorted(gangs.items()):
+                metrics.GANG_PLACEMENTS.inc(
+                    outcome="placed" if placed else "stranded")
         for pod_name, node_name in result.existing_assignments.items():
             pod = self.cluster.pods.get(pod_name)
             node = self.cluster.nodes.get(node_name)

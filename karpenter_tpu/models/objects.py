@@ -243,8 +243,30 @@ class Pod:
             tuple(sorted(self.meta.labels.items())),
             self.priority,
             self.is_daemonset,
+            # gang identity (ISSUE 15): a gang member is NOT
+            # interchangeable with an identical non-gang pod (its
+            # placement is atomic with its gang), and two gangs never
+            # share a class — the grouped solver's gang unit IS the
+            # equivalence class.  None (inert) when the
+            # KARPENTER_TPU_GANG rollback knob is off.
+            self._gang_key(),
         )
         return self._sched_key_cache
+
+    def _gang_key(self):
+        # delegate to gang_of — the ONE owner of the annotation
+        # grammar (knob gate, size/domain normalization): raw
+        # annotation strings here would split one gang into two
+        # classes on a cosmetic difference ("slice" vs "Slice") that
+        # gang_of parses identically, and _encode_gang would then
+        # reject the gang as multi-class.  Lazy import (the same
+        # direction gang_of's own lazy imports take) avoids the
+        # models↔scheduling cycle.
+        from karpenter_tpu.scheduling.types import gang_of
+        sp = gang_of(self)
+        if sp is None:
+            return None
+        return (sp.name, sp.size, sp.domain_key)
 
     def scheduling_group_id(self) -> int:
         """Interned integer id of the scheduling_key — deep-tuple hashing is

@@ -54,6 +54,17 @@ NODEPOOL_HASH_ANNOTATION = "karpenter.sh/nodepool-hash"
 NODEPOOL_HASH_VERSION_ANNOTATION = "karpenter.sh/nodepool-hash-version"
 NODECLASS_HASH_ANNOTATION = "karpenter.tpu/nodeclass-hash"
 NODECLASS_HASH_VERSION_ANNOTATION = "karpenter.tpu/nodeclass-hash-version"
+# gang scheduling (ISSUE 15): tightly-coupled multi-host workloads
+# declare all-or-nothing, rank-adjacent placement via pod annotations.
+# gang-name groups the members, gang-size declares the expected member
+# count (a gang with fewer pending members than declared is incomplete
+# and strands whole), gang-topology names the adjacency domain the
+# members must share: "slice" (the zone axis — a TPU multi-host slice),
+# "rack" (the capacity-type axis doubling as the rack domain when the
+# catalog encodes racks that way), or "none" (atomic, no adjacency).
+GANG_NAME_ANNOTATION = "karpenter.tpu/gang-name"
+GANG_SIZE_ANNOTATION = "karpenter.tpu/gang-size"
+GANG_TOPOLOGY_ANNOTATION = "karpenter.tpu/gang-topology-domain"
 
 # -- finalizers ----------------------------------------------------------
 TERMINATION_FINALIZER = "karpenter.sh/termination"

@@ -75,7 +75,8 @@ def _kernel_specs(ax: str):
         P(ax, None), P(ax, None),     # col_alloc, col_daemon
         P(ax, None),                  # pt_alloc (block-aligned with O)
         P(ax), P(), P(),              # col_pool, pool_daemon, pool_limit
-        P(), P(), P(), P(), P(), P(), P(), P(),  # group topology (+whole)
+        P(), P(), P(), P(), P(), P(), P(), P(), P(),  # group topology
+                                      # (+whole +gang)
         P(ax), P(ax),                 # col_zone, col_ct
         P(), P(),                     # exist_zone, exist_ct
     )
@@ -88,12 +89,13 @@ def _kernel_specs(ax: str):
 _FULL_PROGRAMS: Dict[tuple, object] = {}
 
 
-def _full_kernel_program(mesh: Mesh, max_nodes: int, zc: int, axis: str):
-    key = (mesh, max_nodes, zc, axis)
+def _full_kernel_program(mesh: Mesh, max_nodes: int, zc: int, axis: str,
+                         with_gang: int = 0):
+    key = (mesh, max_nodes, zc, axis, with_gang)
     fn = _FULL_PROGRAMS.get(key)
     if fn is None:
         body = partial(ffd._solve_ffd_impl, max_nodes=max_nodes, zc=zc,
-                       axis_name=axis)
+                       axis_name=axis, with_gang=with_gang)
         fn = jax.jit(  # kt-lint: disable=jit-purity
             shard_map(body, mesh=mesh, in_specs=_kernel_specs(axis),
                       out_specs=P(), check_rep=False))
@@ -107,11 +109,12 @@ def sharded_solve_ffd(
     col_alloc, col_daemon, pt_alloc, col_pool, pool_daemon,
     pool_limit,
     group_ncap, group_dsel, group_dbase, group_dcap, group_skew,
-    group_mindom, group_delig, group_whole,
+    group_mindom, group_delig, group_whole, group_gang,
     col_zone, col_ct, exist_zone, exist_ct,
     max_nodes: int = 1024,
     zc: int = 1,
     axis: str = "cat",
+    with_gang: int = 0,
 ):
     """solve_ffd with the column axes sharded over `mesh` via shard_map.
 
@@ -125,12 +128,13 @@ def sharded_solve_ffd(
     (every non-column tensor is computed from pmax-combined values), but
     the static replication checker can't see that through the scan.
     """
-    fn = _full_kernel_program(mesh, max_nodes, zc, axis)
+    fn = _full_kernel_program(mesh, max_nodes, zc, axis,
+                              with_gang=with_gang)
     args = (group_req, group_count, group_mask, exist_cap, exist_remaining,
             col_alloc, col_daemon, pt_alloc, col_pool, pool_daemon,
             pool_limit,
             group_ncap, group_dsel, group_dbase, group_dcap, group_skew,
-            group_mindom, group_delig, group_whole,
+            group_mindom, group_delig, group_whole, group_gang,
             col_zone, col_ct, exist_zone, exist_ct)
     specs = _kernel_specs(axis)
     args = tuple(jax.device_put(a, NamedSharding(mesh, s))
@@ -182,14 +186,16 @@ class MeshExecutor:
 
     # -- the resident solve program --------------------------------------
     def _program(self, layout, max_nodes: int, zc: int, sparse_n: int,
-                 donate: bool, explain: int = 0):
-        key = (layout, max_nodes, zc, sparse_n, donate, explain)
+                 donate: bool, explain: int = 0, with_gang: int = 0):
+        key = (layout, max_nodes, zc, sparse_n, donate, explain,
+               with_gang)
         prog = self._progs.get(key)
         if prog is None:
             ax = self.axis
             body = partial(ffd._solve_ffd_resident_impl, layout=layout,
                            max_nodes=max_nodes, zc=zc, sparse_n=sparse_n,
-                           axis_name=ax, explain=explain)
+                           axis_name=ax, explain=explain,
+                           with_gang=with_gang)
             sm = shard_map(
                 body, mesh=self.mesh,
                 in_specs=(P(),            # problem buffer (replicated)
@@ -253,7 +259,8 @@ class MeshExecutor:
                     dev["pool_daemon"], dev["col_zone"], dev["col_ct"])
 
     def solve(self, buf, mask_table, dev: dict, layout, max_nodes: int,
-              sparse_n: int, donate: bool, explain: int = 0):
+              sparse_n: int, donate: bool, explain: int = 0,
+              with_gang: int = 0):
         """Dispatch one resident-path solve.  `buf` is the coalesced
         replicated problem buffer (committed — possibly through a
         donated DeviceSlots rotation — or host numpy, which jit commits
@@ -262,7 +269,8 @@ class MeshExecutor:
         concurrent capacity cycle may have replaced it); everything with
         a column axis is already resident."""
         prog = self._program(layout, max_nodes, dev["ZC"], sparse_n,
-                             donate, explain=explain)
+                             donate, explain=explain,
+                             with_gang=with_gang)
         return prog(buf, mask_table,
                     dev["col_alloc"], dev["col_daemon"], dev["pt_alloc"],
                     dev["col_pool"], dev["pool_daemon"],

@@ -42,6 +42,8 @@ from karpenter_tpu.scheduling.types import (
     ScheduleInput,
     ScheduleResult,
     effective_request,
+    gang_of,
+    gang_trial_order,
     min_values_violation,
 )
 # the reason-code registry (jax-free: the solver package resolves its
@@ -165,10 +167,215 @@ class Scheduler:
             key=lambda p: (p.requests.sort_key(), p.meta.name),
             reverse=True,
         )
+        # gang pre-scan (ISSUE 15): members of one gang place ATOMICALLY
+        # at the position of their first member in FFD order — all or
+        # none, in one adjacency domain — instead of pod by pod.  The
+        # map is keyed by gang name so even heterogeneous gangs (several
+        # pod classes sharing a name — inexpressible for the kernel,
+        # which hands them here via the residue path) stay atomic.
+        gang_members: Dict[str, List[Pod]] = {}
         for pod in pods:
-            self._schedule_one(pod)
+            sp = gang_of(pod)
+            if sp is not None:
+                gang_members.setdefault(sp.name, []).append(pod)
+        done_gangs: set = set()
+        for pod in pods:
+            sp = gang_of(pod)
+            if sp is None:
+                self._schedule_one(pod)
+            elif sp.name not in done_gangs:
+                done_gangs.add(sp.name)
+                self._schedule_gang(sp, gang_members[sp.name])
         self._finalize()
         return self.result
+
+    # -- gang scheduling (ISSUE 15) ------------------------------------
+    def _snapshot(self) -> tuple:
+        """Value snapshot of every mutable piece a gang trial can touch.
+        Resources/Requirements are rebound (never mutated in place) by
+        the placement paths, so object references suffice for them;
+        lists/sets/dicts that mutate are copied or length-recorded."""
+        ex = [(sim.remaining, set(sim.failed_keys))
+              for sim in self.existing]
+        new = [(sim.requirements, sim.candidates, sim.requests,
+                len(sim.pods), sim.last_key, dict(sim.domains),
+                set(sim.failed_keys))
+               for sim in self.new_sims]
+        return (ex, new, len(self.new_sims),
+                dict(self._remaining_limits),
+                dict(self.result.existing_assignments),
+                dict(self.result.unschedulable),
+                len(self.result.new_claims),
+                self.tracker.snapshot())
+
+    def _restore(self, snap: tuple) -> None:
+        (ex, new, n_new, limits, assigns, unsched, n_claims,
+         tsnap) = snap
+        for sim, (rem, fk) in zip(self.existing, ex):
+            sim.remaining = rem
+            sim.failed_keys = fk
+        del self.new_sims[n_new:]
+        for sim, (reqs, cands, requests, npods, lk, doms, fk) in zip(
+                self.new_sims, new):
+            sim.requirements = reqs
+            sim.candidates = cands
+            sim.requests = requests
+            del sim.pods[npods:]
+            sim.last_key = lk
+            # the tracker holds this dict BY REFERENCE — restore its
+            # contents in place, never rebind it
+            sim.domains.clear()
+            sim.domains.update(doms)
+            sim.failed_keys = fk
+        self._remaining_limits = limits
+        self.result.existing_assignments.clear()
+        self.result.existing_assignments.update(assigns)
+        self.result.unschedulable.clear()
+        self.result.unschedulable.update(unsched)
+        del self.result.new_claims[n_claims:]
+        self.tracker.restore(tsnap)
+
+    def _schedule_gang(self, spec, members: List[Pod]) -> None:
+        """All-or-nothing multi-node gang placement: try each adjacency
+        domain in the SHARED deterministic order (gang_trial_order —
+        the rank the device encoder folds into dbase), placing every
+        member restricted to that domain; the first domain that takes
+        the whole gang commits, any failure rolls the trial back
+        bit-exactly via the state snapshot.  No domain ⇒ the gang
+        strands WHOLE with a gang reason code.  Soft terms on gang
+        members are ignored (gangs never enter the relaxation ladder);
+        a gang with fewer/more pending members than its declared size
+        waits (GangIncomplete) — the same verdict the encoder applies,
+        so kernel-vs-oracle parity covers the incomplete case too."""
+        import dataclasses
+        cnt = len(members)
+        # members already BOUND on live nodes count toward completeness
+        # (code-review regression: a recreated member of a running gang
+        # must not strand GangIncomplete forever — the residual must
+        # rejoin its gang), and their nodes pin the adjacency domain
+        # the pending ranks must land in
+        bound = 0
+        bound_nodes = []
+        for en in self.inp.existing_nodes:
+            n = 0
+            for p in en.pods:
+                bsp = gang_of(p)
+                if bsp is not None and bsp.name == spec.name:
+                    n += 1
+            if n:
+                bound += n
+                bound_nodes.append(en)
+        if spec.size and cnt + bound != spec.size:
+            reason = explainmod.make(
+                explainmod.GANG_INCOMPLETE,
+                f"gang {spec.name}: {cnt} member(s) pending"
+                + (f" + {bound} bound" if bound else "")
+                + f" of {spec.size} declared — "
+                + ("waiting for the full gang" if cnt + bound < spec.size
+                   else "more members than declared; fix gang-size"),
+                {"code": explainmod.GANG_INCOMPLETE,
+                 "constraint": "gang",
+                 "gang": {"name": spec.name, "declared_size": spec.size,
+                          "members_pending": cnt,
+                          "members_bound": bound}})
+            for m in members:
+                self.result.unschedulable[m.meta.name] = reason
+            return
+        key = spec.domain_key
+        if key is None:
+            domains: List[Optional[str]] = [None]
+        else:
+            if bound_nodes:
+                # residual gang: the ONLY candidate domains are where
+                # the bound members already run (rank adjacency is to
+                # the RUNNING ranks, not to any domain with capacity);
+                # an unlabeled bound node contributes nothing and an
+                # empty set strands GangDomainExhausted below
+                cand = {d for d in (en.node.labels.get(key)
+                                    for en in bound_nodes)
+                        if d is not None}
+            else:
+                cand = self.tracker.known_domains.get(key, set())
+            domains = [
+                d for d in gang_trial_order(cand)
+                if all((m.requirements.get(key) is None
+                        or m.requirements.get(key).matches(d))
+                       for m in members)]
+        best_placed = 0
+        best_domain: Optional[str] = None
+        for d in domains:
+            snap = self._snapshot()
+            placed = 0
+            for m in members:
+                variant = m
+                if d is not None:
+                    variant = dataclasses.replace(
+                        m, requirements=m.requirements.intersection(
+                            Requirements(
+                                Requirement.make(key, "In", d))))
+                if self._place(variant, effective_request(m)) is None:
+                    placed += 1
+                else:
+                    break
+            if placed == cnt:
+                return  # the whole gang committed in domain d
+            if placed > best_placed:
+                best_placed, best_domain = placed, d
+            self._restore(snap)
+        # node-deficit estimate on the kernel tree's basis (allocatable
+        # minus daemon overhead, best catalog column): how many MORE
+        # nodes the nearest domain would need — the actionable number
+        # for a stranded tightly-coupled job
+        deficit = cnt - best_placed
+        best_fit = 0
+        mreq = effective_request(members[0])
+        for pool in self.inp.nodepools:
+            daemon = self.inp.daemon_overhead.get(pool.name, Resources())
+            for it in self.inp.instance_types.get(pool.name, []):
+                avail = it.allocatable() - daemon
+                fit = None
+                for i, r in enumerate(mreq.v):
+                    if r > 1e-9:
+                        k = int((avail.v[i] + 1e-9) // r)
+                        fit = k if fit is None else min(fit, k)
+                best_fit = max(best_fit, fit or 0)
+        if best_placed <= 0:
+            if best_fit == 0 and not any(
+                    mreq.fits(en.available)
+                    for en in self.inp.existing_nodes):
+                # no purchasable type and no live node can hold even ONE
+                # member: the gang can NEVER fit — the kernel's
+                # GangTooLarge verdict, kept here so _rescue_stranded's
+                # oracle re-judgement doesn't demote it to the
+                # wait-might-help GangDomainExhausted
+                code = explainmod.GANG_TOO_LARGE
+                detail = (f"gang {spec.name}: no instance type or "
+                          "existing node can hold a single member — "
+                          "the gang cannot fit at any capacity")
+            else:
+                code = explainmod.GANG_DOMAIN
+                detail = (f"gang {spec.name}: no adjacency domain can "
+                          "currently hold any member")
+        else:
+            code = explainmod.GANG_PARTIAL
+            detail = (f"gang {spec.name}: best domain holds "
+                      f"{best_placed} of {cnt} members — stranded "
+                      "whole rather than split")
+        reason = explainmod.make(code, detail, {
+            "code": code, "constraint": "gang",
+            "gang": {"name": spec.name, "declared_size": spec.size,
+                     "members_pending": cnt,
+                     "domain_axis": (
+                         "zone" if key == wellknown.ZONE_LABEL
+                         else "capacity-type" if key is not None
+                         else "none"),
+                     "nearest_domain": best_domain,
+                     "nearest_domain_members": best_placed,
+                     "deficit_members": deficit,
+                     "deficit_nodes": (-(-deficit // best_fit)
+                                       if best_fit else None)}})
+        for m in members:
+            self.result.unschedulable[m.meta.name] = reason
 
     # ------------------------------------------------------------------
     def _schedule_one(self, pod: Pod) -> None:

@@ -77,6 +77,28 @@ class TopologyTracker:
         for tkey, domain in node_domains.items():
             self.known_domains[tkey].add(domain)
 
+    def snapshot(self) -> tuple:
+        """A value snapshot of the tracker's whole mutable state, for
+        the oracle's atomic gang trials (ISSUE 15): a failed trial must
+        roll back every registration it made.  Placements are truncated
+        by length (entries are append-only); the caches/sets are copied
+        by value."""
+        return (len(self._placed),
+                {k: Counter(v) for k, v in self._match_cache.items()},
+                {k: set(v) for k, v in self._anti_terms.items()},
+                {k: set(v) for k, v in self.known_domains.items()})
+
+    def restore(self, snap: tuple) -> None:
+        n, match_cache, anti_terms, known = snap
+        del self._placed[n:]
+        self._match_cache = match_cache
+        self._anti_terms = defaultdict(set)
+        for k, v in anti_terms.items():
+            self._anti_terms[k] = v
+        self.known_domains = defaultdict(set)
+        for k, v in known.items():
+            self.known_domains[k] = v
+
     def invalidate_counts(self) -> None:
         """Rebuild domain-keyed caches after a registered node's domains
         dict gained an entry (a claim pinned an undetermined zone/

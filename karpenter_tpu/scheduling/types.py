@@ -140,6 +140,143 @@ def fold_volume_topology(pods: List[Pod]) -> List[Pod]:
     return out
 
 
+# -- gang scheduling (ISSUE 15) -------------------------------------------
+# A gang is a pod class annotated with gang-name/gang-size: placement is
+# ATOMIC (all members or none — partial placement of a tightly-coupled
+# MPI/multi-host-TPU job is worse than none) and, when an adjacency
+# domain is declared, rank-ADJACENT (every member lands in ONE domain).
+# The adjacency axes reuse the solver's existing domain machinery:
+# "slice" is the zone axis (a TPU multi-host slice), "rack" the
+# capacity-type axis (for catalogs that encode racks as capacity types),
+# "none" disables adjacency (pure atomicity).  The annotation being
+# OPTIONAL defaults to "slice" — rank adjacency is the point of gang
+# scheduling for multi-host accelerator workloads; a gang that does not
+# care says so explicitly.
+
+GANG_DOMAIN_VALUES = {
+    "slice": "zone-axis",
+    "rack": "capacity-type-axis",
+    "none": None,
+}
+
+
+@dataclass(frozen=True)
+class GangSpec:
+    """Parsed gang identity of one pod: the gang name, the declared
+    member count (0 = undeclared/malformed — "whatever is pending"),
+    and the adjacency domain label key (ZONE_LABEL, CAPACITY_TYPE_LABEL,
+    or None for no adjacency requirement)."""
+    name: str
+    size: int
+    domain_key: "str | None"
+
+
+def gang_of(pod: Pod) -> "GangSpec | None":
+    """The pod's gang spec, or None for ordinary pods (or when the
+    KARPENTER_TPU_GANG rollback knob is off — gang annotations are then
+    inert and members schedule independently).  Malformed sizes degrade
+    to 0 (no completeness requirement); unknown topology-domain values
+    degrade to "slice" — the conservative default keeps adjacency
+    rather than silently dropping it on a typo.  The parsed spec is
+    cached on the pod (keyed by the knob state, which tests flip):
+    grouping, encode, delta planning, and the oracle all call this per
+    pod per pass, and the annotation parse must not become an O(groups)
+    tax on the delta hot path."""
+    from karpenter_tpu.models import wellknown
+    from karpenter_tpu.utils.knobs import gang_enabled
+    enabled = gang_enabled()
+    cached = getattr(pod, "_gang_of_cache", None)
+    if cached is not None and cached[0] == enabled:
+        return cached[1]
+    if not enabled:
+        pod._gang_of_cache = (False, None)
+        return None
+    a = pod.meta.annotations
+    name = a.get(wellknown.GANG_NAME_ANNOTATION)
+    if not name:
+        pod._gang_of_cache = (True, None)
+        return None
+    raw_size = a.get(wellknown.GANG_SIZE_ANNOTATION)
+    try:
+        size = max(int(raw_size), 0) if raw_size is not None else 0
+    except (TypeError, ValueError):
+        size = 0
+    raw_dom = (a.get(wellknown.GANG_TOPOLOGY_ANNOTATION) or "slice")
+    dom = raw_dom.strip().lower()
+    if dom not in GANG_DOMAIN_VALUES:
+        dom = "slice"
+    if dom == "none":
+        key = None
+    elif dom == "rack":
+        key = wellknown.CAPACITY_TYPE_LABEL
+    else:
+        key = wellknown.ZONE_LABEL
+    sp = GangSpec(name=name, size=size, domain_key=key)
+    pod._gang_of_cache = (True, sp)
+    return sp
+
+
+def gang_placement_audit(inp, res) -> dict:
+    """Per-gang placement audit over a ScheduleResult — the ONE
+    implementation of the atomicity/adjacency invariant that the gang
+    test suite, the fuzz class, and the config9 acceptance bench all
+    assert (a private copy drifting in one of them would make the
+    bench gate and the test suite enforce different invariants).
+
+    Returns ``{gang_name: entry}`` where entry carries ``spec``,
+    ``total``/``placed`` member counts, ``stranded`` (names),
+    ``domains`` (the adjacency values the placed members landed in —
+    claim-pinned requirement values for new nodes, the node's own
+    label for existing assignments; ``None`` marks an unlabeled node),
+    and ``unpinned`` (placed members whose new-node claim is not
+    pinned to exactly one value of the gang's domain key).  The
+    invariant holds iff ``placed in (0, total)`` and, for placed
+    adjacency gangs, ``not unpinned and len(domains) == 1``."""
+    members: dict = {}
+    for p in inp.pods:
+        sp = gang_of(p)
+        if sp is not None:
+            members.setdefault(sp.name, (sp, []))[1].append(p)
+    claim_of = {p.meta.name: c for c in res.new_claims for p in c.pods}
+    node_labels = {en.name: en.node.labels for en in inp.existing_nodes}
+    out = {}
+    for gname, (sp, pods) in members.items():
+        stranded = [p.meta.name for p in pods
+                    if p.meta.name in res.unschedulable]
+        domains: set = set()
+        unpinned: list = []
+        if sp.domain_key is not None:
+            for p in pods:
+                if p.meta.name in res.unschedulable:
+                    continue
+                c = claim_of.get(p.meta.name)
+                if c is not None:
+                    req = c.requirements.get(sp.domain_key)
+                    if req is None or not req.is_finite() or \
+                            len(req.values()) != 1:
+                        unpinned.append(p.meta.name)
+                    else:
+                        domains |= req.values()
+                else:
+                    node = res.existing_assignments.get(p.meta.name)
+                    domains.add(
+                        node_labels.get(node, {}).get(sp.domain_key))
+        out[gname] = {"spec": sp, "total": len(pods),
+                      "placed": len(pods) - len(stranded),
+                      "stranded": stranded, "domains": domains,
+                      "unpinned": unpinned}
+    return out
+
+
+def gang_trial_order(domains) -> list:
+    """The SHARED deterministic order both engines try adjacency
+    domains in: lexicographic by domain name.  The kernel encodes it as
+    a per-domain rank (encode.py folds it into the gang group's dbase
+    row); the oracle walks candidate domains in exactly this order —
+    parity of the chosen domain depends on the two never drifting."""
+    return sorted(d for d in domains if d is not None)
+
+
 @dataclass
 class ExistingNode:
     """A live node as the scheduler sees it: identity + headroom + resident

@@ -122,6 +122,36 @@ def parse_weights(spec: Optional[str]) -> Dict[str, float]:
     return out
 
 
+def load_weights(environ=None) -> Dict[str, float]:
+    """Tenant weights from the CONFIG SURFACE (ROADMAP item 2 headroom):
+    the optional weights file named by
+    ``KARPENTER_TPU_TENANT_WEIGHTS_FILE`` — the operator-options /
+    deploy-config surface (the supervisor's ``--tenant-weights-file``
+    flag exports it to the worker) — overlaid by the
+    ``KARPENTER_TPU_TENANT_WEIGHTS`` env knob, which STAYS the
+    per-tenant override lever.  File grammar: the same ``tenant=weight``
+    entries, one or many per line (commas or newlines), ``#`` comments;
+    a missing or unreadable file degrades to the env knob alone, never
+    crashes the daemon."""
+    env = os.environ if environ is None else environ
+    out: Dict[str, float] = {}
+    path = env.get("KARPENTER_TPU_TENANT_WEIGHTS_FILE")
+    if path:
+        try:
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.split("#", 1)[0].strip()
+                    if line:
+                        out.update(parse_weights(line))
+        except (OSError, UnicodeDecodeError):
+            # "unreadable degrades, never crashes the daemon" covers a
+            # non-UTF-8 file (binary dropped by mistake) too — not an
+            # OSError subclass
+            pass
+    out.update(parse_weights(env.get("KARPENTER_TPU_TENANT_WEIGHTS")))
+    return out
+
+
 class Item:
     """One queued schedule request.  `key` is the opaque fusion-bucket
     key (hashable; the backend builds it from the catalog fingerprint,
@@ -215,7 +245,7 @@ class TenantScheduler:
         # fusing with fresh arrivals)
         self.batch_tiers = tuple(sorted(batch_tiers))
         self._weights = dict(weights) if weights is not None else \
-            parse_weights(env.get("KARPENTER_TPU_TENANT_WEIGHTS"))
+            load_weights(env)
         self._clock = clock
         # _lock guards queue/ledger state only — never held across a
         # dispatch; _dispatch_fn_lock elects the single dispatcher and

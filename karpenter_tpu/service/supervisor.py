@@ -388,11 +388,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--metrics-port", type=int, default=0,
                     help="serve /metrics (worker restart counter) on "
                          "this port; 0 = off")
+    ap.add_argument("--tenant-weights-file", default=None,
+                    help="tenant-weights config file exported to the "
+                         "worker as KARPENTER_TPU_TENANT_WEIGHTS_FILE "
+                         "(the env knob KARPENTER_TPU_TENANT_WEIGHTS "
+                         "stays the per-tenant override)")
     ap.add_argument("worker_args", nargs="*",
                     help="extra kt_solverd args (after --)")
     args = ap.parse_args(argv)
+    env = dict(os.environ)
+    if args.tenant_weights_file:
+        # export-only, never parsed here: the worker's scheduler.py
+        # (the knob's grammar owner) reads and parses the file
+        env["KARPENTER_TPU_TENANT_WEIGHTS_FILE"] = (  # kt-lint: disable=env-knob
+            args.tenant_weights_file)
     sup = SolverdSupervisor(
         args.socket, binary=args.binary, extra_args=args.worker_args,
+        env=env,
         stderr_path=args.stderr, backoff_base=args.backoff_base,
         backoff_max=args.backoff_max, probe_interval=args.probe_interval,
         probe_timeout=args.probe_timeout)

@@ -48,7 +48,7 @@ import numpy as np
 
 from karpenter_tpu.models import wellknown
 from karpenter_tpu.models.resources import RESOURCE_AXIS
-from karpenter_tpu.scheduling.types import effective_request
+from karpenter_tpu.scheduling.types import effective_request, gang_of
 from karpenter_tpu.solver.ffd import EPS
 from karpenter_tpu.solver.encode import (
     BIG,
@@ -117,6 +117,11 @@ class DeltaRecord:
     # the per-class opener feasibility rows
     exist_tables: Optional[tuple] = None
     feas_cache: Dict[int, np.ndarray] = field(default_factory=dict)
+    # lazy member-name → group-row index (ISSUE 15 satellite): maps a
+    # dirty pod name to the ONE record row it can invalidate, so plan()
+    # resolves a small dirty set in O(churn) dict probes instead of the
+    # O(cluster × members) per-name scans the prefix walk used to pay
+    name_rows: Optional[Dict[str, int]] = None
 
     @property
     def n_groups(self) -> int:
@@ -276,6 +281,16 @@ def plan(rec: Optional[DeltaRecord], inp, groups, dirty,
     SolveCache.dirty_snapshot() — taken once per pass so put() can
     retire exactly what this diff observed.  Returns a DeltaPlan, or a
     fallback-reason string (every string return is counted)."""
+    gang_specs = [gang_of(g[0]) for g in groups]
+    if any(sp is not None and sp.domain_key is not None
+           for sp in gang_specs):
+        # adjacency gangs pin their nodes to a domain; the seeded merge
+        # rebuilds node_zone/ct from the suffix solve alone (always -1
+        # on the topology-free delta path), so the pins would be lost —
+        # and make_record rejects dsel>0 anyway, so no base ever forms.
+        # Checked FIRST so the counted reason names the real cause
+        # instead of an eternal "cold".
+        return "gang"
     if rec is None:
         return "cold"
     dirty_pods, dirty_nodes, all_dirty, _gen = dirty
@@ -297,6 +312,23 @@ def plan(rec: Optional[DeltaRecord], inp, groups, dirty,
     if not _nodes_unchanged(rec, inp.existing_nodes, dirty_nodes):
         return "nodes"
 
+    # dirty-set short-circuit (ISSUE 15 satellite): resolve the dirty
+    # names to record ROWS once via the lazily-built name index —
+    # O(churn) dict probes.  A dirty name the record never saw needs no
+    # row: its group (new/renamed member) fails _same_group on its own.
+    # This replaces the per-group any(n in dirty_pods) scans that made
+    # even a single-dirty-pod pass O(cluster × members).
+    dirty_rows: "frozenset | set" = frozenset()
+    if dirty_pods:
+        idx = rec.name_rows
+        if idx is None:
+            idx = {}
+            for i, (_gid, names) in enumerate(rec.gkeys):
+                for n in names:
+                    idx[n] = i
+            rec.name_rows = idx
+        dirty_rows = {idx[n] for n in dirty_pods if n in idx}
+
     prev_groups, prev_keys = rec.groups, rec.gkeys
     m = 0
     limit = min(len(groups), rec.n_groups)
@@ -305,12 +337,19 @@ def plan(rec: Optional[DeltaRecord], inp, groups, dirty,
         g = groups[m]
         if g[0].scheduling_group_id() != gid:
             break
-        if dirty_pods and any(n in dirty_pods for n in names):
+        if m in dirty_rows:
             break
         if not _same_group(g, prev_groups[m], names):
             break
         m += 1
     suffix = groups[m:]
+    if any(gang_specs[m + j] is not None
+           for j in range(len(suffix))):
+        # a gang in the suffix — a dirty gang member, or any gang
+        # behind the first changed group: the seeded kernel runs
+        # with_gang=0 by contract, so the whole gang's prefix reuse is
+        # invalidated and the pass falls back whole (counted)
+        return "gang"
     if suffix and (bucket(len(suffix), g_buckets)
                    >= bucket(len(groups), g_buckets)):
         # the restricted slab would pad to the full problem's bucket —
@@ -323,7 +362,7 @@ def plan(rec: Optional[DeltaRecord], inp, groups, dirty,
         i = prev_by_gid.get(g[0].scheduling_group_id())
         if i is not None:
             _, names = prev_keys[i]
-            if (not (dirty_pods and any(n in dirty_pods for n in names))
+            if (i not in dirty_rows
                     and _same_group(g, prev_groups[i], names)):
                 reuse.append(i)
                 continue
@@ -613,6 +652,11 @@ def merge(plan_: DeltaPlan, sp: SuffixProblem, cat, inp,
                        np.zeros((Gd, D), dtype=bool)),
         group_whole_node=cc(enc_p.group_whole_node[:m],
                             np.zeros(Gd, dtype=bool)),
+        # gang rows stitch like every other group tensor; plan()
+        # guarantees the SUFFIX is gang-free (counted "gang" fallback
+        # otherwise), so the suffix side is always zeros — prefix gangs
+        # (domain-free, fully placed at record time) reuse bit-exactly
+        group_gang=cc(enc_p.group_gang[:m], np.zeros(Gd, dtype=bool)),
         col_zone=cat.col_zone,
         col_ct=cat.col_ct,
         exist_zone=enc_p.exist_zone,

@@ -35,6 +35,8 @@ from karpenter_tpu.scheduling.types import (
     ExistingNode,
     ScheduleInput,
     effective_request,
+    gang_of,
+    gang_trial_order,
 )
 
 R = len(RESOURCE_AXIS)
@@ -90,6 +92,12 @@ class EncodedProblem:
     # capacity; the post-solve whole-node repair (solve.py) strands the
     # group atomically if the dynamic fill still split it
     group_whole_node: np.ndarray = None
+    # [G] bool — gang unit (ISSUE 15): atomic K-node, single-adjacency-
+    # domain placement.  For gang groups, group_dsel names the adjacency
+    # axis (1 zone/slice, 2 capacity-type/rack, 0 none) and group_dbase
+    # carries the lexicographic domain trial RANK (gang_trial_order),
+    # not spread base counts; skew/mindom/dcap stay inert.
+    group_gang: np.ndarray = None
     col_zone: np.ndarray = None      # [O] i32
     col_ct: np.ndarray = None        # [O] i32
     exist_zone: np.ndarray = None    # [E] i32
@@ -845,6 +853,30 @@ class _TopologyEncoder:
                 [self.ct_ids.get(en.node.labels.get(wellknown.CAPACITY_TYPE_LABEL), -1)
                  for en in self.existing], dtype=np.int32).reshape(len(self.existing))
         self.group_labels = [g[0].meta.labels for g in groups]
+        # gang units (ISSUE 15): per-group gang specs + the gang-name →
+        # group-index map for the heterogeneous-gang check (two pod
+        # classes sharing one gang name would break gang-level
+        # atomicity in the per-group kernel — the oracle handles them)
+        self.gangs = {}
+        self._gang_groups: Dict[str, list] = {}
+        for i, g in enumerate(groups):
+            sp = gang_of(g[0])
+            if sp is not None:
+                self.gangs[i] = sp
+                self._gang_groups.setdefault(sp.name, []).append(i)
+        # gang names with members already BOUND on live nodes: their
+        # pending remainder is a RESIDUAL placement (a recreated member
+        # of a running gang) — completeness counts the bound members
+        # and the ranks must join their domain, which the per-group
+        # kernel unit can't express; _encode_gang routes these to the
+        # oracle.  Only scanned when the problem has gangs at all.
+        self._bound_gangs: set = set()
+        if self.gangs:
+            for en in self.existing:
+                for p in en.pods:
+                    bsp = gang_of(p)
+                    if bsp is not None:
+                        self._bound_gangs.add(bsp.name)
         self.D = max(len(self.zone_ids), len(self.ct_ids), 1)
         self._sel_cache: Dict[tuple, set] = {}
         # pending groups' required anti terms (for the symmetry coupling check)
@@ -911,7 +943,61 @@ class _TopologyEncoder:
                 gmin = 0
         return gmin
 
+    def _encode_gang(self, gi: int, rep: Pod, spec) -> dict:
+        """Gang-unit tensors (ISSUE 15): dsel names the adjacency axis,
+        dbase the lexicographic domain trial rank (the SAME order the
+        oracle's trial loop walks — scheduling.types.gang_trial_order),
+        delig the domains the gang may try.  Everything else stays the
+        inactive-encoder constants: the kernel's gang branch owns all
+        fill-time restriction, so no static mask narrowing happens
+        here.  Shapes the tensor encoding can't express atomically —
+        gangs combined with other topology constraints, soft terms, or
+        a gang spanning several pod classes — raise Unsupported and the
+        gang rides the residue to the (gang-aware) oracle."""
+        if rep.topology_spread or rep.pod_affinities or rep.preferences:
+            raise Unsupported(
+                "gang combined with topology/soft constraints")
+        if len(self._gang_groups.get(spec.name, ())) > 1:
+            raise Unsupported("gang spans multiple pod classes")
+        if spec.name in self._bound_gangs:
+            raise Unsupported("gang has bound members")
+        E = len(self.existing)
+        out = dict(
+            ncap=BIG, ecap=np.full(E, BIG, dtype=np.int32), dsel=0,
+            dbase=np.zeros(self.D, dtype=np.int32),
+            dcap=np.full(self.D, BIG, dtype=np.int32), skew=BIG,
+            mindom=0, delig=np.zeros(self.D, dtype=bool),
+            allowed={k: None for k in _DOM_KEYS},
+            requires={k: False for k in _DOM_KEYS},
+            whole_node=False, gang=True)
+        if spec.domain_key is None:
+            # domain-free gang: one global trial domain (the kernel
+            # maps every column/node to domain 0 when dsel == 0)
+            out["delig"][0] = True
+            return out
+        if self.dense_layout:
+            # the gang branch reads a column's domain from its grid
+            # slot (ffd zc_dom), same invariant as dynamic spread
+            raise Unsupported("gang adjacency on a dense catalog layout")
+        out["dsel"] = 1 if spec.domain_key == wellknown.ZONE_LABEL else 2
+        ids = self._dom_ids(spec.domain_key)
+        req = rep.requirements.get(spec.domain_key)
+        for pos, d in enumerate(gang_trial_order(ids)):
+            i = ids[d]
+            out["dbase"][i] = pos
+            if req is None or req.matches(d):
+                out["delig"][i] = True
+        # no eligible domain ⇒ the kernel strands the gang whole
+        # (GangDomainExhausted) — exactly the oracle's empty-trial-list
+        # verdict, so no Unsupported here
+        return out
+
     def encode_group(self, gi: int, rep: Pod) -> dict:
+        spec = self.gangs.get(gi)
+        if spec is not None:
+            # gangs bypass the inactive-encoder fast path: their domain
+            # tensors are needed even when no spread/affinity is active
+            return self._encode_gang(gi, rep, spec)
         E = len(self.existing)
         if not self.active:
             return dict(
@@ -1243,6 +1329,7 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None,
     group_mindom = np.zeros(G, dtype=np.int32)
     group_delig = np.zeros((G, D), dtype=bool)
     group_whole_node = np.zeros(G, dtype=bool)
+    group_gang = np.zeros(G, dtype=bool)
     static_allowed: List[Dict[str, Optional[set]]] = []
     merged_reqs: List[List[Optional[Requirements]]] = []
 
@@ -1288,6 +1375,7 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None,
         group_mindom[gi] = t["mindom"]
         group_delig[gi] = t["delig"]
         group_whole_node[gi] = t["whole_node"]
+        group_gang[gi] = t.get("gang", False)
 
         gmask, merged_per_pool = group_column_mask(cat, rep)
         # static topology domain restrictions → column mask
@@ -1301,6 +1389,17 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None,
             gmask = gmask & (_np_fit_count(
                 cat.col_alloc - cat.col_daemon,
                 group_req[gi]) >= len(g))
+        gang_incomplete = False
+        if t.get("gang"):
+            sp = topo.gangs[gi]
+            if sp.size and len(g) != sp.size:
+                # incomplete (or over-declared) gang: placement waits
+                # for exactly the declared membership — zero the column
+                # mask and the exist rows so the kernel strands the
+                # gang WHOLE (decode emits GangIncomplete).  The oracle
+                # applies the identical verdict, so parity holds.
+                gmask = np.zeros_like(gmask)
+                gang_incomplete = True
         static_allowed.append(t["allowed"])
         group_mask[gi] = gmask
         merged_reqs.append(merged_per_pool)
@@ -1327,6 +1426,8 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None,
                 cap_row = np.where(
                     _np_fit_count(exist_avail(), group_req[gi]) >= len(g),
                     cap_row, 0)
+            if gang_incomplete:
+                cap_row = np.zeros_like(cap_row)
             exist_cap[gi] = cap_row
 
     if dropped:
@@ -1344,6 +1445,7 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None,
         group_mindom = group_mindom[keep]
         group_delig = group_delig[keep]
         group_whole_node = group_whole_node[keep]
+        group_gang = group_gang[keep]
         groups = [g for gi, g in enumerate(groups) if keep[gi]]
         # static_allowed / merged_reqs were only appended for kept groups
 
@@ -1381,6 +1483,7 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None,
         group_mindom=group_mindom,
         group_delig=group_delig,
         group_whole_node=group_whole_node,
+        group_gang=group_gang,
         col_zone=cat.col_zone,
         col_ct=cat.col_ct,
         exist_zone=topo.exist_zone,

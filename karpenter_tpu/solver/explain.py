@@ -59,7 +59,13 @@ from typing import Dict, List, Optional
 # bitset's bit i is KERNEL_CONSTRAINTS[i].
 HOST_CONSTRAINTS = ("compat", "price")
 KERNEL_CONSTRAINTS = ("fit", "limit", "topology", "whole_node", "slots")
-CONSTRAINTS = HOST_CONSTRAINTS + KERNEL_CONSTRAINTS
+# "gang" classifies the atomic multi-node verdicts (ISSUE 15).  It is
+# NOT a kernel aux class: the kernel attributes a gang's atomic failure
+# to the existing whole_node class (the gang fill IS the whole-node
+# fill's K-node generalization), keeping the aux row width — and every
+# recorded delta prefix — stable; the gang-specific discrimination
+# lives in the reason CODES below and their per-gang trees.
+CONSTRAINTS = HOST_CONSTRAINTS + KERNEL_CONSTRAINTS + ("gang",)
 
 _CONSTRAINT_HELP = {
     "compat": "label/taint/requirement incompatibility (host encode mask)",
@@ -69,6 +75,7 @@ _CONSTRAINT_HELP = {
     "topology": "the column's domain is ineligible or at its skew ceiling",
     "whole_node": "no single node could hold the whole co-located group",
     "slots": "the solver's node-slot axis was exhausted",
+    "gang": "the gang's all-or-nothing, single-domain placement failed",
 }
 
 
@@ -116,6 +123,31 @@ MIN_VALUES = _register(
 POOL_LIMIT = _register(
     "PoolLimitExceeded", "limit",
     "a binding nodepool limit blocked the placement (oracle authority)")
+# gang scheduling verdicts (ISSUE 15): emitted by BOTH engines — the
+# kernel's _unsched_reason (solver/solve.py) and the oracle's atomic
+# gang pre-pass (scheduling/oracle.py) — always for the WHOLE gang
+# (atomicity: one member's verdict is every member's verdict)
+GANG_PARTIAL = _register(
+    "GangPartiallyPlaceable", "gang",
+    "the best adjacency domain can hold some but not all gang members "
+    "— the gang strands whole rather than split (tree carries the "
+    "nearest domain and the deficit)")
+GANG_DOMAIN = _register(
+    "GangDomainExhausted", "gang",
+    "no adjacency domain can currently hold any gang member — every "
+    "eligible domain is out of capacity or ineligible")
+GANG_TOO_LARGE = _register(
+    "GangTooLarge", "gang",
+    "the gang's member count exceeds what any single adjacency domain "
+    "could hold even on an empty fleet at the solver's node ceiling")
+GANG_INCOMPLETE = _register(
+    "GangIncomplete", "gang",
+    "the pending member count (plus members already bound on live "
+    "nodes) does not match the gang-size annotation (fewer: placement "
+    "waits for the full gang; more: fix gang-size — an over-full gang "
+    "never self-heals by waiting)")
+GANG_CODES = frozenset((GANG_PARTIAL, GANG_DOMAIN, GANG_TOO_LARGE,
+                        GANG_INCOMPLETE))
 LEGACY = "Legacy"  # unregistered plain-string reason (should not occur)
 
 # -- disruption decision vocabulary (ISSUE 14): the controllers'
@@ -177,7 +209,7 @@ NODEPOOL_DRIFT = _register(
 # these — an unknown reason is a registry violation, not a new string
 DELTA_FALLBACK_REASONS = frozenset((
     "cold", "nodes", "price-cap", "limits", "small", "topology",
-    "bucket", "seed", "slots", "stranded", "shape"))
+    "bucket", "seed", "slots", "stranded", "shape", "gang"))
 
 # tenant-scheduler shed vocabulary (service/scheduler.py)
 SHED_ADMISSION = "admission"

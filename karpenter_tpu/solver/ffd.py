@@ -233,6 +233,14 @@ def _solve_ffd_impl(
                                   # columns/rows to whole-group fits, but
                                   # fill-time capacity is dynamic — a
                                   # partial take would split the group)
+    group_gang: jnp.ndarray,      # [G] bool — gang unit (ISSUE 15): the
+                                  # group is an atomic K-NODE gang — it
+                                  # commits only when every member fits
+                                  # in ONE adjacency domain (dsel names
+                                  # the axis; dbase carries the domain
+                                  # trial RANK, not spread base counts);
+                                  # otherwise nothing is placed.  Dead
+                                  # unless the with_gang static is set.
     col_zone: jnp.ndarray,        # [O] i32
     col_ct: jnp.ndarray,          # [O] i32
     exist_zone: jnp.ndarray,      # [E] i32
@@ -315,6 +323,16 @@ def _solve_ffd_impl(
                                   # no replicated form).  Under a mesh,
                                   # counts combine via one psum over the
                                   # column shards.
+    with_gang: int = 0,           # static: 0 skips TRACING the gang
+                                  # branch entirely — gang-free problems
+                                  # (every existing workload) lower to
+                                  # the exact pre-gang program, so bit
+                                  # parity with the pre-gang kernel is
+                                  # by construction, and the sweep /
+                                  # delta lanes never pay the branch's
+                                  # compile time.  1 arms the atomic
+                                  # K-node gang fill for groups with
+                                  # group_gang set.
 ):
     G, RDIM = group_req.shape
     E = exist_remaining.shape[0]
@@ -325,7 +343,7 @@ def _solve_ffd_impl(
                 with_topology=with_topology, sparse_k=sparse_k,
                 sparse_n=sparse_n, mask_packed=mask_packed,
                 axis_name=axis_name, seeded=seed_used is not None,
-                explain=explain)
+                explain=explain, with_gang=with_gang)
     if explain >= 2:
         # the [G, O] class map is column-sharded under a mesh and the
         # shard_map out-spec is replicated — counts-only there
@@ -413,7 +431,7 @@ def _solve_ffd_impl(
 
     def step(carry, xs):
         (req, cnt, gmask, ecap, ncap, dsel,
-         dbase, dcap, skew, mindom, delig, whole) = xs
+         dbase, dcap, skew, mindom, delig, whole, gang) = xs
 
         def light(carry):
             exist_rem = carry["exist_rem"]
@@ -775,13 +793,239 @@ def _solve_ffd_impl(
                        dom_placed=dom_placed)
             return out_carry, out
 
+        def gang_fill(carry):
+            # -- atomic K-node gang fill (ISSUE 15) ---------------------
+            # The whole-node all-or-nothing fill generalized to MANY
+            # nodes in ONE adjacency domain.  For every domain this
+            # computes the EXACT candidate fill — the light cascade
+            # (existing → in-flight → open-new) restricted to that
+            # domain's columns/nodes against an independent copy of the
+            # pool budget (sound: at most one domain commits) — then
+            # commits the feasible domain of minimal trial RANK (dbase
+            # carries the encoder's lexicographic domain order, the
+            # same order the oracle's trial loop walks) and discards
+            # every other candidate.  "Bit-exact rollback" is
+            # structural: a non-winning (or infeasible-everywhere)
+            # candidate fill is never applied to the carry at all.
+            # dsel names the adjacency axis (1 zone/slice, 2
+            # capacity-type/rack); a domain-free gang (dsel=0) maps
+            # every column/node to domain 0 and the machinery
+            # degenerates to a single global trial.
+            exist_rem = carry["exist_rem"]
+            used = carry["used"]
+            colmask = carry["colmask"]
+            active = carry["active"]
+            node_pool = carry["node_pool"]
+            node_zone = carry["node_zone"]
+            node_ct = carry["node_ct"]
+            num_active = carry["num_active"]
+            limits = carry["limits"]
+
+            col_dom = jnp.where(
+                dsel == 1, col_zone,
+                jnp.where(dsel == 2, col_ct, jnp.zeros_like(col_zone)))
+            dom_cols = col_dom[None, :] == dom_ids[:, None]    # [D, O]
+            if E:
+                ex_dom = jnp.where(
+                    dsel == 1, exist_zone,
+                    jnp.where(dsel == 2, exist_ct,
+                              jnp.zeros_like(exist_zone)))
+                dom_ex = ex_dom[None, :] == dom_ids[:, None]   # [D, E]
+
+            # -- 1. existing-node candidate fills per domain ------------
+            want0 = jnp.full((D,), cnt, jnp.int32)
+            if E:
+                cap_e = jnp.minimum(_fit_count(exist_rem, req), ecap)
+                cap_ed = jnp.where(dom_ex, cap_e[None, :], 0)  # [D, E]
+                take_ed = jax.vmap(_prefix_fill)(cap_ed, want0)
+                rem1 = cnt - take_ed.sum(-1)                   # [D]
+            else:
+                take_ed = jnp.zeros((D, 0), jnp.int32)
+                rem1 = want0
+
+            # -- 2. in-flight candidate fills per domain ----------------
+            # pt-granular fit + the zc-slot domain combine, exactly the
+            # heavy branch's discipline; a node already pinned to some
+            # domain is excluded from the others automatically (its
+            # colmask was narrowed to its domain's columns)
+            cap_npt = _fit_count(
+                pt_alloc[None, :, :] - used[:, None, :], req)  # [N, PT]
+            cap_no = jnp.where(colmask & gmask[None, :],
+                               pt_expand(cap_npt), 0)          # [N, O]
+            zc_dom_g = col_dom[:zc]                            # [ZC]
+            if axis_name is not None:
+                # shard 0 owns the global leading block (the heavy
+                # branch's zc_dom rule — a pure-padding shard must see
+                # the global slot→domain map)
+                zc_dom_g = jax.lax.all_gather(zc_dom_g, axis_name)[0]
+            slotmax = _axmax(cap_no.reshape(-1, PT, zc), axis_name,
+                             axis=1)                           # [N, ZC]
+            cap_nd = jnp.where(
+                zc_dom_g[None, :, None] == dom_ids[None, None, :],
+                slotmax[:, :, None], 0).max(axis=1).T          # [D, N]
+            cap_nd = jnp.minimum(cap_nd, ncap)
+            cap_nd = jnp.where(active[None, :], cap_nd, 0)
+            cap_nd = jax.vmap(
+                lambda c: _clamp_pool_limits(c, node_pool, limits,
+                                             req))(cap_nd)
+            take_nd = jax.vmap(_prefix_fill)(cap_nd, rem1)     # [D, N]
+            rem2 = rem1 - take_nd.sum(-1)                      # [D]
+
+            # -- 3. open-new candidate cascade per domain ---------------
+            per_col = jnp.minimum(
+                _fit_count(col_alloc - col_daemon, req), ncap)
+            col_feas = gmask & (per_col >= 1)
+            kfull_pd = jnp.stack([
+                jnp.where(dom_cols & (col_feas
+                                      & (col_pool == p))[None, :],
+                          per_col[None, :], 0).max(-1)
+                for p in range(P)])                            # [P, D]
+            if axis_name is not None:
+                # one all-reduce for the whole winner table (heavy rule)
+                kfull_pd = jax.lax.pmax(kfull_pd, axis_name)
+            # independent per-domain budget copies, pre-charged with the
+            # domain's own in-flight take (the commit charges in that
+            # order too)
+            limits_d = jnp.broadcast_to(limits[None], (D, P, RDIM))
+            pool_take_d = jax.vmap(lambda t: jax.ops.segment_sum(
+                t.astype(jnp.float32), node_pool,
+                num_segments=P))(take_nd)                      # [D, P]
+            limits_d = (limits_d
+                        - pool_take_d[:, :, None] * req[None, None, :])
+            c_rem_d = rem2
+            k_new_d = jnp.zeros((D, N), jnp.int32)
+            new_pool_d = jnp.zeros((D, N), jnp.int32)
+            newmask_d = jnp.zeros((D, N), bool)
+            na_d = jnp.zeros((D,), jnp.int32) + num_active
+            for p in range(P):
+                kf_raw = kfull_pd[p]                           # [D]
+                lim_p = limits_d[:, p]                         # [D, R]
+                pool_room = jnp.all(
+                    lim_p - pool_daemon[p][None, :] - req[None, :]
+                    >= -EPS, axis=-1)                          # [D]
+                can = pool_room & (c_rem_d > 0) & (kf_raw > 0)
+                kf = jnp.maximum(kf_raw, 1)
+                # budget-exact node count, the light branch's two-pass
+                # discipline: affordable pods first, then the per-node
+                # daemon charge for the implied node count
+                t = jnp.minimum(c_rem_d, _fit_count(lim_p, req))
+                m_t = -(-t // kf)
+                t = jnp.minimum(t, _fit_count(
+                    lim_p - m_t[:, None].astype(jnp.float32)
+                    * pool_daemon[p][None, :], req))
+                m_need = jnp.where(can, -(-t // kf), 0)
+                m = jnp.minimum(m_need, N - na_d)
+                newmask = ((idx[None, :] >= na_d[:, None])
+                           & (idx[None, :] < (na_d + m)[:, None]))
+                pos = idx[None, :] - na_d[:, None]
+                taken = jnp.minimum(t, m * kf_raw)
+                k_node = jnp.where(
+                    newmask,
+                    jnp.where(pos == (m - 1)[:, None],
+                              (taken - (m - 1) * kf_raw)[:, None],
+                              kf_raw[:, None]),
+                    0)
+                k_new_d = k_new_d + k_node
+                new_pool_d = jnp.where(newmask, jnp.int32(p),
+                                       new_pool_d)
+                newmask_d = newmask_d | newmask
+                na_d = na_d + m
+                limits_d = limits_d.at[:, p].add(
+                    -(m[:, None].astype(jnp.float32)
+                      * pool_daemon[p][None, :]
+                      + taken[:, None].astype(jnp.float32)
+                      * req[None, :]))
+                c_rem_d = c_rem_d - taken
+            placed_d = ((take_ed.sum(-1) if E else 0)
+                        + take_nd.sum(-1) + (rem2 - c_rem_d))  # [D]
+
+            # -- winner: feasible domain of minimal trial rank ----------
+            feas = delig & (placed_d >= cnt)
+            rank = jnp.where(feas, dbase, jnp.int32(_BIG))
+            w = jnp.argmin(rank).astype(jnp.int32)
+            ok = (rank[w] < _BIG) & (cnt > 0)
+
+            # -- commit the winner (everything else is never applied) ---
+            if E:
+                take_e = jnp.where(ok, take_ed[w],
+                                   jnp.zeros_like(cap_e))
+                exist_rem = exist_rem - take_e[:, None] * req
+            else:
+                take_e = jnp.zeros((0,), jnp.int32)
+            take_n = jnp.where(ok, take_nd[w], 0)              # [N]
+            used = used + take_n[:, None] * req
+            touched = take_n > 0
+            dcols = slot_expand((zc_dom_g == w)[None, :])      # [1, O]
+            colmask = jnp.where(touched[:, None],
+                                colmask & gmask[None, :] & dcols,
+                                colmask)
+            ok_pt = jnp.all(
+                pt_alloc[None, :, :] - used[:, None, :] >= -EPS,
+                axis=-1)
+            colmask = colmask & pt_expand(ok_pt)
+            pool_take = jax.ops.segment_sum(
+                take_n.astype(jnp.float32), node_pool, num_segments=P)
+            limits = limits - pool_take[:, None] * req
+
+            k_new = jnp.where(ok, k_new_d[w], 0)               # [N]
+            newmask = jnp.where(ok, newmask_d[w], False)
+            new_pool = new_pool_d[w]                           # [N]
+            new_used = (pool_daemon[new_pool]
+                        + k_new[:, None].astype(jnp.float32) * req)
+            used = jnp.where(newmask[:, None], new_used, used)
+            new_cols = (col_feas[None, :]
+                        & (col_pool[None, :] == new_pool[:, None])
+                        & dcols)
+            new_ok_pt = jnp.all(
+                pt_alloc[None, :, :] - new_used[:, None, :] >= -EPS,
+                axis=-1)
+            new_colmask = new_cols & pt_expand(new_ok_pt)
+            colmask = jnp.where(newmask[:, None], new_colmask, colmask)
+            active_ = active | newmask
+            node_pool_ = jnp.where(newmask, new_pool, node_pool)
+            num_active_ = num_active + newmask.astype(jnp.int32).sum()
+            for p in range(P):
+                on_p = newmask & (new_pool == p)
+                m_p = on_p.astype(jnp.float32).sum()
+                taken_p = jnp.where(on_p, k_new,
+                                    0).astype(jnp.float32).sum()
+                limits = limits.at[p].add(
+                    -(m_p * pool_daemon[p] + taken_p * req))
+            # pin every node the gang touched to the winning domain so
+            # decode narrows the claims (rank adjacency must survive
+            # launch) — exactly the heavy branch's pinning discipline
+            node_zone = jnp.where((touched | newmask) & (dsel == 1),
+                                  w, node_zone)
+            node_ct = jnp.where((touched | newmask) & (dsel == 2),
+                                w, node_ct)
+
+            out_carry = dict(exist_rem=exist_rem, used=used,
+                             colmask=colmask, active=active_,
+                             node_pool=node_pool_, node_zone=node_zone,
+                             node_ct=node_ct, num_active=num_active_,
+                             limits=limits)
+            # dom_placed carries the per-domain CANDIDATE totals (what
+            # each domain could have held, saturated at the gang size)
+            # — the explain tree's nearest-domain/deficit answer
+            out = dict(take_exist=take_e, take_new=take_n + k_new,
+                       unsched=jnp.where(ok, 0, cnt),
+                       dom_placed=jnp.minimum(placed_d,
+                                              cnt).astype(jnp.int32))
+            return out_carry, out
+
+        if with_gang:
+            def nongang(c):
+                if not with_topology:
+                    return light(c)
+                return jax.lax.cond(dsel > 0, heavy, light, c)
+            return jax.lax.cond(gang, gang_fill, nongang, carry)
         if not with_topology:
             return light(carry)
         return jax.lax.cond(dsel > 0, heavy, light, carry)
 
     xs = (group_req, group_count, group_mask, exist_cap, group_ncap,
           group_dsel, group_dbase, group_dcap, group_skew, group_mindom,
-          group_delig, group_whole)
+          group_delig, group_whole, group_gang)
     final, outs = jax.lax.scan(step, init, xs)
     # Results are packed into ONE flat f32 buffer: each host pull pays a
     # full round trip on the device link, so small arrays cost one RTT each
@@ -901,9 +1145,23 @@ def _solve_ffd_impl(
         # (whole + dynamic spread is Unsupported at encode, so topology
         # never overlaps)
         stranded = outs["unsched"] > 0
-        elim_whole = jnp.where(
-            group_whole & stranded,
-            jnp.where(ok_pt, cols_per_block, 0).sum(-1), 0)
+        if with_gang:
+            # gang strands attribute to the SAME whole_node class (the
+            # gang fill is the whole-node fill's K-node generalization)
+            # but a gang carries dsel>0, so the topology class CAN
+            # overlap here — keep the partition by excluding columns
+            # topology already claimed (the map's precedence)
+            whole_like = group_whole | group_gang
+            topo_sel = ((group_dsel > 0)[:, None, None]
+                        & slot_blocked[:, None, :])
+            whole_cols = jnp.where(
+                ok_pt[:, :, None] & ~topo_sel,
+                gmask_pt.astype(jnp.int32), 0).sum((1, 2))
+            elim_whole = jnp.where(whole_like & stranded, whole_cols, 0)
+        else:
+            elim_whole = jnp.where(
+                group_whole & stranded,
+                jnp.where(ok_pt, cols_per_block, 0).sum(-1), 0)
         local = jnp.stack(
             [elim_fit, elim_limit, elim_topo, elim_whole],
             axis=1).astype(jnp.int32)                           # [G, 4]
@@ -938,8 +1196,10 @@ def _solve_ffd_impl(
             cls_map = jnp.where(
                 group_mask & (group_dsel > 0)[:, None] & col_blocked
                 & (cls_map == 0), 3, cls_map)
+            whole_map = (((group_whole | group_gang) if with_gang
+                          else group_whole) & stranded)
             cls_map = jnp.where(
-                group_mask & (group_whole & stranded)[:, None]
+                group_mask & whole_map[:, None]
                 & (cls_map == 0), 4, cls_map)
             aux.append(cls_map.astype(jnp.float32).reshape(-1))  # G*O
     packed = jnp.concatenate(head + mid + [
@@ -956,7 +1216,7 @@ def _solve_ffd_impl(
 
 solve_ffd = partial(jax.jit, static_argnames=(
     "max_nodes", "zc", "with_topology", "sparse_k", "sparse_n",
-    "mask_packed", "explain"))(_solve_ffd_impl)
+    "mask_packed", "explain", "with_gang"))(_solve_ffd_impl)
 
 
 def pack_problem(prob):
@@ -1014,27 +1274,28 @@ def _solve_ffd_coalesced_impl(buf, col_alloc, col_daemon, pt_alloc,
                               zc: int = 1, with_topology: bool = True,
                               sparse_k: int = 0, sparse_n: int = 0,
                               mask_packed: bool = False,
-                              explain: int = 0):
+                              explain: int = 0, with_gang: int = 0):
     """solve_ffd fed from one coalesced problem buffer (see
     pack_problem).  Catalog args stay separate — they are
     device-resident across solves and never travel."""
     (group_req, group_count, group_mask, exist_cap, exist_remaining,
      pool_limit, group_ncap, group_dsel, group_dbase, group_dcap,
-     group_skew, group_mindom, group_delig, group_whole,
+     group_skew, group_mindom, group_delig, group_whole, group_gang,
      exist_zone, exist_ct) = _unpack_problem(buf, layout)
     return _solve_ffd_impl(
         group_req, group_count, group_mask, exist_cap, exist_remaining,
         col_alloc, col_daemon, pt_alloc, col_pool, pool_daemon,
         pool_limit, group_ncap, group_dsel, group_dbase, group_dcap,
-        group_skew, group_mindom, group_delig, group_whole,
+        group_skew, group_mindom, group_delig, group_whole, group_gang,
         col_zone, col_ct, exist_zone, exist_ct,
         max_nodes=max_nodes, zc=zc, with_topology=with_topology,
         sparse_k=sparse_k, sparse_n=sparse_n, mask_packed=mask_packed,
-        explain=explain)
+        explain=explain, with_gang=with_gang)
 
 
 _COALESCED_STATICS = ("layout", "max_nodes", "zc", "with_topology",
-                      "sparse_k", "sparse_n", "mask_packed", "explain")
+                      "sparse_k", "sparse_n", "mask_packed", "explain",
+                      "with_gang")
 solve_ffd_coalesced = partial(
     jax.jit, static_argnames=_COALESCED_STATICS)(_solve_ffd_coalesced_impl)
 # The pipelined executor's variant: the problem buffer (arg 0) is DONATED
@@ -1051,7 +1312,8 @@ def _solve_ffd_resident_impl(buf, mask_table, col_alloc, col_daemon,
                              pt_alloc, col_pool, pool_daemon, col_zone,
                              col_ct, layout=None, max_nodes: int = 1024,
                              zc: int = 1, sparse_n: int = 0,
-                             axis_name=None, explain: int = 0):
+                             axis_name=None, explain: int = 0,
+                             with_gang: int = 0):
     """The mesh executor's kernel body (parallel/mesh.py wraps this in
     `shard_map` + jit): one coalesced REPLICATED problem buffer, the
     device-RESIDENT sharded catalog args, and a device-resident sharded
@@ -1062,23 +1324,24 @@ def _solve_ffd_resident_impl(buf, mask_table, col_alloc, col_daemon,
     The row gather runs on each device's local [C, O/devices] shard."""
     (group_req, group_count, group_rows, exist_cap, exist_remaining,
      pool_limit, group_ncap, group_dsel, group_dbase, group_dcap,
-     group_skew, group_mindom, group_delig, group_whole,
+     group_skew, group_mindom, group_delig, group_whole, group_gang,
      exist_zone, exist_ct) = _unpack_problem(buf, layout)
     group_mask = mask_table[group_rows]
     return _solve_ffd_impl(
         group_req, group_count, group_mask, exist_cap, exist_remaining,
         col_alloc, col_daemon, pt_alloc, col_pool, pool_daemon,
         pool_limit, group_ncap, group_dsel, group_dbase, group_dcap,
-        group_skew, group_mindom, group_delig, group_whole,
+        group_skew, group_mindom, group_delig, group_whole, group_gang,
         col_zone, col_ct, exist_zone, exist_ct,
         max_nodes=max_nodes, zc=zc, sparse_n=sparse_n,
-        axis_name=axis_name, explain=explain)
+        axis_name=axis_name, explain=explain, with_gang=with_gang)
 
 def _solve_ffd_delta_impl(buf, col_alloc, col_daemon, pt_alloc, col_pool,
                           pool_daemon, col_zone, col_ct, layout=None,
                           max_nodes: int = 1024, zc: int = 1,
                           sparse_n: int = 0, mask_packed: bool = False,
-                          seed_packed: bool = False, explain: int = 0):
+                          seed_packed: bool = False, explain: int = 0,
+                          with_gang: int = 0):
     """The delta path's seeded kernel (single-device): one coalesced
     buffer carrying the restricted SUFFIX problem (the changed groups
     only) PLUS the prefix seed state — used/pool/active for the node
@@ -1091,7 +1354,7 @@ def _solve_ffd_delta_impl(buf, col_alloc, col_daemon, pt_alloc, col_pool,
     branch is never traced (with_topology=False)."""
     (group_req, group_count, group_mask, exist_cap, exist_remaining,
      pool_limit, group_ncap, group_dsel, group_dbase, group_dcap,
-     group_skew, group_mindom, group_delig, group_whole,
+     group_skew, group_mindom, group_delig, group_whole, group_gang,
      exist_zone, exist_ct, seed_used, seed_pool, seed_active,
      seed_colmask) = _unpack_problem(buf, layout)
     if seed_packed:
@@ -1101,16 +1364,17 @@ def _solve_ffd_delta_impl(buf, col_alloc, col_daemon, pt_alloc, col_pool,
         group_req, group_count, group_mask, exist_cap, exist_remaining,
         col_alloc, col_daemon, pt_alloc, col_pool, pool_daemon,
         pool_limit, group_ncap, group_dsel, group_dbase, group_dcap,
-        group_skew, group_mindom, group_delig, group_whole,
+        group_skew, group_mindom, group_delig, group_whole, group_gang,
         col_zone, col_ct, exist_zone, exist_ct,
         seed_used=seed_used, seed_colmask=seed_colmask,
         seed_pool=seed_pool, seed_active=seed_active,
         max_nodes=max_nodes, zc=zc, with_topology=False,
-        sparse_n=sparse_n, mask_packed=mask_packed, explain=explain)
+        sparse_n=sparse_n, mask_packed=mask_packed, explain=explain,
+        with_gang=with_gang)
 
 
 _DELTA_STATICS = ("layout", "max_nodes", "zc", "sparse_n", "mask_packed",
-                  "seed_packed", "explain")
+                  "seed_packed", "explain", "with_gang")
 solve_ffd_delta = partial(
     jax.jit, static_argnames=_DELTA_STATICS)(_solve_ffd_delta_impl)
 
@@ -1120,7 +1384,8 @@ def _solve_ffd_delta_resident_impl(buf, seed_colmask, mask_table,
                                    col_pool, pool_daemon, col_zone,
                                    col_ct, layout=None,
                                    max_nodes: int = 1024, zc: int = 1,
-                                   axis_name=None, explain: int = 0):
+                                   axis_name=None, explain: int = 0,
+                                   with_gang: int = 0):
     """Mesh variant of the delta kernel (parallel/mesh.py wraps it in
     shard_map): the suffix problem's slot 2 carries row indices into the
     resident mask table (exactly like _solve_ffd_resident_impl), and the
@@ -1129,7 +1394,7 @@ def _solve_ffd_delta_resident_impl(buf, seed_colmask, mask_table,
     residency accounting stays honest."""
     (group_req, group_count, group_rows, exist_cap, exist_remaining,
      pool_limit, group_ncap, group_dsel, group_dbase, group_dcap,
-     group_skew, group_mindom, group_delig, group_whole,
+     group_skew, group_mindom, group_delig, group_whole, group_gang,
      exist_zone, exist_ct, seed_used, seed_pool,
      seed_active) = _unpack_problem(buf, layout)
     group_mask = mask_table[group_rows]
@@ -1137,12 +1402,12 @@ def _solve_ffd_delta_resident_impl(buf, seed_colmask, mask_table,
         group_req, group_count, group_mask, exist_cap, exist_remaining,
         col_alloc, col_daemon, pt_alloc, col_pool, pool_daemon,
         pool_limit, group_ncap, group_dsel, group_dbase, group_dcap,
-        group_skew, group_mindom, group_delig, group_whole,
+        group_skew, group_mindom, group_delig, group_whole, group_gang,
         col_zone, col_ct, exist_zone, exist_ct,
         seed_used=seed_used, seed_colmask=seed_colmask,
         seed_pool=seed_pool, seed_active=seed_active,
         max_nodes=max_nodes, zc=zc, with_topology=False,
-        axis_name=axis_name, explain=explain)
+        axis_name=axis_name, explain=explain, with_gang=with_gang)
 
 
 # The consolidation simulator's batch axis (SURVEY §7 step 6): many
@@ -1153,25 +1418,28 @@ _BATCH_AXES = (0, 0, 0, 0, 0,          # group_req..exist_remaining
                None, None, None,        # col_alloc, col_daemon, pt_alloc
                None, None,              # col_pool, pool_daemon (shared)
                0,                       # pool_limit
-               0, 0, 0, 0, 0, 0, 0, 0,  # topology group arrays (+whole)
+               0, 0, 0, 0, 0, 0, 0, 0, 0,  # topology group arrays
+                                        # (+whole +gang)
                None, None,              # col_zone, col_ct (shared)
                0, 0)                    # exist_zone, exist_ct
 
 def _solve_ffd_batch_impl(*args, max_nodes: int = 1024, zc: int = 1,
                           sparse_k: int = 0, sparse_n: int = 0,
-                          mask_packed: bool = False, explain: int = 0):
+                          mask_packed: bool = False, explain: int = 0,
+                          with_gang: int = 0):
     # explain is armed (counts) only for UNCAPPED batches — the fused
     # solverd lane's real provisioning requests; capped consolidation
     # sims keep explain=0 (counterfactuals must not pay or pollute)
     return jax.vmap(partial(_solve_ffd_impl, max_nodes=max_nodes, zc=zc,
                             sparse_k=sparse_k, sparse_n=sparse_n,
                             mask_packed=mask_packed,
-                            explain=min(explain, 1)),
+                            explain=min(explain, 1),
+                            with_gang=with_gang),
                     in_axes=_BATCH_AXES)(*args)
 
 
 _BATCH_STATICS = ("max_nodes", "zc", "sparse_k", "sparse_n",
-                  "mask_packed", "explain")
+                  "mask_packed", "explain", "with_gang")
 solve_ffd_batch = partial(
     jax.jit, static_argnames=_BATCH_STATICS)(_solve_ffd_batch_impl)
 # pipelined variant: the per-problem stacked tensors (batch axis 0 in
@@ -1249,6 +1517,7 @@ def _solve_ffd_sweep_impl(
             zG,                                 # mindom
             jnp.zeros((G, 1), bool),            # delig
             jnp.zeros((G,), bool),              # whole (sweep holes coloc)
+            jnp.zeros((G,), bool),              # gang (sweep holes gangs)
             col_zone, col_ct, exist_zone, exist_ct,
             max_nodes=max_nodes, zc=zc, with_topology=False,
             sparse_k=sparse_k)
@@ -1314,6 +1583,7 @@ def _solve_ffd_sweep_topo_impl(
             col_alloc, col_daemon, pt_alloc, col_pool, pool_daemon, plim,
             ncap, dsel, dbase, dcap, skew, mindom, delig,
             jnp.zeros(greq.shape[:1], bool),    # whole (sweep holes coloc)
+            jnp.zeros(greq.shape[:1], bool),    # gang (sweep holes gangs)
             col_zone, col_ct, exist_zone, exist_ct,
             max_nodes=max_nodes, zc=zc, with_topology=True,
             sparse_k=sparse_k)

@@ -32,10 +32,12 @@ from karpenter_tpu.solver.encode import (
     EncodedProblem,
     SharedExistEncoding,
     Unsupported,
+    _np_fit_count,
     bucket,
     encode,
 )
 from karpenter_tpu.utils import faults, metrics, tracing
+from karpenter_tpu.utils import knobs as _knobs
 
 R = len(RESOURCE_AXIS)
 
@@ -493,6 +495,7 @@ class TPUSolver:
             self._pad(enc.group_mindom, 0, G),
             self._pad(self._pad(enc.group_delig, 1, Db), 0, G),
             self._pad(enc.group_whole_node, 0, G),
+            self._pad(enc.group_gang, 0, G),
             self._pad(enc.exist_zone, 0, E, value=-1),
             self._pad(enc.exist_ct, 0, E, value=-1),
         )
@@ -534,7 +537,7 @@ class TPUSolver:
         """Interleave per-problem and shared catalog args in kernel order."""
         (group_req, group_count, group_mask, exist_cap, exist_remaining,
          pool_limit, group_ncap, group_dsel, group_dbase, group_dcap,
-         group_skew, group_mindom, group_delig, group_whole,
+         group_skew, group_mindom, group_delig, group_whole, group_gang,
          exist_zone, exist_ct) = prob
         return (group_req, group_count, group_mask, exist_cap, exist_remaining,
                 dev["col_alloc"], dev["col_daemon"],
@@ -542,6 +545,7 @@ class TPUSolver:
                 dev["pool_daemon"], pool_limit,
                 group_ncap, group_dsel, group_dbase, group_dcap,
                 group_skew, group_mindom, group_delig, group_whole,
+                group_gang,
                 dev["col_zone"], dev["col_ct"], exist_zone, exist_ct)
 
     def solve(self, inp: ScheduleInput,
@@ -833,7 +837,7 @@ class TPUSolver:
         return self.max_nodes
 
     def _make_run(self, prob, dev, mbits: bool, pipe: bool,
-                  mesh_table=None):
+                  mesh_table=None, with_gang: Optional[int] = None):
         """Build the dispatch closure ``run(n, kn)`` for one padded
         problem — shared verbatim by _solve_attempt and warmup(), so
         warm-up requests exactly the programs the real solve will (the
@@ -844,6 +848,13 @@ class TPUSolver:
         with the program it fed (retries — slot exhaustion, compaction
         overflow — re-dispatch)."""
         exc = self._explain_kernel_mode()
+        # the gang static is derived from the problem itself (slot 14 is
+        # the padded group_gang row): warmup and the real solve share
+        # this closure, so the two can't disagree about which program a
+        # gang workload compiles.  Gang-free problems keep with_gang=0 —
+        # the exact pre-gang program, bit parity by construction.
+        wg = (with_gang if with_gang is not None
+              else int(bool(np.asarray(prob[14]).any())))
         if self._resolve_mesh() is not None:
             # mesh resident path: ONE coalesced replicated buffer through
             # the donated two-slot rotation; the mask table and catalog
@@ -856,7 +867,7 @@ class TPUSolver:
                 b = (self._upload_slots.put(buf, ex.rep) if pipe
                      else buf)
                 out = ex.solve(b, mesh_table, dev, layout, n, kn,
-                               donate=pipe, explain=exc)
+                               donate=pipe, explain=exc, with_gang=wg)
                 if pipe and not b.is_deleted():
                     # donate_argnums marks the slot for reuse, but a
                     # backend that can't alias the replicated buffer into
@@ -883,14 +894,14 @@ class TPUSolver:
                           dev["pool_daemon"], dev["col_zone"],
                           dev["col_ct"], layout=layout, max_nodes=n,
                           zc=dev["ZC"], sparse_n=kn, mask_packed=mbits,
-                          explain=exc)
+                          explain=exc, with_gang=wg)
         else:
             args = self._assemble(dev, self._put_problem(prob))
 
             def run(n, kn):
                 return ffd.solve_ffd(*args, max_nodes=n, zc=dev["ZC"],
                                      sparse_n=kn, mask_packed=mbits,
-                                     explain=exc)
+                                     explain=exc, with_gang=wg)
         return run
 
     # -- placement provenance (solver/explain.py) -------------------------
@@ -966,6 +977,10 @@ class TPUSolver:
                 "pipeline": pipelining.pipeline_enabled(),
                 "topk_segments": self._last_new_segments,
                 "explain": explainmod.mode_name(self._explain_mode()),
+                # the resolved gang knob (ISSUE 15): kt_replay/kt_explain
+                # pin it so gang solves reproduce bit-for-bit even when
+                # the replaying shell's env disagrees
+                "gang": _knobs.gang_enabled(),
             },
             phase_ms={k: round(v, 3)
                       for k, v in self.last_phase_ms.items()},
@@ -1018,6 +1033,8 @@ class TPUSolver:
             np.zeros(G, dtype=np.int32),
             np.zeros((G, Db), dtype=bool),
             np.zeros(G, dtype=bool),
+            np.zeros(G, dtype=bool),   # group_gang (delta: gang-free
+                                       # by contract — plan() falls back)
             self._pad(enc_p.exist_zone, 0, E, value=-1),
             self._pad(enc_p.exist_ct, 0, E, value=-1),
         )
@@ -1137,6 +1154,7 @@ class TPUSolver:
         t2 = _time.perf_counter()
         enc_m, out_m = deltam.merge(plan, sp, cat, inp, out_s, Gd)
         self._repair_whole_node(enc_m, out_m)
+        self._repair_gang(enc_m, out_m)
         self._repair_topology(enc_m, out_m)
         self._explain_trees = bool(self._explain_mode())
         res = self._decode(enc_m, out_m)
@@ -1251,10 +1269,12 @@ class TPUSolver:
             # fill enforces per-node caps (exist_cap) but not the dynamic
             # per-domain quotas, so dynamically-constrained groups go to
             # the oracle instead of risking a skew/anti violation.
-            if (enc.group_dsel > 0).any():
+            if (enc.group_dsel > 0).any() or (
+                    enc.group_gang is not None and enc.group_gang.any()):
                 raise UnsupportedPods(
-                    "zone/capacity-type-constrained pods with no purchasable "
-                    "capacity: domain quotas need the device solve")
+                    "zone/capacity-type-constrained or gang pods with no "
+                    "purchasable capacity: domain quotas / atomic fills "
+                    "need the device solve")
             return self._existing_only(enc)
 
         G = bucket(enc.n_groups, G_BUCKETS)
@@ -1354,6 +1374,7 @@ class TPUSolver:
             self._last_new_segments = max(segs, 1)
         t3 = _time.perf_counter()
         self._repair_whole_node(enc, out)
+        self._repair_gang(enc, out)
         self._repair_topology(enc, out)
         t4 = _time.perf_counter()
         res = self._decode(enc, out)
@@ -1466,7 +1487,7 @@ class TPUSolver:
             proto = self._problem_args(enc, baseG, baseE, Db, dev["O"],
                                        pack_mask=mbits)
             mesh_table = None
-        _G_AX = (0, 1, 2, 3, 6, 7, 8, 9, 10, 11, 12, 13)
+        _G_AX = (0, 1, 2, 3, 6, 7, 8, 9, 10, 11, 12, 13, 14)
 
         def zeros_at(i, a, G2, E2):
             shp = list(a.shape)
@@ -1474,7 +1495,7 @@ class TPUSolver:
                 shp[0] = G2
             if i == 3:
                 shp[1] = E2
-            if i in (4, 14, 15):
+            if i in (4, 15, 16):
                 shp[0] = E2
             return np.zeros(shp, dtype=a.dtype)
 
@@ -1488,26 +1509,36 @@ class TPUSolver:
             (bucket(max(int(g), 1), G_BUCKETS),
              bucket(max(int(e), 0), E_BUCKETS)) for g, e in shapes}
         warmed = 0
+        # gang workloads compile a distinct static config (with_gang=1):
+        # warm it alongside the gang-free programs whenever the proto
+        # encoding carries a gang, so the first real gang solve after
+        # startup still performs zero XLA compiles (the zero-retrace
+        # gate covers gang problems exactly like plain ones)
+        gang_variants = ((0, 1) if bool(np.asarray(proto[14]).any())
+                         else (0,))
         for (G2, E2) in sorted(targets):
             prob2 = tuple(zeros_at(i, a, G2, E2)
                           for i, a in enumerate(proto))
-            run = self._make_run(prob2, dev, mbits, pipe, mesh_table)
-            for mn in ladder:
-                # dense (kn=0, what solve #1 runs while _last_new_segments
-                # is unmeasured) PLUS every take_new compaction tier the
-                # engage gate admits at this node axis: _pick_sparse_n
-                # switches to a kn>0 static config on solve #2, and an
-                # unwarmed tier would put the compile cliff right back
-                # inside the second latency-sensitive reconcile
-                for kn in (0,) + tuple(
-                        k for k in self.NSEG_BUCKETS
-                        if (2 * k + 1) * 2 <= mn):
-                    packed = run(mn, kn)
-                    try:
-                        packed.block_until_ready()
-                    except AttributeError:
-                        pass
-                    warmed += 1
+            for wg in gang_variants:
+                run = self._make_run(prob2, dev, mbits, pipe, mesh_table,
+                                     with_gang=wg)
+                for mn in ladder:
+                    # dense (kn=0, what solve #1 runs while
+                    # _last_new_segments is unmeasured) PLUS every
+                    # take_new compaction tier the engage gate admits at
+                    # this node axis: _pick_sparse_n switches to a kn>0
+                    # static config on solve #2, and an unwarmed tier
+                    # would put the compile cliff right back inside the
+                    # second latency-sensitive reconcile
+                    for kn in (0,) + tuple(
+                            k for k in self.NSEG_BUCKETS
+                            if (2 * k + 1) * 2 <= mn):
+                        packed = run(mn, kn)
+                        try:
+                            packed.block_until_ready()
+                        except AttributeError:
+                            pass
+                        warmed += 1
         # the generic batched kernel runs the gcol-sharded DENSE-mask
         # path under a mesh (solve_batch does not use the resident
         # row-index form), so its warm proto must be the dense one —
@@ -1536,18 +1567,27 @@ class TPUSolver:
             # DONATES them, so the first run's are dead after dispatch.
             exc_b = min(self._explain_kernel_mode(), 1)
             for exb in sorted({0, exc_b}):
-                stacked = self._put_problem(
-                    tuple(np.zeros((B,) + a.shape, a.dtype)
-                          for a in prob0),
-                    batched=True)
-                packed = fn(*self._assemble(dev, stacked),
-                            max_nodes=self.max_nodes, zc=dev["ZC"],
-                            sparse_k=sk, mask_packed=mbits, explain=exb)
-                try:
-                    packed.block_until_ready()
-                except AttributeError:
-                    pass
-                warmed += 1
+                # with_gang is passed EXPLICITLY (even 0): jit keys
+                # static kwargs as-passed, so an omitted-default warmup
+                # call and solve_batch's explicit with_gang=0 would
+                # compile the same program into two cache entries — the
+                # real batch would retrace right after warmup.  Gang
+                # protos warm the with_gang=1 batch program too (the
+                # fused solverd lane arms it per batch).
+                for wg in gang_variants:
+                    stacked = self._put_problem(
+                        tuple(np.zeros((B,) + a.shape, a.dtype)
+                              for a in prob0),
+                        batched=True)
+                    packed = fn(*self._assemble(dev, stacked),
+                                max_nodes=self.max_nodes, zc=dev["ZC"],
+                                sparse_k=sk, mask_packed=mbits,
+                                explain=exb, with_gang=wg)
+                    try:
+                        packed.block_until_ready()
+                    except AttributeError:
+                        pass
+                    warmed += 1
         if delta_shapes and self._resolve_delta():
             from karpenter_tpu.solver import delta as deltam
             P = max(len(cat.pools), 1)
@@ -1568,6 +1608,7 @@ class TPUSolver:
                     np.zeros(Gd, np.int32),
                     np.zeros((Gd, Db), bool),
                     np.zeros(Gd, bool),
+                    np.zeros(Gd, bool),   # group_gang (delta: gang-free)
                     np.full(baseE, -1, np.int32),
                     np.full(baseE, -1, np.int32),
                 )
@@ -1971,6 +2012,13 @@ class TPUSolver:
             gid = rep.scheduling_group_id()
             row = class_row.get(gid)
             if row is None:
+                from karpenter_tpu.scheduling.types import gang_of
+                if gang_of(rep) is not None:
+                    # gang units need the atomic K-node fill — the
+                    # sweep's shared-snapshot lanes never trace it, so
+                    # the sim holes out to the generic batched path
+                    # (which arms with_gang per batch)
+                    raise Unsupported("gang unit in sweep")
                 info = None
                 if (has_res_anti or rep.topology_spread
                         or rep.pod_affinities):
@@ -2483,6 +2531,11 @@ class TPUSolver:
             # dashboard merges); capped consolidation sims stay aux-free
             exc_b = (min(self._explain_kernel_mode(), 1)
                      if max_nodes is None else 0)
+            # gang static for the whole batch: one gang-carrying input
+            # arms the branch for the fused program (values gate per
+            # group, so gang-free entries still take the light path)
+            wg_b = int(any(bool(np.asarray(e.group_gang).any())
+                           for _, e in encs))
             batch_fn = (ffd.solve_ffd_batch_donated if pipe
                         else ffd.solve_ffd_batch)
             chunk_size = B_BUCKETS[-1]
@@ -2516,7 +2569,7 @@ class TPUSolver:
                 packed = batch_fn(
                     *self._assemble(dev, stacked), max_nodes=mn,
                     zc=dev["ZC"], sparse_k=sparse_k, mask_packed=mbits,
-                    explain=exc_b)
+                    explain=exc_b, with_gang=wg_b)
                 device_s += _time.perf_counter() - t_dev0
                 return packed
 
@@ -2550,6 +2603,7 @@ class TPUSolver:
                     exhausted = bool(out["unsched"].sum() > 0
                                      and out["num_active"] >= mn)
                     self._repair_whole_node(enc, out)
+                    self._repair_gang(enc, out)
                     self._repair_topology(enc, out)
                     t_dec1 = _time.perf_counter()
                     repair_s += t_dec1 - t_dec0
@@ -2639,16 +2693,59 @@ class TPUSolver:
             tn = out["take_new"][gi, :num_active]
             if int((te > 0).sum()) + int((tn > 0).sum()) <= 1:
                 continue
-            out["unsched"][gi] += te.sum() + tn.sum()
-            # release the phantom consumption on shared new nodes (same
-            # accounting as _repair_topology): decode rebuilds each
-            # node's surviving-column mask from used[ni], which must
-            # reflect only the pods actually staying on the node
-            req = enc.group_req[gi]
-            for ni in np.nonzero(tn > 0)[0]:
-                out["used"][ni] -= int(tn[ni]) * req
-            te[:] = 0
-            tn[:] = 0
+            self._strand_group(enc, out, gi, te, tn)
+
+    @staticmethod
+    def _strand_group(enc: EncodedProblem, out: Dict[str, np.ndarray],
+                      gi: int, te: np.ndarray, tn: np.ndarray) -> None:
+        """Shared strand-and-release rollback for the host repair nets
+        (whole-node + gang): mark every taken member unschedulable and
+        release the phantom consumption on shared new nodes (same
+        accounting as _repair_topology) — decode rebuilds each node's
+        surviving-column mask from used[ni], which must reflect only
+        the pods actually staying on the node."""
+        out["unsched"][gi] += te.sum() + tn.sum()
+        req = enc.group_req[gi]
+        for ni in np.nonzero(tn > 0)[0]:
+            out["used"][ni] -= int(tn[ni]) * req
+        te[:] = 0
+        tn[:] = 0
+
+    def _repair_gang(self, enc: EncodedProblem,
+                     out: Dict[str, np.ndarray]) -> None:
+        """Gang atomicity safety net (ISSUE 15): every gang group must
+        be either FULLY placed inside one adjacency domain or fully
+        stranded.  The kernel's gang branch commits all-or-nothing by
+        construction, so this host check is defense in depth — if a
+        commit/estimate bug ever slips a partial or cross-domain gang
+        through, it is rolled back bit-exactly here (takes zeroed, used
+        released, members stranded whole) rather than silently
+        splitting a tightly-coupled job.  The fuzz class and config9
+        assert the invariant on the DECODED result, so a repair firing
+        here is visible as a stranded gang, never a partial one."""
+        gg = enc.group_gang
+        if gg is None or not gg.any():
+            return
+        Er = len(enc.existing)
+        num_active = int(out["num_active"])
+        for gi in np.nonzero(gg[:enc.n_groups])[0]:
+            te = out["take_exist"][gi, :Er]
+            tn = out["take_new"][gi, :num_active]
+            placed = int(te.sum()) + int(tn.sum())
+            if placed == 0:
+                continue
+            ok = placed == int(enc.group_count[gi])
+            dsel = int(enc.group_dsel[gi])
+            if ok and dsel > 0:
+                ex_dom = (enc.exist_zone if dsel == 1 else enc.exist_ct)
+                nd = (out["node_zone"] if dsel == 1 else out["node_ct"])
+                doms = {int(ex_dom[ei]) for ei in np.nonzero(te > 0)[0]}
+                doms |= {int(nd[ni]) for ni in np.nonzero(tn > 0)[0]}
+                ok = len(doms) <= 1
+            if ok:
+                continue
+            metrics.SOLVER_GANG_REPAIRS.inc()
+            self._strand_group(enc, out, gi, te, tn)
 
     def _repair_topology(self, enc: EncodedProblem, out: Dict[str, np.ndarray]) -> None:
         """The kernel's per-domain quotas are planned against a capacity
@@ -3039,6 +3136,102 @@ class TPUSolver:
             new_claims_append(claim)
         return res
 
+    def _gang_reason(self, enc: EncodedProblem, gi: int,
+                     out: Optional[Dict]) -> str:
+        """One stranded GANG's verdict (ISSUE 15): the whole gang
+        strands with one of the gang codes, and the reason tree always
+        carries the per-gang breakdown — nearest adjacency domain, how
+        many members it could hold, the member deficit and the
+        estimated node deficit — because a stranded tightly-coupled job
+        is exactly the verdict an operator needs decomposed."""
+        from karpenter_tpu.scheduling.types import gang_of
+        pods = enc.groups[gi]
+        spec = gang_of(pods[0]) if pods else None
+        cnt = int(enc.group_count[gi])
+        dsel = int(enc.group_dsel[gi])
+        D = enc.n_domains
+        delig = np.asarray(enc.group_delig[gi][:D], dtype=bool)
+        placed_d = None
+        if isinstance(out, dict) and "dom_placed" in out \
+                and gi < len(out["dom_placed"]):
+            placed_d = np.asarray(out["dom_placed"][gi][:D],
+                                  dtype=np.int64)
+        best = 0
+        best_dom = None
+        if placed_d is not None and delig.any():
+            masked = np.where(delig, placed_d, -1)
+            bi = int(masked.argmax())
+            best = max(int(masked[bi]), 0)
+            values = (enc.zone_values if dsel == 1
+                      else enc.ct_values if dsel == 2 else [])
+            if dsel > 0 and bi < len(values):
+                best_dom = values[bi]
+        # best per-node fan-out over the gang's admitted columns — the
+        # deficit-node estimate and the too-large bound both need it
+        gmask = np.asarray(enc.group_mask[gi], dtype=bool)
+        per = _np_fit_count(
+            np.asarray(enc.col_alloc, dtype=np.float32)
+            - np.asarray(enc.col_daemon, dtype=np.float32),
+            np.asarray(enc.group_req[gi], dtype=np.float32))
+        best_fit = int(per[gmask].max()) if gmask.any() else 0
+        n_axis = (out["take_new"].shape[1]
+                  if isinstance(out, dict) and "take_new" in out
+                  else self.max_nodes)
+        exist_fit = 0
+        if len(enc.existing):
+            exist_fit = int(_np_fit_count(
+                np.asarray(enc.exist_remaining, dtype=np.float32),
+                np.asarray(enc.group_req[gi],
+                           dtype=np.float32)).sum())
+        name = spec.name if spec is not None else "?"
+        if spec is not None and spec.size and len(pods) != spec.size:
+            code = explainmod.GANG_INCOMPLETE
+            detail = (f"gang {name}: {len(pods)} member(s) pending of "
+                      f"{spec.size} declared — "
+                      + ("waiting for the full gang"
+                         if len(pods) < spec.size
+                         else "more members than declared; fix "
+                              "gang-size"))
+        elif best <= 0:
+            if cnt > best_fit * n_axis + exist_fit:
+                # a sound global upper bound over every domain: the gang
+                # could not fit even on an empty fleet at the node
+                # ceiling
+                code = explainmod.GANG_TOO_LARGE
+                detail = (f"gang {name}: {cnt} members exceed any "
+                          "single adjacency domain's possible capacity "
+                          f"(≤{best_fit} pods/node × {n_axis} node "
+                          "slots)")
+            else:
+                code = explainmod.GANG_DOMAIN
+                detail = (f"gang {name}: no adjacency domain can "
+                          "currently hold any member")
+        else:
+            code = explainmod.GANG_PARTIAL
+            detail = (f"gang {name}: best domain holds {best} of {cnt} "
+                      "members — stranded whole rather than split")
+        deficit = max(cnt - best, 0)
+        gang_tree = {
+            "name": name,
+            "declared_size": spec.size if spec is not None else 0,
+            "members_pending": len(pods),
+            "domain_axis": ("zone" if dsel == 1
+                            else "capacity-type" if dsel == 2
+                            else "none"),
+            "nearest_domain": best_dom,
+            "nearest_domain_members": best,
+            "deficit_members": deficit,
+            "deficit_nodes": (-(-deficit // best_fit)
+                              if best_fit else None),
+        }
+        tree = {"code": code, "constraint": explainmod.constraint_of(code),
+                "gang": gang_tree}
+        if self._explain_trees:
+            full = explainmod.build_tree(enc, out or {}, gi, code)
+            full["gang"] = gang_tree
+            tree = full
+        return explainmod.make(code, detail, tree)
+
     def _unsched_reason(self, enc: EncodedProblem, gi: int,
                         out: Optional[Dict] = None) -> str:
         """One stranded group's verdict as a registry `Reason`
@@ -3046,6 +3239,9 @@ class TPUSolver:
         string as the detail (existing logs and assertions keep
         working), with the constraint-elimination tree attached when
         explain is armed on a REAL solve (`_explain_trees`)."""
+        if enc.group_gang is not None and gi < len(enc.group_gang) \
+                and enc.group_gang[gi]:
+            return self._gang_reason(enc, gi, out)
         if not enc.group_mask[gi].any() and not (enc.exist_cap[gi] > 0).any():
             details = []
             for pidx, pool in enumerate(enc.pools):

@@ -44,6 +44,16 @@ def env_bool(name: str, default: bool = False,
     return default
 
 
+def gang_enabled() -> bool:
+    """`KARPENTER_TPU_GANG`: the gang-scheduling rollback lever
+    (default on).  Off, gang annotations are inert — members schedule
+    as ordinary independent pods (no atomicity, no adjacency).  Parsed
+    here (not in the scheduling layer) because BOTH the jax-free
+    oracle/model layer and the solver read it, and each knob keeps
+    exactly one grammar owner."""
+    return env_bool("KARPENTER_TPU_GANG", default=True)
+
+
 def bind_host() -> str:
     """`KARPENTER_TPU_BIND_HOST`: the metrics/health/probe bind address
     (default loopback; `0.0.0.0` in containers).  Shared by the

@@ -393,6 +393,20 @@ UNSCHEDULABLE_PODS = _c(
     "reason code (solver/explain.py). reason=Legacy marks a plain-string "
     "reason from an unregistered producer — kt-lint's reason-literal "
     "check keeps this at zero.", ("reason",))
+# -- gang scheduling (ISSUE 15): atomic multi-node placement outcomes
+GANG_PLACEMENTS = _c(
+    "karpenter_tpu_gang_placements_total",
+    "Gang placement outcomes per provisioning pass (one increment per "
+    "gang): outcome=placed when every member landed, outcome=stranded "
+    "when the gang stranded whole — by the atomicity invariant there "
+    "is no third outcome (a partial gang is a bug, counted on "
+    "karpenter_tpu_solver_gang_repairs_total).", ("outcome",))
+SOLVER_GANG_REPAIRS = _c(
+    "karpenter_tpu_solver_gang_repairs_total",
+    "Gang fills the host-side atomicity safety net rolled back "
+    "(partial or cross-domain placement out of the kernel) — expected "
+    "to stay at zero; any increment is a kernel gang-commit bug made "
+    "visible instead of a silently split gang.")
 SOLVER_CONSTRAINT_ELIM = _c(
     "karpenter_tpu_solver_constraint_eliminations_total",
     "Catalog-column eliminations attributed per constraint class by the "

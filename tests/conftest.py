@@ -34,6 +34,15 @@ os.environ.pop("KARPENTER_TPU_EXPLAIN", None)
 os.environ.pop("KARPENTER_TPU_AUDIT", None)
 os.environ.pop("KARPENTER_TPU_LEDGER_DIR", None)
 
+# Tier-1 runs with gang scheduling at its DEFAULT (on): an inherited
+# KARPENTER_TPU_GANG=off from a shell that just drove the rollback
+# lever would silently turn every gang-suite pod into independent
+# singletons — atomicity tests would "pass" by testing nothing.  The
+# weights-file knob is scrubbed alongside so a leftover deploy config
+# can't skew the tenant-scheduler fairness assertions.
+os.environ.pop("KARPENTER_TPU_GANG", None)
+os.environ.pop("KARPENTER_TPU_TENANT_WEIGHTS_FILE", None)
+
 # Dynamic lock-order observer (ISSUE 12, opt-in): under
 # KARPENTER_TPU_LOCK_OBSERVER=1 every threading.Lock/RLock/Condition a
 # karpenter_tpu module constructs from here on is wrapped, real

@@ -83,8 +83,14 @@ class TestRegistry:
         # ffd's aux row width and column order ARE the registry's
         # KERNEL_CONSTRAINTS — a drift here silently misattributes
         assert ffd.EXPLAIN_C == len(explain.KERNEL_CONSTRAINTS)
+        # "gang" (ISSUE 15) is a VERDICT class only — the kernel aux
+        # row keeps attributing gang strands to whole_node, so the aux
+        # width (and every recorded delta prefix) is exactly the
+        # kernel-constraint tuple, with gang appended host-side
         assert explain.CONSTRAINTS == (explain.HOST_CONSTRAINTS
-                                       + explain.KERNEL_CONSTRAINTS)
+                                       + explain.KERNEL_CONSTRAINTS
+                                       + ("gang",))
+        assert "gang" not in explain.KERNEL_CONSTRAINTS
         for code, spec in explain.REGISTRY.items():
             assert spec.code == code
             assert spec.constraint in explain.CONSTRAINTS + ("none",)

@@ -636,6 +636,30 @@ def test_reason_literal_flags_adhoc_strings(tmp_path):
     assert all("reason-literal" in m for m in msgs)
 
 
+def test_reason_literal_covers_gang_verdict_sites(tmp_path):
+    # ISSUE 15: the gang emitters (oracle gang pre-pass, the solver's
+    # _gang_reason) must ride the registry like every other verdict —
+    # a gang-style bare literal is flagged, the make() form is clean
+    findings, _ = _check(tmp_path, """
+        from karpenter_tpu.solver import explain as explainmod
+
+
+        def strand_gang(res, members, spec):
+            for m in members:
+                res.unschedulable[m.meta.name] = (
+                    f"gang {spec.name}: partially placeable")
+
+
+        def strand_gang_ok(res, members, reason):
+            for m in members:
+                res.unschedulable[m.meta.name] = explainmod.make(
+                    explainmod.GANG_PARTIAL, "gang: stranded whole")
+    """, observability, relname="karpenter_tpu/scheduling/demo.py")
+    msgs = [f.message for f in findings]
+    assert len(findings) == 1, msgs
+    assert "reason-literal" in msgs[0]
+
+
 def test_reason_literal_negatives(tmp_path):
     # registry-made Reasons, variable assignments, and unrelated
     # subscripts are all clean

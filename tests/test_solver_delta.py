@@ -248,6 +248,127 @@ class TestDeltaFallbacks:
         assert "doomed-0" in res.unschedulable
 
 
+class TestDeltaGang:
+    """Gang × delta (ISSUE 15): a dirty gang member invalidates the
+    whole gang's prefix reuse; adjacency gangs and suffix gangs are
+    counted "gang" fallbacks; domain-free gangs in the unchanged
+    prefix reuse bit-exactly."""
+
+    @staticmethod
+    def _gang_pods(n=4, cpu_m=4000, dom=None, name="dgang"):
+        out = []
+        for i in range(n):
+            ann = {wellknown.GANG_NAME_ANNOTATION: name,
+                   wellknown.GANG_SIZE_ANNOTATION: str(n)}
+            if dom is not None:
+                ann[wellknown.GANG_TOPOLOGY_ANNOTATION] = dom
+            out.append(Pod(
+                meta=ObjectMeta(name=f"{name}-{i}", annotations=ann),
+                requests=Resources.parse(
+                    {"cpu": f"{cpu_m}m", "memory": "2048Mi"})))
+        return out
+
+    def test_adjacency_gang_always_falls_back(self):
+        on = TPUSolver(mesh="off", delta="on")
+        pods = churn_pods(0) + self._gang_pods(dom="slice")
+        on.solve(mkinput(list(pods)))
+        assert outcome(on) == ("fallback", "gang")
+        on.solve(mkinput(list(pods)))
+        assert outcome(on) == ("fallback", "gang")
+        assert "gang" in __import__(
+            "karpenter_tpu.solver.explain",
+            fromlist=["x"]).DELTA_FALLBACK_REASONS
+
+    def test_domain_free_prefix_gang_reuses_exactly(self):
+        # the gang's cpu makes it FFD-FIRST (prefix); tail churn
+        # engages delta and parity with the full path must hold
+        on = TPUSolver(mesh="off", delta="on")
+        off = TPUSolver(mesh="off", delta="off")
+        for gen in range(3):
+            pods = self._gang_pods(dom="none") + churn_pods(gen)
+            r_on = on.solve(mkinput(list(pods)))
+            r_off = off.solve(mkinput(list(pods)))
+            assert canon(r_on) == canon(r_off), f"gen {gen}"
+        assert outcome(on) == ("delta", None)
+
+    def test_dirty_gang_member_invalidates_whole_gang(self):
+        on = TPUSolver(mesh="off", delta="on")
+        pods = self._gang_pods(dom="none") + churn_pods(0)
+        on.solve(mkinput(list(pods)))
+        on.solve(mkinput(list(pods)))
+        assert outcome(on) == ("delta", None)
+        # one dirty MEMBER: the gang's row breaks the prefix, the gang
+        # lands in the suffix, and the pass is the counted fallback
+        on.delta_invalidate(pods=["dgang-0"])
+        res = on.solve(mkinput(list(pods)))
+        assert outcome(on) == ("fallback", "gang")
+        off = TPUSolver(mesh="off", delta="off")
+        assert canon(res) == canon(off.solve(mkinput(list(pods))))
+
+
+class TestDeltaPlanShortCircuit:
+    """ISSUE 15 satellite: the dirty-set bookkeeping must be O(churn),
+    not O(cluster) — a single dirty pod resolves through the record's
+    lazily-built member-name → row index instead of per-group name
+    scans."""
+
+    @staticmethod
+    def _big_record(n_groups=3000):
+        from karpenter_tpu.solver import delta as deltam
+        groups = []
+        for g in range(n_groups):
+            groups.append([
+                mkpod(f"sc{g}-{i}", cpu_m=4000 - g) for i in range(2)])
+        gkeys = [(grp[0].scheduling_group_id(),
+                  tuple(p.meta.name for p in grp)) for grp in groups]
+        enc = type("E", (), {"existing": []})()
+        rec = deltam.DeltaRecord(
+            cat=object(), enc=enc, groups=groups, gkeys=gkeys,
+            out_te=np.zeros((n_groups, 0), np.float32),
+            out_tn=np.zeros((n_groups, 0), np.float32),
+            node_pool=np.zeros(0, np.int32), num_active=0,
+            node_fps=[], res_anti_any=False)
+        return rec, groups
+
+    def test_single_dirty_pod_resolves_via_name_index(self):
+        from karpenter_tpu.solver import delta as deltam
+        from karpenter_tpu.solver.solve import G_BUCKETS
+        rec, groups = self._big_record()
+        inp = mkinput([])
+        dirty = (frozenset({"sc2999-1"}), frozenset(), False, 0)
+        plan_ = deltam.plan(rec, inp, groups, dirty, 0, G_BUCKETS)
+        assert isinstance(plan_, deltam.DeltaPlan)
+        assert plan_.m == 2999          # prefix breaks AT the dirty row
+        assert len(plan_.suffix) == 1
+        assert rec.name_rows is not None
+        assert rec.name_rows["sc2999-1"] == 2999
+        # the index is built ONCE and reused across passes
+        idx = rec.name_rows
+        deltam.plan(rec, inp, groups, dirty, 0, G_BUCKETS)
+        assert rec.name_rows is idx
+
+    def test_single_dirty_pod_plan_is_fast(self):
+        # regression-timed: 3000 groups, one dirty pod — the plan diff
+        # must stay identity-fast (the pre-index implementation walked
+        # every member name of every prefix group per pass).  The bound
+        # is generous (CI hosts are noisy); the structural assertion
+        # above is the sharp half of the regression net.
+        import time
+        from karpenter_tpu.solver import delta as deltam
+        from karpenter_tpu.solver.solve import G_BUCKETS
+        rec, groups = self._big_record()
+        inp = mkinput([])
+        dirty = (frozenset({"sc2999-0"}), frozenset(), False, 0)
+        deltam.plan(rec, inp, groups, dirty, 0, G_BUCKETS)  # warm index
+        t0 = time.perf_counter()
+        reps = 10
+        for _ in range(reps):
+            plan_ = deltam.plan(rec, inp, groups, dirty, 0, G_BUCKETS)
+        per_pass = (time.perf_counter() - t0) / reps
+        assert isinstance(plan_, deltam.DeltaPlan)
+        assert per_pass < 0.10, f"plan() {per_pass * 1e3:.1f} ms/pass"
+
+
 class TestDeltaKnob:
     def test_env_off_disables(self, monkeypatch):
         monkeypatch.setenv("KARPENTER_TPU_DELTA", "off")

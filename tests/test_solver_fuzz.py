@@ -751,6 +751,162 @@ class TestFuzzMixed:
                 f"oracle {oracle.node_count()} (gap {node_gap} > 2)")
 
 
+# -- gang tier (ISSUE 15): atomicity under churn ---------------------------
+#
+# Gangs of sizes 2-64 (slice/rack/none adjacency, occasional
+# deliberately-incomplete declarations) mixed with singleton load.  The
+# invariant is ATOMICITY: a gang is fully placed inside one adjacency
+# domain or fully stranded — never split — and it must hold on every
+# pass of a churning multi-pass sequence with the delta path armed
+# (a dirty gang member invalidates the gang's prefix reuse; the seam
+# falls back counted, never silently).
+
+GANG_DOMS = ["slice", "rack", "none", ""]  # "" = annotation absent
+
+
+def _gen_problem_gang(seed: int) -> ScheduleInput:
+    rng = np.random.RandomState(300_000 + seed)
+    catalog = _pick_catalog(rng)
+    pods = []
+    n_gangs = rng.randint(1, 5)
+    for g in range(n_gangs):
+        size = int(rng.choice([2, 3, 4, 8, 12, 16, 32, 64]))
+        cpu = int(rng.choice([500, 1000, 2000, 4000]))
+        mem = int(rng.choice([1024, 2048, 4096]))
+        dom = GANG_DOMS[rng.randint(0, len(GANG_DOMS))]
+        declared = size
+        if rng.rand() < 0.2:
+            declared = size + int(rng.randint(1, 3))  # incomplete: waits
+        for i in range(size):
+            ann = {wellknown.GANG_NAME_ANNOTATION: f"gang-{g}",
+                   wellknown.GANG_SIZE_ANNOTATION: str(declared)}
+            if dom:
+                ann[wellknown.GANG_TOPOLOGY_ANNOTATION] = dom
+            pods.append(Pod(
+                meta=ObjectMeta(name=f"gang{g}-p{i}", annotations=ann),
+                requests=Resources.parse(
+                    {"cpu": f"{cpu}m", "memory": f"{mem}Mi"})))
+    for i in range(int(rng.randint(10, 150))):
+        pods.append(Pod(
+            meta=ObjectMeta(name=f"solo-{i}"),
+            requests=Resources.parse(
+                {"cpu": f"{int(rng.choice([125, 250, 500, 1000]))}m",
+                 "memory": f"{int(rng.choice([256, 512, 1024]))}Mi"})))
+    existing = []
+    for i in range(rng.randint(0, 5)):
+        zone = DEFAULT_ZONES[rng.randint(0, len(DEFAULT_ZONES))]
+        alloc = Resources.parse(
+            {"cpu": "16", "memory": "64Gi", "pods": "110"})
+        node = Node(meta=ObjectMeta(
+            name=f"gexist-{i}",
+            labels={ZONE: zone, CT: "on-demand", HOST: f"gexist-{i}",
+                    wellknown.NODEPOOL_LABEL: "default"}),
+            allocatable=alloc, ready=True)
+        existing.append(ExistingNode(node=node, available=alloc,
+                                     pods=[]))
+    limits = {"default": None}
+    if rng.rand() < 0.25:
+        total_cpu = sum(p.requests.get("cpu") for p in pods)
+        limits["default"] = Resources.limits(
+            cpu=int(total_cpu * rng.uniform(0.4, 1.3)))
+    return ScheduleInput(
+        pods=pods, nodepools=[NodePool(meta=ObjectMeta(name="default"))],
+        instance_types={"default": catalog},
+        existing_nodes=existing, remaining_limits=limits)
+
+
+def check_gang_atomicity(ctx: str, inp: ScheduleInput, res) -> None:
+    """The hard invariant: every gang fully placed in ONE adjacency
+    domain, or fully stranded with a gang reason code.  The invariant
+    computation itself is the shared gang_placement_audit — one owner
+    for the fuzz class, the gang suite, and the config9 bench gate."""
+    from karpenter_tpu.scheduling.types import gang_placement_audit
+    from karpenter_tpu.solver import explain as explainmod
+    for gname, a in gang_placement_audit(inp, res).items():
+        assert a["placed"] in (0, a["total"]), (
+            f"{ctx} gang {gname} PARTIAL: "
+            f"{len(a['stranded'])}/{a['total']} stranded")
+        if a["stranded"]:
+            codes = {explainmod.code_of(res.unschedulable[n])
+                     for n in a["stranded"]}
+            assert codes <= set(explainmod.GANG_CODES) | {
+                explainmod.LEGACY}, (ctx, gname, codes)
+            continue
+        if a["spec"].domain_key is None:
+            continue
+        assert not a["unpinned"], (
+            f"{ctx} gang {gname}: member on unpinned claim: "
+            f"{a['unpinned']}")
+        assert len(a["domains"]) == 1, (
+            f"{ctx} gang {gname} split across {sorted(a['domains'], key=str)}")
+
+
+class TestFuzzGang:
+    @pytest.mark.parametrize("seed", range(30))
+    def test_seeded_gang(self, solver, seed):
+        inp = _gen_problem_gang(seed)
+        res = solver.solve(inp)
+        check_validity(seed, inp, res)
+        check_gang_atomicity(f"GANG_SEED={seed}", inp, res)
+        # verdict parity vs the gang-aware oracle (skipped under finite
+        # limits, where the two engines' budget interleavings can
+        # legitimately settle different-but-valid gang verdicts)
+        finite_limits = any(
+            lim is not None
+            for lim in (inp.remaining_limits or {}).values())
+        if len(inp.pods) <= ORACLE_CMP_MAX_PODS and not finite_limits:
+            from karpenter_tpu.scheduling.types import gang_of
+            orc = Scheduler(inp).solve()
+            check_gang_atomicity(f"GANG_SEED={seed}/oracle", inp, orc)
+            names = {}
+            for p in inp.pods:
+                sp = gang_of(p)
+                if sp is not None:
+                    names.setdefault(sp.name, []).append(p.meta.name)
+            for gname, ns in names.items():
+                sv = all(n not in res.unschedulable for n in ns)
+                ov = all(n not in orc.unschedulable for n in ns)
+                assert sv == ov, (
+                    f"GANG_SEED={seed} gang {gname}: solver "
+                    f"{'placed' if sv else 'stranded'} vs oracle "
+                    f"{'placed' if ov else 'stranded'}")
+
+    @pytest.mark.parametrize("seed", range(10))
+    def test_gang_atomicity_under_churn_with_delta(self, seed):
+        """Multi-pass churn with the delta path armed: drop/add
+        singletons, dirty a gang member mid-sequence — atomicity must
+        hold on EVERY pass, and every delta seam pass is a counted
+        delta or fallback (never silent)."""
+        import dataclasses
+        s = TPUSolver(mesh="off", delta="on")
+        inp = _gen_problem_gang(seed)
+        rng = np.random.RandomState(900_000 + seed)
+        for pass_i in range(4):
+            res = s.solve(inp)
+            ctx = f"GANG_SEED={seed} pass={pass_i}"
+            check_validity(seed, inp, res)
+            check_gang_atomicity(ctx, inp, res)
+            outcome = s._delta_cache.last_outcome
+            assert outcome in ("delta", "fallback"), (ctx, outcome)
+            # churn: retire a few singletons, add fresh ones, and
+            # occasionally mark a gang member dirty through the
+            # controller feed
+            pods = [p for p in inp.pods
+                    if not (p.meta.name.startswith("solo-")
+                            and rng.rand() < 0.1)]
+            for j in range(int(rng.randint(0, 5))):
+                pods.append(Pod(
+                    meta=ObjectMeta(name=f"solo-new-{pass_i}-{j}"),
+                    requests=Resources.parse(
+                        {"cpu": "250m", "memory": "512Mi"})))
+            gang_names = [p.meta.name for p in inp.pods
+                          if p.meta.name.startswith("gang")]
+            if gang_names and rng.rand() < 0.5:
+                s.delta_invalidate(
+                    pods=[gang_names[rng.randint(0, len(gang_names))]])
+            inp = dataclasses.replace(inp, pods=pods)
+
+
 class TestFuzzSweep:
     """Randomized leave-k-out sweeps: the device fast path must match the
     generic batched path exactly on arbitrary cluster snapshots, pod

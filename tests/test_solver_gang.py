@@ -1,0 +1,504 @@
+"""Gang scheduling (ISSUE 15): atomic multi-node, topology-adjacent
+placement for tightly-coupled workloads.
+
+Coverage: annotation parsing + the KARPENTER_TPU_GANG rollback knob,
+gang identity in the scheduling key, atomic K-node placement with
+slice/rack adjacency through the kernel, whole-gang stranding (never a
+partial placement), the GangIncomplete/GangPartiallyPlaceable/
+GangDomainExhausted/GangTooLarge verdict vocabulary with per-gang
+reason trees, the oracle's atomic gang pre-pass and kernel-vs-oracle
+verdict parity, the host-side atomicity safety net, the provisioning
+metric, and the flight recorder's resolved-knob stamp.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.env import Environment
+from karpenter_tpu.models import (
+    Node,
+    NodePool,
+    ObjectMeta,
+    Pod,
+    Resources,
+    wellknown,
+)
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.providers import generate_catalog
+from karpenter_tpu.providers.catalog import CatalogSpec
+from karpenter_tpu.scheduling import ExistingNode, ScheduleInput, Scheduler
+from karpenter_tpu.scheduling.types import (
+    gang_of, gang_placement_audit, gang_trial_order,
+)
+from karpenter_tpu.solver import TPUSolver, explain
+from karpenter_tpu.utils import metrics, telemetry
+
+CATALOG = generate_catalog(CatalogSpec(max_types=24, include_gpu=False))
+ZONE = wellknown.ZONE_LABEL
+CT = wellknown.CAPACITY_TYPE_LABEL
+
+
+def gang_pod(name, gname, size, cpu="2", mem="4Gi", dom=None, **kw):
+    ann = {wellknown.GANG_NAME_ANNOTATION: gname,
+           wellknown.GANG_SIZE_ANNOTATION: str(size)}
+    if dom is not None:
+        ann[wellknown.GANG_TOPOLOGY_ANNOTATION] = dom
+    return Pod(meta=ObjectMeta(name=name, annotations=ann),
+               requests=Resources.parse({"cpu": cpu, "memory": mem}),
+               **kw)
+
+
+def singleton(name, cpu="500m", mem="1Gi"):
+    return Pod(meta=ObjectMeta(name=name),
+               requests=Resources.parse({"cpu": cpu, "memory": mem}))
+
+
+def mkinp(pods, pools=None, **kw):
+    pools = pools or [NodePool(meta=ObjectMeta(name="default"))]
+    return ScheduleInput(pods=pods, nodepools=pools,
+                         instance_types={p.name: CATALOG for p in pools},
+                         **kw)
+
+
+def placed_domains(inp, res, pods, key):
+    """The set of adjacency-domain values the gang owning `pods` landed
+    in (fully placed, every new-node claim pinned to one value) — thin
+    view over the shared gang_placement_audit."""
+    sp = gang_of(pods[0])
+    assert sp is not None and sp.domain_key == key
+    a = gang_placement_audit(inp, res)[sp.name]
+    assert a["placed"] == a["total"], a
+    assert not a["unpinned"], a
+    return a["domains"]
+
+
+def assert_atomic(inp, res):
+    """The invariant: every gang fully placed (in one domain) or fully
+    stranded."""
+    for gname, a in gang_placement_audit(inp, res).items():
+        assert a["placed"] in (0, a["total"]), (
+            f"gang {gname} PARTIAL: {len(a['stranded'])}/{a['total']} "
+            "stranded")
+        if a["placed"] and a["spec"].domain_key is not None:
+            assert not a["unpinned"], (gname, a)
+            assert len(a["domains"]) == 1, (gname, a["domains"])
+
+
+@pytest.fixture(scope="module")
+def solver():
+    return TPUSolver(mesh="off")
+
+
+class TestGangModel:
+    def test_gang_of_parsing(self):
+        p = gang_pod("a", "g1", 4)
+        sp = gang_of(p)
+        assert sp.name == "g1" and sp.size == 4
+        assert sp.domain_key == ZONE  # default slice
+        assert gang_of(gang_pod("b", "g1", 4, dom="rack")).domain_key \
+            == CT
+        assert gang_of(gang_pod("c", "g1", 4, dom="none")).domain_key \
+            is None
+        # unknown domain values degrade to slice (keep adjacency, never
+        # silently drop it)
+        assert gang_of(gang_pod("d", "g1", 4,
+                                dom="blorp")).domain_key == ZONE
+        assert gang_of(singleton("s")) is None
+
+    def test_malformed_size_degrades_to_zero(self):
+        p = gang_pod("a", "g1", 4)
+        p.meta.annotations[wellknown.GANG_SIZE_ANNOTATION] = "many"
+        assert gang_of(p).size == 0  # no completeness requirement
+
+    def test_knob_off_makes_annotations_inert(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_GANG", "off")
+        assert gang_of(gang_pod("a", "g1", 4)) is None
+
+    def test_gang_identity_splits_scheduling_key(self):
+        a = gang_pod("a", "g1", 2)
+        b = gang_pod("b", "g2", 2)
+        c = singleton("c", cpu="2", mem="4Gi")
+        assert a.scheduling_key() != b.scheduling_key()
+        assert a.scheduling_key() != c.scheduling_key()
+        # same gang, same spec → one class
+        assert a.scheduling_key() == gang_pod("a2", "g1",
+                                              2).scheduling_key()
+
+    def test_gang_key_normalizes_like_gang_of(self):
+        # code-review regression: the scheduling key must use gang_of's
+        # PARSED spec, not raw annotation strings — cosmetic differences
+        # gang_of normalizes away ("Slice" vs "slice", an explicit
+        # default) must not split one gang into two classes (which
+        # _encode_gang would reject as multi-class)
+        a = gang_pod("a", "g1", 2, dom="slice")
+        b = gang_pod("b", "g1", 2, dom="Slice")
+        c = gang_pod("c", "g1", 2)          # default domain IS slice
+        assert a.scheduling_key() == b.scheduling_key()
+        assert a.scheduling_key() == c.scheduling_key()
+
+    def test_trial_order_is_lexicographic(self):
+        assert gang_trial_order({"b", "a", "c"}) == ["a", "b", "c"]
+
+
+class TestGangKernel:
+    def test_single_node_gang(self, solver):
+        inp = mkinp([gang_pod(f"g-{i}", "mpi", 4) for i in range(4)])
+        res = solver.solve(inp)
+        assert not res.unschedulable
+        assert_atomic(inp, res)
+
+    def test_multi_node_gang_single_zone(self, solver):
+        # 12cpu per member × 16 members won't fit one node: the gang
+        # needs a K-node atomic fill in ONE zone
+        inp = mkinp([gang_pod(f"g-{i}", "mpi", 16, cpu="12", mem="24Gi")
+                     for i in range(16)])
+        res = solver.solve(inp)
+        assert not res.unschedulable
+        assert res.node_count() > 1
+        assert_atomic(inp, res)
+
+    def test_rack_adjacency_uses_capacity_type_axis(self, solver):
+        inp = mkinp([gang_pod(f"g-{i}", "mpi", 6, dom="rack")
+                     for i in range(6)])
+        res = solver.solve(inp)
+        assert not res.unschedulable
+        doms = placed_domains(inp, res,
+                              [p for p in inp.pods], CT)
+        assert len(doms) == 1
+
+    def test_domain_free_gang_is_atomic_only(self, solver):
+        inp = mkinp([gang_pod(f"g-{i}", "mpi", 4, dom="none")
+                     for i in range(4)])
+        res = solver.solve(inp)
+        assert not res.unschedulable
+        assert_atomic(inp, res)
+
+    def test_mixed_gangs_and_singletons(self, solver):
+        pods = ([gang_pod(f"a-{i}", "mpi-a", 8) for i in range(8)]
+                + [gang_pod(f"b-{i}", "mpi-b", 3, cpu="4", mem="8Gi")
+                   for i in range(3)]
+                + [singleton(f"s-{i}") for i in range(40)])
+        inp = mkinp(pods)
+        res = solver.solve(inp)
+        assert not res.unschedulable
+        assert_atomic(inp, res)
+
+    def test_member_zone_requirement_restricts_trials(self, solver):
+        from karpenter_tpu.models import Requirement, Requirements
+        pods = []
+        for i in range(4):
+            p = gang_pod(f"g-{i}", "mpi", 4)
+            p.requirements = Requirements(
+                Requirement.make(ZONE, "In", "tpu-west-1b"))
+            pods.append(p)
+        inp = mkinp(pods)
+        res = solver.solve(inp)
+        assert not res.unschedulable
+        assert placed_domains(inp, res, pods, ZONE) == {"tpu-west-1b"}
+
+    def test_incomplete_gang_waits_whole(self, solver):
+        inp = mkinp([gang_pod(f"g-{i}", "mpi", 8) for i in range(5)])
+        res = solver.solve(inp)
+        assert len(res.unschedulable) == 5
+        codes = {explain.code_of(r) for r in res.unschedulable.values()}
+        assert codes == {explain.GANG_INCOMPLETE}
+
+    def test_too_large_gang_strands_whole_with_tree(self, solver):
+        inp = mkinp([gang_pod(f"g-{i}", "mpi", 6, cpu="4", mem="9000Gi")
+                     for i in range(6)]
+                    + [singleton(f"s-{i}") for i in range(5)])
+        res = solver.solve(inp)
+        assert sum(1 for n in res.unschedulable if n.startswith("g-")) \
+            == 6
+        # singletons still place — the gang strands ALONE
+        assert not any(n.startswith("s-") for n in res.unschedulable)
+        r = res.unschedulable["g-0"]
+        tree = getattr(r, "tree", None)
+        assert tree is not None
+        gt = tree.get("gang") or tree.get("kernel", {}).get("gang")
+        assert gt and gt["deficit_members"] == 6, tree
+
+    def test_partial_capacity_strands_whole_never_splits(self, solver):
+        # a binding pool limit that funds ~3 of 8 members: the gang
+        # must strand WHOLE (the oracle agrees), never place 3
+        pods = [gang_pod(f"g-{i}", "mpi", 8, cpu="4", mem="8Gi")
+                for i in range(8)]
+        inp = mkinp(pods,
+                    remaining_limits={
+                        "default": Resources.limits(cpu=14000)})
+        res = solver.solve(inp)
+        assert_atomic(inp, res)
+        assert len(res.unschedulable) == 8
+        orc = Scheduler(inp).solve()
+        assert len(orc.unschedulable) == 8
+        assert_atomic(inp, orc)
+
+    def test_knob_off_places_independently(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_GANG", "off")
+        s = TPUSolver(mesh="off")
+        # incomplete-gang shape: with the knob ON these wait; OFF they
+        # place as plain pods
+        inp = mkinp([gang_pod(f"g-{i}", "mpi", 8) for i in range(5)])
+        res = s.solve(inp)
+        assert not res.unschedulable
+
+    def test_heterogeneous_gang_rides_split_to_oracle(self, solver):
+        # two pod classes sharing one gang name: inexpressible for the
+        # per-group kernel; the split path hands the gang to the
+        # (gang-aware) oracle, which still places it atomically
+        pods = ([gang_pod(f"a-{i}", "mix", 6) for i in range(4)]
+                + [gang_pod(f"b-{i}", "mix", 6, cpu="4", mem="8Gi")
+                   for i in range(2)]
+                + [singleton(f"s-{i}") for i in range(6)])
+        inp = mkinp(pods)
+        res = solver.solve(inp)
+        assert not res.unschedulable
+        assert_atomic(inp, res)
+        doms = placed_domains(
+            inp, res, [p for p in pods if gang_of(p) is not None], ZONE)
+        assert len(doms) == 1
+
+    def test_gang_with_spread_rides_split_to_oracle(self, solver):
+        from karpenter_tpu.models import TopologySpreadConstraint
+        pods = [gang_pod(f"g-{i}", "mpi", 4,
+                         topology_spread=[TopologySpreadConstraint(
+                             topology_key=wellknown.HOSTNAME_LABEL,
+                             max_skew=2,
+                             label_selector={})])
+                for i in range(4)]
+        inp = mkinp(pods + [singleton(f"s-{i}") for i in range(4)])
+        res = solver.solve(inp)
+        assert_atomic(inp, res)
+
+
+class TestGangOracle:
+    def test_oracle_parity_verdicts(self, solver):
+        pods = ([gang_pod(f"a-{i}", "mpi-a", 8) for i in range(8)]
+                + [gang_pod(f"b-{i}", "mpi-b", 12, cpu="6", mem="12Gi")
+                   for i in range(12)]
+                + [gang_pod(f"w-{i}", "waiting", 5) for i in range(3)]
+                + [singleton(f"s-{i}") for i in range(30)])
+        inp = mkinp(pods)
+        res = solver.solve(inp)
+        orc = Scheduler(inp).solve()
+        assert_atomic(inp, res)
+        assert_atomic(inp, orc)
+        # per-gang verdict parity, and the same chosen domain
+        for gname in ("mpi-a", "mpi-b", "waiting"):
+            mem = [p for p in pods
+                   if (gang_of(p) or type("o", (), {"name": None})).name
+                   == gname]
+            sv = all(p.meta.name not in res.unschedulable for p in mem)
+            ov = all(p.meta.name not in orc.unschedulable for p in mem)
+            assert sv == ov, (gname, sv, ov)
+            if sv and gang_of(mem[0]).domain_key is not None:
+                assert placed_domains(inp, res, mem, ZONE) == \
+                    placed_domains(inp, orc, mem, ZONE), gname
+        assert {n for n in res.unschedulable} == \
+            {n for n in orc.unschedulable}
+
+    def test_oracle_rollback_restores_state(self):
+        # a failing trial must leave NO trace: solve the same input
+        # with and without an impossible gang — the singleton packing
+        # must be identical
+        base = [singleton(f"s-{i}", cpu="2", mem="4Gi")
+                for i in range(20)]
+        impossible = [gang_pod(f"g-{i}", "nope", 4, cpu="4",
+                               mem="9000Gi") for i in range(4)]
+        res_a = Scheduler(mkinp(list(base))).solve()
+        res_b = Scheduler(mkinp(base + impossible)).solve()
+        assert len(res_b.unschedulable) == 4
+        assert res_a.node_count() == res_b.node_count()
+        assert abs(res_a.total_price() - res_b.total_price()) < 1e-9
+
+    def test_oracle_uses_existing_nodes_in_domain(self):
+        alloc = Resources.parse(
+            {"cpu": "16", "memory": "64Gi", "pods": "110"})
+        existing = []
+        for i, z in enumerate(["tpu-west-1b", "tpu-west-1b"]):
+            node = Node(meta=ObjectMeta(
+                name=f"n{i}", labels={ZONE: z, CT: "on-demand",
+                                      wellknown.HOSTNAME_LABEL: f"n{i}",
+                                      wellknown.NODEPOOL_LABEL:
+                                          "default"}),
+                allocatable=alloc, ready=True)
+            existing.append(ExistingNode(node=node, available=alloc,
+                                         pods=[]))
+        pods = [gang_pod(f"g-{i}", "mpi", 8) for i in range(8)]
+        inp = mkinp(pods)
+        inp.existing_nodes = existing
+        res = Scheduler(inp).solve()
+        assert not res.unschedulable
+        assert_atomic(inp, res)
+        sres = TPUSolver(mesh="off").solve(inp)
+        assert not sres.unschedulable
+        assert_atomic(inp, sres)
+
+    @staticmethod
+    def _bound_input(n_bound, n_pending, size, zone="tpu-west-1b"):
+        alloc = Resources.parse(
+            {"cpu": "16", "memory": "64Gi", "pods": "110"})
+        bound = [gang_pod(f"g-{i}", "mpi", size)
+                 for i in range(n_bound)]
+        node = Node(meta=ObjectMeta(
+            name="n0", labels={ZONE: zone, CT: "on-demand",
+                               wellknown.HOSTNAME_LABEL: "n0",
+                               wellknown.NODEPOOL_LABEL: "default"}),
+            allocatable=alloc, ready=True)
+        avail = alloc - Resources.parse(
+            {"cpu": "2", "memory": "4Gi"}) * n_bound
+        existing = [ExistingNode(node=node, available=avail, pods=bound)]
+        pending = [gang_pod(f"g-{n_bound + i}", "mpi", size)
+                   for i in range(n_pending)]
+        inp = mkinp(pending)
+        inp.existing_nodes = existing
+        return inp
+
+    def test_residual_gang_rejoins_bound_members(self):
+        # code-review regression: a recreated member of a RUNNING gang
+        # must not strand GangIncomplete forever — bound members count
+        # toward completeness, and the residual rank must land in the
+        # bound members' domain (trial order alone would pick
+        # tpu-west-1a; the pin forces 1b where the gang runs)
+        inp = self._bound_input(n_bound=3, n_pending=1, size=4)
+        for res in (Scheduler(inp).solve(),
+                    TPUSolver(mesh="off").solve(inp)):
+            assert "g-3" not in res.unschedulable, res.unschedulable
+            doms = placed_domains(inp, res, inp.pods, ZONE)
+            assert doms == {"tpu-west-1b"}, doms
+
+    def test_residual_gang_incomplete_counts_bound(self):
+        # 1 pending + 2 bound of 4 declared: still incomplete — the
+        # verdict counts both and the tree carries members_bound
+        inp = self._bound_input(n_bound=2, n_pending=1, size=4)
+        res = TPUSolver(mesh="off").solve(inp)
+        r = res.unschedulable["g-2"]
+        assert r.code == explain.GANG_INCOMPLETE, r.code
+        gt = r.tree.get("gang") or {}
+        assert gt.get("members_bound") == 2, gt
+        assert "1 member(s) pending + 2 bound of 4" in str(r), str(r)
+
+
+class TestGangRepairNet:
+    def test_repair_rolls_back_partial_gang(self, solver):
+        # fabricate a partial fill out of a real encoding: the safety
+        # net must zero it atomically and release the used vectors
+        from karpenter_tpu.solver.encode import encode, encode_catalog
+        inp = mkinp([gang_pod(f"g-{i}", "mpi", 4) for i in range(4)])
+        cat = encode_catalog(inp)
+        enc = encode(inp, cat)
+        assert enc.group_gang[0]
+        N = 8
+        out = {
+            "take_exist": np.zeros((1, 0), np.float32),
+            "take_new": np.zeros((1, N), np.float32),
+            "unsched": np.zeros(1, np.float32),
+            "used": np.zeros((N, enc.group_req.shape[1]), np.float32),
+            "node_pool": np.zeros(N, np.int32),
+            "node_zone": np.zeros(N, np.int32),
+            "node_ct": np.zeros(N, np.int32),
+            "num_active": 1,
+            "dom_placed": np.zeros((1, enc.n_domains), np.float32),
+        }
+        out["take_new"][0, 0] = 2  # 2 of 4 members: PARTIAL
+        out["used"][0] = 2 * enc.group_req[0]
+        before = metrics.SOLVER_GANG_REPAIRS.value()
+        solver._repair_gang(enc, out)
+        assert out["take_new"][0].sum() == 0
+        assert out["unsched"][0] == 2
+        assert np.allclose(out["used"][0], 0)
+        assert metrics.SOLVER_GANG_REPAIRS.value() == before + 1
+
+    def test_repair_rolls_back_cross_domain_gang(self, solver):
+        from karpenter_tpu.solver.encode import encode, encode_catalog
+        inp = mkinp([gang_pod(f"g-{i}", "mpi", 4) for i in range(4)])
+        cat = encode_catalog(inp)
+        enc = encode(inp, cat)
+        N = 8
+        out = {
+            "take_exist": np.zeros((1, 0), np.float32),
+            "take_new": np.zeros((1, N), np.float32),
+            "unsched": np.zeros(1, np.float32),
+            "used": np.zeros((N, enc.group_req.shape[1]), np.float32),
+            "node_pool": np.zeros(N, np.int32),
+            "node_zone": np.zeros(N, np.int32),
+            "node_ct": np.zeros(N, np.int32),
+            "num_active": 2,
+            "dom_placed": np.zeros((1, enc.n_domains), np.float32),
+        }
+        out["take_new"][0, 0] = 2
+        out["take_new"][0, 1] = 2
+        out["node_zone"][0], out["node_zone"][1] = 0, 1  # SPLIT domains
+        solver._repair_gang(enc, out)
+        assert out["take_new"][0].sum() == 0
+        assert out["unsched"][0] == 4
+
+
+class TestGangProvenance:
+    def test_gang_placement_metric(self):
+        env = Environment(options=Options(batch_idle_duration=0))
+        env.add_default_nodeclass()
+        env.cluster.nodepools.create(
+            NodePool(meta=ObjectMeta(name="default")))
+        for i in range(4):
+            env.cluster.pods.create(gang_pod(f"ok-{i}", "mpi-ok", 4))
+        for i in range(3):
+            env.cluster.pods.create(
+                gang_pod(f"no-{i}", "mpi-no", 3, cpu="4",
+                         mem="9000Gi"))
+        before = dict(telemetry._series(metrics.GANG_PLACEMENTS))
+        env.provisioner.reconcile()
+        after = telemetry._series(metrics.GANG_PLACEMENTS)
+        assert after.get("placed", 0) == before.get("placed", 0) + 1
+        assert after.get("stranded", 0) == before.get("stranded", 0) + 1
+
+    def test_flight_record_carries_gang_knob(self, solver):
+        from karpenter_tpu.utils import flightrecorder as fr
+        fr.RECORDER.reset()
+        assert fr.RECORDER.enabled  # on by default (conftest scrubs env)
+        solver.solve(mkinp([gang_pod(f"g-{i}", "mpi", 2)
+                            for i in range(2)]))
+        recs = fr.RECORDER.tail(1)
+        assert recs and recs[-1]["knobs"]["gang"] is True
+
+    def test_gang_codes_registered_and_constraint(self):
+        for code in (explain.GANG_PARTIAL, explain.GANG_DOMAIN,
+                     explain.GANG_TOO_LARGE, explain.GANG_INCOMPLETE):
+            assert code in explain.REGISTRY
+            assert explain.constraint_of(code) == "gang"
+
+    def test_partial_reason_tree_names_nearest_domain(self, solver):
+        # limit funds a few members: the tree must carry the deficit
+        pods = [gang_pod(f"g-{i}", "mpi", 8, cpu="4", mem="8Gi")
+                for i in range(8)]
+        inp = mkinp(pods,
+                    remaining_limits={
+                        "default": Resources.limits(cpu=14000)})
+        res = solver.solve(inp)
+        assert len(res.unschedulable) == 8
+        r = res.unschedulable["g-0"]
+        tree = getattr(r, "tree", None)
+        assert tree is not None
+        gt = tree.get("gang") or tree.get("kernel", {}).get("gang")
+        assert gt is not None, tree
+        assert gt["deficit_members"] >= 1
+        assert gt["domain_axis"] == "zone"
+
+    def test_too_large_survives_rescue_rejudgement(self, solver):
+        # code-review regression: a gang no node shape can EVER hold
+        # must surface GangTooLarge in the FINAL result — the rescue
+        # path re-judges kernel strands through the oracle, whose gang
+        # pre-pass used to know only GangDomainExhausted ("currently",
+        # i.e. waiting might help — wrong for a can-never-fit gang)
+        pods = [gang_pod(f"g-{i}", "mpi", 4, mem="8000Gi")
+                for i in range(4)]
+        res = solver.solve(mkinp(pods))
+        assert len(res.unschedulable) == 4
+        r = res.unschedulable["g-0"]
+        assert r.code == explain.GANG_TOO_LARGE, (r.code, str(r))
+        # the oracle-side tree agrees (deficit_nodes is None: no
+        # purchasable shape holds a member, so no node count helps)
+        gt = r.tree.get("gang") or {}
+        assert gt.get("deficit_nodes") is None, gt

@@ -212,6 +212,53 @@ class TestShardedEqualsSingle:
             [dataclasses.replace(i_) for i_ in inps], max_nodes=8)
         assert [canon(x) for x in ra] == [canon(x) for x in rb]
 
+    def test_gang_atomic_fill_combines_bit_identically(self, solvers):
+        # gang scheduling (ISSUE 15): the K-node atomic gang fill's
+        # winner selections ride the same _axmax/pmax path as every
+        # other column reduction, so the sharded program must produce
+        # BIT-identical claims (canon compares pods, ranked types, and
+        # exact prices) — including the per-domain candidate totals
+        # that pick the winning adjacency domain
+        pods = ([mkpod(f"g-{i}", cpu="12", mem="24Gi") for i in range(16)]
+                + [mkpod(f"r-{i}", cpu="1", mem="2Gi") for i in range(6)]
+                + [mkpod(f"s-{i}") for i in range(30)])
+        for i in range(16):
+            pods[i].meta.annotations.update({
+                wellknown.GANG_NAME_ANNOTATION: "mesh-mpi",
+                wellknown.GANG_SIZE_ANNOTATION: "16"})
+        for i in range(16, 22):
+            pods[i].meta.annotations.update({
+                wellknown.GANG_NAME_ANNOTATION: "mesh-rack",
+                wellknown.GANG_SIZE_ANNOTATION: "6",
+                wellknown.GANG_TOPOLOGY_ANNOTATION: "rack"})
+        res = assert_same(solvers, mkinput(pods))
+        assert not res.unschedulable
+        # the gang really is multi-node and single-zone
+        gang_claims = [c for c in res.new_claims
+                       if any(p.meta.name.startswith("g-")
+                              for p in c.pods)]
+        assert len(gang_claims) > 1
+        zones = set()
+        for c in gang_claims:
+            zr = c.requirements.get(wellknown.ZONE_LABEL)
+            assert zr is not None and len(zr.values()) == 1
+            zones |= zr.values()
+        assert len(zones) == 1
+
+    def test_gang_stranded_atomically_under_mesh(self, solvers):
+        # a gang the fleet cannot hold strands WHOLE and identically on
+        # both solvers — the all-or-nothing rollback must also combine
+        # exactly across shards
+        pods = [mkpod(f"ng-{i}", cpu="4", mem="9000Gi") for i in range(4)]
+        for p in pods:
+            p.meta.annotations.update({
+                wellknown.GANG_NAME_ANNOTATION: "mesh-nope",
+                wellknown.GANG_SIZE_ANNOTATION: "4"})
+        pods += [mkpod(f"ok-{i}") for i in range(8)]
+        res = assert_same(solvers, mkinput(pods))
+        assert len(res.unschedulable) == 4
+        assert not any(n.startswith("ok-") for n in res.unschedulable)
+
     def test_explicit_device_count(self):
         s2 = TPUSolver(mesh=2)
         assert s2.mesh is not None and s2.mesh.size == 2

@@ -261,6 +261,68 @@ class TestSchedulerUnits:
         assert parse_weights("bad, x=0, y=oops") == {"x": 0.1}
         assert parse_weights(None) == {}
 
+    def test_weights_file_config_surface(self, tmp_path, monkeypatch):
+        # ISSUE 15 satellite: weights from the config file
+        # (operator-options surface), env knob stays the OVERRIDE
+        from karpenter_tpu.service.scheduler import load_weights
+        f = tmp_path / "weights.conf"
+        f.write_text(
+            "# tiers\n"
+            "gold=4, silver=2\n"
+            "free=1   # the rest\n"
+            "typo-no-equals\n")
+        monkeypatch.setenv("KARPENTER_TPU_TENANT_WEIGHTS_FILE", str(f))
+        monkeypatch.delenv("KARPENTER_TPU_TENANT_WEIGHTS",
+                           raising=False)
+        assert load_weights() == {"gold": 4.0, "silver": 2.0,
+                                  "free": 1.0}
+        # env OVERRIDES per tenant, file entries it doesn't name stay
+        monkeypatch.setenv("KARPENTER_TPU_TENANT_WEIGHTS",
+                           "gold=8,platinum=16")
+        assert load_weights() == {"gold": 8.0, "silver": 2.0,
+                                  "free": 1.0, "platinum": 16.0}
+        # the scheduler picks the merged view up by default
+        sched = TenantScheduler()
+        assert sched._weights["gold"] == 8.0
+        assert sched._weights["silver"] == 2.0
+
+    def test_weights_file_missing_degrades(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_TENANT_WEIGHTS_FILE",
+                           "/nonexistent/weights.conf")
+        monkeypatch.setenv("KARPENTER_TPU_TENANT_WEIGHTS", "a=2")
+        from karpenter_tpu.service.scheduler import load_weights
+        assert load_weights() == {"a": 2.0}
+
+    def test_weights_file_bad_bytes_degrades(self, tmp_path, monkeypatch):
+        # code-review regression: UnicodeDecodeError is not an OSError —
+        # a binary/latin-1 file must degrade, not crash the daemon
+        f = tmp_path / "weights.bin"
+        f.write_bytes(b"gold=\xff\xfe4\n")
+        monkeypatch.setenv("KARPENTER_TPU_TENANT_WEIGHTS_FILE", str(f))
+        monkeypatch.setenv("KARPENTER_TPU_TENANT_WEIGHTS", "a=2")
+        from karpenter_tpu.service.scheduler import load_weights
+        assert load_weights() == {"a": 2.0}
+
+    def test_supervisor_flag_exports_weights_file(self, monkeypatch):
+        # --tenant-weights-file lands in the WORKER env (export-only;
+        # the scheduler inside the worker owns the parse)
+        captured = {}
+        from karpenter_tpu.service import supervisor as sup_mod
+
+        class FakeSup:
+            def __init__(self, *a, **kw):
+                captured.update(kw)
+                raise KeyboardInterrupt  # stop main() before start()
+
+        monkeypatch.setattr(sup_mod, "SolverdSupervisor", FakeSup)
+        try:
+            sup_mod.main(["--socket", "/tmp/x.sock",
+                          "--tenant-weights-file", "/etc/kt/weights"])
+        except KeyboardInterrupt:
+            pass
+        assert captured["env"]["KARPENTER_TPU_TENANT_WEIGHTS_FILE"] \
+            == "/etc/kt/weights"
+
     def test_concurrent_pumps_fuse_across_threads(self):
         """Two threads submitting compatible items concurrently: one
         becomes the dispatcher and carries the other's items; both pumps

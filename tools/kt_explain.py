@@ -34,7 +34,7 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 
-def explain_capture(payload: dict) -> dict:
+def explain_capture(payload: dict, knobs: "dict | None" = None) -> dict:
     """Re-execute a captured problem with explain armed; returns
     {summary, unschedulable: {pod: entry}} where each entry carries the
     code/detail/tree."""
@@ -45,6 +45,11 @@ def explain_capture(payload: dict) -> dict:
     os.environ["KARPENTER_TPU_DELTA"] = "off"
     os.environ.setdefault("KARPENTER_TPU_MESH", "off")
     os.environ["KARPENTER_TPU_EXPLAIN"] = "full"
+    # gang is semantic (ISSUE 15): resolve it as the recording did so
+    # the re-executed verdicts match the ones the operator saw
+    if knobs is not None and "gang" in knobs:
+        os.environ["KARPENTER_TPU_GANG"] = (
+            "on" if knobs.get("gang") else "off")
     from karpenter_tpu.utils.platform import configure
     configure()
     from karpenter_tpu.solver import TPUSolver
@@ -85,7 +90,8 @@ def explain_file(path: str, seq=None, trace_id=None) -> dict:
                 f"record seq={record.get('seq')} carries no capture "
                 "(fingerprint-only); re-run the workload with "
                 "KARPENTER_TPU_FLIGHT_CAPTURE=1")
-    out = explain_capture(load_capture(record["capture"]))
+    out = explain_capture(load_capture(record["capture"]),
+                          knobs=record.get("knobs"))
     out["record"] = {k: record.get(k) for k in
                      ("seq", "trace_id", "fingerprint", "pods",
                       "groups", "knobs", "capture")}

@@ -66,7 +66,7 @@ def load_capture(path: str) -> dict:
     return payload
 
 
-def replay(payload: dict) -> dict:
+def replay(payload: dict, knobs: "dict | None" = None) -> dict:
     """Re-execute the captured problem and return its bit-exact digest
     (the same shape `flightrecorder.result_digest` records)."""
     # pin the replay environment BEFORE the solver imports resolve the
@@ -77,6 +77,13 @@ def replay(payload: dict) -> dict:
     os.environ["KARPENTER_TPU_FLIGHT"] = "off"
     os.environ["KARPENTER_TPU_DELTA"] = "off"
     os.environ.setdefault("KARPENTER_TPU_MESH", "off")
+    # the gang knob is SEMANTIC, not an execution strategy: a solve
+    # recorded with gangs disabled placed gang members as plain pods,
+    # so replay must resolve the knob exactly as the recording did or
+    # the digest legitimately differs (ISSUE 15)
+    if knobs is not None and "gang" in knobs:
+        os.environ["KARPENTER_TPU_GANG"] = (
+            "on" if knobs.get("gang") else "off")
     from karpenter_tpu.utils.platform import configure
     configure()
     from karpenter_tpu.solver import TPUSolver
@@ -115,7 +122,8 @@ def replay_file(path: str, seq=None, trace_id=None) -> dict:
                 f"record seq={record.get('seq')} carries no capture "
                 "(fingerprint-only); re-run the workload with "
                 "KARPENTER_TPU_FLIGHT_CAPTURE=1")
-    replayed = replay(load_capture(record["capture"]))
+    replayed = replay(load_capture(record["capture"]),
+                      knobs=record.get("knobs"))
     recorded = record.get("result") or {}
     return {"record": {k: record.get(k) for k in
                        ("seq", "trace_id", "fingerprint", "pods",
