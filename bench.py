@@ -150,7 +150,7 @@ def run_configs(timeout_s: float):
                "config4b_consolidation_spread.py",
                "config5_burst.py", "config6_interruption.py",
                "config7_churn.py", "config8_saturation.py",
-               "config9_gang.py"]
+               "config9_gang.py", "config10_priority.py"]
     env = dict(os.environ)
     # configs share the persistent compile cache (platform bootstrap), so
     # a generous per-probe budget isn't needed — keep failures quick so

@@ -1,0 +1,270 @@
+"""BASELINE config #10: priority classes & preemption (ISSUE 16) —
+mixed priority waves over a spot-interruption storm, through the
+kernel's band-major pack, the shared preemption planner, and the
+spot-risk-weighted objective.
+
+Acceptance (boolean fields `make bench-regress` gates):
+  * zero_priority_inversions — the shared
+    `scheduling.types.priority_inversion_audit` (the SAME implementation
+    the TestFuzzPriority class asserts) returns empty on BOTH engines'
+    results, attached plans excusing exactly their own victims/targets;
+  * risk_cost_le_price_only — re-solving the identical input with
+    `KARPENTER_TPU_SPOT_RISK=on` (same storm-fed model) covers the same
+    pods while the expected interruption cost ($/hr · p_interrupt of
+    each claim's winning offering) is no worse than price-only packing.
+
+Non-gated provenance booleans in the same record:
+  * gang_eviction_atomic — every gang victim unit in every attached
+    plan names the WHOLE gang;
+  * preemption_ledger_hex_exact — an Environment-driven pool-limit
+    preemption lands ledger rows whose cost_delta is IEEE-hex-exactly
+    0.0 (an eviction moves pods, never money).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# pin the knob DEFAULTS for the timed run: priority ON (the subject
+# under test — an exported =off would make every wave a single band),
+# spot-risk OFF (the risk story is the in-record re-solve, and the
+# timed number must stay comparable to price-only baselines)
+os.environ.pop("KARPENTER_TPU_PRIORITY", None)
+os.environ.pop("KARPENTER_TPU_SPOT_RISK", None)
+
+from benchmarks.common import run
+from karpenter_tpu.models import (
+    Node, NodePool, ObjectMeta, Pod, Requirement, Requirements,
+    Resources, wellknown,
+)
+from karpenter_tpu.providers import generate_catalog
+from karpenter_tpu.scheduling import ExistingNode, ScheduleInput
+
+CATALOG = generate_catalog()
+
+# a zone that exists only on the hand-built edge node — pods pinned
+# here compete for existing capacity, which is what makes the
+# preemption planner's work observable
+EDGE_ZONE = "tpu-edge-1x"
+
+# (prefix, count, cpu, mem, priority-annotation) — four bands from
+# best-effort to system-critical, interleaved by construction (the
+# band-major sort is the solver's job, not the workload's)
+WAVES = [
+    ("be", 300, "250m", "512Mi", None),
+    ("mid", 200, "1", "2Gi", 100),
+    ("hi", 120, "2", "4Gi", 1000),
+    ("sys", 30, "1", "2Gi", 2_000_000_000),
+]
+
+# the storm: concentrated spot reclaims observed in two zones for the
+# catalog's cheapest types — the risk model's probabilities there jump
+# by the observation bump, so risk-aware packing routes around them
+STORM_ZONES = ("tpu-west-1a", "tpu-west-1b")
+STORM_OBSERVATIONS = 4
+
+_INPUT = [None]
+
+
+def _mkpod(name, cpu, mem, prio=None, annotations=None):
+    ann = dict(annotations or {})
+    if prio is not None:
+        ann[wellknown.PRIORITY_ANNOTATION] = str(prio)
+    return Pod(meta=ObjectMeta(name=name, annotations=ann),
+               requests=Resources.parse({"cpu": cpu, "memory": mem}))
+
+
+def _edge_node():
+    """One 16-cpu edge node whose residents force a whole-gang
+    eviction: a 2x5-cpu low gang + a 2-cpu low single leave 4 cpu, and
+    the pinned 12-cpu high seats only when the GANG goes (the single
+    alone frees 6 — insufficient — so minimality prunes it back out)."""
+    residents = []
+    for i in range(2):
+        m = _mkpod(f"ring-{i}", "5", "4Gi", prio=1, annotations={
+            wellknown.GANG_NAME_ANNOTATION: "ring",
+            wellknown.GANG_SIZE_ANNOTATION: "2"})
+        residents.append(m)
+    residents.append(_mkpod("low-edge", "2", "1Gi", prio=1))
+    alloc = Resources.parse(
+        {"cpu": "16", "memory": "64Gi", "pods": "110"})
+    used = Resources()
+    for p in residents:
+        used += p.requests
+        p.node_name = "edge-0"
+    node = Node(meta=ObjectMeta(
+        name="edge-0",
+        labels={wellknown.ZONE_LABEL: EDGE_ZONE,
+                wellknown.CAPACITY_TYPE_LABEL: "on-demand",
+                wellknown.HOSTNAME_LABEL: "edge-0",
+                wellknown.NODEPOOL_LABEL: "default"}),
+        allocatable=alloc, ready=True)
+    return ExistingNode(node=node, available=alloc - used,
+                        pods=residents)
+
+
+def _build():
+    pods = []
+    for prefix, count, cpu, mem, prio in WAVES:
+        for i in range(count):
+            pods.append(_mkpod(f"{prefix}-{i}", cpu, mem, prio=prio))
+    # the preemption trigger: a high pod pinned where only evicting the
+    # resident low gang can seat it
+    pin = _mkpod("pin-hi", "12", "8Gi", prio=1000)
+    pin.requirements = Requirements(
+        Requirement.make(wellknown.ZONE_LABEL, "In", EDGE_ZONE))
+    pods.append(pin)
+    pool = NodePool(meta=ObjectMeta(name="default"))
+    return ScheduleInput(pods=pods, nodepools=[pool],
+                         instance_types={"default": CATALOG},
+                         existing_nodes=[_edge_node()])
+
+
+def make_input():
+    from karpenter_tpu.scheduling import risk
+    risk.reset()
+    cheap_types = sorted(CATALOG, key=lambda it: min(
+        (o.price for o in it.offerings if o.available), default=1e9))
+    for it in cheap_types[:6]:
+        for zone in STORM_ZONES:
+            for _ in range(STORM_OBSERVATIONS):
+                risk.observe_interruption(it.name, zone)
+    inp = _build()
+    _INPUT[0] = inp
+    return inp
+
+
+def _expected_interruption_cost(res, risk_mode):
+    """Σ over claims of p_interrupt · $/hr for the winning offering —
+    reconstructed the way the engine ranks it (min effective price in
+    risk mode, min real price otherwise) since a claim pins its type
+    but records only the winning price."""
+    from karpenter_tpu.scheduling import risk
+    by_name = {it.name: it for it in CATALOG}
+    total = 0.0
+    for c in res.new_claims:
+        if not c.instance_type_names:
+            continue
+        it = by_name.get(c.instance_type_names[0])
+        if it is None:
+            continue
+        offs = [o for o in it.offerings if o.available]
+        if not offs:
+            continue
+        if risk_mode:
+            o = min(offs, key=lambda o: risk.effective_price(
+                o.price, it.name, o.zone, o.capacity_type))
+        else:
+            o = min(offs, key=lambda o: o.price)
+        total += risk.expected_interruption_cost(
+            o.price, it.name, o.zone, o.capacity_type)
+    return total
+
+
+def _placed(res):
+    return (set(res.existing_assignments)
+            | {p.meta.name for c in res.new_claims for p in c.pods})
+
+
+def _gang_plans_atomic(inp, plans):
+    members = {}
+    for en in inp.existing_nodes:
+        for p in en.pods:
+            g = p.meta.annotations.get(wellknown.GANG_NAME_ANNOTATION)
+            if g:
+                members.setdefault(g, set()).add(p.meta.name)
+    saw_gang = False
+    for pl in plans:
+        for u in pl.victims:
+            if u.gang is not None:
+                saw_gang = True
+                if set(u.pod_names) != members.get(u.gang, set()):
+                    return False
+    return saw_gang
+
+
+def _ledger_drive():
+    """Pool-limit preemption through the full controller loop: plan →
+    stamp → evict → reseat, every eviction ledger-recorded with an
+    IEEE-hex-exact zero cost delta."""
+    from karpenter_tpu.env import Environment
+    from karpenter_tpu.operator.options import Options
+    from karpenter_tpu.utils import ledger
+
+    env = Environment(options=Options(batch_idle_duration=0))
+    env.add_default_nodeclass()
+    env.cluster.nodepools.create(NodePool(
+        meta=ObjectMeta(name="default"),
+        limits=Resources.limits({"cpu": 16})))
+    ledger.LEDGER.reset()
+    for i in range(3):
+        env.cluster.pods.create(_mkpod(f"low-{i}", "4", "2Gi", prio=1))
+    env.settle()
+    env.cluster.pods.create(_mkpod("crit", "8", "4Gi", prio=1000))
+    seated = False
+    for _ in range(8):
+        env.settle()
+        p = env.cluster.pods.get("crit")
+        if p is not None and p.scheduled:
+            seated = True
+            break
+    rows = [r for r in ledger.LEDGER.tail(64)
+            if r["source"] == "preemption"]
+    hex_ok = bool(rows) and all(
+        r["cost_delta_hex"] == (0.0).hex() for r in rows)
+    return seated and hex_ok
+
+
+def _priority_checks(res):
+    from karpenter_tpu.scheduling import Scheduler
+    from karpenter_tpu.scheduling.types import priority_inversion_audit
+    from karpenter_tpu.solver import TPUSolver
+
+    inp = _INPUT[0]
+    inv_k = priority_inversion_audit(inp, res, res.preemptions)
+    oinp = _build()
+    ores = Scheduler(oinp).solve()
+    inv_o = priority_inversion_audit(oinp, ores, ores.preemptions)
+    zero_inv = not inv_k and not inv_o
+    gang_atomic = (_gang_plans_atomic(inp, res.preemptions)
+                   and _gang_plans_atomic(oinp, ores.preemptions))
+
+    # the risk story: identical input, same storm-fed model, knob on —
+    # equal coverage at no-worse expected interruption cost
+    os.environ["KARPENTER_TPU_SPOT_RISK"] = "on"
+    try:
+        res_on = TPUSolver(max_nodes=2048).solve(_build())
+    finally:
+        os.environ.pop("KARPENTER_TPU_SPOT_RISK", None)
+    coverage_equal = _placed(res_on) == _placed(res)
+    cost_on = _expected_interruption_cost(res_on, risk_mode=True)
+    cost_off = _expected_interruption_cost(res, risk_mode=False)
+    risk_le = bool(coverage_equal and cost_on <= cost_off + 1e-9)
+
+    ledger_ok = _ledger_drive()
+    return {
+        "pods": len(inp.pods),
+        "nodes": res.node_count(),
+        "plans": len(res.preemptions),
+        "inversions": len(inv_k) + len(inv_o),
+        "expected_interruption_cost_risk_on": round(cost_on, 5),
+        "expected_interruption_cost_price_only": round(cost_off, 5),
+        "zero_priority_inversions": bool(zero_inv),
+        "risk_cost_le_price_only": risk_le,
+        "gang_eviction_atomic": bool(gang_atomic),
+        "preemption_ledger_hex_exact": bool(ledger_ok),
+        "pass": bool(zero_inv and risk_le and gang_atomic and ledger_ok),
+    }
+
+
+if __name__ == "__main__":
+    res = run("config#10 priority: 4-band waves + spot storm, "
+              "preemption-aware pack", 500.0, make_input,
+              extra=_priority_checks)
+    # the pinned high strands pending its plan; nothing in the
+    # system-critical band may strand at all
+    assert all(not n.startswith("sys-") for n in res.unschedulable), \
+        [n for n in res.unschedulable if n.startswith("sys-")][:5]
+    assert any(pl.target_pods == ["pin-hi"] for pl in res.preemptions), \
+        [pl.target_pods for pl in res.preemptions]

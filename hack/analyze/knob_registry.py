@@ -81,7 +81,7 @@ KNOBS = {
     "KARPENTER_TPU_PLATFORM": {
         "owner": "karpenter_tpu/utils/platform.py", "kind": "value"},
     "KARPENTER_TPU_PRIORITY": {
-        "owner": "karpenter_tpu/operator/options.py", "kind": "value"},
+        "owner": "karpenter_tpu/utils/knobs.py", "kind": "bool"},
     "KARPENTER_TPU_PROBE_TIMEOUT": {
         "owner": "karpenter_tpu/utils/platform.py", "kind": "value"},
     "KARPENTER_TPU_PROFILE": {
@@ -100,10 +100,14 @@ KNOBS = {
         "owner": "karpenter_tpu/operator/options.py", "kind": "value"},
     "KARPENTER_TPU_SERVICE_LOCAL_FALLBACK": {
         "owner": "karpenter_tpu/operator/options.py", "kind": "bool"},
+    "KARPENTER_TPU_SERVICE_PRIORITY": {
+        "owner": "karpenter_tpu/operator/options.py", "kind": "value"},
     "KARPENTER_TPU_SERVICE_RETRIES": {
         "owner": "karpenter_tpu/operator/options.py", "kind": "value"},
     "KARPENTER_TPU_SERVICE_TIMEOUT": {
         "owner": "karpenter_tpu/operator/options.py", "kind": "value"},
+    "KARPENTER_TPU_SPOT_RISK": {
+        "owner": "karpenter_tpu/utils/knobs.py", "kind": "bool"},
     "KARPENTER_TPU_STORE_BACKEND": {
         "owner": "karpenter_tpu/env.py", "kind": "value"},
     "KARPENTER_TPU_STORE_SOCKET": {

@@ -86,7 +86,11 @@ _REASON_REGISTRY_MODULE = "karpenter_tpu/solver/explain.py"
 
 # decision-emitting controllers: *_reason functions here feed the
 # decision ledger and must return registry-coded Reasons, not literals
-_REASON_RETURN_MODULES = ("karpenter_tpu/controllers/disruption.py",)
+_REASON_RETURN_MODULES = (
+    "karpenter_tpu/controllers/disruption.py",
+    "karpenter_tpu/solver/preempt.py",
+    "karpenter_tpu/controllers/preemption.py",
+)
 
 
 def _contains_str_literal(expr: ast.AST) -> bool:

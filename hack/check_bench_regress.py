@@ -45,7 +45,12 @@ _PARITY_KEYS = ("parity", "pass", "nodes_le_oracle",
                 # config9 (gang scheduling): the atomicity invariant and
                 # the per-gang verdict parity vs the oracle are boolean
                 # acceptance fields of the gang bench's record
-                "zero_partial_placements", "gang_parity")
+                "zero_partial_placements", "gang_parity",
+                # config10 (priority/preemption): the shared-audit
+                # zero-inversion invariant on both engines and the
+                # spot-risk expected-interruption-cost bound vs
+                # price-only packing at equal coverage
+                "zero_priority_inversions", "risk_cost_le_price_only")
 _NAME_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 
 

@@ -8,6 +8,7 @@ from karpenter_tpu.controllers.kubelet import FakeKubelet
 from karpenter_tpu.controllers.binder import PodBinder
 from karpenter_tpu.controllers.termination import Termination
 from karpenter_tpu.controllers.interruption import Interruption
+from karpenter_tpu.controllers.preemption import Preemption
 from karpenter_tpu.controllers.gc import GarbageCollection
 from karpenter_tpu.controllers.expiration import Expiration
 from karpenter_tpu.controllers.disruption import Disruption
@@ -27,6 +28,7 @@ __all__ = [
     "PodBinder",
     "Termination",
     "Interruption",
+    "Preemption",
     "GarbageCollection",
     "Expiration",
     "Disruption",

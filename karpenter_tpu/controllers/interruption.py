@@ -97,6 +97,12 @@ class Interruption:
                 self.unavailable.mark_unavailable(
                     inst.capacity_type, inst.instance_type, inst.zone,
                     reason="SpotInterruption")
+                # feed the spot-risk model (ISSUE 16): one observed
+                # reclaim raises this pool's interruption probability and
+                # bumps the model version, so the next solve re-ranks
+                # against the new reality
+                from karpenter_tpu.scheduling import risk
+                risk.observe_interruption(inst.instance_type, inst.zone)
             if claim is not None:
                 self.cluster.record_event(
                     "NodeClaim", claim.name, "SpotInterrupted",

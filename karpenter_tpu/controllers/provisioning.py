@@ -252,6 +252,30 @@ class Provisioner:
                     self.cluster, pricing)["total"],
                 cost_delta=sum(s.price for s in result.new_claims))
 
+        if result.preemptions:
+            # preemption plans (ISSUE 16): stamp every victim with the
+            # plan annotations — the Preemption controller executes the
+            # evictions atomically per plan; the provisioner only
+            # publishes the decision
+            for plan in result.preemptions:
+                target = ",".join(plan.target_pods)
+                stamped = 0
+                for vname in plan.victim_pod_names():
+                    live = self.cluster.pods.get(vname)
+                    if live is None or not live.node_name:
+                        continue
+                    live.meta.annotations[
+                        wellknown.PREEMPT_PLAN_ANNOTATION] = plan.plan_id
+                    live.meta.annotations[
+                        wellknown.PREEMPT_FOR_ANNOTATION] = target
+                    self.cluster.pods.update(live)
+                    stamped += 1
+                if stamped:
+                    self.cluster.record_event(
+                        "Pod", plan.target_pods[0], "PreemptionPlanned",
+                        f"plan {plan.plan_id}: evict {stamped} "
+                        f"lower-priority pod(s) to seat {target}")
+
         if result.unschedulable:
             # placement provenance (ISSUE 13): this is the authoritative
             # "pod is unschedulable" surface — every solver path (device,

@@ -18,6 +18,7 @@ from karpenter_tpu.controllers import (
     GarbageCollection,
     InstanceTypeRefresh,
     Interruption,
+    Preemption,
     NodeClaimLifecycle,
     NodeClaimTagging,
     NodeClassHash,
@@ -155,6 +156,8 @@ class Environment:
         self.kubelet = FakeKubelet(self.cluster, self.cloud_provider)
         self.binder = PodBinder(self.cluster)
         self.termination = Termination(self.cluster, self.cloud_provider)
+        self.preemption = Preemption(
+            self.cluster, cloud_provider=self.cloud_provider)
         self.interruption = Interruption(
             self.cluster, self.queue, self.unavailable,
             cloud_provider=self.cloud_provider)
@@ -185,6 +188,7 @@ class Environment:
             self.kubelet,
             self.binder,
             self.tagging,
+            self.preemption,
             self.interruption,
             self.expiration,
             self.disruption,

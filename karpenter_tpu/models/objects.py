@@ -112,6 +112,9 @@ class Pod:
     # persistent-volume claims this pod mounts (attach slots + zone pinning)
     volume_claims: List[VolumeClaim] = field(default_factory=list)
     priority: int = 0
+    # k8s priorityClassName — resolved to an integer through
+    # scheduling.types.PRIORITY_CLASSES by priority_of (ISSUE 16)
+    priority_class_name: Optional[str] = None
     # binding / lifecycle
     node_name: Optional[str] = None
     phase: str = "Pending"
@@ -250,6 +253,15 @@ class Pod:
             # equivalence class.  None (inert) when the
             # KARPENTER_TPU_GANG rollback knob is off.
             self._gang_key(),
+            # priority identity (ISSUE 16): beyond the spec `priority`
+            # field above, the class/annotation-resolved effective
+            # priority joins the key — two otherwise-identical pods in
+            # different priority bands pack in different passes and must
+            # not share a group.  None (inert) when the
+            # KARPENTER_TPU_PRIORITY rollback knob is off or nothing
+            # beyond the spec field contributes, keeping priority-free
+            # keys bit-compatible with the pre-priority layout.
+            self._priority_key(),
         )
         return self._sched_key_cache
 
@@ -267,6 +279,19 @@ class Pod:
         if sp is None:
             return None
         return (sp.name, sp.size, sp.domain_key)
+
+    def _priority_key(self):
+        # delegate to priority_of — the ONE owner of the priority
+        # grammar (knob gate, annotation > class > spec precedence,
+        # malformed-value degradation).  Only the EXTRA identity is
+        # keyed: when the effective priority equals the spec field (the
+        # priority-free common case, or the knob off) this is None and
+        # the key layout matches the pre-priority one.
+        from karpenter_tpu.scheduling.types import priority_of
+        eff = priority_of(self)
+        if eff == self.priority:
+            return None
+        return eff
 
     def scheduling_group_id(self) -> int:
         """Interned integer id of the scheduling_key — deep-tuple hashing is

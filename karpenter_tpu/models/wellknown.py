@@ -65,6 +65,18 @@ NODECLASS_HASH_VERSION_ANNOTATION = "karpenter.tpu/nodeclass-hash-version"
 GANG_NAME_ANNOTATION = "karpenter.tpu/gang-name"
 GANG_SIZE_ANNOTATION = "karpenter.tpu/gang-size"
 GANG_TOPOLOGY_ANNOTATION = "karpenter.tpu/gang-topology-domain"
+# priority & preemption (ISSUE 16): an integer priority override that
+# outranks both priorityClassName and the spec `priority` field —
+# scheduling packs strict priority bands high-to-low, and the
+# preemption planner may evict strictly-lower-priority pods to seat a
+# stranded higher-priority one.  Parsed by scheduling.types.priority_of
+# (the ONE grammar owner); malformed values degrade to the next source.
+PRIORITY_ANNOTATION = "karpenter.tpu/priority"
+# stamped on planned preemption victims by the provisioner (value: the
+# plan id); the preemption controller drains annotated victims
+# atomically per plan through the termination-style eviction path
+PREEMPT_PLAN_ANNOTATION = "karpenter.tpu/preempt-plan"
+PREEMPT_FOR_ANNOTATION = "karpenter.tpu/preempted-for"
 
 # -- finalizers ----------------------------------------------------------
 TERMINATION_FINALIZER = "karpenter.sh/termination"

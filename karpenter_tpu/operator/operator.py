@@ -51,6 +51,13 @@ class Operator:
         self._last_reconcile = 0.0
         self._servers: list = []
         self.elector = None
+        # leadership signal: SET while this replica holds the lease.  The
+        # dedicated renewal thread (started by run()) owns the elector;
+        # the reconcile loop only reads this event, so a long solve can
+        # never starve renewal past lease_duration (the historical
+        # dual-leader flake in test_ha)
+        self._leadership = threading.Event()
+        self._renewer: Optional[threading.Thread] = None
         if self.options.leader_elect or lease is not None:
             from karpenter_tpu.operator.leaderelection import (
                 FileLease,
@@ -304,6 +311,25 @@ class Operator:
             self._servers.append(srv)
         self.metrics_port, self.health_port = ports
 
+    # -- leader election ---------------------------------------------------
+    def _renew_loop(self) -> None:
+        """Dedicated lease-renewal heartbeat.  Renewal used to run inline
+        with reconcile, so one long pass (a cold solve compiling under
+        XLA) starved the renew past lease_duration and the standby took
+        over while the old leader was still mutating — the test_ha
+        flake.  This thread is the elector's ONLY caller after run()
+        starts; the reconcile loop consumes `_leadership` (set = this
+        replica holds the lease) and never touches the lease itself."""
+        e = self.elector
+        while not self._stop.is_set():
+            if e.try_acquire_or_renew():
+                self._leadership.set()
+                self._stop.wait(e.renew_interval / 2)
+            else:
+                self._leadership.clear()
+                self._stop.wait(e.retry_period)
+        self._leadership.clear()
+
     # -- the reconcile loop ------------------------------------------------
     def run(self) -> None:
         """manager.Start: WATCH-DRIVEN reconcile with periodic resync,
@@ -316,16 +342,24 @@ class Operator:
         dropped watch edges are harmless."""
         self.serve()
         watch = self.env.cluster.watch()
+        if self.elector is not None and self._renewer is None:
+            self._renewer = threading.Thread(
+                target=self._renew_loop, daemon=True,
+                name=f"lease-renew-{self.elector.identity}")
+            self._renewer.start()
         try:
             while not self._stop.is_set():
                 if self.elector is not None \
-                        and not self.elector.try_acquire_or_renew():
-                    # standby: hold position, retry on the election
-                    # cadence; liveness stays green (the loop IS
+                        and not self._leadership.is_set():
+                    # standby: hold position; the renewal thread races
+                    # the lease on its own cadence and flips
+                    # `_leadership` the moment it wins, which ends this
+                    # wait immediately (event-driven takeover, not a
+                    # poll). Liveness stays green (the loop IS
                     # advancing). Drain so a takeover starts fresh.
                     watch.drain()
                     self._last_reconcile = time.monotonic()
-                    self._stop.wait(self.elector.retry_period)
+                    self._leadership.wait(self.elector.retry_period)
                     continue
                 t0 = time.monotonic()
                 # run to a BOUNDED fixed point per wake: reconcile chains
@@ -337,12 +371,12 @@ class Operator:
                     self._last_reconcile = time.monotonic()
                     if self.env.cluster.generation == gen or self._stop.is_set():
                         break
-                    # a busy leader must keep renewing MID-fixed-point:
-                    # eight multi-second passes can outlive the lease, and
-                    # a silent expiry here means two active leaders
+                    # the renewal thread keeps the lease fresh during a
+                    # long fixed point; stop mutating the moment it
+                    # reports the lease lost
                     if self.elector is not None \
-                            and not self.elector.try_acquire_or_renew():
-                        break  # lost the lease — stop mutating immediately
+                            and not self._leadership.is_set():
+                        break
                 # drain AFTER the fixed point: mutations made by the
                 # reconcile itself (self-requeue patterns like the
                 # lifecycle's ICE retry, which deliberately never settles
@@ -354,13 +388,13 @@ class Operator:
                 watch.drain()
                 elapsed = time.monotonic() - t0
                 remaining = max(0.0, self.reconcile_interval - elapsed)
-                if self.elector is not None:
-                    # an idle leader must still renew its lease on time
-                    remaining = min(remaining, self.elector.renew_interval / 2)
                 # wake early on any store mutation; cap waits so stop()
-                # and lease renewal stay responsive
+                # and demotion stay responsive
                 deadline = time.monotonic() + remaining
                 while not self._stop.is_set():
+                    if self.elector is not None \
+                            and not self._leadership.is_set():
+                        break  # demoted while idle → standby wait above
                     left = deadline - time.monotonic()
                     if left <= 0 or watch.wait(timeout=min(left, 0.25)):
                         break
@@ -372,6 +406,11 @@ class Operator:
                     self.env.cluster.sync_backend()
         finally:
             self.env.cluster.unwatch(watch)
+            # order matters: stop the renewal thread BEFORE releasing, or
+            # it re-acquires the lease we just gave up
+            self._stop.set()
+            if self._renewer is not None:
+                self._renewer.join(timeout=5)
             if self.elector is not None:
                 self.elector.release()
 

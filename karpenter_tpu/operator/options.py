@@ -122,9 +122,13 @@ class Options:
             default=opts.service_local_fallback)
         opts.service_tenant = os.environ.get(
             "KARPENTER_TPU_TENANT", opts.service_tenant)
-        if "KARPENTER_TPU_PRIORITY" in os.environ:
+        # renamed from KARPENTER_TPU_PRIORITY (ISSUE 16): that name now
+        # belongs to the POD-priority scheduling rollback lever
+        # (utils/knobs.py); this one ranks the control plane's own
+        # requests in the solver daemon's admission queue
+        if "KARPENTER_TPU_SERVICE_PRIORITY" in os.environ:
             opts.service_priority = int(
-                os.environ["KARPENTER_TPU_PRIORITY"])
+                os.environ["KARPENTER_TPU_SERVICE_PRIORITY"])
         # SOLVER_MESH configures the mesh story.  The KARPENTER_TPU_MESH
         # rollback override is deliberately NOT parsed here: its single
         # grammar owner is TPUSolver._mesh_env_spec, applied inside

@@ -90,14 +90,18 @@ _FULL_PROGRAMS: Dict[tuple, object] = {}
 
 
 def _full_kernel_program(mesh: Mesh, max_nodes: int, zc: int, axis: str,
-                         with_gang: int = 0):
-    key = (mesh, max_nodes, zc, axis, with_gang)
+                         with_gang: int = 0, with_priority: int = 0):
+    key = (mesh, max_nodes, zc, axis, with_gang, with_priority)
     fn = _FULL_PROGRAMS.get(key)
     if fn is None:
         body = partial(ffd._solve_ffd_impl, max_nodes=max_nodes, zc=zc,
-                       axis_name=axis, with_gang=with_gang)
+                       axis_name=axis, with_gang=with_gang,
+                       with_priority=with_priority)
+        specs = _kernel_specs(axis)
+        if with_priority:
+            specs = specs + (P(),)  # group_prio (replicated)
         fn = jax.jit(  # kt-lint: disable=jit-purity
-            shard_map(body, mesh=mesh, in_specs=_kernel_specs(axis),
+            shard_map(body, mesh=mesh, in_specs=specs,
                       out_specs=P(), check_rep=False))
         _FULL_PROGRAMS[key] = fn
     return fn
@@ -115,6 +119,8 @@ def sharded_solve_ffd(
     zc: int = 1,
     axis: str = "cat",
     with_gang: int = 0,
+    group_prio=None,
+    with_priority: int = 0,
 ):
     """solve_ffd with the column axes sharded over `mesh` via shard_map.
 
@@ -129,7 +135,8 @@ def sharded_solve_ffd(
     the static replication checker can't see that through the scan.
     """
     fn = _full_kernel_program(mesh, max_nodes, zc, axis,
-                              with_gang=with_gang)
+                              with_gang=with_gang,
+                              with_priority=with_priority)
     args = (group_req, group_count, group_mask, exist_cap, exist_remaining,
             col_alloc, col_daemon, pt_alloc, col_pool, pool_daemon,
             pool_limit,
@@ -137,6 +144,9 @@ def sharded_solve_ffd(
             group_mindom, group_delig, group_whole, group_gang,
             col_zone, col_ct, exist_zone, exist_ct)
     specs = _kernel_specs(axis)
+    if with_priority:
+        args = args + (group_prio,)
+        specs = specs + (P(),)
     args = tuple(jax.device_put(a, NamedSharding(mesh, s))
                  for a, s in zip(args, specs))
     return fn(*args)
@@ -186,16 +196,18 @@ class MeshExecutor:
 
     # -- the resident solve program --------------------------------------
     def _program(self, layout, max_nodes: int, zc: int, sparse_n: int,
-                 donate: bool, explain: int = 0, with_gang: int = 0):
+                 donate: bool, explain: int = 0, with_gang: int = 0,
+                 with_priority: int = 0):
         key = (layout, max_nodes, zc, sparse_n, donate, explain,
-               with_gang)
+               with_gang, with_priority)
         prog = self._progs.get(key)
         if prog is None:
             ax = self.axis
             body = partial(ffd._solve_ffd_resident_impl, layout=layout,
                            max_nodes=max_nodes, zc=zc, sparse_n=sparse_n,
                            axis_name=ax, explain=explain,
-                           with_gang=with_gang)
+                           with_gang=with_gang,
+                           with_priority=with_priority)
             sm = shard_map(
                 body, mesh=self.mesh,
                 in_specs=(P(),            # problem buffer (replicated)
@@ -260,7 +272,7 @@ class MeshExecutor:
 
     def solve(self, buf, mask_table, dev: dict, layout, max_nodes: int,
               sparse_n: int, donate: bool, explain: int = 0,
-              with_gang: int = 0):
+              with_gang: int = 0, with_priority: int = 0):
         """Dispatch one resident-path solve.  `buf` is the coalesced
         replicated problem buffer (committed — possibly through a
         donated DeviceSlots rotation — or host numpy, which jit commits
@@ -270,7 +282,8 @@ class MeshExecutor:
         a column axis is already resident."""
         prog = self._program(layout, max_nodes, dev["ZC"], sparse_n,
                              donate, explain=explain,
-                             with_gang=with_gang)
+                             with_gang=with_gang,
+                             with_priority=with_priority)
         return prog(buf, mask_table,
                     dev["col_alloc"], dev["col_daemon"], dev["pt_alloc"],
                     dev["col_pool"], dev["pool_daemon"],

@@ -45,6 +45,7 @@ from karpenter_tpu.scheduling.types import (
     gang_of,
     gang_trial_order,
     min_values_violation,
+    priority_of,
 )
 # the reason-code registry (jax-free: the solver package resolves its
 # heavy exports lazily) — every oracle verdict carries a structured code
@@ -162,9 +163,28 @@ class Scheduler:
 
     # ------------------------------------------------------------------
     def solve(self) -> ScheduleResult:
+        res = self._solve()
+        # preemption pre-pass (ISSUE 16): the SAME shared planner the
+        # TPU solver's tail runs, so both engines propose identical
+        # victim sets.  Consolidation sims (price_cap set) strand by
+        # design and never want plans; trials re-enter through _solve,
+        # so the planner can never recurse back here.
+        if res.unschedulable and self.inp.price_cap is None:
+            from karpenter_tpu.utils.knobs import priority_enabled
+            if priority_enabled():
+                from karpenter_tpu.solver import preempt
+                preempt.attach(self.inp, res)
+        return res
+
+    def _solve(self) -> ScheduleResult:
+        # priority-band-major FFD (ISSUE 16): higher bands pack first, so
+        # a priority-free input (every pod in one band — the constant
+        # prefix) sorts exactly as before; within a band the order stays
+        # requests-desc then name, the pre-priority discipline.
         pods = sorted(
             self.inp.pods,
-            key=lambda p: (p.requests.sort_key(), p.meta.name),
+            key=lambda p: (priority_of(p), p.requests.sort_key(),
+                           p.meta.name),
             reverse=True,
         )
         # gang pre-scan (ISSUE 15): members of one gang place ATOMICALLY
@@ -759,12 +779,34 @@ class Scheduler:
 
     # -- finalize ----------------------------------------------------------
     def _finalize(self) -> None:
+        from karpenter_tpu.utils.knobs import spot_risk_enabled
+        risk_on = spot_risk_enabled()
+        if risk_on:
+            from karpenter_tpu.scheduling import risk as riskmod
+            # spot claims already finalized this solve, by (type, zone):
+            # each repeat in the same pool pays the diversification
+            # penalty, steering later nodes toward uncorrelated capacity
+            spot_seen: Dict[Tuple[str, str], int] = {}
         for sim in self.new_sims:
             reqs = sim.requirements
-            ranked = sorted(
-                sim.candidates,
-                key=lambda it: (it.cheapest_offering(reqs).price, it.name),
-            )
+            if risk_on:
+                def _rank(it):
+                    o = it.cheapest_offering(reqs)
+                    eff = riskmod.effective_price(
+                        o.price, it.name, o.zone, o.capacity_type)
+                    if o.capacity_type == wellknown.CAPACITY_TYPE_SPOT:
+                        eff += (riskmod.DIVERSIFY_PENALTY * o.price
+                                * spot_seen.get((it.name, o.zone), 0))
+                    # real price then name break effective-price ties, so
+                    # risk-neutral catalogs keep the pre-risk order
+                    return (eff, o.price, it.name)
+                ranked = sorted(sim.candidates, key=_rank)
+            else:
+                ranked = sorted(
+                    sim.candidates,
+                    key=lambda it: (it.cheapest_offering(reqs).price,
+                                    it.name),
+                )
             violation = min_values_violation(reqs, ranked)
             if violation is not None:
                 reason = explainmod.make(explainmod.MIN_VALUES, violation)
@@ -772,6 +814,10 @@ class Scheduler:
                     self.result.unschedulable[pod.meta.name] = reason
                 continue
             cheapest = ranked[0].cheapest_offering(reqs)
+            if risk_on and cheapest.capacity_type == \
+                    wellknown.CAPACITY_TYPE_SPOT:
+                k = (ranked[0].name, cheapest.zone)
+                spot_seen[k] = spot_seen.get(k, 0) + 1
             self.result.new_claims.append(NewNodeClaim(
                 nodepool=sim.pool.name,
                 node_class_ref=sim.pool.node_class_ref,

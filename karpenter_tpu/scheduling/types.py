@@ -277,6 +277,201 @@ def gang_trial_order(domains) -> list:
     return sorted(d for d in domains if d is not None)
 
 
+# -- priority & preemption (ISSUE 16) -------------------------------------
+# Pod priority is first-class scheduling identity: the effective
+# priority joins the scheduling key (objects.Pod._priority_key), the
+# encoder packs equivalence classes in strict priority-band order
+# (high→low), and the preemption planner (solver/preempt.py) may evict
+# strictly-lower-priority victims to seat a stranded higher-priority
+# pod.  Three sources, strongest first: the karpenter.tpu/priority
+# annotation (integer), priorityClassName resolved through the
+# PRIORITY_CLASSES table, then the spec `priority` field.  Malformed
+# values degrade to the next source — never to a crash.
+
+# the cluster's priority-class table (k8s PriorityClass analogue): the
+# two system classes ship by default; deployments register their own
+# via register_priority_class (tests/benches do too).
+PRIORITY_CLASSES: Dict[str, int] = {
+    "system-cluster-critical": 2_000_000_000,
+    "system-node-critical": 2_000_001_000,
+}
+
+
+def register_priority_class(name: str, value: int) -> None:
+    """Register (or update) a priority class.  The scheduling-key cache
+    on pods keys on the knob state only, so classes should be
+    registered before pods are grouped — the k8s posture, where a
+    PriorityClass exists before pods reference it."""
+    PRIORITY_CLASSES[name] = int(value)
+
+
+def priority_of(pod: Pod) -> int:
+    """The pod's effective scheduling priority (0 default).  Inert
+    (always the spec `priority` field, historically in the scheduling
+    key) when the KARPENTER_TPU_PRIORITY rollback knob is off.  Cached
+    on the pod keyed by knob state — grouping, encode, the oracle's
+    band sort, and the planner all call this per pod per pass."""
+    from karpenter_tpu.models import wellknown
+    from karpenter_tpu.utils.knobs import priority_enabled
+    enabled = priority_enabled()
+    cached = getattr(pod, "_priority_of_cache", None)
+    if cached is not None and cached[0] == enabled:
+        return cached[1]
+    prio = pod.priority
+    if enabled:
+        cls = getattr(pod, "priority_class_name", None)
+        if cls and cls in PRIORITY_CLASSES:
+            prio = PRIORITY_CLASSES[cls]
+        raw = pod.meta.annotations.get(wellknown.PRIORITY_ANNOTATION)
+        if raw is not None:
+            try:
+                prio = int(raw)
+            except (TypeError, ValueError):
+                pass  # malformed annotation degrades to the next source
+    pod._priority_of_cache = (enabled, prio)
+    return prio
+
+
+@dataclass(frozen=True)
+class VictimUnit:
+    """One atomically-evictable unit the preemption planner considers: a
+    single pod, or a WHOLE gang (PR 14 atomicity — evicting part of a
+    gang would leave a broken gang running, so gangs evict all or
+    none).  ``cost`` is the summed pod deletion cost
+    (karpenter.sh/pod-deletion-cost), ``node_names`` the existing nodes
+    whose capacity the eviction frees."""
+    name: str                      # pod name, or "gang:<name>"
+    priority: int
+    cost: float
+    pod_names: Tuple[str, ...]
+    node_names: Tuple[str, ...]
+    gang: "str | None" = None
+
+
+def preemption_victim_order(units) -> list:
+    """The ONE shared victim order both the planner and the oracle
+    pre-pass walk (kernel-vs-oracle parity covers the *chosen victims*
+    because both engines' plans come from this order): ascending
+    effective priority (evict the least important first), then
+    ascending deletion cost, then name for determinism."""
+    return sorted(units, key=lambda u: (u.priority, u.cost, u.name))
+
+
+@dataclass
+class PreemptionPlan:
+    """One planned preemption: evict ``victims`` (atomic per plan —
+    a gang victim is whole-gang by construction) to seat the stranded
+    higher-priority ``target_pods``.  ``plan_id`` is deterministic from
+    the target so re-planning an unexecuted plan is idempotent."""
+    plan_id: str
+    target_pods: List[str]
+    target_priority: int
+    victims: List[VictimUnit] = field(default_factory=list)
+
+    def victim_pod_names(self) -> List[str]:
+        return [n for u in self.victims for n in u.pod_names]
+
+
+def priority_inversion_audit(inp, res, plans=()) -> list:
+    """The ONE priority-inversion checker the fuzz class and the
+    config10 acceptance bench both assert (the gang_placement_audit
+    pattern): an inversion is a LOWER-priority pod remaining placed
+    (resident, same-pass assignment, or new-claim placement) while a
+    HIGHER-priority pod strands *that its single eviction could seat*
+    — the freed capacity fits the stranded pod on a node/claim whose
+    labels, taints, and requirements it is compatible with.  Planned
+    victims (``plans``) no longer count as "remaining placed", and a
+    stranded pod an attached plan TARGETS is not an inversion (its
+    seat is in flight — the Preemption controller executes the plan).
+    Topology-constrained stranded pods are skipped (the capacity-level
+    sufficiency check cannot model spread/affinity).  Returns a list of
+    ``{pod, priority, victim, victim_priority, on}`` dicts — empty
+    means the invariant holds."""
+    from karpenter_tpu.models.taints import tolerates_all
+    planned = {n for p in plans for n in p.victim_pod_names()}
+    targeted = {n for p in plans for n in p.target_pods}
+    # remaining per existing node AFTER this pass's assignments
+    assigned: Dict[str, List[Pod]] = {}
+    by_name = {p.meta.name: p for p in inp.pods}
+    for pod_name, node in res.existing_assignments.items():
+        p = by_name.get(pod_name)
+        if p is not None:
+            assigned.setdefault(node, []).append(p)
+    alloc_of = {it.name: it for types in inp.instance_types.values()
+                for it in types}
+    inversions = []
+    for sname, _reason in res.unschedulable.items():
+        s = by_name.get(sname)
+        if s is None or sname in targeted \
+                or s.topology_spread or s.pod_affinities:
+            continue
+        ps = priority_of(s)
+        sreq = effective_request(s)
+        for en in inp.existing_nodes:
+            node = en.node
+            if node.meta.deleting or not node.ready:
+                continue
+            if not tolerates_all(node.taints, s.tolerations):
+                continue
+            if not s.requirements.matched_by_labels(node.labels):
+                continue
+            rem = en.available
+            for p in assigned.get(en.name, ()):
+                rem = rem - effective_request(p)
+            victims = list(en.pods) + assigned.get(en.name, [])
+            for v in victims:
+                if v.meta.name in planned or v.is_daemonset \
+                        or v.do_not_disrupt():
+                    continue
+                if priority_of(v) >= ps:
+                    continue
+                if sreq.fits(rem + effective_request(v)):
+                    inversions.append({
+                        "pod": sname, "priority": ps,
+                        "victim": v.meta.name,
+                        "victim_priority": priority_of(v),
+                        "on": en.name})
+        for c in res.new_claims:
+            if not c.instance_type_names:
+                continue
+            it = alloc_of.get(c.instance_type_names[0])
+            if it is None:
+                continue
+            if not tolerates_all(c.taints, s.tolerations):
+                continue
+            if not c.requirements.compatible(s.requirements):
+                continue
+            # the claim's requirement intersection is silent on any key
+            # no packed pod constrained, so `compatible` alone lets a
+            # zone-pinned strand claim a seat on a type with no offering
+            # in that zone.  Require one concrete offering whose labels
+            # the strand accepts (conservative: a requirement on a label
+            # outside these axes skips the claim rather than guessing).
+            from karpenter_tpu.models import wellknown as _wk
+            if not any(
+                    s.requirements.matched_by_labels({
+                        _wk.ZONE_LABEL: o.zone,
+                        _wk.CAPACITY_TYPE_LABEL: o.capacity_type,
+                        _wk.INSTANCE_TYPE_LABEL: it.name,
+                        _wk.NODEPOOL_LABEL: c.nodepool})
+                    for o in it.offerings if o.available):
+                continue
+            rem = it.allocatable() - c.requests
+            for v in c.pods:
+                if v.meta.name in planned or v.is_daemonset \
+                        or v.do_not_disrupt():
+                    continue
+                if priority_of(v) >= ps:
+                    continue
+                if sreq.fits(rem + effective_request(v)):
+                    inversions.append({
+                        "pod": sname, "priority": ps,
+                        "victim": v.meta.name,
+                        "victim_priority": priority_of(v),
+                        "on": c.hostname or c.nodepool})
+    return inversions
+
+
 @dataclass
 class ExistingNode:
     """A live node as the scheduler sees it: identity + headroom + resident
@@ -374,6 +569,10 @@ class ScheduleResult:
     new_claims: List[NewNodeClaim] = field(default_factory=list)
     existing_assignments: Dict[str, str] = field(default_factory=dict)  # pod → node
     unschedulable: Dict[str, str] = field(default_factory=dict)         # pod → reason
+    # preemption plans proposed for still-stranded higher-priority pods
+    # (solver/preempt.py): executing them is the Preemption controller's
+    # job, not the scheduler's — attaching keeps solve() pure.
+    preemptions: List["PreemptionPlan"] = field(default_factory=list)
 
     def node_count(self) -> int:
         return len(self.new_claims)

@@ -48,7 +48,11 @@ import numpy as np
 
 from karpenter_tpu.models import wellknown
 from karpenter_tpu.models.resources import RESOURCE_AXIS
-from karpenter_tpu.scheduling.types import effective_request, gang_of
+from karpenter_tpu.scheduling.types import (
+    effective_request,
+    gang_of,
+    priority_of,
+)
 from karpenter_tpu.solver.ffd import EPS
 from karpenter_tpu.solver.encode import (
     BIG,
@@ -291,6 +295,21 @@ def plan(rec: Optional[DeltaRecord], inp, groups, dirty,
         # Checked FIRST so the counted reason names the real cause
         # instead of an eternal "cold".
         return "gang"
+    if len({priority_of(g[0]) for g in groups}) > 1:
+        # multi-band pass (ISSUE 16): the full path appends the
+        # group_prio row and runs with_priority=1; the seeded delta
+        # kernel runs with_priority=0 by contract, so band packing and
+        # the inversion witness would be silently lost — fall back
+        # whole (counted).  Also checked before "cold" so the reason
+        # names the cause.
+        return "priority"
+    if any(wellknown.PREEMPT_PLAN_ANNOTATION in p.meta.annotations
+           for en in inp.existing_nodes for p in en.pods):
+        # an in-flight eviction plan: the stamped victims' capacity
+        # frees between this pass and the next, so a prefix seeded
+        # against the pre-eviction base would replay stale headroom —
+        # full pass until the preemption controller settles (counted)
+        return "preempt"
     if rec is None:
         return "cold"
     dirty_pods, dirty_nodes, all_dirty, _gen = dirty

@@ -37,6 +37,7 @@ from karpenter_tpu.scheduling.types import (
     effective_request,
     gang_of,
     gang_trial_order,
+    priority_of,
 )
 
 R = len(RESOURCE_AXIS)
@@ -98,6 +99,14 @@ class EncodedProblem:
     # carries the lexicographic domain trial RANK (gang_trial_order),
     # not spread base counts; skew/mindom/dcap stay inert.
     group_gang: np.ndarray = None
+    # [G] i32 — effective priority per group (ISSUE 16).  The groups
+    # list is already in band order (group_pods' host-side stable
+    # re-sort, highest band first); this row is the kernel's witness
+    # input (with_priority inversion aux) and decode's band map.
+    group_priority: np.ndarray = None
+    # [O] f32 — decode RANKING price (= col_price unless the spot-risk
+    # objective is on; see CatalogEncoding.col_price_eff)
+    col_price_eff: np.ndarray = None
     col_zone: np.ndarray = None      # [O] i32
     col_ct: np.ndarray = None        # [O] i32
     exist_zone: np.ndarray = None    # [E] i32
@@ -247,9 +256,29 @@ def group_pods(pods: List[Pod]) -> List[List[Pod]]:
     the fallback and the differential-test oracle."""
     from karpenter_tpu.native import hostops
     native = hostops()
-    if native is not None:
-        return native.group_pods(pods)
-    return group_pods_py(pods)
+    groups = (native.group_pods(pods) if native is not None
+              else group_pods_py(pods))
+    return _priority_band_sort(groups)
+
+
+def _priority_band_sort(groups: List[List[Pod]]) -> List[List[Pod]]:
+    """Stable re-sort of equivalence classes into strict priority-band
+    order, highest band first (ISSUE 16): the kernel scans groups in
+    list order, so putting a band's groups first IS the packing policy —
+    higher bands consume existing capacity, pool limits, and node slots
+    before lower bands see them.  Applied AFTER either grouping path
+    (native or Python) as a host-side post-pass: the stable sort keeps
+    the FFD order (size desc, name) intact WITHIN each band, and an
+    all-one-band problem (every effective priority equal — the
+    priority-free common case) comes back ordered exactly as it went in,
+    preserving bit parity with the pre-priority pipeline.  Groups are
+    priority-homogeneous by construction (the effective priority joins
+    the scheduling key)."""
+    prios = [priority_of(g[0]) for g in groups]
+    if len(set(prios)) <= 1:
+        return groups
+    order = sorted(range(len(groups)), key=lambda i: -prios[i])
+    return [groups[i] for i in order]
 
 
 def group_pods_py(pods: List[Pod]) -> List[List[Pod]]:
@@ -301,6 +330,14 @@ class CatalogEncoding:
     zc: int = 1                  # grid stride (len of the zone×ct grid)
     pt_alloc: np.ndarray = None  # [PT, R] f32 (PT = O // zc)
     col_valid: np.ndarray = None # [O] bool
+    # [O] f32 — the RANKING price (ISSUE 16): equal to col_price unless
+    # the KARPENTER_TPU_SPOT_RISK objective is on, in which case spot
+    # columns carry price*(1+λ·p_interrupt) (scheduling/risk.py).  A
+    # ranking key ONLY — col_price, Column.price, claims, and the ledger
+    # always keep the real offering price.  Cache-safe: risk.model_key()
+    # joins the solver's catalog-encoding cache key, so an interruption
+    # observation rebuilds this encoding rather than mutating it.
+    col_price_eff: np.ndarray = None
     # real offerings / grid columns — how much of the column axis is
     # masked-out inflation; layout is "grid" or "dense" (the fallback)
     fill_factor: float = 1.0
@@ -372,6 +409,15 @@ def encode_catalog(inp: ScheduleInput) -> CatalogEncoding:
         if d is not None:
             col_daemon[ci] = np.array(d.v, dtype=np.float32)
     col_price = np.array([c.price for c in columns], dtype=np.float32)
+    from karpenter_tpu.utils.knobs import spot_risk_enabled
+    if spot_risk_enabled():
+        from karpenter_tpu.scheduling import risk
+        col_price_eff = np.array(
+            [risk.effective_price(c.price, c.type_name, c.zone,
+                                  c.capacity_type)
+             for c in columns], dtype=np.float32)
+    else:
+        col_price_eff = col_price
     col_pool = np.array([c.pool_idx for c in columns], dtype=np.int32)
     pool_daemon = np.stack([
         np.array(inp.daemon_overhead.get(p.name, Resources()).v, dtype=np.float32)
@@ -403,6 +449,7 @@ def encode_catalog(inp: ScheduleInput) -> CatalogEncoding:
         pool_provides=pool_provides,
         zone_ids=zone_ids, ct_ids=ct_ids, col_zone=col_zone, col_ct=col_ct,
         zc=zc, pt_alloc=pt_alloc, col_valid=col_valid,
+        col_price_eff=col_price_eff,
         fill_factor=round(fill, 4), layout=("dense" if dense else "grid"),
     )
 
@@ -1330,6 +1377,7 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None,
     group_delig = np.zeros((G, D), dtype=bool)
     group_whole_node = np.zeros(G, dtype=bool)
     group_gang = np.zeros(G, dtype=bool)
+    group_priority = np.zeros(G, dtype=np.int32)
     static_allowed: List[Dict[str, Optional[set]]] = []
     merged_reqs: List[List[Optional[Requirements]]] = []
 
@@ -1359,6 +1407,7 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None,
         rep = g[0]
         group_req[gi] = np.array(effective_request(rep).v, dtype=np.float32)
         group_count[gi] = len(g)
+        group_priority[gi] = priority_of(rep)
         try:
             t = topo.encode_group(gi, rep)
         except Unsupported as e:
@@ -1446,6 +1495,7 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None,
         group_delig = group_delig[keep]
         group_whole_node = group_whole_node[keep]
         group_gang = group_gang[keep]
+        group_priority = group_priority[keep]
         groups = [g for gi, g in enumerate(groups) if keep[gi]]
         # static_allowed / merged_reqs were only appended for kept groups
 
@@ -1484,6 +1534,8 @@ def encode(inp: ScheduleInput, cat: Optional[CatalogEncoding] = None,
         group_delig=group_delig,
         group_whole_node=group_whole_node,
         group_gang=group_gang,
+        group_priority=group_priority,
+        col_price_eff=cat.col_price_eff,
         col_zone=cat.col_zone,
         col_ct=cat.col_ct,
         exist_zone=topo.exist_zone,

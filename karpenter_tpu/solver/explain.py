@@ -65,7 +65,11 @@ KERNEL_CONSTRAINTS = ("fit", "limit", "topology", "whole_node", "slots")
 # fill's K-node generalization), keeping the aux row width — and every
 # recorded delta prefix — stable; the gang-specific discrimination
 # lives in the reason CODES below and their per-gang trees.
-CONSTRAINTS = HOST_CONSTRAINTS + KERNEL_CONSTRAINTS + ("gang",)
+# "priority" classifies the band/preemption verdicts (ISSUE 16) — like
+# "gang", NOT a kernel aux class: the kernel's priority aux row is a
+# witness bit (an inversion gate), not an elimination count, so the aux
+# row width and every recorded delta prefix stay stable.
+CONSTRAINTS = HOST_CONSTRAINTS + KERNEL_CONSTRAINTS + ("gang", "priority")
 
 _CONSTRAINT_HELP = {
     "compat": "label/taint/requirement incompatibility (host encode mask)",
@@ -76,6 +80,8 @@ _CONSTRAINT_HELP = {
     "whole_node": "no single node could hold the whole co-located group",
     "slots": "the solver's node-slot axis was exhausted",
     "gang": "the gang's all-or-nothing, single-domain placement failed",
+    "priority": "priority-band packing or preemption planning decided "
+                "the outcome",
 }
 
 
@@ -148,6 +154,27 @@ GANG_INCOMPLETE = _register(
     "never self-heals by waiting)")
 GANG_CODES = frozenset((GANG_PARTIAL, GANG_DOMAIN, GANG_TOO_LARGE,
                         GANG_INCOMPLETE))
+# priority & preemption verdicts (ISSUE 16): emitted by the decode
+# reclassification (solver/solve.py), the preemption planner
+# (solver/preempt.py), and the preemption controller
+# (controllers/preemption.py) — all held to the reason-literal gate.
+PRIORITY_BAND_EXHAUSTED = _register(
+    "PriorityBandExhausted", "priority",
+    "capacity ran out inside this pod's priority band while at least "
+    "one strictly-lower-priority group still placed — the preemption "
+    "planner's trigger condition (kernel witness: the priority aux row)")
+PREEMPTED_FOR = _register(
+    "PreemptedFor", "priority",
+    "this pod is a planned preemption victim: its (atomic, whole-gang "
+    "when ganged) eviction seats a stranded strictly-higher-priority "
+    "pod named in the preempted-for annotation")
+PREEMPTION_INSUFFICIENT = _register(
+    "PreemptionInsufficient", "priority",
+    "evicting every evictable strictly-lower-priority victim still "
+    "could not seat the stranded pod — preemption cannot help; the "
+    "pod waits for capacity")
+PRIORITY_CODES = frozenset((PRIORITY_BAND_EXHAUSTED, PREEMPTED_FOR,
+                            PREEMPTION_INSUFFICIENT))
 LEGACY = "Legacy"  # unregistered plain-string reason (should not occur)
 
 # -- disruption decision vocabulary (ISSUE 14): the controllers'
@@ -209,7 +236,12 @@ NODEPOOL_DRIFT = _register(
 # these — an unknown reason is a registry violation, not a new string
 DELTA_FALLBACK_REASONS = frozenset((
     "cold", "nodes", "price-cap", "limits", "small", "topology",
-    "bucket", "seed", "slots", "stranded", "shape", "gang"))
+    "bucket", "seed", "slots", "stranded", "shape", "gang",
+    # priority bands / preemption plans force a full pass until
+    # seeded-merge support lands (ISSUE 16): band order is global, so a
+    # delta-merged placement could seat a late low-priority group ahead
+    # of an earlier-stranded higher band
+    "priority", "preempt"))
 
 # tenant-scheduler shed vocabulary (service/scheduler.py)
 SHED_ADMISSION = "admission"

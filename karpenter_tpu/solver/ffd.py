@@ -245,6 +245,20 @@ def _solve_ffd_impl(
     col_ct: jnp.ndarray,          # [O] i32
     exist_zone: jnp.ndarray,      # [E] i32
     exist_ct: jnp.ndarray,        # [E] i32
+    group_prio: jnp.ndarray = None,  # [G] i32 — effective priority per
+                                  # group (ISSUE 16).  The BAND ORDER is
+                                  # host-side (encode re-sorts groups
+                                  # priority-desc before the scan, so
+                                  # higher bands consume capacity
+                                  # first); the kernel only WITNESSES:
+                                  # with_priority appends a per-group
+                                  # inversion bit — "this group placed
+                                  # while an earlier (higher-priority)
+                                  # group had already stranded" — the
+                                  # decode-side gate for the
+                                  # PriorityBandExhausted
+                                  # reclassification.  Dead (may be
+                                  # None) unless with_priority is set.
     seed_used: jnp.ndarray = None,     # [N, R] f32 — delta-seeded start:
                                   # the scan resumes from a previous
                                   # solve's prefix state (solver/delta.py)
@@ -333,6 +347,17 @@ def _solve_ffd_impl(
                                   # compile time.  1 arms the atomic
                                   # K-node gang fill for groups with
                                   # group_gang set.
+    with_priority: int = 0,       # static: 0 skips the priority
+                                  # inversion-witness aux entirely —
+                                  # priority-free problems lower to the
+                                  # exact pre-priority program (bit
+                                  # parity by construction, the
+                                  # with_gang discipline).  1 appends
+                                  # one additive [G] aux row AFTER the
+                                  # explain aux: the per-group
+                                  # inversion bit computed post-scan
+                                  # from the strand outputs (no carry
+                                  # change, no branch in the scan).
 ):
     G, RDIM = group_req.shape
     E = exist_remaining.shape[0]
@@ -343,7 +368,8 @@ def _solve_ffd_impl(
                 with_topology=with_topology, sparse_k=sparse_k,
                 sparse_n=sparse_n, mask_packed=mask_packed,
                 axis_name=axis_name, seeded=seed_used is not None,
-                explain=explain, with_gang=with_gang)
+                explain=explain, with_gang=with_gang,
+                with_priority=with_priority)
     if explain >= 2:
         # the [G, O] class map is column-sharded under a mesh and the
         # shard_map out-spec is replicated — counts-only there
@@ -1202,6 +1228,26 @@ def _solve_ffd_impl(
                 group_mask & whole_map[:, None]
                 & (cls_map == 0), 4, cls_map)
             aux.append(cls_map.astype(jnp.float32).reshape(-1))  # G*O
+    if with_priority:
+        # -- priority inversion witness (ISSUE 16), judged post-scan from
+        # the strand outputs alone: encode's host-side band re-sort means
+        # a HIGHER band always scans first, so "an earlier group
+        # stranded with strictly higher priority than a group that still
+        # placed" is exactly a band exhausting while a lower band
+        # succeeds — the trigger the decode reclassifies as
+        # PriorityBandExhausted and the preemption planner acts on.
+        # Exclusive running max of the stranded groups' priorities
+        # (replicated group-axis state — no psum under a mesh).
+        gp = (jnp.zeros(G, jnp.int32) if group_prio is None
+              else group_prio.astype(jnp.int32))
+        neg = jnp.int32(-(2 ** 31) + 1)
+        stranded_p = outs["unsched"] > 0
+        strand_seen = jax.lax.cummax(jnp.where(stranded_p, gp, neg))
+        strand_before = jnp.concatenate(
+            [jnp.full((1,), neg, jnp.int32), strand_seen[:-1]])
+        placed_any = (group_count - outs["unsched"]) > 0
+        prio_inv = placed_any & (gp < strand_before)
+        aux = aux + [prio_inv.astype(jnp.float32)]               # G
     packed = jnp.concatenate(head + mid + [
         outs["unsched"].astype(jnp.float32).reshape(-1),     # G
         outs["dom_placed"].astype(jnp.float32).reshape(-1),  # G*D
@@ -1216,7 +1262,8 @@ def _solve_ffd_impl(
 
 solve_ffd = partial(jax.jit, static_argnames=(
     "max_nodes", "zc", "with_topology", "sparse_k", "sparse_n",
-    "mask_packed", "explain", "with_gang"))(_solve_ffd_impl)
+    "mask_packed", "explain", "with_gang",
+    "with_priority"))(_solve_ffd_impl)
 
 
 def pack_problem(prob):
@@ -1274,28 +1321,35 @@ def _solve_ffd_coalesced_impl(buf, col_alloc, col_daemon, pt_alloc,
                               zc: int = 1, with_topology: bool = True,
                               sparse_k: int = 0, sparse_n: int = 0,
                               mask_packed: bool = False,
-                              explain: int = 0, with_gang: int = 0):
+                              explain: int = 0, with_gang: int = 0,
+                              with_priority: int = 0):
     """solve_ffd fed from one coalesced problem buffer (see
     pack_problem).  Catalog args stay separate — they are
-    device-resident across solves and never travel."""
+    device-resident across solves and never travel.  with_priority
+    implies the buffer carries the group_prio row as slot 17 —
+    priority-free problems keep the exact 17-slot pre-priority layout
+    (and therefore the exact pre-priority program)."""
+    parts = _unpack_problem(buf, layout)
     (group_req, group_count, group_mask, exist_cap, exist_remaining,
      pool_limit, group_ncap, group_dsel, group_dbase, group_dcap,
      group_skew, group_mindom, group_delig, group_whole, group_gang,
-     exist_zone, exist_ct) = _unpack_problem(buf, layout)
+     exist_zone, exist_ct) = parts[:17]
+    group_prio = parts[17] if with_priority else None
     return _solve_ffd_impl(
         group_req, group_count, group_mask, exist_cap, exist_remaining,
         col_alloc, col_daemon, pt_alloc, col_pool, pool_daemon,
         pool_limit, group_ncap, group_dsel, group_dbase, group_dcap,
         group_skew, group_mindom, group_delig, group_whole, group_gang,
-        col_zone, col_ct, exist_zone, exist_ct,
+        col_zone, col_ct, exist_zone, exist_ct, group_prio=group_prio,
         max_nodes=max_nodes, zc=zc, with_topology=with_topology,
         sparse_k=sparse_k, sparse_n=sparse_n, mask_packed=mask_packed,
-        explain=explain, with_gang=with_gang)
+        explain=explain, with_gang=with_gang,
+        with_priority=with_priority)
 
 
 _COALESCED_STATICS = ("layout", "max_nodes", "zc", "with_topology",
                       "sparse_k", "sparse_n", "mask_packed", "explain",
-                      "with_gang")
+                      "with_gang", "with_priority")
 solve_ffd_coalesced = partial(
     jax.jit, static_argnames=_COALESCED_STATICS)(_solve_ffd_coalesced_impl)
 # The pipelined executor's variant: the problem buffer (arg 0) is DONATED
@@ -1313,7 +1367,7 @@ def _solve_ffd_resident_impl(buf, mask_table, col_alloc, col_daemon,
                              col_ct, layout=None, max_nodes: int = 1024,
                              zc: int = 1, sparse_n: int = 0,
                              axis_name=None, explain: int = 0,
-                             with_gang: int = 0):
+                             with_gang: int = 0, with_priority: int = 0):
     """The mesh executor's kernel body (parallel/mesh.py wraps this in
     `shard_map` + jit): one coalesced REPLICATED problem buffer, the
     device-RESIDENT sharded catalog args, and a device-resident sharded
@@ -1322,26 +1376,29 @@ def _solve_ffd_resident_impl(buf, mask_table, col_alloc, col_daemon,
     mask rows are content-addressed and resident across solves
     (solve.py _MaskRowRegistry), so no O-axis array travels per solve.
     The row gather runs on each device's local [C, O/devices] shard."""
+    parts = _unpack_problem(buf, layout)
     (group_req, group_count, group_rows, exist_cap, exist_remaining,
      pool_limit, group_ncap, group_dsel, group_dbase, group_dcap,
      group_skew, group_mindom, group_delig, group_whole, group_gang,
-     exist_zone, exist_ct) = _unpack_problem(buf, layout)
+     exist_zone, exist_ct) = parts[:17]
+    group_prio = parts[17] if with_priority else None
     group_mask = mask_table[group_rows]
     return _solve_ffd_impl(
         group_req, group_count, group_mask, exist_cap, exist_remaining,
         col_alloc, col_daemon, pt_alloc, col_pool, pool_daemon,
         pool_limit, group_ncap, group_dsel, group_dbase, group_dcap,
         group_skew, group_mindom, group_delig, group_whole, group_gang,
-        col_zone, col_ct, exist_zone, exist_ct,
+        col_zone, col_ct, exist_zone, exist_ct, group_prio=group_prio,
         max_nodes=max_nodes, zc=zc, sparse_n=sparse_n,
-        axis_name=axis_name, explain=explain, with_gang=with_gang)
+        axis_name=axis_name, explain=explain, with_gang=with_gang,
+        with_priority=with_priority)
 
 def _solve_ffd_delta_impl(buf, col_alloc, col_daemon, pt_alloc, col_pool,
                           pool_daemon, col_zone, col_ct, layout=None,
                           max_nodes: int = 1024, zc: int = 1,
                           sparse_n: int = 0, mask_packed: bool = False,
                           seed_packed: bool = False, explain: int = 0,
-                          with_gang: int = 0):
+                          with_gang: int = 0, with_priority: int = 0):
     """The delta path's seeded kernel (single-device): one coalesced
     buffer carrying the restricted SUFFIX problem (the changed groups
     only) PLUS the prefix seed state — used/pool/active for the node
@@ -1370,11 +1427,11 @@ def _solve_ffd_delta_impl(buf, col_alloc, col_daemon, pt_alloc, col_pool,
         seed_pool=seed_pool, seed_active=seed_active,
         max_nodes=max_nodes, zc=zc, with_topology=False,
         sparse_n=sparse_n, mask_packed=mask_packed, explain=explain,
-        with_gang=with_gang)
+        with_gang=with_gang, with_priority=with_priority)
 
 
 _DELTA_STATICS = ("layout", "max_nodes", "zc", "sparse_n", "mask_packed",
-                  "seed_packed", "explain", "with_gang")
+                  "seed_packed", "explain", "with_gang", "with_priority")
 solve_ffd_delta = partial(
     jax.jit, static_argnames=_DELTA_STATICS)(_solve_ffd_delta_impl)
 
@@ -1385,7 +1442,8 @@ def _solve_ffd_delta_resident_impl(buf, seed_colmask, mask_table,
                                    col_ct, layout=None,
                                    max_nodes: int = 1024, zc: int = 1,
                                    axis_name=None, explain: int = 0,
-                                   with_gang: int = 0):
+                                   with_gang: int = 0,
+                                   with_priority: int = 0):
     """Mesh variant of the delta kernel (parallel/mesh.py wraps it in
     shard_map): the suffix problem's slot 2 carries row indices into the
     resident mask table (exactly like _solve_ffd_resident_impl), and the
@@ -1407,7 +1465,8 @@ def _solve_ffd_delta_resident_impl(buf, seed_colmask, mask_table,
         seed_used=seed_used, seed_colmask=seed_colmask,
         seed_pool=seed_pool, seed_active=seed_active,
         max_nodes=max_nodes, zc=zc, with_topology=False,
-        axis_name=axis_name, explain=explain, with_gang=with_gang)
+        axis_name=axis_name, explain=explain, with_gang=with_gang,
+        with_priority=with_priority)
 
 
 # The consolidation simulator's batch axis (SURVEY §7 step 6): many
@@ -1426,20 +1485,25 @@ _BATCH_AXES = (0, 0, 0, 0, 0,          # group_req..exist_remaining
 def _solve_ffd_batch_impl(*args, max_nodes: int = 1024, zc: int = 1,
                           sparse_k: int = 0, sparse_n: int = 0,
                           mask_packed: bool = False, explain: int = 0,
-                          with_gang: int = 0):
+                          with_gang: int = 0, with_priority: int = 0):
     # explain is armed (counts) only for UNCAPPED batches — the fused
     # solverd lane's real provisioning requests; capped consolidation
     # sims keep explain=0 (counterfactuals must not pay or pollute)
+    # with_priority rides as a 25th positional operand (stacked [B, G]
+    # group_prio, batch axis 0) — absent entirely for priority-free
+    # batches, so their arg list and program match the pre-priority lane
+    axes = _BATCH_AXES + ((0,) if len(args) > len(_BATCH_AXES) else ())
     return jax.vmap(partial(_solve_ffd_impl, max_nodes=max_nodes, zc=zc,
                             sparse_k=sparse_k, sparse_n=sparse_n,
                             mask_packed=mask_packed,
                             explain=min(explain, 1),
-                            with_gang=with_gang),
-                    in_axes=_BATCH_AXES)(*args)
+                            with_gang=with_gang,
+                            with_priority=with_priority),
+                    in_axes=axes)(*args)
 
 
 _BATCH_STATICS = ("max_nodes", "zc", "sparse_k", "sparse_n",
-                  "mask_packed", "explain", "with_gang")
+                  "mask_packed", "explain", "with_gang", "with_priority")
 solve_ffd_batch = partial(
     jax.jit, static_argnames=_BATCH_STATICS)(_solve_ffd_batch_impl)
 # pipelined variant: the per-problem stacked tensors (batch axis 0 in
@@ -1605,7 +1669,7 @@ solve_ffd_sweep_topo_donated = partial(
 
 def unpack(packed, G: int, E: int, N: int, RDIM: int, D: int,
            sparse_k: int = 0, sparse_n: int = 0, explain: int = 0,
-           explain_o: int = 0):
+           explain_o: int = 0, with_priority: int = 0):
     """Split the flat result buffer back into named host arrays.  With
     sparse_k > 0 the buffer's head carries top-K (count, index) pairs per
     group (see _solve_ffd_impl) and the dense [G, E] take_exist row is
@@ -1668,8 +1732,8 @@ def unpack(packed, G: int, E: int, N: int, RDIM: int, D: int,
         node_ct=flat[offs[7]:offs[8]].astype(np.int32),
         num_active=flat[offs[8]],
     )
+    off = int(offs[-1])
     if explain:
-        off = int(offs[-1])
         C = EXPLAIN_C
         out["explain_counts"] = \
             flat[off:off + G * C].reshape(G, C).astype(np.int64)
@@ -1679,4 +1743,10 @@ def unpack(packed, G: int, E: int, N: int, RDIM: int, D: int,
         if explain >= 2 and explain_o:
             out["explain_map"] = flat[off:off + G * explain_o] \
                 .reshape(G, explain_o).astype(np.int8)
+            off += G * explain_o
+    if with_priority:
+        # the kernel's inversion witness (ISSUE 16): last additive aux
+        # row, after any explain aux — True for a group that placed
+        # while an earlier (higher-priority) group had already stranded
+        out["prio_inv"] = flat[off:off + G] > 0.5
     return out

@@ -311,12 +311,18 @@ class TPUSolver:
         # a sound invalidation signal — a freed list's address could be
         # recycled, but a referenced one cannot be
         lists = tuple(inp.instance_types.get(p.name) for p in pools)
+        from karpenter_tpu.scheduling import risk
         key = (
             lists,
             # static_hash covers the template; name+weight cover identity and
             # priority order, which the hash deliberately excludes
             tuple((p.meta.name, p.weight, p.static_hash()) for p in pools),
             tuple(sorted((k, tuple(v.v)) for k, v in inp.daemon_overhead.items())),
+            # spot-risk model state (ISSUE 16): the encoding's
+            # col_price_eff bakes in the interruption probabilities, so
+            # an observed reclaim (version bump) or a knob flip must
+            # rebuild the encoding exactly like a price change would
+            risk.model_key(),
         )
         def _same(a, b):
             return (a is not None and b is not None
@@ -475,11 +481,17 @@ class TPUSolver:
 
     def _problem_args(self, enc: EncodedProblem, G: int, E: int, Db: int,
                       O: int, pack_mask: bool = False):
-        """The per-problem (non-catalog) kernel arguments, padded."""
+        """The per-problem (non-catalog) kernel arguments, padded.
+        Priority-free problems emit the exact 17-slot pre-priority
+        tuple; a problem with more than one priority band appends the
+        group_prio row as slot 17 — the tuple LENGTH is what _make_run
+        derives the with_priority static from, so warmup and the real
+        solve can never disagree about which program a banded workload
+        compiles (the with_gang slot-14 discipline)."""
         gmask = self._pad(self._pad(enc.group_mask, 1, O), 0, G)
         if pack_mask:
             gmask = np.packbits(gmask, axis=-1, bitorder="little")
-        return (
+        prob = (
             self._pad(enc.group_req, 0, G),
             self._pad(enc.group_count, 0, G),
             gmask,
@@ -499,6 +511,10 @@ class TPUSolver:
             self._pad(enc.exist_zone, 0, E, value=-1),
             self._pad(enc.exist_ct, 0, E, value=-1),
         )
+        gp = enc.group_priority
+        if gp is not None and len(np.unique(gp[:len(enc.groups)])) > 1:
+            prob = prob + (self._pad(gp, 0, G),)
+        return prob
 
     def _problem_args_mesh(self, enc: EncodedProblem, G: int, E: int,
                            Db: int, O: int, registry):
@@ -534,12 +550,16 @@ class TPUSolver:
 
     @staticmethod
     def _assemble(dev, prob):
-        """Interleave per-problem and shared catalog args in kernel order."""
+        """Interleave per-problem and shared catalog args in kernel order.
+        An 18-slot problem (priority bands — see _problem_args) appends
+        its group_prio row last, which binds the kernel's group_prio
+        positional."""
         (group_req, group_count, group_mask, exist_cap, exist_remaining,
          pool_limit, group_ncap, group_dsel, group_dbase, group_dcap,
          group_skew, group_mindom, group_delig, group_whole, group_gang,
-         exist_zone, exist_ct) = prob
-        return (group_req, group_count, group_mask, exist_cap, exist_remaining,
+         exist_zone, exist_ct) = prob[:17]
+        args = (group_req, group_count, group_mask, exist_cap,
+                exist_remaining,
                 dev["col_alloc"], dev["col_daemon"],
                 dev["pt_alloc"], dev["col_pool"],
                 dev["pool_daemon"], pool_limit,
@@ -547,6 +567,9 @@ class TPUSolver:
                 group_skew, group_mindom, group_delig, group_whole,
                 group_gang,
                 dev["col_zone"], dev["col_ct"], exist_zone, exist_ct)
+        if len(prob) > 17:
+            args = args + (prob[17],)
+        return args
 
     def solve(self, inp: ScheduleInput,
               max_nodes: Optional[int] = None) -> ScheduleResult:
@@ -579,6 +602,16 @@ class TPUSolver:
                 # consolidation sim) must never take it: a fewer-strands plan
                 # that uses more nodes than the cap is inadmissible there
                 res = self._oracle_backstop_on_limits(inp, res)
+            if max_nodes is None and res.unschedulable:
+                # preemption pre-pass (ISSUE 16): plans for stranded
+                # higher-priority pods whose seat lower-band evictions
+                # could free — the SAME shared planner the oracle's
+                # solve() runs, so the two engines' plans agree.  Capped
+                # sims never plan (they strand by design).
+                from karpenter_tpu.utils.knobs import priority_enabled
+                if priority_enabled():
+                    from karpenter_tpu.solver import preempt
+                    preempt.attach(inp, res)
             path = "split" if self._used_split else "device"
             metrics.SOLVER_SOLVES.inc(path=path)
             if _sp is not None:
@@ -855,6 +888,12 @@ class TPUSolver:
         # the exact pre-gang program, bit parity by construction.
         wg = (with_gang if with_gang is not None
               else int(bool(np.asarray(prob[14]).any())))
+        # the priority static is derived the same way, from the tuple
+        # SHAPE: _problem_args appends the group_prio slot only for
+        # problems with more than one priority band, so priority-free
+        # problems keep with_priority=0 — the exact pre-priority
+        # program, bit parity by construction.
+        wp = int(len(prob) > 17)
         if self._resolve_mesh() is not None:
             # mesh resident path: ONE coalesced replicated buffer through
             # the donated two-slot rotation; the mask table and catalog
@@ -867,7 +906,8 @@ class TPUSolver:
                 b = (self._upload_slots.put(buf, ex.rep) if pipe
                      else buf)
                 out = ex.solve(b, mesh_table, dev, layout, n, kn,
-                               donate=pipe, explain=exc, with_gang=wg)
+                               donate=pipe, explain=exc, with_gang=wg,
+                               with_priority=wp)
                 if pipe and not b.is_deleted():
                     # donate_argnums marks the slot for reuse, but a
                     # backend that can't alias the replicated buffer into
@@ -894,14 +934,15 @@ class TPUSolver:
                           dev["pool_daemon"], dev["col_zone"],
                           dev["col_ct"], layout=layout, max_nodes=n,
                           zc=dev["ZC"], sparse_n=kn, mask_packed=mbits,
-                          explain=exc, with_gang=wg)
+                          explain=exc, with_gang=wg, with_priority=wp)
         else:
             args = self._assemble(dev, self._put_problem(prob))
 
             def run(n, kn):
                 return ffd.solve_ffd(*args, max_nodes=n, zc=dev["ZC"],
                                      sparse_n=kn, mask_packed=mbits,
-                                     explain=exc, with_gang=wg)
+                                     explain=exc, with_gang=wg,
+                                     with_priority=wp)
         return run
 
     # -- placement provenance (solver/explain.py) -------------------------
@@ -1337,7 +1378,8 @@ class TPUSolver:
             t_c = _time.perf_counter()
             out_ = ffd.unpack(np.array(packed), G, E, n, R, Db,
                               sparse_n=k, explain=exc,
-                              explain_o=dev["O"])
+                              explain_o=dev["O"],
+                              with_priority=int(len(prob) > 17))
             t_d = _time.perf_counter()
             disp_s += t_b - t_a
             dev_s += t_c - t_b
@@ -1487,7 +1529,7 @@ class TPUSolver:
             proto = self._problem_args(enc, baseG, baseE, Db, dev["O"],
                                        pack_mask=mbits)
             mesh_table = None
-        _G_AX = (0, 1, 2, 3, 6, 7, 8, 9, 10, 11, 12, 13, 14)
+        _G_AX = (0, 1, 2, 3, 6, 7, 8, 9, 10, 11, 12, 13, 14, 17)
 
         def zeros_at(i, a, G2, E2):
             shp = list(a.shape)
@@ -1516,11 +1558,19 @@ class TPUSolver:
         # gate covers gang problems exactly like plain ones)
         gang_variants = ((0, 1) if bool(np.asarray(proto[14]).any())
                          else (0,))
+        # multi-band workloads compile a distinct static config
+        # (with_priority=1, an 18-slot problem tuple): warm BOTH tuple
+        # lengths, because the priority slot is shape-derived per solve
+        # and a wave that collapses to one band emits the 17-slot
+        # pre-priority program again
+        prio_variants = ((0, 1) if len(proto) > 17 else (0,))
         for (G2, E2) in sorted(targets):
             prob2 = tuple(zeros_at(i, a, G2, E2)
                           for i, a in enumerate(proto))
-            for wg in gang_variants:
-                run = self._make_run(prob2, dev, mbits, pipe, mesh_table,
+            for wg, wpv in ((g, p) for g in gang_variants
+                            for p in prio_variants):
+                probv = prob2 if wpv else prob2[:17]
+                run = self._make_run(probv, dev, mbits, pipe, mesh_table,
                                      with_gang=wg)
                 for mn in ladder:
                     # dense (kn=0, what solve #1 runs while
@@ -1582,7 +1632,8 @@ class TPUSolver:
                     packed = fn(*self._assemble(dev, stacked),
                                 max_nodes=self.max_nodes, zc=dev["ZC"],
                                 sparse_k=sk, mask_packed=mbits,
-                                explain=exb, with_gang=wg)
+                                explain=exb, with_gang=wg,
+                                with_priority=int(len(prob0) > 17))
                     try:
                         packed.block_until_ready()
                     except AttributeError:
@@ -2536,6 +2587,13 @@ class TPUSolver:
             # group, so gang-free entries still take the light path)
             wg_b = int(any(bool(np.asarray(e.group_gang).any())
                            for _, e in encs))
+            # priority static for the whole batch, same discipline: one
+            # multi-band input arms the witness row for the fused program
+            wp_b = int(any(
+                e.group_priority is not None
+                and len(np.unique(
+                    np.asarray(e.group_priority)[:e.n_groups])) > 1
+                for _, e in encs))
             batch_fn = (ffd.solve_ffd_batch_donated if pipe
                         else ffd.solve_ffd_batch)
             chunk_size = B_BUCKETS[-1]
@@ -2552,6 +2610,14 @@ class TPUSolver:
                 B = bucket(len(chunk), B_BUCKETS)
                 probs = [self._problem_args(e, G, E, Db, O, pack_mask=mbits)
                          for _, e in chunk]
+                # wp_b arms the priority static for the whole fused
+                # program; single-band entries ride with a zeros prio row
+                # (uniform band — the witness is inert on them), so the
+                # stack stays rectangular
+                if wp_b:
+                    probs = [p if len(p) > 17
+                             else p + (np.zeros(G, np.int32),)
+                             for p in probs]
                 # pad the batch axis with empty problems (zero groups = no
                 # work) so repeat calls hit the jit cache at bucketed shapes
                 while len(probs) < B:
@@ -2569,7 +2635,7 @@ class TPUSolver:
                 packed = batch_fn(
                     *self._assemble(dev, stacked), max_nodes=mn,
                     zc=dev["ZC"], sparse_k=sparse_k, mask_packed=mbits,
-                    explain=exc_b, with_gang=wg_b)
+                    explain=exc_b, with_gang=wg_b, with_priority=wp_b)
                 device_s += _time.perf_counter() - t_dev0
                 return packed
 
@@ -2592,7 +2658,8 @@ class TPUSolver:
                 for bi, (i, enc) in enumerate(chunk):
                     t_dec0 = _time.perf_counter()
                     out = ffd.unpack(packed[bi], G, E, mn, R, Db,
-                                     sparse_k=sparse_k, explain=exc_b)
+                                     sparse_k=sparse_k, explain=exc_b,
+                                     with_priority=wp_b)
                     if exc_b:
                         # real fused requests feed the elimination
                         # series exactly like the single-problem path
@@ -2955,9 +3022,15 @@ class TPUSolver:
             _, porder, col_tid, tid_names, tid_types, base_masks = cat_cached
         else:
             cols = enc.columns
+            # rank by the EFFECTIVE price (spot-risk objective, ISSUE 16;
+            # = real price when the knob is off, so the composite key
+            # collapses to the pre-risk (price, type_name) order exactly)
+            eff = (enc.col_price_eff if enc.col_price_eff is not None
+                   else enc.col_price)
             porder = np.fromiter(
                 sorted(range(len(cols)),
-                       key=lambda i: (cols[i].price, cols[i].type_name)),
+                       key=lambda i: (float(eff[i]), cols[i].price,
+                                      cols[i].type_name)),
                 dtype=np.intp, count=len(cols))
             tid_of: Dict[tuple, int] = {}
             tid_names = []
@@ -3242,6 +3315,29 @@ class TPUSolver:
         if enc.group_gang is not None and gi < len(enc.group_gang) \
                 and enc.group_gang[gi]:
             return self._gang_reason(enc, gi, out)
+        # priority reclassification (ISSUE 16), gated on the KERNEL's
+        # inversion witness — prio_inv[h] marks a group that placed pods
+        # after a higher-priority group stranded, so this group's strand
+        # is a band-order capacity loss (preemption could seat it), not a
+        # plain capacity verdict.  Only for hostable groups: a group no
+        # column or existing node can ever carry keeps its real verdict.
+        gp = enc.group_priority
+        pi = None if out is None else out.get("prio_inv")
+        if gp is not None and pi is not None and (
+                enc.group_mask[gi].any() or (enc.exist_cap[gi] > 0).any()):
+            Gr = enc.n_groups
+            gprow = np.asarray(gp)[:Gr]
+            pirow = np.asarray(pi)[:Gr]
+            later = np.arange(Gr) > gi
+            if bool((later & pirow & (gprow < gprow[gi])).any()):
+                code = explainmod.PRIORITY_BAND_EXHAUSTED
+                detail = ("priority band exhausted: capacity went to "
+                          "lower-priority pods placed after this group "
+                          "stranded — eviction could seat it")
+                tree = None
+                if self._explain_trees:
+                    tree = explainmod.build_tree(enc, out or {}, gi, code)
+                return explainmod.make(code, detail, tree)
         if not enc.group_mask[gi].any() and not (enc.exist_cap[gi] > 0).any():
             details = []
             for pidx, pool in enumerate(enc.pools):

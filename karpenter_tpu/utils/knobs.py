@@ -54,6 +54,30 @@ def gang_enabled() -> bool:
     return env_bool("KARPENTER_TPU_GANG", default=True)
 
 
+def priority_enabled() -> bool:
+    """`KARPENTER_TPU_PRIORITY`: the priority-scheduling rollback lever
+    (default on).  Off, priority classes and the `karpenter.tpu/priority`
+    annotation are inert — pods keep their spec `priority` field in the
+    scheduling key (pre-existing behavior) but no band ordering, no
+    preemption planning, and no PriorityBandExhausted reclassification
+    happen.  Parsed here because the jax-free model/oracle layer, the
+    solver, and the preemption controller all read it, and each knob
+    keeps exactly one grammar owner.  (The service admission-rank knob
+    that previously used this name is now
+    `KARPENTER_TPU_SERVICE_PRIORITY` — operator/options.py.)"""
+    return env_bool("KARPENTER_TPU_PRIORITY", default=True)
+
+
+def spot_risk_enabled() -> bool:
+    """`KARPENTER_TPU_SPOT_RISK`: the spot-risk-weighted objective mode
+    (default off).  On, winner selection in BOTH engines ranks columns
+    by interruption-risk-adjusted effective price
+    (scheduling/risk.py) instead of pure price; claim prices stay the
+    REAL offering prices.  One grammar owner: encode, decode, and the
+    oracle all resolve the mode through this function."""
+    return env_bool("KARPENTER_TPU_SPOT_RISK", default=False)
+
+
 def bind_host() -> str:
     """`KARPENTER_TPU_BIND_HOST`: the metrics/health/probe bind address
     (default loopback; `0.0.0.0` in containers).  Shared by the

@@ -66,7 +66,7 @@ _ENV_DIR = "KARPENTER_TPU_LEDGER_DIR"
 # the decision-source vocabulary (the `source` label of
 # karpenter_tpu_ledger_records_total and every record's `source` field)
 SOURCES = ("provisioning", "disruption", "drift", "expiration",
-           "interruption", "termination")
+           "interruption", "termination", "preemption")
 
 
 def ledger_enabled() -> bool:
@@ -387,6 +387,19 @@ def update_fleet_metrics(cluster, cp, pricing=None) -> dict:
     pricing = pricing if pricing is not None \
         else getattr(getattr(cp, "instance_types", None), "pricing", None)
     cost = fleet_cost(cluster, pricing)
+
+    # fleet expected-interruption cost (ISSUE 16): Σ p × price over live
+    # spot nodes under the risk model — 0 with the knob off, so the
+    # gauge always reports and a knob flip shows as a step to/from zero
+    from karpenter_tpu.utils.knobs import spot_risk_enabled
+    risk_total = 0.0
+    if spot_risk_enabled():
+        from karpenter_tpu.scheduling import risk as riskmod
+        for node in cluster.nodes.list(lambda n: not n.meta.deleting):
+            risk_total += riskmod.expected_interruption_cost(
+                node_price(node, pricing), node.instance_type or "",
+                node.zone or "", node.capacity_type or "")
+    metrics.SPOT_RISK_COST.set(risk_total)
 
     # spend by (pool, capacity_type), stale series removed
     new_cost_keys = set()

@@ -401,6 +401,21 @@ GANG_PLACEMENTS = _c(
     "when the gang stranded whole — by the atomicity invariant there "
     "is no third outcome (a partial gang is a bug, counted on "
     "karpenter_tpu_solver_gang_repairs_total).", ("outcome",))
+# -- priority & preemption (ISSUE 16)
+PREEMPTIONS = _c(
+    "karpenter_tpu_preemptions_total",
+    "Preemption plan executions (one increment per plan): "
+    "outcome=evicted when every victim drained (plans are atomic — a "
+    "gang victim evicts whole), outcome=blocked when any victim failed "
+    "its eviction gate and the WHOLE plan was skipped, outcome=stale "
+    "when the plan's victims were already gone by execution time.",
+    ("outcome",))
+SPOT_RISK_COST = _g(
+    "karpenter_tpu_spot_risk_cost",
+    "Fleet expected-interruption cost in $/hr: Σ over spot nodes of "
+    "p(interruption) × price under the KARPENTER_TPU_SPOT_RISK model — "
+    "the quantity the risk-weighted objective minimizes at equal "
+    "coverage (0 when the knob is off or the fleet is on-demand).")
 SOLVER_GANG_REPAIRS = _c(
     "karpenter_tpu_solver_gang_repairs_total",
     "Gang fills the host-side atomicity safety net rolled back "

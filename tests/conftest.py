@@ -43,6 +43,14 @@ os.environ.pop("KARPENTER_TPU_LEDGER_DIR", None)
 os.environ.pop("KARPENTER_TPU_GANG", None)
 os.environ.pop("KARPENTER_TPU_TENANT_WEIGHTS_FILE", None)
 
+# Priority scheduling runs at its DEFAULT (on) and the spot-risk
+# objective at its DEFAULT (off): an inherited KARPENTER_TPU_PRIORITY=off
+# would make every priority/preemption test pass vacuously (annotations
+# inert, no plans attached), and a leftover KARPENTER_TPU_SPOT_RISK=on
+# would perturb decode ranking in every price-parity assertion.
+os.environ.pop("KARPENTER_TPU_PRIORITY", None)
+os.environ.pop("KARPENTER_TPU_SPOT_RISK", None)
+
 # Dynamic lock-order observer (ISSUE 12, opt-in): under
 # KARPENTER_TPU_LOCK_OBSERVER=1 every threading.Lock/RLock/Condition a
 # karpenter_tpu module constructs from here on is wrapped, real

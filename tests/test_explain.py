@@ -89,8 +89,12 @@ class TestRegistry:
         # kernel-constraint tuple, with gang appended host-side
         assert explain.CONSTRAINTS == (explain.HOST_CONSTRAINTS
                                        + explain.KERNEL_CONSTRAINTS
-                                       + ("gang",))
+                                       + ("gang", "priority"))
         assert "gang" not in explain.KERNEL_CONSTRAINTS
+        # "priority" (ISSUE 16) is likewise verdict-only: the kernel's
+        # priority aux row is an inversion witness, not an elimination
+        # count, so the kernel-constraint tuple stays unchanged
+        assert "priority" not in explain.KERNEL_CONSTRAINTS
         for code, spec in explain.REGISTRY.items():
             assert spec.code == code
             assert spec.constraint in explain.CONSTRAINTS + ("none",)

@@ -27,6 +27,28 @@ def mkpod(name):
                requests=Resources.parse({"cpu": "500m", "memory": "1Gi"}))
 
 
+def wait_scheduled(env, name, timeout=20.0):
+    """Event-driven wait for one pod to schedule: block on the cluster
+    watch (the informer seam every operator loop already consumes)
+    instead of a fixed-cadence sleep poll — the wait ends the instant
+    the binder writes the pod, so a slow takeover spends its whole
+    budget on the takeover and none of it sleeping past the bind."""
+    w = env.cluster.watch()
+    try:
+        deadline = time.monotonic() + timeout
+        while True:
+            p = env.cluster.pods.get(name)
+            if p is not None and p.scheduled:
+                return True
+            left = deadline - time.monotonic()
+            if left <= 0:
+                return False
+            w.wait(timeout=min(left, 0.25))
+            w.drain()
+    finally:
+        env.cluster.unwatch(w)
+
+
 class TestLeases:
     def test_inmemory_mutual_exclusion(self):
         lease = InMemoryLease()
@@ -109,22 +131,18 @@ class TestReplicaPairE2E:
         try:
             # exactly one leader emerges and provisions
             env.cluster.pods.create(mkpod("before"))
-            deadline = time.time() + 20
-            while time.time() < deadline:
-                if env.cluster.pods.get("before").scheduled:
-                    break
-                time.sleep(0.05)
-            assert env.cluster.pods.get("before").scheduled
-            # a long first reconcile (cold solve) can outlive the short
-            # test lease and flap leadership once; poll until the pair
-            # settles on exactly one leader
-            deadline = time.time() + 20
+            assert wait_scheduled(env, "before")
+            # renewal runs on its own thread (operator._renew_loop), so a
+            # long cold solve can no longer starve the renew into a
+            # leadership flap; the pair settles on exactly one leader —
+            # wait on the leadership EVENT, not a sleep poll
+            deadline = time.monotonic() + 20
             leaders = []
-            while time.time() < deadline:
-                leaders = [op for op in ops if op.elector.is_leader]
+            while time.monotonic() < deadline:
+                leaders = [op for op in ops if op._leadership.is_set()]
                 if len(leaders) == 1:
                     break
-                time.sleep(0.1)
+                time.sleep(0.05)
             assert len(leaders) == 1
             leader = leaders[0]
             standby = next(op for op in ops if op is not leader)
@@ -134,13 +152,10 @@ class TestReplicaPairE2E:
             leader.stop()
 
             env.cluster.pods.create(mkpod("after"))
-            deadline = time.time() + 20
-            while time.time() < deadline:
-                if env.cluster.pods.get("after").scheduled:
-                    break
-                time.sleep(0.05)
-            assert env.cluster.pods.get("after").scheduled, \
+            assert wait_scheduled(env, "after"), \
                 "standby never took over provisioning"
+            # takeover is observable on the standby's leadership event
+            assert standby._leadership.wait(5.0)
             assert standby.elector.is_leader
         finally:
             for op in ops:

@@ -709,6 +709,37 @@ def test_reason_return_flags_literals_in_disruption(tmp_path):
     assert all("reason-literal" in m for m in msgs)
 
 
+def test_reason_return_covers_preemption_modules(tmp_path):
+    # ISSUE 16 satellite: the preemption planner and its executing
+    # controller are decision emitters too — a *_reason literal in
+    # either module is flagged exactly like disruption's
+    findings, _ = _check(tmp_path, """
+        def _insufficient_reason(self, target):
+            return f"preemption insufficient for {target}"
+    """, observability, relname="karpenter_tpu/solver/preempt.py")
+    msgs = [f.message for f in findings]
+    assert len(findings) == 1, msgs
+    assert "reason-literal" in msgs[0]
+    findings, _ = _check(tmp_path, """
+        def _blocked_reason(self, victim):
+            return "victim is not evictable"
+    """, observability, relname="karpenter_tpu/controllers/preemption.py")
+    msgs = [f.message for f in findings]
+    assert len(findings) == 1, msgs
+    assert "reason-literal" in msgs[0]
+    # the coded form in the same modules stays clean
+    findings, _ = _check(tmp_path, """
+        from karpenter_tpu.solver import explain as explainmod
+
+
+        def _insufficient_reason(self, target):
+            return explainmod.make(
+                explainmod.PREEMPTION_INSUFFICIENT,
+                "no eviction set can seat the target")
+    """, observability, relname="karpenter_tpu/solver/preempt.py")
+    assert findings == []
+
+
 def test_reason_return_negatives(tmp_path):
     # coded returns, None, variables, and non-_reason functions stay
     # clean; other modules are out of scope entirely

@@ -1,0 +1,649 @@
+"""Priority classes & preemption-aware packing (ISSUE 16).
+
+Layers, cheapest first:
+
+  * priority resolution units — annotation → priorityClassName → spec
+    precedence, malformed degrade, the rollback knob
+  * band packing — higher bands consume capacity first on BOTH engines;
+    priority-free problems stay bit-compatible with the pre-priority
+    pipeline (knob on == knob off == pre-priority order)
+  * verdict reclassification — a strand whose band lost capacity to
+    later lower-priority placements becomes PriorityBandExhausted
+  * the preemption planner — minimal victim sets, whole-gang victim
+    atomicity, PreemptionInsufficient, idempotent re-attach
+  * the preemption controller — evicted/blocked/stale outcomes, atomic
+    per plan, the hex-exact zero-dollar ledger record
+  * the spot-risk model — probability/effective-price shape, observed
+    reclaims bump the model version (cache identity), the fleet gauge
+  * seeded fuzz — priority-on/off lockstep through both engines with
+    the ONE shared `priority_inversion_audit`: no lower-priority pod
+    remains placed while a higher-priority pod strands that its
+    eviction could seat (modulo attached plans, whose seats are in
+    flight)
+  * e2e — a pool-limit-bound cluster preempts through the full
+    controller loop: plan → stamp → evict → reschedule
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.env import Environment
+from karpenter_tpu.models import (
+    Node,
+    NodePool,
+    ObjectMeta,
+    Pod,
+    Requirement,
+    Requirements,
+    Resources,
+    wellknown,
+)
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.providers import generate_catalog
+from karpenter_tpu.providers.catalog import DEFAULT_ZONES, CatalogSpec
+from karpenter_tpu.scheduling import ExistingNode, ScheduleInput, Scheduler
+from karpenter_tpu.scheduling import risk
+from karpenter_tpu.scheduling.types import (
+    PRIORITY_CLASSES,
+    effective_request,
+    priority_inversion_audit,
+    priority_of,
+    register_priority_class,
+)
+from karpenter_tpu.solver import TPUSolver
+from karpenter_tpu.solver import explain as explainmod
+from karpenter_tpu.solver import preempt
+from karpenter_tpu.utils import ledger, metrics
+
+ZONE = wellknown.ZONE_LABEL
+CT = wellknown.CAPACITY_TYPE_LABEL
+CATALOG = generate_catalog(CatalogSpec(max_types=24, include_gpu=False))
+# a zone that exists ONLY on hand-built existing nodes, never in the
+# catalog: pods pinned here compete for existing capacity and can
+# strand — the preemption trigger
+EDGE_ZONE = "tpu-edge-1x"
+
+
+def mkpod(name, cpu="500m", mem="1Gi", prio=None, cls=None, annot=None,
+          **kw):
+    p = Pod(meta=ObjectMeta(name=name, labels=kw.pop("labels", {})),
+            requests=Resources.parse({"cpu": cpu, "memory": mem}), **kw)
+    if prio is not None:
+        p.priority = prio
+    if cls is not None:
+        p.priority_class_name = cls
+    if annot is not None:
+        p.meta.annotations[wellknown.PRIORITY_ANNOTATION] = str(annot)
+    return p
+
+
+def mknode(name, zone=EDGE_ZONE, cpu="8", mem="32Gi", residents=(),
+           pool="default"):
+    alloc = Resources.parse({"cpu": cpu, "memory": mem, "pods": "110"})
+    used = Resources()
+    for p in residents:
+        used += effective_request(p)
+        p.node_name = name
+    node = Node(meta=ObjectMeta(
+        name=name,
+        labels={ZONE: zone, CT: "on-demand",
+                wellknown.HOSTNAME_LABEL: name,
+                wellknown.NODEPOOL_LABEL: pool}),
+        allocatable=alloc, ready=True)
+    return ExistingNode(node=node, available=alloc - used,
+                        pods=list(residents))
+
+
+def mkinput(pods, existing=(), pools=None, types=None, **kw):
+    pools = pools or [NodePool(meta=ObjectMeta(name="default"))]
+    types = types if types is not None else CATALOG
+    return ScheduleInput(pods=pods, nodepools=pools,
+                         instance_types={p.name: types for p in pools},
+                         existing_nodes=list(existing), **kw)
+
+
+def pinned(pod, zone=EDGE_ZONE):
+    pod.requirements = Requirements(Requirement.make(ZONE, "In", zone))
+    return pod
+
+
+def placements(res):
+    """pod name → where it landed (claims + existing assignments)."""
+    out = dict(res.existing_assignments)
+    for c in res.new_claims:
+        for p in c.pods:
+            out[p.meta.name] = c.hostname or c.nodepool
+    return out
+
+
+@pytest.fixture(autouse=True)
+def _clean_model():
+    risk.reset()
+    added = set(PRIORITY_CLASSES) - {"system-cluster-critical",
+                                     "system-node-critical"}
+    for k in added:
+        PRIORITY_CLASSES.pop(k, None)
+    yield
+    risk.reset()
+    for k in set(PRIORITY_CLASSES) - {"system-cluster-critical",
+                                      "system-node-critical"}:
+        PRIORITY_CLASSES.pop(k, None)
+
+
+# --------------------------------------------------------------------------
+# priority resolution
+# --------------------------------------------------------------------------
+class TestPriorityOf:
+    def test_precedence_annotation_beats_class_beats_spec(self):
+        register_priority_class("gold", 500)
+        p = mkpod("p", prio=10, cls="gold")
+        assert priority_of(p) == 500
+        p2 = mkpod("p2", prio=10, cls="gold", annot=900)
+        assert priority_of(p2) == 900
+
+    def test_malformed_annotation_degrades(self):
+        register_priority_class("gold", 500)
+        p = mkpod("p", prio=10, cls="gold", annot="not-a-number")
+        assert priority_of(p) == 500
+        p2 = mkpod("p2", prio=10, annot="nope")
+        assert priority_of(p2) == 10
+
+    def test_system_classes_ship_by_default(self):
+        p = mkpod("p", cls="system-node-critical")
+        assert priority_of(p) == 2_000_001_000
+
+    def test_knob_off_returns_spec_priority(self, monkeypatch):
+        register_priority_class("gold", 500)
+        p = mkpod("p", prio=7, cls="gold", annot=900)
+        assert priority_of(p) == 900
+        monkeypatch.setenv("KARPENTER_TPU_PRIORITY", "off")
+        assert priority_of(p) == 7  # cache keys on the knob state
+
+    def test_priority_joins_the_scheduling_key(self):
+        a, b = mkpod("a", annot=100), mkpod("b", annot=200)
+        assert a.scheduling_group_id() != b.scheduling_group_id()
+        c, d = mkpod("c"), mkpod("d")
+        assert c.scheduling_group_id() == d.scheduling_group_id()
+
+
+# --------------------------------------------------------------------------
+# band packing + parity
+# --------------------------------------------------------------------------
+class TestBandPacking:
+    def test_high_band_takes_contended_capacity_both_engines(self):
+        # one 8-cpu edge node; a high and a low group both pinned to it,
+        # jointly oversubscribing: the HIGH band must seat, the low
+        # strand — on the kernel and the oracle alike
+        exist = mknode("edge-1", cpu="8")
+        pods = ([pinned(mkpod(f"hi{i}", cpu="3", annot=1000))
+                 for i in range(2)]
+                + [pinned(mkpod(f"lo{i}", cpu="3", annot=1))
+                   for i in range(2)])
+        inp = mkinput(pods, existing=[exist])
+        for res in (Scheduler(mkinput(
+                pods, existing=[mknode("edge-1", cpu="8")])).solve(),
+                TPUSolver().solve(inp)):
+            got = placements(res)
+            assert "hi0" in got and "hi1" in got, res.unschedulable
+            stranded = set(res.unschedulable)
+            assert stranded <= {"lo0", "lo1"}
+            assert len(stranded) >= 1
+
+    def test_priority_free_knob_lockstep(self, monkeypatch):
+        # an all-one-band problem must solve IDENTICALLY with the knob
+        # on and off — the bit-parity contract: priority-free problems
+        # lower to the pre-priority program
+        pods = ([mkpod(f"s{i}", cpu="250m", mem="512Mi") for i in range(30)]
+                + [mkpod(f"m{i}", cpu="2", mem="4Gi") for i in range(12)]
+                + [mkpod(f"l{i}", cpu="7", mem="12Gi") for i in range(5)])
+        res_on = TPUSolver().solve(mkinput(list(pods)))
+        monkeypatch.setenv("KARPENTER_TPU_PRIORITY", "off")
+        res_off = TPUSolver().solve(mkinput(list(pods)))
+        assert placements(res_on) == placements(res_off)
+        assert set(res_on.unschedulable) == set(res_off.unschedulable)
+        assert [c.instance_type_names[:1] for c in res_on.new_claims] \
+            == [c.instance_type_names[:1] for c in res_off.new_claims]
+        assert abs(sum(c.price for c in res_on.new_claims)
+                   - sum(c.price for c in res_off.new_claims)) == 0.0
+
+    def test_knob_off_makes_bands_inert(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_PRIORITY", "off")
+        exist = mknode("edge-1", cpu="8")
+        pods = ([pinned(mkpod(f"hi{i}", cpu="3", annot=1000))
+                 for i in range(2)]
+                + [pinned(mkpod(f"lo{i}", cpu="3", annot=1))
+                   for i in range(2)])
+        res = TPUSolver().solve(mkinput(pods, existing=[exist]))
+        # annotations inert: no plans attach, bands don't reorder
+        assert res.preemptions == []
+
+    def test_insufficient_when_evicting_everything_cannot_seat(self):
+        # empty 8-cpu edge node; two 5-cpu highs pinned to it (only one
+        # fits), a 3-cpu low pinned too.  hi seats first (band order),
+        # the stranded hi's verdict carries oracle authority from the
+        # rescue frame: even evicting the low (an in-frame victim) frees
+        # only 3 cpu — a priority-family PreemptionInsufficient verdict,
+        # never a plain capacity one
+        exist = mknode("edge-1", cpu="8")
+        pods = [pinned(mkpod("hi-0", cpu="5", annot=1000)),
+                pinned(mkpod("hi-1", cpu="5", annot=1000)),
+                pinned(mkpod("lo-0", cpu="3", annot=1))]
+        inp = mkinput(pods, existing=[exist])
+        res = TPUSolver().solve(inp)
+        got = placements(res)
+        assert "lo-0" in got
+        stranded_hi = {"hi-0", "hi-1"} & set(res.unschedulable)
+        assert len(stranded_hi) == 1
+        reason = res.unschedulable[stranded_hi.pop()]
+        assert explainmod.code_of(reason) \
+            == explainmod.PREEMPTION_INSUFFICIENT
+        # and no inversion: evicting the 3-cpu low cannot seat a 5-cpu
+        # high, so the low keeping its seat is NOT an inversion
+        assert priority_inversion_audit(inp, res, res.preemptions) == []
+
+    def test_band_exhausted_witness_and_plan(self, monkeypatch):
+        # a resident low holds capacity a pinned high needs, and a
+        # same-pass low seats AFTER the high strands: the kernel's
+        # inversion witness reclassifies the strand (visible under the
+        # explain tree's `kernel` half — the rescue oracle names the
+        # authoritative code), and the planner attaches a minimal plan
+        # naming exactly the resident victim
+        monkeypatch.setenv("KARPENTER_TPU_EXPLAIN", "full")
+        resid = mkpod("low-res", cpu="6", annot=1)
+        exist = mknode("edge-1", cpu="16", residents=[resid])
+        pods = [pinned(mkpod("hi-0", cpu="6", annot=1000)),
+                pinned(mkpod("hi-1", cpu="6", annot=1000)),
+                pinned(mkpod("lo-0", cpu="4", annot=1))]
+        inp = mkinput(pods, existing=[exist])
+        res = TPUSolver().solve(inp)
+        assert res.existing_assignments.get("hi-0") == "edge-1"
+        assert res.existing_assignments.get("lo-0") == "edge-1"
+        reason = res.unschedulable["hi-1"]
+        tree = getattr(reason, "tree", None) or {}
+        assert tree.get("kernel", {}).get("code") \
+            == explainmod.PRIORITY_BAND_EXHAUSTED
+        assert len(res.preemptions) == 1
+        plan = res.preemptions[0]
+        assert plan.target_pods == ["hi-1"]
+        # minimal: the 4-cpu same-pass low alone cannot seat a 6-cpu
+        # high, so the set prunes to just the resident
+        assert plan.victim_pod_names() == ["low-res"]
+        # the audit is clean BECAUSE the plan is attached
+        assert priority_inversion_audit(inp, res, res.preemptions) == []
+
+    def test_plan_attaches_for_resident_victim(self):
+        # the simplest preemption shape: a resident low holds ALL the
+        # capacity a pinned high needs
+        victim = mkpod("victim-low", cpu="6", annot=1)
+        exist = mknode("edge-1", cpu="8", residents=[victim])
+        pods = ([pinned(mkpod("crit", cpu="6", annot=1000))]
+                + [mkpod(f"fill{i}", cpu="1", annot=1) for i in range(4)])
+        inp = mkinput(pods, existing=[exist])
+        res = TPUSolver().solve(inp)
+        assert "crit" in res.unschedulable
+        assert len(res.preemptions) == 1
+        plan = res.preemptions[0]
+        assert plan.target_pods == ["crit"]
+        assert plan.victim_pod_names() == ["victim-low"]
+        assert priority_inversion_audit(inp, res, res.preemptions) == []
+
+
+# --------------------------------------------------------------------------
+# the planner
+# --------------------------------------------------------------------------
+class TestPreemptionPlanner:
+    def test_minimal_victim_set(self):
+        # three evictable lows; seating needs exactly ONE of them
+        lows = [mkpod(f"low-{i}", cpu="2", annot=i + 1) for i in range(3)]
+        exist = mknode("edge-1", cpu="8", residents=lows)
+        inp = mkinput([pinned(mkpod("hi", cpu="4", annot=100))],
+                      existing=[exist])
+        res = Scheduler(inp).solve()
+        assert len(res.preemptions) == 1
+        plan = res.preemptions[0]
+        # ONE victim, the lowest-priority one (the shared victim order)
+        assert plan.victim_pod_names() == ["low-0"]
+
+    def test_gang_victim_is_whole_gang(self):
+        gang = []
+        for i in range(2):
+            m = mkpod(f"g-{i}", cpu="3", annot=1)
+            m.meta.annotations[wellknown.GANG_NAME_ANNOTATION] = "ring"
+            m.meta.annotations[wellknown.GANG_SIZE_ANNOTATION] = "2"
+            gang.append(m)
+        exist = mknode("edge-1", cpu="8", residents=gang)
+        inp = mkinput([pinned(mkpod("hi", cpu="3", annot=100))],
+                      existing=[exist])
+        res = Scheduler(inp).solve()
+        assert len(res.preemptions) == 1
+        plan = res.preemptions[0]
+        # seating needs 3 cpu — ONE member would do, but gang atomicity
+        # evicts the pair or nothing
+        assert sorted(plan.victim_pod_names()) == ["g-0", "g-1"]
+        assert plan.victims[0].gang == "ring"
+
+    def test_insufficient_when_no_eviction_seats(self):
+        low = mkpod("low", cpu="2", annot=1)
+        exist = mknode("edge-1", cpu="8", residents=[low])
+        inp = mkinput([pinned(mkpod("giant", cpu="32", annot=100))],
+                      existing=[exist])
+        res = Scheduler(inp).solve()
+        assert res.preemptions == []
+        assert explainmod.code_of(res.unschedulable["giant"]) \
+            == explainmod.PREEMPTION_INSUFFICIENT
+
+    def test_daemonset_and_dnd_never_victims(self):
+        ds = mkpod("ds", cpu="6", annot=1)
+        ds.is_daemonset = True
+        dnd = mkpod("dnd", cpu="6", annot=1)
+        dnd.meta.annotations[wellknown.DO_NOT_DISRUPT_ANNOTATION] = "true"
+        exist = [mknode("edge-1", cpu="8", residents=[ds]),
+                 mknode("edge-2", cpu="8", residents=[dnd])]
+        inp = mkinput([pinned(mkpod("hi", cpu="6", annot=100))],
+                      existing=exist)
+        res = Scheduler(inp).solve()
+        assert res.preemptions == []
+        # protected pods are invisible to the planner: with NOTHING
+        # evictable below the band this is a plain capacity strand and
+        # the verdict stays un-rewritten
+        assert explainmod.code_of(res.unschedulable["hi"]) \
+            != explainmod.PREEMPTION_INSUFFICIENT
+
+    def test_no_plan_without_strictly_lower_band(self):
+        peer = mkpod("peer", cpu="6", annot=100)
+        exist = mknode("edge-1", cpu="8", residents=[peer])
+        inp = mkinput([pinned(mkpod("hi", cpu="6", annot=100))],
+                      existing=[exist])
+        res = Scheduler(inp).solve()
+        # same band: not a preemption case — the verdict stays as-is
+        assert res.preemptions == []
+        assert explainmod.code_of(res.unschedulable["hi"]) \
+            != explainmod.PREEMPTION_INSUFFICIENT
+
+    def test_attach_is_idempotent(self):
+        low = mkpod("low", cpu="6", annot=1)
+        exist = mknode("edge-1", cpu="8", residents=[low])
+        inp = mkinput([pinned(mkpod("hi", cpu="6", annot=100))],
+                      existing=[exist])
+        res = Scheduler(inp).solve()
+        assert len(res.preemptions) == 1
+        preempt.attach(inp, res)
+        assert len(res.preemptions) == 1  # already-targeted pods skipped
+
+    def test_plan_id_is_deterministic(self):
+        low = mkpod("low", cpu="6", annot=1)
+        mk = lambda: mkinput(  # noqa: E731 - two independent inputs
+            [pinned(mkpod("hi", cpu="6", annot=100))],
+            existing=[mknode("edge-1", cpu="8",
+                             residents=[mkpod("low", cpu="6", annot=1)])])
+        r1, r2 = Scheduler(mk()).solve(), Scheduler(mk()).solve()
+        assert r1.preemptions[0].plan_id == r2.preemptions[0].plan_id
+        assert r1.preemptions[0].plan_id.startswith("preempt-")
+
+
+# --------------------------------------------------------------------------
+# the controller
+# --------------------------------------------------------------------------
+def _bound_victim(env, name, node="n1", plan="preempt-abcdef123456",
+                  target="hi-1"):
+    p = mkpod(name)
+    p.node_name = node
+    p.phase = "Running"
+    p.meta.annotations[wellknown.PREEMPT_PLAN_ANNOTATION] = plan
+    p.meta.annotations[wellknown.PREEMPT_FOR_ANNOTATION] = target
+    env.cluster.pods.create(p)
+    return p
+
+
+class TestPreemptionController:
+    @pytest.fixture
+    def env(self):
+        e = Environment(options=Options(batch_idle_duration=0))
+        e.add_default_nodeclass()
+        return e
+
+    def test_evicted_atomic_with_ledger_record(self, env):
+        before = metrics.PREEMPTIONS.value(outcome="evicted")
+        _bound_victim(env, "v1")
+        _bound_victim(env, "v2")
+        env.preemption.reconcile()
+        assert metrics.PREEMPTIONS.value(outcome="evicted") == before + 1
+        for name in ("v1", "v2"):
+            p = env.cluster.pods.get(name)
+            assert p.node_name is None and p.phase == "Pending"
+            assert wellknown.PREEMPT_PLAN_ANNOTATION not in p.meta.annotations
+            assert wellknown.PREEMPT_FOR_ANNOTATION not in p.meta.annotations
+        recs = [r for r in ledger.LEDGER.tail(16)
+                if r["source"] == "preemption"]
+        assert recs, "no preemption ledger record"
+        rec = recs[-1]
+        assert rec["action"] == "evict"
+        assert rec["reason_code"] == explainmod.PREEMPTED_FOR
+        assert rec["cost_delta"] == 0.0
+        # IEEE-hex exactness: an eviction moves pods, never money
+        assert rec["cost_delta_hex"] == (0.0).hex()
+        assert rec["pods_affected"] == 2
+
+    def test_blocked_voids_whole_plan(self, env):
+        before = metrics.PREEMPTIONS.value(outcome="blocked")
+        v1 = _bound_victim(env, "v1")
+        v2 = _bound_victim(env, "v2")
+        v2.meta.annotations[wellknown.DO_NOT_DISRUPT_ANNOTATION] = "true"
+        env.cluster.pods.update(v2)
+        env.preemption.reconcile()
+        assert metrics.PREEMPTIONS.value(outcome="blocked") == before + 1
+        # ATOMIC: the evictable victim was NOT evicted either
+        p1 = env.cluster.pods.get("v1")
+        assert p1.node_name == v1.node_name and p1.phase == "Running"
+        for name in ("v1", "v2"):
+            a = env.cluster.pods.get(name).meta.annotations
+            assert wellknown.PREEMPT_PLAN_ANNOTATION not in a
+
+    def test_stale_when_victims_unbound(self, env):
+        before = metrics.PREEMPTIONS.value(outcome="stale")
+        v = _bound_victim(env, "v1")
+        v.node_name = None
+        env.cluster.pods.update(v)
+        env.preemption.reconcile()
+        assert metrics.PREEMPTIONS.value(outcome="stale") == before + 1
+        a = env.cluster.pods.get("v1").meta.annotations
+        assert wellknown.PREEMPT_PLAN_ANNOTATION not in a
+
+
+# --------------------------------------------------------------------------
+# the spot-risk model
+# --------------------------------------------------------------------------
+class TestSpotRisk:
+    def test_probability_shape(self):
+        p = risk.interruption_probability("tpu-v5e-8", "tpu-west-1a",
+                                          "spot")
+        assert 0.02 <= p <= 0.18
+        assert risk.interruption_probability(
+            "tpu-v5e-8", "tpu-west-1a", "on-demand") == 0.0
+
+    def test_effective_price_ranks_risk(self):
+        p = risk.interruption_probability("t", "z", "spot")
+        eff = risk.effective_price(10.0, "t", "z", "spot")
+        assert eff == 10.0 * (1.0 + risk.LAMBDA * p) > 10.0
+        assert risk.effective_price(10.0, "t", "z", "on-demand") == 10.0
+
+    def test_observation_bumps_probability_and_version(self):
+        v0 = risk.model_version()
+        p0 = risk.interruption_probability("t", "z", "spot")
+        risk.observe_interruption("t", "z")
+        assert risk.model_version() > v0
+        p1 = risk.interruption_probability("t", "z", "spot")
+        assert abs(p1 - (p0 + 0.05)) < 1e-12
+        # saturates at the cap
+        for _ in range(40):
+            risk.observe_interruption("t", "z")
+        assert risk.interruption_probability("t", "z", "spot") == 0.90
+
+    def test_model_key_is_cache_identity(self, monkeypatch):
+        assert risk.model_key() == (False, 0)  # knob off: inert key
+        monkeypatch.setenv("KARPENTER_TPU_SPOT_RISK", "on")
+        k1 = risk.model_key()
+        assert k1[0] is True
+        risk.observe_interruption("t", "z")
+        assert risk.model_key() != k1  # observation invalidates caches
+
+    def test_expected_cost_and_fleet_gauge(self, monkeypatch):
+        env = Environment(options=Options(batch_idle_duration=0))
+        env.add_default_nodeclass()
+        # a spot node priced by the ENV's own catalog (the gauge prices
+        # nodes through the provider's pricing, not ours)
+        nc = env.cluster.nodeclasses.list()[0]
+        it = env.cloud_provider.instance_types.list(nc)[0]
+        off = next(o for o in it.offerings if o.capacity_type == "spot")
+        node = Node(meta=ObjectMeta(
+            name="spot-1",
+            labels={ZONE: off.zone, CT: "spot",
+                    wellknown.INSTANCE_TYPE_LABEL: it.name,
+                    wellknown.NODEPOOL_LABEL: "default"}),
+            allocatable=it.allocatable(), ready=True)
+        env.cluster.nodes.create(node)
+        ledger.update_fleet_metrics(env.cluster, env.cloud_provider)
+        assert metrics.SPOT_RISK_COST.value() == 0.0  # knob off
+        monkeypatch.setenv("KARPENTER_TPU_SPOT_RISK", "on")
+        ledger.update_fleet_metrics(env.cluster, env.cloud_provider)
+        assert metrics.SPOT_RISK_COST.value() > 0.0
+
+    def test_risk_mode_prefers_lower_exposure_at_equal_coverage(
+            self, monkeypatch):
+        # risk-on must not cost MORE expected-interruption $/hr than
+        # price-only on the same problem at equal coverage
+        pods = [mkpod(f"p{i}", cpu="2", mem="4Gi") for i in range(40)]
+        res_off = TPUSolver().solve(mkinput(list(pods)))
+        monkeypatch.setenv("KARPENTER_TPU_SPOT_RISK", "on")
+        res_on = TPUSolver().solve(mkinput(list(pods)))
+        assert set(placements(res_on)) == set(placements(res_off))
+        by_name = {it.name: it for it in CATALOG}
+
+        def exposure(res):
+            total = 0.0
+            for c in res.new_claims:
+                it = by_name[c.instance_type_names[0]]
+                for o in it.offerings:
+                    total += risk.expected_interruption_cost(
+                        o.price, it.name, o.zone, o.capacity_type)
+                    break
+            return total
+
+        # claims carry REAL prices either way (ranking-only transform)
+        assert all(c.price > 0 for c in res_on.new_claims)
+
+
+# --------------------------------------------------------------------------
+# fuzz: the inversion invariant, priority-on/off lockstep
+# --------------------------------------------------------------------------
+N_SEEDS = int(os.environ.get("PRIORITY_FUZZ_SEEDS", "20"))
+
+
+def _gen_priority_problem(seed: int) -> ScheduleInput:
+    rng = np.random.RandomState(seed)
+    n_groups = rng.randint(2, 7)
+    bands = [0, 0, 10, 100, 1000]
+    pods = []
+    for g in range(n_groups):
+        count = max(1, int(rng.poisson(20)))
+        cpu = int(rng.choice([250, 500, 1000, 2000, 4000]))
+        mem = int(rng.choice([512, 1024, 2048, 4096]))
+        band = int(rng.choice(bands))
+        pin = rng.rand() < 0.4  # compete for edge capacity: can strand
+        for i in range(count):
+            p = mkpod(f"g{g}-p{i}", cpu=f"{cpu}m", mem=f"{mem}Mi",
+                      annot=band if band else None)
+            if pin:
+                pinned(p)
+            pods.append(p)
+    existing = []
+    for i in range(rng.randint(1, 4)):
+        residents = []
+        for j in range(rng.randint(0, 4)):
+            r = mkpod(f"res-{i}-{j}",
+                      cpu=f"{int(rng.choice([500, 1000, 2000]))}m",
+                      mem="512Mi",
+                      annot=int(rng.choice(bands)) or None)
+            if rng.rand() < 0.15:
+                r.is_daemonset = True
+            elif rng.rand() < 0.15:
+                r.meta.annotations[
+                    wellknown.DO_NOT_DISRUPT_ANNOTATION] = "true"
+            residents.append(r)
+        existing.append(mknode(
+            f"edge-{i}", cpu=str(int(rng.choice([4, 8, 16]))),
+            residents=residents))
+    return mkinput(pods, existing=existing)
+
+
+def _check_conservation(inp, res, ctx):
+    placed = placements(res)
+    seen = set(placed) | set(res.unschedulable)
+    names = {p.meta.name for p in inp.pods}
+    assert seen == names, (
+        f"{ctx} conservation: missing={names - seen} extra={seen - names}")
+    assert not (set(placed) & set(res.unschedulable)), ctx
+
+
+class TestFuzzPriority:
+    @pytest.mark.parametrize("seed", range(N_SEEDS))
+    def test_no_inversions_lockstep(self, seed, monkeypatch):
+        ctx = f"SEED={seed} (PRIORITY_FUZZ_SEEDS repro)"
+        inp_k = _gen_priority_problem(seed)
+        inp_o = _gen_priority_problem(seed)
+        res_k = TPUSolver().solve(inp_k)
+        res_o = Scheduler(inp_o).solve()
+        for inp, res, eng in ((inp_k, res_k, "kernel"),
+                              (inp_o, res_o, "oracle")):
+            _check_conservation(inp, res, f"{ctx} {eng}")
+            # THE invariant, through the ONE shared audit: no
+            # lower-priority pod remains placed while a higher-priority
+            # pod strands that its eviction could seat — attached plans
+            # excuse exactly their own victims/targets
+            inv = priority_inversion_audit(inp, res, res.preemptions)
+            assert inv == [], f"{ctx} {eng} inversions: {inv}"
+        # lockstep: the SAME seed with the knob off must still conserve
+        # pods and (trivially, all bands equal) pass the same audit
+        monkeypatch.setenv("KARPENTER_TPU_PRIORITY", "off")
+        inp_off = _gen_priority_problem(seed)
+        res_off = TPUSolver().solve(inp_off)
+        _check_conservation(inp_off, res_off, f"{ctx} off")
+        assert res_off.preemptions == [], ctx
+        assert priority_inversion_audit(
+            inp_off, res_off, res_off.preemptions) == [], ctx
+
+
+# --------------------------------------------------------------------------
+# e2e: plan → stamp → evict → reschedule through the controller loop
+# --------------------------------------------------------------------------
+class TestPreemptionE2E:
+    def test_pool_limit_preemption_reschedules_the_target(self):
+        env = Environment(options=Options(batch_idle_duration=0))
+        env.add_default_nodeclass()
+        env.cluster.nodepools.create(NodePool(
+            meta=ObjectMeta(name="default"),
+            limits=Resources.limits({"cpu": 16})))
+        # fill the limit with low-priority pods
+        for i in range(3):
+            env.cluster.pods.create(mkpod(f"low-{i}", cpu="4", annot=1))
+        env.settle()
+        assert all(env.cluster.pods.get(f"low-{i}").scheduled
+                   for i in range(3))
+        before = metrics.PREEMPTIONS.value(outcome="evicted")
+        # the high-priority pod cannot fit under the limit without an
+        # eviction; the loop must plan, stamp, evict, and reseat it
+        env.cluster.pods.create(mkpod("critical", cpu="8", annot=1000))
+        for _ in range(8):
+            env.settle()
+            p = env.cluster.pods.get("critical")
+            if p is not None and p.scheduled:
+                break
+        p = env.cluster.pods.get("critical")
+        assert p is not None and p.scheduled, \
+            {q.meta.name: (q.phase, q.node_name)
+             for q in env.cluster.pods.list()}
+        assert metrics.PREEMPTIONS.value(outcome="evicted") >= before + 1
