@@ -5,7 +5,7 @@ PY ?= python
 
 .PHONY: test tier1 multichip lint analyze analyze-fast native asan tsan \
 	repro-crash repro-crash-tsan saturation-smoke explain-smoke \
-	ledger-smoke bench-regress
+	ledger-smoke rewind-smoke bench-regress
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
@@ -62,6 +62,14 @@ explain-smoke:
 # is `python bench.py --ledger`.
 ledger-smoke:
 	JAX_PLATFORMS=cpu $(PY) hack/ledger_smoke.py
+
+# The cluster-rewind loop end to end (ISSUE 17): a seeded ~30 s mixed
+# scenario (arrivals, gang burst, priority wave, spot reclaim, worker
+# crash) replayed through a real Operator with every trajectory
+# invariant auditor armed — all booleans must hold, then seek must be
+# bit-identical.  The macro-bench is `python bench.py --rewind`.
+rewind-smoke:
+	JAX_PLATFORMS=cpu $(PY) hack/rewind_smoke.py
 
 # Gate the BENCH_r*.json trajectory: the newest recording must not
 # regress >15% on its same-metric predecessor's headline latency nor

@@ -150,7 +150,8 @@ def run_configs(timeout_s: float):
                "config4b_consolidation_spread.py",
                "config5_burst.py", "config6_interruption.py",
                "config7_churn.py", "config8_saturation.py",
-               "config9_gang.py", "config10_priority.py"]
+               "config9_gang.py", "config10_priority.py",
+               "config11_rewind.py"]
     env = dict(os.environ)
     # configs share the persistent compile cache (platform bootstrap), so
     # a generous per-probe budget isn't needed — keep failures quick so
@@ -794,6 +795,59 @@ def ledger_overhead_main(reps: int = 24,
         raise SystemExit(1)
 
 
+def rewind_main(out_path: str = "BENCH_r13.json") -> None:
+    """`bench.py --rewind`: the cluster-rewind macro-bench (ISSUE 17) —
+    config11's compressed fleet day replayed through a REAL Operator
+    with every trajectory invariant auditor armed (ledger-hex-exact
+    chain, gang atomicity, priority inversions, rate=1 shadow audit,
+    lost-pod reconciliation, seek bit-identity).
+
+    Runs the config in its own subprocess (fresh backend, same
+    isolation as run_configs) and stamps its one-line JSON record into
+    `BENCH_r13.json`, where `make bench-regress` gates the invariant
+    booleans against flips.  Exits 1 when the replay itself failed an
+    invariant."""
+    import subprocess
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmarks", "config11_rewind.py")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, script], env=env, capture_output=True,
+        text=True,
+        timeout=float(os.environ.get("KARPENTER_TPU_BENCH_TIMEOUT",
+                                     "600")))
+    wall_s = time.perf_counter() - t0
+    if proc.stderr:
+        sys.stderr.write(proc.stderr)
+    parsed = None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+            break
+        except ValueError:
+            continue
+    result = {"config": "config11_rewind.py", "rc": proc.returncode,
+              "harness_wall_s": round(wall_s, 1)}
+    if isinstance(parsed, dict):
+        result.update(parsed)
+    else:
+        result["error"] = (proc.stdout or "no output")[-2000:]
+    log_attempt({"stage": "rewind", **result, "ts": time.time()})
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+    print(json.dumps(result))
+    ok = proc.returncode == 0 and bool(result.get("pass"))
+    print(f"rewind: {result.get('events_total', '?')} events in "
+          f"{result.get('value', '?')}ms "
+          f"({result.get('events_per_s', '?')} ev/s) "
+          f"pass={ok} -> {out_path}", file=sys.stderr)
+    if not ok:
+        raise SystemExit(1)
+
+
 def main() -> None:
     # evict stale chip holders (leftover kt_solverd — the round-1 failure
     # mode) BEFORE the config subprocesses run: they probe with
@@ -963,5 +1017,7 @@ if __name__ == "__main__":
         argv = sys.argv[1:]
         ledger_overhead_main(reps=_int_opt(
             argv, "--reps", 24, "bench.py --ledger [--reps R]"))
+    elif "--rewind" in sys.argv[1:]:
+        rewind_main()
     else:
         main()

@@ -1,0 +1,129 @@
+"""BASELINE config #11: cluster rewind (ISSUE 17) — a compressed "day
+of fleet life" replayed through a REAL Operator with every trajectory
+invariant auditor armed.
+
+The stream is seeded and composed (timeline/generators.py): a diurnal
+arrival wave (the background hum), one spot-interruption storm mid-day
+(KubePACS's scenario class), a gang burst, a priority wave, and one
+solve-worker crash/restart — ≥5000 events end to end, quantized to
+240 s replay ticks (each tick = one operator drain + audit round).
+
+Acceptance (boolean fields `make bench-regress` gates):
+  * ledger_hex_exact — every ledger row's fleet $/hr chain holds
+    bit-for-bit (after == before + delta in IEEE hex) across the
+    whole day;
+  * zero_gang_atomicity_violations — the shared gang_placement_audit
+    over every solve of the replay;
+  * zero_priority_inversions — the shared priority_inversion_audit
+    (plans attached) over every solve;
+  * audit_clean — shadow sampler at rate=1: zero diverged / zero
+    error verdicts for the whole trajectory;
+  * zero_lost_pods — set reconciliation between the events fed in and
+    the cluster at the end: nothing silently dropped;
+  * seek_bit_identical — an independent seek onto a mid-timeline
+    checkpoint digests bit-identically to the straight-line replay
+    (checked on a deterministic-driver prefix of the same stream).
+
+Headline value: replay wall-time (ms) with events/sec alongside —
+the macro-bench the smaller per-decision benches compose into.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# pin the knob DEFAULTS for the replay: gang/priority ON (the scenario
+# exercises both), no inherited fault schedule or spill directories
+# (the stream injects its own crash; a leaked spill dir would slow the
+# recorder and skew the headline)
+for _k in ("KARPENTER_TPU_FAULTS", "KARPENTER_TPU_GANG",
+           "KARPENTER_TPU_PRIORITY", "KARPENTER_TPU_TIMELINE",
+           "KARPENTER_TPU_TIMELINE_DIR", "KARPENTER_TPU_LEDGER_DIR",
+           "KARPENTER_TPU_FLIGHT_DIR"):
+    os.environ.pop(_k, None)
+
+from benchmarks.common import env_fingerprint  # noqa: E402
+from karpenter_tpu.timeline import generators as g  # noqa: E402
+from karpenter_tpu.timeline import rewind  # noqa: E402
+
+TICK = 240.0        # replay frame: one settle/audit round per 4 min
+DAY = 21600.0       # 6 h of compressed fleet life
+MIN_EVENTS = 5000
+
+
+def build_day(seed: int = 1107):
+    """The composed day: diurnal hum + noon spot storm + afternoon
+    gang burst + evening priority wave + one worker crash."""
+    return g.compose(
+        g.diurnal_load(seed=seed, duration=DAY, step=TICK,
+                       base=12, peak=48, lifetime=2700.0),
+        g.spot_storm(at=DAY * 0.45, reclaims=60, spacing=20.0,
+                     seed=seed + 1),
+        g.gang_burst(at=DAY * 0.6, gangs=30, size=6, spacing=8.0,
+                     seed=seed + 2),
+        g.priority_wave(at=DAY * 0.75,
+                        bands=((1000, 40), (100, 40), (0, 40)),
+                        seed=seed + 3),
+        g.crash_schedule(DAY * 0.3, restart_after=TICK),
+    )
+
+
+def main() -> int:
+    seed = int(os.environ.get("KARPENTER_TPU_REWIND_SEED", "1107"))
+    stream = build_day(seed)
+    assert len(stream) >= MIN_EVENTS, \
+        f"day stream too small: {len(stream)} < {MIN_EVENTS}"
+
+    report = rewind.replay(stream, driver="operator", resolution=TICK)
+
+    # seek bit-identity on a deterministic-driver prefix of the SAME
+    # stream (the full day twice would double the bench; the contract
+    # is per-tick, so a prefix proves it)
+    prefix = stream[:600]
+    chk = rewind.seek_check(prefix, len(prefix) // 2,
+                            resolution=TICK, audit=False)
+
+    ok = bool(report["invariants_held"] and chk["bit_identical"])
+    record = {
+        "metric": "rewind replay of a compressed fleet day (config11)",
+        "value": round(report["wall_s"] * 1000.0, 1),
+        "unit": "ms",
+        "events_total": report["events_total"],
+        "events_applied": report["events_applied"],
+        "events_per_s": report["events_per_s"],
+        "solves": report["solves"],
+        "ledger_rows_checked": report["ledger_rows_checked"],
+        "pods_final": report["pods_final"],
+        "scheduled_final": report["scheduled_final"],
+        "nodes_final": report["nodes_final"],
+        "ledger_hex_exact": report["ledger_hex_exact"],
+        "zero_gang_atomicity_violations":
+            report["zero_gang_atomicity_violations"],
+        "zero_priority_inversions":
+            report["zero_priority_inversions"],
+        "audit_clean": report["audit_clean"],
+        "zero_lost_pods": report["zero_lost_pods"],
+        "seek_bit_identical": chk["bit_identical"],
+        "seek_k": chk["k"],
+        "seed": seed,
+        "pass": ok,
+        "env": env_fingerprint(platform=os.environ.get("JAX_PLATFORMS")),
+    }
+    print(json.dumps(record, default=str))
+    if not ok:
+        for key in ("ledger_breaks", "gang_violations",
+                    "priority_inversions", "lost_pods"):
+            if report.get(key):
+                print(f"config11: {key}: {report[key]}",
+                      file=sys.stderr)
+        if not chk["bit_identical"]:
+            print(f"config11: seek digest {chk['seek_digest']} != "
+                  f"straight {chk['straight_digest']}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

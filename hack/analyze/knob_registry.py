@@ -116,6 +116,12 @@ KNOBS = {
         "owner": "karpenter_tpu/solver/solve.py", "kind": "value"},
     "KARPENTER_TPU_TENANT": {
         "owner": "karpenter_tpu/operator/options.py", "kind": "value"},
+    "KARPENTER_TPU_TIMELINE": {
+        "owner": "karpenter_tpu/timeline/recorder.py", "kind": "bool"},
+    "KARPENTER_TPU_TIMELINE_BUFFER": {
+        "owner": "karpenter_tpu/timeline/recorder.py", "kind": "value"},
+    "KARPENTER_TPU_TIMELINE_DIR": {
+        "owner": "karpenter_tpu/timeline/recorder.py", "kind": "value"},
     "KARPENTER_TPU_TENANT_FUSE": {
         "owner": "karpenter_tpu/service/scheduler.py", "kind": "bool"},
     "KARPENTER_TPU_TENANT_MAX_FUSE": {

@@ -34,6 +34,13 @@ Checks, over string-literal registrations anywhere in the tree:
     (constant, f-string, or literal concatenation) — the decision
     ledger stores registry CODES, and a literal return is exactly how
     an uncoded verdict sneaks past the registry into the ledger.
+  * timeline event-kind literals (ISSUE 17): any ``emit("<literal>",
+    ...)`` call (bare or attribute form) outside the event-kind
+    registry module (`karpenter_tpu/timeline/events.py`) is a finding
+    — replay dispatch, the /debug/timeline filter, and the generators
+    all key on the kind string, so a kind spelled inline is a typo'd
+    event no replayer will ever match.  Callers name kinds through the
+    registry's constants (`events.POD_ADD`, `events.store_event(...)`).
 """
 
 from __future__ import annotations
@@ -83,6 +90,10 @@ def _span_name_arg(call: ast.Call) -> Optional[ast.Constant]:
 
 # the one module allowed to spell reason strings next to their codes
 _REASON_REGISTRY_MODULE = "karpenter_tpu/solver/explain.py"
+
+# the one module allowed to spell timeline event-kind strings (ISSUE
+# 17): every other emitter names kinds through its constants
+_EVENT_KIND_REGISTRY_MODULE = "karpenter_tpu/timeline/events.py"
 
 # decision-emitting controllers: *_reason functions here feed the
 # decision ledger and must return registry-coded Reasons, not literals
@@ -145,6 +156,25 @@ def _reason_return_findings(ctx: FileContext,
                 "(reason-literal)")
 
 
+def _event_kind_findings(ctx: FileContext,
+                         call: ast.Call) -> Iterator[Finding]:
+    if ctx.rel.endswith(_EVENT_KIND_REGISTRY_MODULE):
+        return
+    fn = call.func
+    named = (isinstance(fn, ast.Name) and fn.id == "emit") or (
+        isinstance(fn, ast.Attribute) and fn.attr == "emit")
+    if not named:
+        return
+    if call.args and _contains_str_literal(call.args[0]):
+        yield ctx.finding(
+            RULE_NAME, call,
+            "timeline event kind passed to emit() as a string literal "
+            "— kinds live in karpenter_tpu/timeline/events.py; use its "
+            "constants (events.POD_ADD, events.store_event(...)) so "
+            "replay dispatch and the /debug/timeline filter can match "
+            "it (event-kind-literal)")
+
+
 def check(ctx: FileContext) -> Iterator[Finding]:
     for node in ast.walk(ctx.tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -154,6 +184,7 @@ def check(ctx: FileContext) -> Iterator[Finding]:
             continue
         if not isinstance(node, ast.Call):
             continue
+        yield from _event_kind_findings(ctx, node)
         reg = _registration(node)
         if reg is not None:
             kind, call = reg

@@ -25,6 +25,7 @@ from karpenter_tpu.models.objects import (
     NodePool,
     Pod,
 )
+from karpenter_tpu.timeline import recorder as timeline_recorder
 from karpenter_tpu.utils import tracing
 from karpenter_tpu.utils.clock import Clock, RealClock
 
@@ -233,6 +234,41 @@ class Cluster:
                 watches = list(self._watches)
             for w in watches:
                 w._publish(ev)
+        if kind:
+            # the timeline recorder's capture point: every informer-cache
+            # mutation (local write or peer event via sync_backend) lands
+            # as one store.<kind>.<op> timeline event — the recorder
+            # checks its own gate and costs one env read when off
+            timeline_recorder.record_store_mutation(self, kind, op, name)
+
+    def wait_synced(self, predicate: Callable[[], bool],
+                    timeout: float = 5.0) -> bool:
+        """Event-driven convergence wait over the replication seam:
+        drain peer events, check `predicate`, and if it does not hold
+        yet BLOCK on the backend's watch stream until the next peer
+        event (or the deadline) instead of sleep-polling.  Mirrors the
+        `wait_events` deflake (PR 11): a loaded host delays the watch
+        thread, and a fixed sleep cadence turns that delay into a
+        spurious timeout, while blocking on the stream's condition
+        variable waits exactly as long as the event takes.  Falls back
+        to a short poll when the backend has no `wait_events` (the
+        in-memory backend, where sync is a no-op anyway)."""
+        import time as _time
+        deadline = _time.monotonic() + timeout
+        waiter = getattr(self.backend, "wait_events", None)
+        while True:
+            self.sync_backend()
+            if predicate():
+                return True
+            left = deadline - _time.monotonic()
+            if left <= 0:
+                return False
+            if waiter is not None:
+                # returns early on a new event or a dead stream; either
+                # way re-check the predicate against a fresh sync
+                waiter(1, timeout=min(left, 1.0))
+            else:
+                _time.sleep(min(left, 0.01))
 
     def record_event(self, kind: str, obj_name: str, reason: str,
                      message: str = "") -> None:

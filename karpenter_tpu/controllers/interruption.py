@@ -90,6 +90,12 @@ class Interruption:
                  if c.provider_id == instance_id), None)
         kind = msg.get("kind")
         if kind == "spot_interruption":
+            # the timeline's spot.reclaim capture point — one event per
+            # reclaim message, cross-linked to the claim it takes down
+            from karpenter_tpu.timeline import events as tev
+            from karpenter_tpu.timeline import recorder as trec
+            trec.emit(tev.SPOT_RECLAIM, name=str(instance_id or ""),
+                      data={"claim": claim.name if claim else None})
             inst = self.queue.cloud.instances.get(instance_id)
             if inst is not None:
                 # the reclaimed pool is unavailable for the next 3 minutes —

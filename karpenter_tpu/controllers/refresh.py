@@ -50,6 +50,12 @@ class PricingRefresh(_IntervalController):
     def refresh(self) -> None:
         try:
             self.pricing.update()
+            # the timeline's price.refresh capture point: a successful
+            # book refresh is a cluster-trajectory input (solves after
+            # it rank against new prices)
+            from karpenter_tpu.timeline import events as tev
+            from karpenter_tpu.timeline import recorder as trec
+            trec.emit(tev.PRICE_REFRESH, name=self.name)
         except Exception as e:  # noqa: BLE001 — keep the stale book (static
             # fallback semantics, pricing.go:54-59) — but visibly: a price
             # book aging silently is how cost regressions go unnoticed

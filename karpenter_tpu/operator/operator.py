@@ -212,6 +212,44 @@ class Operator:
                                     .tail(limit, trace_id=tid)},
                                    default=str) + "\n",
                         "application/json; charset=utf-8")
+                elif path == "/debug/timeline":
+                    # the cluster timeline tail (timeline/recorder.py):
+                    # every informer-cache mutation + semantic drive
+                    # event with trace/flight/ledger cross-links.
+                    # ?kind= narrows to one event kind, ?since=<seq>
+                    # to events after a sequence number, ?limit= caps
+                    # the count (default 64); ?format=html renders the
+                    # no-tooling view.
+                    from karpenter_tpu import timeline
+                    from karpenter_tpu.timeline import events as tev
+                    from karpenter_tpu.utils import telemetry
+                    q = parse_qs(url.query)
+                    kind = (q.get("kind") or [None])[0]
+                    try:
+                        limit = int((q.get("limit") or ["64"])[0])
+                    except ValueError:
+                        limit = 64
+                    try:
+                        since = int((q.get("since") or [""])[0])
+                    except ValueError:
+                        since = None
+                    evts = timeline.RECORDER.tail(limit, kind=kind,
+                                                  since=since)
+                    doc = {"events": evts,
+                           "last_seq": timeline.RECORDER.last_seq(),
+                           "kinds": tev.KINDS}
+                    fmt = (q.get("format") or ["json"])[0]
+                    if fmt == "html":
+                        self._respond(
+                            200,
+                            telemetry.html_page(
+                                "karpenter-tpu cluster timeline",
+                                [("events", evts)]),
+                            telemetry.HTML_CONTENT_TYPE)
+                    else:
+                        self._respond(
+                            200, json.dumps(doc, default=str) + "\n",
+                            "application/json; charset=utf-8")
                 elif path == "/debug/explain":
                     # placement provenance (ISSUE 13): the per-pod
                     # constraint-elimination tree behind a FailedScheduling

@@ -98,6 +98,24 @@ def sample_rate() -> float:
     return min(rate, 1.0)
 
 
+def arm(rate: str = "1"):
+    """Arm the sampler at `rate` (this knob's own grammar — "1" is
+    rate=1.0) and return a zero-arg restore callable honoring whatever
+    spelling was armed before.  The ONE place KARPENTER_TPU_AUDIT is
+    written programmatically (env-knob ownership): the rewind engine
+    forces rate=1 for a replay and must put the operator's setting
+    back afterwards."""
+    prior = os.environ.get(_ENV)
+    os.environ[_ENV] = rate
+
+    def restore() -> None:
+        if prior is None:
+            os.environ.pop(_ENV, None)
+        else:
+            os.environ[_ENV] = prior
+    return restore
+
+
 class _Job:
     __slots__ = ("inp", "digest", "delta_engaged", "max_nodes",
                  "solver_max_nodes", "trace_id")
@@ -258,6 +276,11 @@ class AuditSampler:
         verdict = VERDICT_DIVERGED if diverged else \
             self._classify(live, oracle)
         if verdict == VERDICT_DIVERGED:
+            # which tripwire fired decides the debugging path: the
+            # delta full-resolve compare points at the seeded-scan
+            # replay, the oracle compare at device-vs-host parity
+            detail["tripwire"] = ("delta-full-resolve" if diverged
+                                  else "oracle")
             self._capture_divergence(job, live, detail)
         return verdict
 
@@ -301,7 +324,8 @@ class AuditSampler:
         fr.RECORDER.record(
             kind="audit", trace_id=job.trace_id,
             pods=len(job.inp.pods), knobs={"audit": sample_rate()},
-            delta={"engaged": job.delta_engaged},
+            delta={"engaged": job.delta_engaged,
+                   "tripwire": detail.get("tripwire")},
             result=live, capture=path,
             phase_ms={}, retraces=0,
             device_memory_peak_bytes=0,
@@ -309,8 +333,10 @@ class AuditSampler:
         from karpenter_tpu.utils.logging import get_logger
         get_logger("solver").warn(
             "shadow audit divergence",
+            tripwire=detail.get("tripwire"),
             live_nodes=live.get("nodes"),
             oracle_nodes=detail.get("oracle", {}).get("nodes"),
+            full_nodes=detail.get("full", {}).get("nodes"),
             capture=path or "unavailable (set KARPENTER_TPU_FLIGHT_DIR)")
 
     # -- lifecycle ---------------------------------------------------------

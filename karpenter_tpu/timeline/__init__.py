@@ -1,0 +1,19 @@
+"""Cluster timeline: recorder + rewind engine (ISSUE 17).
+
+The package is split so the recorder half stays import-light (it is on
+the `Cluster.mutated` hot path): this __init__ exposes only the event
+registry and the recorder.  The heavyweight halves — `rewind` (builds
+an Environment / Operator), `generators`, and `invariants` — are
+imported explicitly by their consumers (tools/kt_rewind.py,
+benchmarks/config11_rewind.py, hack/rewind_smoke.py).
+"""
+
+from karpenter_tpu.timeline import events  # noqa: F401
+from karpenter_tpu.timeline.recorder import (  # noqa: F401
+    RECORDER,
+    emit,
+    load_events,
+    pod_spec,
+    record_store_mutation,
+    recording_enabled,
+)

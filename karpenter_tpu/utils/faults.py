@@ -113,6 +113,14 @@ def arm(point: str, mode: str, arg: Optional[float] = None,
     with _lock:
         _registry.setdefault(point, []).append(spec)
         ARMED = True
+    # the timeline's fault.inject capture point: every armed spec —
+    # env-loaded, test-armed, or replayed — lands on the cluster
+    # timeline so a recorded stream reproduces the fault schedule
+    from karpenter_tpu.timeline import events as _tev
+    from karpenter_tpu.timeline import recorder as _trec
+    _trec.emit(_tev.FAULT_INJECT, name=point,
+               data={"mode": mode, "arg": arg, "times": times,
+                     "after": after})
 
 
 def disarm(point: Optional[str] = None) -> None:

@@ -189,6 +189,14 @@ class Ledger:
         with self._lock:
             return len(self._ring)
 
+    def last_seq(self) -> Optional[int]:
+        """Newest row's sequence number, or None while empty — the
+        cross-link the timeline recorder stamps so a timeline event
+        jumps to the ledger row of the decision that preceded it
+        (mirror of FlightRecorder.last_seq)."""
+        with self._lock:
+            return self._seq if self._seq else None
+
     def reset(self) -> None:
         """Clear the ring and close any spill handle (tests)."""
         with self._lock:
@@ -204,6 +212,17 @@ class Ledger:
 
 
 LEDGER = Ledger()
+
+
+def ensure_buffer(n: int) -> None:
+    """Widen the module ledger's ring to hold at least `n` rows unless
+    the caller already pinned KARPENTER_TPU_LEDGER_BUFFER — the
+    owner-module seam for the rewind engine, whose hex-exact trajectory
+    judge must see EVERY row of a replay (the default 512-row ring
+    silently evicts a long day's head)."""
+    if _ENV_BUFFER not in os.environ:
+        os.environ[_ENV_BUFFER] = str(int(n))
+        LEDGER.reset()
 
 
 def load_records(path: str) -> List[dict]:

@@ -436,6 +436,12 @@ FLIGHT_RECORDS = _c(
     "Flight-recorder records written, by record kind (solve = one "
     "single-problem attempt, delta = an engaged delta pass, batch = one "
     "fused solverd batch).", ("kind",))
+TIMELINE_EVENTS = _c(
+    "karpenter_tpu_timeline_events_total",
+    "Timeline-recorder events written, by event kind (store.<kind>.<op> "
+    "informer-cache observations plus the semantic drive kinds from "
+    "timeline/events.py — spot.reclaim, price.refresh, fault.inject, "
+    "gang/priority arrival markers).", ("kind",))
 SOLVER_RETRACES = _c(
     "karpenter_tpu_solver_retraces_total",
     "Kernel-body retraces (each is the only event that can trigger an "

@@ -126,6 +126,21 @@ def _cost_section(ledger_tail: int = 8) -> dict:
     }
 
 
+def _timeline_section(tail: int = 8) -> dict:
+    """This process's cluster-timeline observables (timeline/
+    recorder.py): the per-kind event counters plus a short tail with
+    trace/flight/ledger cross-links intact."""
+    try:
+        from karpenter_tpu import timeline
+        return {
+            "events": _series(metrics.TIMELINE_EVENTS),
+            "last_seq": timeline.RECORDER.last_seq(),
+            "tail": timeline.RECORDER.tail(tail),
+        }
+    except Exception:  # noqa: BLE001 — best-effort, never the data path
+        return {"events": {}, "last_seq": None, "tail": []}
+
+
 def local_snapshot(flight_tail: int = 16) -> dict:
     """This process's observable state: the compact dict every process
     role (operator, solverd backend, supervisor CLI) can produce and the
@@ -194,6 +209,7 @@ def local_snapshot(flight_tail: int = 16) -> dict:
         "spans_dropped": metrics.TRACE_SPANS_DROPPED.value(),
         "flight_records": _series(metrics.FLIGHT_RECORDS),
         "flight_tail": flightrecorder.RECORDER.tail(flight_tail),
+        "timeline": _timeline_section(),
     }
 
 

@@ -51,6 +51,15 @@ os.environ.pop("KARPENTER_TPU_TENANT_WEIGHTS_FILE", None)
 os.environ.pop("KARPENTER_TPU_PRIORITY", None)
 os.environ.pop("KARPENTER_TPU_SPOT_RISK", None)
 
+# The timeline recorder runs at its DEFAULT (on, ring-only): an
+# inherited KARPENTER_TPU_TIMELINE=off would make every recorder test
+# pass vacuously, an inherited _DIR (from a shell that just drove the
+# rewind bench) would scribble timeline JSONL into an operator's trail,
+# and a pinned _BUFFER would skew the ring-bound assertions.
+os.environ.pop("KARPENTER_TPU_TIMELINE", None)
+os.environ.pop("KARPENTER_TPU_TIMELINE_DIR", None)
+os.environ.pop("KARPENTER_TPU_TIMELINE_BUFFER", None)
+
 # Dynamic lock-order observer (ISSUE 12, opt-in): under
 # KARPENTER_TPU_LOCK_OBSERVER=1 every threading.Lock/RLock/Condition a
 # karpenter_tpu module constructs from here on is wrapped, real
@@ -146,3 +155,16 @@ def _audit_disarmed():
     yield
     audit.SAMPLER.reset()
     ledger.LEDGER.reset()
+
+
+@pytest.fixture(autouse=True)
+def _timeline_reset():
+    """And for the timeline recorder (ISSUE 17): the ring, its seq
+    counter, and the first-member gang/priority markers are cleared
+    before AND after every test, so per-test event-count assertions
+    never see a neighbor's stream and a replay's re-recorded timeline
+    cannot leak into the next test's tail."""
+    from karpenter_tpu.timeline import recorder
+    recorder.RECORDER.reset()
+    yield
+    recorder.RECORDER.reset()

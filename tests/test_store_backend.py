@@ -54,8 +54,10 @@ class TestRemoteBackendProtocol:
         """A client's own writes must not come back as peer events."""
         be = RemoteBackend(daemon.path)
         be.put("pods", "p1", mkpod("p1"))
-        import time
-        time.sleep(0.1)
+        # event-driven absence check: block on the watch condition for
+        # the echo that must not arrive (False = nothing came), instead
+        # of hoping a fixed sleep outlasts the broadcast path
+        assert be.wait_events(1, timeout=0.25) is False
         assert be.events() == []
         be.close()
 
@@ -92,50 +94,40 @@ class TestClusterOnRemoteBackend:
         assert c2.pods.get("p1") is not c1.pods.get("p1")
 
     def test_two_replicas_converge(self, daemon):
+        # event-driven convergence (the remaining load-timing flake
+        # class, same root cause as the PR 11 wait_events fix): the old
+        # sync+sleep(0.01) poll raced a loaded host's watch thread
+        # against a fixed 5 s wall deadline; wait_synced blocks on the
+        # backend's watch condition instead, so a slow event only
+        # delays, never times out spuriously
         a = Cluster(clock=FakeClock(), backend=RemoteBackend(daemon.path))
         b = Cluster(clock=FakeClock(), backend=RemoteBackend(daemon.path))
         a.pods.create(mkpod("p1"))
-        import time
-        deadline = time.time() + 5
-        while b.pods.get("p1") is None and time.time() < deadline:
-            b.sync_backend()
-            time.sleep(0.01)
-        assert b.pods.get("p1") is not None
+        assert b.wait_synced(lambda: b.pods.get("p1") is not None,
+                             timeout=10.0)
         # modify through b; a observes it
         pod_b = b.pods.get("p1")
         pod_b.phase = "Running"
         b.pods.update(pod_b)
-        deadline = time.time() + 5
-        while time.time() < deadline:
-            a.sync_backend()
-            if a.pods.get("p1").phase == "Running":
-                break
-            time.sleep(0.01)
-        assert a.pods.get("p1").phase == "Running"
+        assert a.wait_synced(
+            lambda: a.pods.get("p1").phase == "Running", timeout=10.0)
 
     def test_finalizer_flow_replicates(self, daemon):
-        from karpenter_tpu.models import wellknown
         a = Cluster(clock=FakeClock(), backend=RemoteBackend(daemon.path))
         b = Cluster(clock=FakeClock(), backend=RemoteBackend(daemon.path))
         pod = mkpod("f1")
         pod.meta.finalizers = ["test/finalizer"]
         a.pods.create(pod)
         a.pods.delete("f1")  # only marks deleting
-        import time
-        deadline = time.time() + 5
-        while time.time() < deadline:
-            b.sync_backend()
+
+        def deleting_visible():
             got = b.pods.get("f1")
-            if got is not None and got.meta.deleting:
-                break
-            time.sleep(0.01)
-        assert b.pods.get("f1").meta.deleting
+            return got is not None and got.meta.deleting
+
+        assert b.wait_synced(deleting_visible, timeout=10.0)
         a.pods.remove_finalizer("f1", "test/finalizer")
-        deadline = time.time() + 5
-        while b.pods.get("f1") is not None and time.time() < deadline:
-            b.sync_backend()
-            time.sleep(0.01)
-        assert b.pods.get("f1") is None
+        assert b.wait_synced(lambda: b.pods.get("f1") is None,
+                             timeout=10.0)
 
 
 class TestEnvironmentOnRemoteBackend:
@@ -171,21 +163,15 @@ class TestEnvironmentOnRemoteBackend:
         a = Cluster(clock=FakeClock(), backend=RemoteBackend(daemon.path))
         b = Cluster(clock=FakeClock(), backend=RemoteBackend(daemon.path))
         a.pods.create(mkpod("z1"))
-        import time
-        deadline = time.time() + 5
-        while b.pods.get("z1") is None and time.time() < deadline:
-            b.sync_backend()
-            time.sleep(0.01)
+        assert b.wait_synced(lambda: b.pods.get("z1") is not None,
+                             timeout=10.0)
         stale = b.pods.get("z1")
         a.pods.delete("z1")
         # b holds a stale reference and hasn't synced the delete yet; its
         # cache still contains z1, so the guard that matters is daemon-side
         b.pods.update(stale)
-        deadline = time.time() + 5
-        while b.pods.get("z1") is not None and time.time() < deadline:
-            b.sync_backend()
-            time.sleep(0.01)
-        assert b.pods.get("z1") is None
+        assert b.wait_synced(lambda: b.pods.get("z1") is None,
+                             timeout=10.0)
         # authoritative store agrees: no zombie
         fresh = RemoteBackend(daemon.path)
         assert "z1" not in fresh.load("pods")

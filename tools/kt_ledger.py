@@ -45,7 +45,10 @@ def _filter(records, pool=None, since=None, limit=None):
 
 def load(path: str):
     """Records from a spilled JSONL file, or the newest ledger-*.jsonl
-    in a directory."""
+    in a directory.  A directory with no spills yet is the first-run
+    case — an EMPTY trail, reported as such ("no decisions yet" is an
+    answer, exit 0 per the module contract); a path that does not
+    exist at all is unusable input (exit 2), not a traceback."""
     from karpenter_tpu.utils import ledger
     if os.path.isdir(path):
         spills = sorted(
@@ -53,10 +56,16 @@ def load(path: str):
              if f.startswith("ledger-") and f.endswith(".jsonl")),
             key=os.path.getmtime)
         if not spills:
-            raise SystemExit(f"no ledger-*.jsonl under {path} — was the "
-                             "operator run with KARPENTER_TPU_LEDGER_DIR?")
+            print(f"kt-ledger: no ledger-*.jsonl under {path} yet — "
+                  "no decisions recorded (was the operator run with "
+                  "KARPENTER_TPU_LEDGER_DIR?)", file=sys.stderr)
+            return []
         path = spills[-1]
-    return ledger.load_records(path)
+    try:
+        return ledger.load_records(path)
+    except OSError as e:
+        print(f"kt-ledger: cannot read {path!r}: {e}", file=sys.stderr)
+        raise SystemExit(2)
 
 
 def fetch(url: str, pool=None, since=None, limit=None):
