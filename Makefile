@@ -5,7 +5,7 @@ PY ?= python
 
 .PHONY: test tier1 multichip lint analyze analyze-fast native asan tsan \
 	repro-crash repro-crash-tsan saturation-smoke explain-smoke \
-	ledger-smoke rewind-smoke bench-regress
+	ledger-smoke rewind-smoke determinism-smoke bench-regress
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
@@ -20,6 +20,8 @@ test:
 # Documented in docs/operations.md §Development gates.
 tier1:
 	-JAX_PLATFORMS=cpu $(PY) hack/warm_tier1_cache.py
+	$(MAKE) analyze
+	$(MAKE) determinism-smoke
 	JAX_PLATFORMS=cpu timeout -k 10 870 $(PY) -m pytest tests/ -q \
 		-m 'not slow' --continue-on-collection-errors \
 		-p no:cacheprovider
@@ -62,6 +64,25 @@ explain-smoke:
 # is `python bench.py --ledger`.
 ledger-smoke:
 	JAX_PLATFORMS=cpu $(PY) hack/ledger_smoke.py
+
+# The determinism double-run (ISSUE 18, the kt-lint families' dynamic
+# twin): the representative solve set (mixed constraints, delta churn,
+# gang+priority, a rewind segment) runs twice in separate processes
+# under PYTHONHASHSEED 0 vs 1 with distinct spill dirs; every flight
+# digest and ledger hex chain must be bit-identical.  Then the drill:
+# --drill arms the determinism.digest fault point (a time.time()
+# perturbation in the canonical record) and the compare MUST fail —
+# a drill that exits zero means the harness has no teeth.
+determinism-smoke:
+	JAX_PLATFORMS=cpu $(PY) hack/determinism_harness.py
+	@echo "determinism-smoke: drill — the perturbed compare must fail"
+	@JAX_PLATFORMS=cpu $(PY) hack/determinism_harness.py --drill \
+		>/dev/null 2>&1; rc=$$?; \
+	if [ $$rc -eq 0 ]; then \
+		echo "determinism-smoke: DRILL PASSED THE COMPARE (harness has no teeth)"; \
+		exit 1; \
+	fi; \
+	echo "determinism-smoke: drill caught the perturbation (rc=$$rc) — OK"
 
 # The cluster-rewind loop end to end (ISSUE 17): a seeded ~30 s mixed
 # scenario (arrivals, gang burst, priority wave, spot reclaim, worker

@@ -1,0 +1,150 @@
+"""Incremental analysis cache for kt-lint (ISSUE 18 satellite).
+
+Warm `make analyze` must not re-parse and re-check ~100 files when
+nothing changed.  Every per-file rule result is content-addressed by
+(file sha, analyzer signature) and the whole-program pass by the sha of
+EVERY analyzed file plus the same signature — so a single edited file
+re-runs its own file rules and the program families, nothing else, and
+a fully-unchanged tree runs no rule at all (the warm run is 100 file
+hashes plus one JSON load).
+
+The analyzer signature hashes the SOURCE of core.py, the constant
+registry, and every active rule module: editing any rule invalidates
+the whole cache, so a hit can never serve findings from an older
+analyzer.  Suppression state is safe to cache (it is a pure function of
+file content, which is in the key); baseline partitioning is NOT cached
+— `core.run` re-applies the live baseline to replayed findings, so
+editing baseline.json never needs a cache flush.
+
+Storage: one JSON blob at `.kt-lint-cache/results.json` under the repo
+root (gitignored), rewritten atomically via rename.  Escape hatches:
+`python -m hack.analyze --no-cache`, or KT_LINT_CACHE=off in the
+environment (the CI-debug knob, docs/operations.md §Development gates).
+Deleting the directory is always safe.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import List, Optional
+
+_ENV_GATE = "KT_LINT_CACHE"
+_FORMAT = 1  # bump on any change to the cached-entry shape
+
+
+def enabled() -> bool:
+    """KT_LINT_CACHE=off|0|false disables caching even when the caller
+    asked for it — the operator override for a suspected stale hit."""
+    return os.environ.get(_ENV_GATE, "").lower() not in ("off", "0", "false")
+
+
+def default_path(root: str) -> str:
+    return os.path.join(root, ".kt-lint-cache", "results.json")
+
+
+def _sha(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()[:24]
+
+
+def file_sha(path: str) -> Optional[str]:
+    try:
+        with open(path, "rb") as f:
+            return _sha(f.read())
+    except OSError:
+        return None
+
+
+def analyzer_signature(rules: list) -> str:
+    """sha over the analyzer's own source: core, the constant registry,
+    and every active rule module, in module-name order.  `rules` is the
+    resolved rule-module list `core.run` is about to execute with, so a
+    `--fast` run (which drops interprocedural families) keys separately
+    from a full run instead of poisoning its cache."""
+    import hack.analyze.constant_registry as reg_mod
+    import hack.analyze.core as core_mod
+    mods = sorted({getattr(m, "__name__", repr(m)): m
+                   for m in rules}.items())
+    h = hashlib.sha256()
+    h.update(str(_FORMAT).encode())
+    for _name, mod in [("core", core_mod), ("registry", reg_mod)] + mods:
+        src = getattr(mod, "__file__", None)
+        if src and os.path.exists(src):
+            with open(src, "rb") as f:
+                h.update(f.read())
+        h.update(b"\x00")
+    return h.hexdigest()[:24]
+
+
+def program_key(file_shas: List[tuple]) -> str:
+    """Key for the whole-program pass: every (rel, sha) pair, in walk
+    order (iter_py_files sorts, so this is deterministic)."""
+    return _sha(json.dumps(file_shas, sort_keys=True).encode())
+
+
+class Cache:
+    """Load-once/save-once view over the results blob.  All reads hit
+    the in-memory doc; `save()` rewrites atomically only when something
+    changed this run."""
+
+    def __init__(self, root: str, rules: list,
+                 path: Optional[str] = None):
+        self.path = path or default_path(root)
+        self.sig = analyzer_signature(rules)
+        self._doc = {"sig": self.sig, "files": {}, "program": None}
+        self._dirty = False
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                doc = json.load(f)
+            if (isinstance(doc, dict) and doc.get("sig") == self.sig
+                    and isinstance(doc.get("files"), dict)):
+                self._doc = doc
+        except (OSError, ValueError):
+            pass
+
+    # -- per-file -----------------------------------------------------------
+    def get_file(self, rel: str, sha: str) -> Optional[dict]:
+        ent = self._doc["files"].get(rel)
+        if ent is not None and ent.get("sha") == sha:
+            return ent
+        return None
+
+    def put_file(self, rel: str, sha: str, ok: bool,
+                 findings: List[dict]) -> None:
+        self._doc["files"][rel] = {"sha": sha, "ok": ok,
+                                   "findings": findings}
+        self._dirty = True
+
+    # -- whole-program ------------------------------------------------------
+    def get_program(self, key: str) -> Optional[List[dict]]:
+        ent = self._doc.get("program")
+        if isinstance(ent, dict) and ent.get("key") == key:
+            return ent.get("findings", [])
+        return None
+
+    def put_program(self, key: str, findings: List[dict]) -> None:
+        self._doc["program"] = {"key": key, "findings": findings}
+        self._dirty = True
+
+    def prune(self, root: str) -> None:
+        """Garbage-collect entries for files deleted from disk.  Keyed
+        on existence, not on this run's analyzed set — a scoped run
+        (`python -m hack.analyze one/file.py`) must not wipe the rest
+        of the tree's warm entries."""
+        stale = [r for r in self._doc["files"]
+                 if not os.path.exists(os.path.join(root, r))]
+        for r in stale:
+            del self._doc["files"][r]
+            self._dirty = True
+
+    def save(self) -> None:
+        if not self._dirty:
+            return
+        d = os.path.dirname(self.path)
+        os.makedirs(d, exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(self._doc, f)
+        os.replace(tmp, self.path)
+        self._dirty = False

@@ -1,0 +1,61 @@
+"""The shared-constant ownership registry (ISSUE 18): one row per
+cross-engine vocabulary or constant, naming the single module allowed to
+DEFINE it.  The `one-owner-constant` rule
+(hack/analyze/rules/one_owner.py) enforces the rows mechanically.
+
+The failure class is drift-by-re-literal: two engines (oracle vs
+kernel, Python vs wire, delta vs full pass) each spell the same
+vocabulary inline, then one edit moves only one copy.  PR 8's
+`exist_group_ok` extraction and PR 11's MESH dual-parser fix each
+caught one instance of this class by hand; this registry makes the
+class un-reintroducible:
+
+  * a module-level binding (assignment or `def`) of a registered name
+    anywhere but its owner is a finding — import it instead;
+  * a literal whose VALUE equals a registered collection's value
+    (tuple/frozenset re-spelled inline) outside the owner is a finding
+    even under a different name — that is the drifting twin;
+  * a registry row whose owner no longer defines the name is stale and
+    fails, exactly like a stale baseline entry.
+
+`kind` values: "value" (a module-level constant whose literal value the
+rule fingerprints and hunts for twins of), "callable" (a function/def —
+one implementation, no value matching), "lint" (the owner lives under
+hack/, outside the default analyzed tree — the rule parses it on
+demand so the contract still has exactly one spelling).
+"""
+
+# constant name -> {"owner": repo-relative module, "kind": ...}
+CONSTANTS = {
+    # the kernel's fit-slack epsilon: every `>= -EPS` / `floor(x + EPS)`
+    # in kernel, delta-seed, and host-recheck code must be THIS value —
+    # a re-literal'd 1e-3 that drifts breaks bit parity silently.  It
+    # lives in explain.py (jax-free) so the encoder's host mirror can
+    # import it; ffd re-exports it for kernel code.
+    "EPS": {
+        "owner": "karpenter_tpu/solver/explain.py", "kind": "value"},
+    # constraint-class order: kernel aux count rows, reason bitsets, and
+    # the explain tree all index by position into this tuple
+    "KERNEL_CONSTRAINTS": {
+        "owner": "karpenter_tpu/solver/explain.py", "kind": "value"},
+    # the delta seam's fallback vocabulary — an unregistered reason is a
+    # programming error (solve.py asserts), a re-spelled set is drift
+    "DELTA_FALLBACK_REASONS": {
+        "owner": "karpenter_tpu/solver/explain.py", "kind": "value"},
+    # tenant-scheduler shed vocabulary (admission/deadline)
+    "SHED_REASONS": {
+        "owner": "karpenter_tpu/solver/explain.py", "kind": "value"},
+    # the oracle's per-nodepool cause vocabulary
+    "POOL_CAUSES": {
+        "owner": "karpenter_tpu/solver/explain.py", "kind": "value"},
+    # the deterministic gang domain trial order: oracle pre-pass and
+    # kernel encode walk domains in THIS order — two implementations
+    # disagreeing on order is a placement divergence, not a style issue
+    "gang_trial_order": {
+        "owner": "karpenter_tpu/scheduling/types.py", "kind": "callable"},
+    # the solverd wire stats-key contract: the lint-side copy in the
+    # wire-protocol rule is the one spelling; a second frozenset of
+    # these keys in service/native code would drift from the cross-check
+    "_STATS_KEYS": {
+        "owner": "hack/analyze/rules/wire_protocol.py", "kind": "lint"},
+}

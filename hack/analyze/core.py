@@ -199,7 +199,8 @@ def iter_py_files(paths: Iterable[str], root: str = REPO) -> List[str]:
 
 def run(paths: Iterable[str], root: str = REPO,
         baseline: Optional[List[dict]] = None,
-        rules: Optional[list] = None) -> Report:
+        rules: Optional[list] = None,
+        use_cache: bool = False) -> Report:
     """Analyze every .py under `paths`; partition findings against the
     suppressions and the baseline. `rules` overrides the registry (tests
     exercise one family at a time).
@@ -210,7 +211,15 @@ def run(paths: Iterable[str], root: str = REPO,
     (lock-order, env-knob ownership, wire-protocol conformance) need the
     complete picture before they can say anything.  Suppressions and the
     baseline apply identically to both; a program finding in a file we
-    did not parse (docs, native/*.cc) simply has no suppression site."""
+    did not parse (docs, native/*.cc) simply has no suppression site.
+
+    ``use_cache=True`` (the CLI default; ISSUE 18) consults the
+    content-addressed result cache (hack/analyze/cache.py): unchanged
+    files replay their cached findings (with the suppression verdict
+    resolved at cache time — it is a pure function of file content),
+    and a fully-unchanged tree skips the program pass too.  Baseline
+    partitioning always runs live against the replayed findings, so the
+    cache never has to know about baseline.json."""
     from hack.analyze.rules import ALL_RULES, PROGRAM_RULES
     active = list(ALL_RULES) + list(PROGRAM_RULES) if rules is None \
         else list(rules)
@@ -222,8 +231,14 @@ def run(paths: Iterable[str], root: str = REPO,
     contexts: List[FileContext] = []
     by_rel: Dict[str, FileContext] = {}
 
-    def _partition(f: Finding, ctx: Optional[FileContext]) -> None:
-        if ctx is not None and ctx.is_suppressed(f.rule, f.line):
+    cache = None
+    if use_cache:
+        from hack.analyze import cache as cache_mod
+        if cache_mod.enabled():
+            cache = cache_mod.Cache(root, file_rules + program_rules)
+
+    def _partition(f: Finding, suppressed: bool) -> None:
+        if suppressed:
             report.suppressed.append(f)
             return
         hit = [i for i, e in enumerate(baseline) if baseline_matches(e, f)]
@@ -233,24 +248,84 @@ def run(paths: Iterable[str], root: str = REPO,
         else:
             report.findings.append(f)
 
-    for path in iter_py_files(paths, root=root):
+    files = iter_py_files(paths, root=root)
+    shas: Dict[str, Optional[str]] = {}
+    rels: Dict[str, str] = {}
+    for path in files:
+        rels[path] = os.path.relpath(path, root).replace(os.sep, "/")
+        if cache is not None:
+            from hack.analyze import cache as cache_mod
+            shas[path] = cache_mod.file_sha(path)
+
+    prog_cached: Optional[List[dict]] = None
+    prog_key = None
+    if cache is not None and not any(s is None for s in shas.values()):
+        from hack.analyze import cache as cache_mod
+        prog_key = cache_mod.program_key(
+            [(rels[p], shas[p]) for p in files])
+        prog_cached = cache.get_program(prog_key)
+    need_contexts = bool(program_rules) and prog_cached is None
+
+    for path in files:
+        rel = rels[path]
+        ent = None if cache is None or shas.get(path) is None \
+            else cache.get_file(rel, shas[path])
+        if ent is not None and not need_contexts:
+            # full warm hit: replay without parsing
+            if ent["ok"]:
+                report.files += 1
+            for d in ent["findings"]:
+                f = Finding(**d["f"])
+                if f.rule == "parse-error":
+                    report.findings.append(f)
+                else:
+                    _partition(f, d["sup"])
+            continue
         try:
             ctx = FileContext(path, root=root)
         except (SyntaxError, UnicodeDecodeError) as e:
-            report.findings.append(Finding(
-                rule="parse-error", path=os.path.relpath(path, root),
+            pe = Finding(
+                rule="parse-error", path=rel,
                 line=getattr(e, "lineno", 1) or 1, symbol="<module>",
-                message=f"file does not parse: {e}", snippet=""))
+                message=f"file does not parse: {e}", snippet="")
+            report.findings.append(pe)
+            if cache is not None and shas.get(path) is not None:
+                cache.put_file(rel, shas[path], ok=False,
+                               findings=[{"f": pe.to_dict(), "sup": False}])
             continue
         report.files += 1
         contexts.append(ctx)
         by_rel[ctx.rel] = ctx
+        if ent is not None:
+            # file rules cached; the parse was only for the program pass
+            for d in ent["findings"]:
+                _partition(Finding(**d["f"]), d["sup"])
+            continue
+        entries: List[dict] = []
         for rule in file_rules:
             for f in rule.check(ctx):
-                _partition(f, ctx)
-    for rule in program_rules:
-        for f in rule.check_program(contexts, root=root):
-            _partition(f, by_rel.get(f.path))
+                sup = ctx.is_suppressed(f.rule, f.line)
+                entries.append({"f": f.to_dict(), "sup": sup})
+                _partition(f, sup)
+        if cache is not None and shas.get(path) is not None:
+            cache.put_file(rel, shas[path], ok=True, findings=entries)
+
+    if prog_cached is not None:
+        for d in prog_cached:
+            _partition(Finding(**d["f"]), d["sup"])
+    else:
+        prog_entries: List[dict] = []
+        for rule in program_rules:
+            for f in rule.check_program(contexts, root=root):
+                ctx = by_rel.get(f.path)
+                sup = ctx is not None and ctx.is_suppressed(f.rule, f.line)
+                prog_entries.append({"f": f.to_dict(), "sup": sup})
+                _partition(f, sup)
+        if cache is not None and prog_key is not None:
+            cache.put_program(prog_key, prog_entries)
+    if cache is not None:
+        cache.prune(root)
+        cache.save()
     # staleness is judged only against rule families that RAN: a
     # baselined lock-order entry must not read as stale under --fast
     # (which deliberately skips the interprocedural family)

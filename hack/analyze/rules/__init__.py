@@ -5,19 +5,23 @@ Iterator[Finding]` (plus INTERPROCEDURAL = True when `--fast` should
 skip them)."""
 
 from hack.analyze.rules import (
+    counted_fallback,
+    dtype_flow,
     env_knobs,
     exception_hygiene,
     jit_purity,
     lock_discipline,
     lock_order,
+    nondeterminism,
     observability,
+    one_owner,
     socket_discipline,
     wire_protocol,
 )
 
 ALL_RULES = (jit_purity, lock_discipline, exception_hygiene, observability,
-             socket_discipline)
+             socket_discipline, dtype_flow, nondeterminism, counted_fallback)
 
-PROGRAM_RULES = (lock_order, env_knobs, wire_protocol)
+PROGRAM_RULES = (lock_order, env_knobs, wire_protocol, one_owner)
 
 RULE_NAMES = tuple(r.RULE_NAME for r in ALL_RULES + PROGRAM_RULES)

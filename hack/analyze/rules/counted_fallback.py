@@ -1,0 +1,148 @@
+"""counted-fallback: every degrade path increments something.
+
+Per-file rule (ISSUE 18).  The repo's resilience idiom is "degrade,
+never fail": spill-to-disk falls back to ring-only, the delta seam
+falls back to a full solve, the scheduler sheds instead of blocking,
+host repair nets strand a group instead of emitting an invalid
+placement.  The idiom's contract — explicit since PR 10's "priority-
+aware sheds (never silent)" — is that every such branch is COUNTED: a
+registered metric or a registry reason moves, so a fleet quietly
+running degraded is visible on a dashboard instead of discovered in an
+incident.  This rule enforces the contract on the two shapes the tree
+actually uses:
+
+  * **degrade-flag assignments** — `self._spill_failed = True` and
+    friends (`*_failed`/`*_degraded`/`*_disabled`/`*_dead` set truthy):
+    the enclosing handler/branch must also count (`.inc(...)`, a
+    shed-dict bump, or a call into a counting helper).
+  * **degrade-named helpers** — a function whose name says it
+    degrades (`*fallback*`/`*shed*`/`*drop*`/`*repair*`/`*degrade*`)
+    must count somewhere in its body; callers then inherit countedness
+    by delegation (calling `_delta_fallback(...)` IS the count).
+
+"Counted" means any of: an `.inc(`/`.observe(` metrics call, the
+shed-dict idiom (`d[reason] = d.get(reason, 0) + 1` or `+= 1` on a
+count-named target), or a call to another degrade-named helper (which
+this rule holds to the same standard wherever it's defined in scope).
+
+Scope: solver/, service/, timeline/, scheduling/, plus the two spill
+modules (utils/flightrecorder.py, utils/ledger.py).  Operator/store
+code keeps its own idioms (exception-hygiene covers controllers).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Optional
+
+from hack.analyze.core import FileContext, Finding
+
+RULE_NAME = "counted-fallback"
+
+_SCOPE_PREFIXES = (
+    "karpenter_tpu/solver/",
+    "karpenter_tpu/service/",
+    "karpenter_tpu/timeline/",
+    "karpenter_tpu/scheduling/",
+)
+_SCOPE_FILES = (
+    "karpenter_tpu/utils/flightrecorder.py",
+    "karpenter_tpu/utils/ledger.py",
+)
+
+_FLAG_RE = re.compile(r"(_failed|_degraded|_disabled|_dead)$")
+_HELPER_RE = re.compile(r"(^|_)(fallback|shed|drop|degrade|repair)")
+_COUNT_NAME_RE = re.compile(r"(count|shed|drop|skip|degrade|repair)",
+                            re.IGNORECASE)
+
+
+def _in_scope(ctx: FileContext) -> bool:
+    return ctx.rel in _SCOPE_FILES or \
+        any(ctx.rel.startswith(p) for p in _SCOPE_PREFIXES)
+
+
+def _attr_or_name(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _is_counted(subtree: ast.AST) -> bool:
+    """Does this subtree move a counter?  Accepts the tree's idioms:
+    metrics `.inc(` / `.observe(`, the shed-dict bump, `+= 1` on a
+    count-named target, or delegation to a degrade-named helper."""
+    for node in ast.walk(subtree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute):
+            if node.func.attr in ("inc", "observe"):
+                return True
+        if isinstance(node, ast.Call):
+            callee = _attr_or_name(node.func)
+            if callee and _HELPER_RE.search(callee):
+                return True
+        if isinstance(node, ast.AugAssign) and \
+                isinstance(node.op, ast.Add):
+            tname = _attr_or_name(node.target)
+            if tname is None and isinstance(node.target, ast.Subscript):
+                tname = _attr_or_name(node.target.value)
+            if tname and _COUNT_NAME_RE.search(tname):
+                return True
+        # d[reason] = d.get(reason, 0) + 1
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Subscript) and \
+                isinstance(node.value, ast.BinOp) and \
+                isinstance(node.value.op, ast.Add):
+            for side in (node.value.left, node.value.right):
+                if isinstance(side, ast.Call) and \
+                        isinstance(side.func, ast.Attribute) and \
+                        side.func.attr == "get":
+                    return True
+    return False
+
+
+def _enclosing_branch(ctx: FileContext, node: ast.AST) -> ast.AST:
+    """The degrade branch a flag assignment lives in: nearest enclosing
+    except-handler or if/else arm; falls back to the enclosing function
+    (a flag set unconditionally still deserves a count somewhere in
+    the function)."""
+    cur = ctx.parent(node)
+    while cur is not None:
+        if isinstance(cur, (ast.ExceptHandler, ast.If)):
+            return cur
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = ctx.parent(cur)
+    return ctx.tree
+
+
+def check(ctx: FileContext) -> Iterator[Finding]:
+    if not _in_scope(ctx):
+        return
+    for node in ast.walk(ctx.tree):
+        # -- degrade-flag assignments ---------------------------------
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Constant) and \
+                node.value.value in (True, 1):
+            for t in node.targets:
+                tname = _attr_or_name(t)
+                if tname and _FLAG_RE.search(tname) and \
+                        not _is_counted(_enclosing_branch(ctx, node)):
+                    yield ctx.finding(
+                        RULE_NAME, node,
+                        f"`{tname} = True` degrades without counting — "
+                        "a fleet quietly running degraded is invisible; "
+                        "increment a registered metric (or registry "
+                        "reason) on this branch")
+        # -- degrade-named helpers ------------------------------------
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                _HELPER_RE.search(node.name) and \
+                not _is_counted(node):
+            yield ctx.finding(
+                RULE_NAME, node,
+                f"degrade helper `{node.name}` counts nothing — every "
+                "fallback/shed/repair path moves a metric or registry "
+                "reason (PR 10's never-silent contract); add an "
+                ".inc(...) where the degrade actually happens")

@@ -1,0 +1,376 @@
+"""dtype-flow: float-width provenance over the kernel's numeric core.
+
+Per-file rule (ISSUE 18), scoped to the modules whose arithmetic feeds
+the solve fingerprint: `solver/{ffd,encode,delta,solve}.py` and
+`scheduling/{oracle,risk}.py`.  The repo's load-bearing invariant is
+bit-exactness (IEEE-hex price parity, op-for-order delta replay, rewind
+digests), and the quietest way to break it is a dtype leak: host numpy
+defaults to float64, JAX kernels run float32, and a 64-bit value that
+sneaks into an encode buffer changes low bits months after the commit
+that introduced it.  A small intraprocedural abstract interpretation
+tracks per-function provenance (python-float names, float64-producing
+reductions, int32-cast names) so findings fire on flows, not just
+spellings:
+
+  * **float64 introductions** — `np.float64(...)` / `dtype=np.float64`
+    / `dtype="float64"` anywhere in scope; host-numpy array
+    constructors (`np.array`, `np.zeros`, `np.full`, ...) with NO dtype
+    (kwarg or the function's positional dtype slot) — host numpy
+    defaults float-y input to float64; and names whose provenance is a
+    dtype-less host reduction (`np.mean`/`np.sum`/... return float64)
+    used in a binop or handed to a `jnp.*` call — the implicit-
+    promotion site.
+  * **epsilon twins** — the kernel's fit slack is `ffd.EPS` and must be
+    spelled that way: a float literal equal to EPS's value outside its
+    owner is a drift-armed twin (one edit moves one copy), and any tiny
+    ad-hoc tolerance (0 < |v| <= 1e-6) in additive or comparison
+    position is a second slack vocabulary the oracle/kernel parity
+    argument doesn't know about.  Name aliases resolve through the
+    provenance environment (`eps = 1e-3; x + eps` still fires).
+  * **non-associative mesh reductions** — float `psum`/`pmean` across
+    the mesh axis depends on reduction order, so mesh width changes
+    low bits.  `pmax`/`pmin` are associative-safe and the blessed
+    helpers (`_axmax`, `_any_ax`) wrap them; `psum` is allowed only
+    when the reduced operand provably carries int32 provenance
+    (`.astype(jnp.int32)` in its defining assignment — integer psum is
+    exact at any width).
+
+Suppression policy: a deliberate host-float64 surface (the oracle's
+exact host arithmetic is one) takes an inline
+`# kt-lint: disable=dtype-flow` with a justifying comment; the
+one-owner-constant rule separately pins EPS's single definition site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Set, Tuple
+
+from hack.analyze.core import FileContext, Finding
+
+RULE_NAME = "dtype-flow"
+
+_SCOPE = (
+    "karpenter_tpu/solver/ffd.py",
+    "karpenter_tpu/solver/encode.py",
+    "karpenter_tpu/solver/delta.py",
+    "karpenter_tpu/solver/solve.py",
+    "karpenter_tpu/scheduling/oracle.py",
+    "karpenter_tpu/scheduling/risk.py",
+)
+
+# the owner's value (karpenter_tpu/solver/explain.py EPS);
+# tests/test_lint.py cross-checks this constant against the owner's AST
+# so the twin hunt can never itself drift from the one true slack
+EPS_VALUE = 1e-3
+_TINY = 1e-6           # ad-hoc tolerance ceiling for the epsilon check
+
+_NUMPY_ALIASES = ("np", "numpy", "onp")
+# host-numpy constructors and the index of their positional dtype slot.
+# zeros/ones/empty/full CREATE float64 with no dtype; array/asarray/
+# arange/linspace only introduce float64 when fed python-float content
+# (a conversion of an existing array preserves its dtype), so those
+# flag only on literal/pyfloat input — see _creates_f64.
+_CONSTRUCTOR_DTYPE_SLOT = {
+    "array": 1, "asarray": 1, "zeros": 1, "ones": 1, "empty": 1,
+    "full": 2, "arange": None, "linspace": None,
+}
+_ALWAYS_F64_CONSTRUCTORS = ("zeros", "ones", "empty", "full")
+# dtype-less host reductions return float64 regardless of input width
+_F64_REDUCTIONS = ("mean", "sum", "average", "std", "var", "dot", "prod")
+_BLESSED_MESH_HELPERS = ("_axmax", "_any_ax")
+_MESH_REDUCES = ("psum", "pmean", "psum_scatter")
+
+
+def _root_name(expr: ast.AST) -> Optional[str]:
+    while isinstance(expr, ast.Attribute):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+def _is_numpy_attr(expr: ast.AST, attr: str) -> bool:
+    return (isinstance(expr, ast.Attribute) and expr.attr == attr
+            and _root_name(expr.value) in _NUMPY_ALIASES)
+
+
+def _has_dtype(call: ast.Call) -> bool:
+    """A dtype was given: `dtype=` kwarg, or the constructor's
+    positional slot (the tree passes both spellings —
+    `np.zeros((N,), np.int32)` is parameterized)."""
+    if any(kw.arg == "dtype" for kw in call.keywords):
+        return True
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    slot = _CONSTRUCTOR_DTYPE_SLOT.get(call.func.attr)
+    return slot is not None and len(call.args) > slot
+
+
+def _names_int32_cast(node: ast.AST) -> bool:
+    """The expression ends in (or contains) an int cast —
+    `.astype(jnp.int32)`, `.astype(int)`, `jnp.int32(...)`."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and \
+                isinstance(sub.func, ast.Attribute) and \
+                sub.func.attr == "astype" and sub.args:
+            a = sub.args[0]
+            if (isinstance(a, ast.Attribute)
+                    and a.attr in ("int32", "int64", "uint32"))\
+                    or (isinstance(a, ast.Name)
+                        and a.id in ("int", "bool")):
+                return True
+    return False
+
+
+class _Prov:
+    """Per-function provenance environment: name -> tag.
+
+    Tags: ("const", value) for names bound to a float literal,
+    "pyfloat" for float()/float-arith results, "npf64" for dtype-less
+    host reductions, "int32" for explicit int casts.  Single forward
+    pass over the statements in source order — intraprocedural, no
+    branches joined (a name keeps its LAST binding's tag), which is
+    exactly the precision the finding messages promise.  `ever_int32`
+    additionally remembers names that carried int provenance at ANY
+    binding: the kernel's psum idiom reassigns the reduced name
+    (`local = psum(local)`), which would otherwise clobber the tag
+    before the reduction check reads it."""
+
+    def __init__(self, func: ast.AST):
+        self.tags: Dict[str, Tuple[str, object]] = {}
+        self.ever_int32: Set[str] = set()
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Assign) or \
+                    len(node.targets) != 1 or \
+                    not isinstance(node.targets[0], ast.Name):
+                continue
+            name, val = node.targets[0].id, node.value
+            tag = self._tag_of(val)
+            if tag is not None:
+                self.tags[name] = tag
+                if tag[0] == "int32":
+                    self.ever_int32.add(name)
+            else:
+                self.tags.pop(name, None)
+
+    def _tag_of(self, val: ast.AST) -> Optional[Tuple[str, object]]:
+        if isinstance(val, ast.Constant) and isinstance(val.value, float):
+            return ("const", val.value)
+        if isinstance(val, ast.Call):
+            if isinstance(val.func, ast.Name) and val.func.id == "float":
+                return ("pyfloat", None)
+            if isinstance(val.func, ast.Attribute) and \
+                    _is_numpy_attr(val.func, val.func.attr) and \
+                    val.func.attr in _F64_REDUCTIONS and \
+                    not any(kw.arg == "dtype" for kw in val.keywords):
+                return ("npf64", None)
+        if _names_int32_cast(val):
+            return ("int32", None)
+        return None
+
+    def const_value(self, expr: ast.AST) -> Optional[float]:
+        if isinstance(expr, ast.UnaryOp) and \
+                isinstance(expr.op, ast.USub):
+            v = self.const_value(expr.operand)
+            return None if v is None else -v
+        if isinstance(expr, ast.Constant) and \
+                isinstance(expr.value, float):
+            return expr.value
+        if isinstance(expr, ast.Name):
+            tag = self.tags.get(expr.id)
+            if tag and tag[0] == "const":
+                return tag[1]  # type: ignore[return-value]
+        return None
+
+    def is_f64(self, expr: ast.AST) -> bool:
+        return isinstance(expr, ast.Name) and \
+            self.tags.get(expr.id, ("", None))[0] == "npf64"
+
+    def is_int32(self, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in self.ever_int32 or \
+                self.tags.get(expr.id, ("", None))[0] == "int32"
+        return _names_int32_cast(expr)
+
+    def is_floaty(self, expr: ast.AST) -> bool:
+        """Python-float content a host constructor would widen to
+        float64: a float literal, a float-tagged name, a division, or
+        a list/tuple/comprehension containing any of those."""
+        if isinstance(expr, ast.Constant):
+            return isinstance(expr.value, float)
+        if isinstance(expr, ast.Name):
+            return self.tags.get(expr.id, ("", None))[0] in \
+                ("pyfloat", "const")
+        if isinstance(expr, ast.BinOp):
+            return isinstance(expr.op, ast.Div) or \
+                self.is_floaty(expr.left) or self.is_floaty(expr.right)
+        if isinstance(expr, ast.UnaryOp):
+            return self.is_floaty(expr.operand)
+        if isinstance(expr, (ast.List, ast.Tuple)):
+            return any(self.is_floaty(e) for e in expr.elts)
+        if isinstance(expr, (ast.ListComp, ast.GeneratorExp)):
+            return self.is_floaty(expr.elt)
+        if isinstance(expr, ast.Call) and \
+                isinstance(expr.func, ast.Name) and \
+                expr.func.id == "float":
+            return True
+        return False
+
+
+def _enclosing_func(ctx: FileContext, node: ast.AST) -> Optional[ast.AST]:
+    cur: Optional[ast.AST] = node
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = ctx.parent(cur)
+    return None
+
+
+def _in_blessed_helper(ctx: FileContext, node: ast.AST) -> bool:
+    cur: Optional[ast.AST] = node
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                cur.name in _BLESSED_MESH_HELPERS:
+            return True
+        cur = ctx.parent(cur)
+    return False
+
+
+def check(ctx: FileContext) -> Iterator[Finding]:
+    if ctx.rel not in _SCOPE:
+        return
+    envs: Dict[ast.AST, _Prov] = {}
+
+    def env_for(node: ast.AST) -> _Prov:
+        func = _enclosing_func(ctx, node)
+        key = func if func is not None else ctx.tree
+        if key not in envs:
+            envs[key] = _Prov(key)
+        return envs[key]
+
+    for node in ast.walk(ctx.tree):
+        # -- float64 introductions ------------------------------------
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "float64" and \
+                _root_name(node.func.value) in _NUMPY_ALIASES:
+            yield ctx.finding(
+                RULE_NAME, node,
+                "np.float64 scalar in kernel-adjacent code — the solve "
+                "runs float32; a 64-bit scalar here promotes whatever "
+                "it touches and shifts low bits of the price parity")
+        if isinstance(node, ast.Attribute) and \
+                node.attr == "float64" and \
+                _root_name(node.value) in _NUMPY_ALIASES:
+            par = ctx.parent(node)
+            if not (isinstance(par, ast.Call) and par.func is node):
+                yield ctx.finding(
+                    RULE_NAME, node,
+                    "np.float64 dtype in kernel-adjacent code — the "
+                    "solve contract is float32; widen deliberately via "
+                    "an explicit named constant if a host surface "
+                    "really needs it")
+        if isinstance(node, ast.Constant) and node.value == "float64":
+            yield ctx.finding(
+                RULE_NAME, node,
+                "dtype=\"float64\" in kernel-adjacent code — the "
+                "solve contract is float32; widen deliberately via "
+                "an explicit named constant if a host surface "
+                "really needs it")
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _CONSTRUCTOR_DTYPE_SLOT and \
+                _root_name(node.func.value) in _NUMPY_ALIASES and \
+                not _has_dtype(node):
+            # array/asarray/arange of an existing array preserves its
+            # dtype — only literal / python-float content widens
+            creates_f64 = node.func.attr in _ALWAYS_F64_CONSTRUCTORS \
+                or any(env_for(node).is_floaty(a) for a in node.args)
+            if creates_f64:
+                yield ctx.finding(
+                    RULE_NAME, node,
+                    f"dtype-less np.{node.func.attr} with float "
+                    "content — host numpy widens it to float64, which "
+                    "crosses the device boundary as a silent "
+                    "down-cast (or worse, a host-side 64-bit compute "
+                    "path); pass an explicit dtype")
+        # npf64-provenance flow: a float64-carrying name in a binop or
+        # handed to jnp — the implicit-promotion site the constructor
+        # check can't see (the reduction LOOKS parameter-free)
+        if isinstance(node, ast.BinOp):
+            env = env_for(node)
+            for side in (node.left, node.right):
+                if env.is_f64(side):
+                    yield ctx.finding(
+                        RULE_NAME, node,
+                        f"`{side.id}` carries float64 provenance "   # type: ignore[union-attr]
+                        "(dtype-less host reduction) into a binop — "
+                        "the other operand promotes; cast at the "
+                        "reduction or pass dtype=np.float32")
+                    break
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                _root_name(node.func.value) in ("jnp", "jax"):
+            env = env_for(node)
+            for arg in node.args:
+                if env.is_f64(arg):
+                    yield ctx.finding(
+                        RULE_NAME, node,
+                        f"`{arg.id}` carries float64 provenance into "  # type: ignore[union-attr]
+                        "a jax call — under x64-disabled JAX this "
+                        "truncates silently, and the host/device "
+                        "values diverge in the low bits")
+        # -- epsilon twins --------------------------------------------
+        if isinstance(node, ast.Compare):
+            env = env_for(node)
+            for expr in [node.left] + list(node.comparators):
+                v = env.const_value(expr)
+                if v is None or v == 0.0:
+                    continue
+                if abs(v) == EPS_VALUE:
+                    yield ctx.finding(
+                        RULE_NAME, expr,
+                        "re-literal'd fit epsilon — this is ffd.EPS's "
+                        "value spelled inline; import ffd.EPS so one "
+                        "edit can never leave a drifting twin")
+                elif abs(v) <= _TINY:
+                    yield ctx.finding(
+                        RULE_NAME, expr,
+                        f"ad-hoc tolerance {v!r} in a comparison — a "
+                        "second slack vocabulary the oracle/kernel "
+                        "parity argument doesn't cover; use ffd.EPS "
+                        "or a named, justified constant")
+        if isinstance(node, ast.BinOp) and \
+                isinstance(node.op, (ast.Add, ast.Sub)):
+            env = env_for(node)
+            for side in (node.left, node.right):
+                v = env.const_value(side)
+                if v is None or v == 0.0:
+                    continue
+                if abs(v) == EPS_VALUE:
+                    yield ctx.finding(
+                        RULE_NAME, side,
+                        "re-literal'd fit epsilon in additive slack — "
+                        "this is ffd.EPS's value spelled inline; "
+                        "import ffd.EPS")
+                elif abs(v) <= _TINY:
+                    yield ctx.finding(
+                        RULE_NAME, side,
+                        f"ad-hoc additive tolerance {v!r} — a second "
+                        "slack vocabulary; use ffd.EPS or a named, "
+                        "justified constant")
+        # -- non-associative mesh reductions --------------------------
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MESH_REDUCES and \
+                not _in_blessed_helper(ctx, node):
+            env = env_for(node)
+            operand = node.args[0] if node.args else None
+            if operand is not None and env.is_int32(operand):
+                continue  # integer psum is exact at any mesh width
+            yield ctx.finding(
+                RULE_NAME, node,
+                f"float {node.func.attr} across the mesh axis — "
+                "reduction order depends on mesh width, so low bits "
+                "move when the mesh does; reduce with the blessed "
+                "helpers (_axmax/pmax) or prove int32 provenance with "
+                "an .astype(jnp.int32) on the reduced operand")

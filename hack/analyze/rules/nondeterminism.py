@@ -1,0 +1,271 @@
+"""nondeterminism-source: ambient-order and ambient-entropy reads in
+replay-scoped code.
+
+Per-file rule (ISSUE 18).  Rewind/replay (PR 17) and the delta seam
+promise that a recorded day re-runs bit-identically: flight digests,
+ledger hex chains, and `state_digest` all assume every solve-side
+computation is a pure function of recorded inputs.  Ambient reads break
+that promise silently — the failure shows up as a digest mismatch weeks
+later with no pointer back to the line that drifted.  This rule flags
+the ambient sources statically, inside an explicit **replay-scope
+map**; operator/HTTP/store code is wall-clock-driven by nature and
+stays exempt:
+
+  in scope   solver/, scheduling/, timeline/, utils/flightrecorder.py,
+             utils/ledger.py — anything whose outputs feed solve
+             fingerprints, delta replay, timeline events, or ledger
+             rows
+  exempt     controllers/, service/, store/, operator.py, utils/ other
+             than the two spill modules — reconcile pacing, HTTP
+             deadlines, and backoff jitter are supposed to read clocks
+
+Flagged sources:
+
+  * **wall clock** — `time.time`/`time.time_ns`/`datetime.now`/
+    `utcnow`: a wall-clock VALUE that reaches an output diverges per
+    run.  (`time.perf_counter`/`monotonic` stay legal: interval timing
+    feeds phase_ms/metrics, which every digest canonicalization
+    excludes.)  Capture-side provenance stamps (the recorder's `ts`)
+    are the sanctioned exception — suppressed inline with
+    justification, because replay rebases them.
+  * **ambient entropy** — module-level `random.*` calls and
+    `uuid.uuid1/uuid4`: replay cannot reproduce them.  Seeded
+    `random.Random(seed)` instances are the blessed idiom (the
+    timeline generators already use it) and are not flagged.
+  * **id()-keyed containers** — `d[id(x)]`, `key=id`: CPython address
+    order varies per run, so anything iterating or sorting such a
+    container inherits address order.
+  * **unsorted directory walks** — `os.listdir`/`os.scandir`/
+    `glob.glob`/`Path.iterdir`/`.glob`/`.rglob` not wrapped directly
+    in `sorted(...)`: filesystem order is whatever the kernel feels
+    like; spill-file stitching made this a load-bearing class
+    (multi-file restart replay reads `flight-<pid>.jsonl` siblings).
+  * **set iteration** — `for x in s` where `s` has set provenance (set
+    literal/call/comprehension or a union/intersection of such), not
+    wrapped in `sorted(...)`: under PYTHONHASHSEED, str-keyed set
+    order varies per process, which is exactly what the determinism
+    harness's double-run compare exists to catch.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Set
+
+from hack.analyze.core import FileContext, Finding
+
+RULE_NAME = "nondeterminism-source"
+
+_SCOPE_PREFIXES = (
+    "karpenter_tpu/solver/",
+    "karpenter_tpu/scheduling/",
+    "karpenter_tpu/timeline/",
+)
+_SCOPE_FILES = (
+    "karpenter_tpu/utils/flightrecorder.py",
+    "karpenter_tpu/utils/ledger.py",
+)
+
+_WALL_CLOCK = {("time", "time"), ("time", "time_ns")}
+_DATETIME_NOW = ("now", "utcnow", "today")
+_DIR_WALKS = {("os", "listdir"), ("os", "scandir"),
+              ("glob", "glob"), ("glob", "iglob")}
+_PATH_WALK_METHODS = ("iterdir", "glob", "rglob")
+
+
+def _in_scope(ctx: FileContext) -> bool:
+    return ctx.rel in _SCOPE_FILES or \
+        any(ctx.rel.startswith(p) for p in _SCOPE_PREFIXES)
+
+
+def _mod_attr(expr: ast.AST) -> Optional[tuple]:
+    """(module_name, attr) for `mod.attr` expressions."""
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+        return (expr.value.id, expr.attr)
+    return None
+
+
+def _wrapped_in_sorted(ctx: FileContext, node: ast.AST) -> bool:
+    par = ctx.parent(node)
+    if isinstance(par, ast.Call) and \
+            isinstance(par.func, ast.Name) and par.func.id == "sorted" and \
+            node in par.args:
+        return True
+    # the filter-then-sort idiom: the walk feeds a comprehension whose
+    # result is itself the direct argument of sorted(...) — e.g.
+    # sorted((f for f in os.listdir(d) if ...), key=...); the sort
+    # still establishes total deterministic order over every element
+    if isinstance(par, ast.comprehension) and node is par.iter:
+        comp = ctx.parent(par)
+        if isinstance(comp, (ast.GeneratorExp, ast.ListComp)) and \
+                getattr(comp, "generators", [None])[0] is par:
+            gpar = ctx.parent(comp)
+            return isinstance(gpar, ast.Call) and \
+                isinstance(gpar.func, ast.Name) and \
+                gpar.func.id == "sorted" and comp in gpar.args
+    return False
+
+
+def _set_names(func: ast.AST) -> Set[str]:
+    """Names with set provenance inside one function: bound to a set
+    literal/call/comprehension, or a binop over such names (union /
+    intersection / difference keeps set order ambient)."""
+    out: Set[str] = set()
+    for node in ast.walk(func):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1 \
+                or not isinstance(node.targets[0], ast.Name):
+            continue
+        name = node.targets[0].id
+        if _is_set_expr(node.value, out):
+            out.add(name)
+        else:
+            out.discard(name)
+    return out
+
+
+def _is_set_expr(expr: ast.AST, set_names: Set[str]) -> bool:
+    if isinstance(expr, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name) \
+            and expr.func.id in ("set", "frozenset"):
+        return True
+    if isinstance(expr, ast.Name):
+        return expr.id in set_names
+    if isinstance(expr, ast.BinOp) and \
+            isinstance(expr.op, (ast.BitOr, ast.BitAnd, ast.Sub)):
+        return _is_set_expr(expr.left, set_names) or \
+            _is_set_expr(expr.right, set_names)
+    if isinstance(expr, ast.Call) and \
+            isinstance(expr.func, ast.Attribute) and \
+            expr.func.attr in ("union", "intersection", "difference",
+                               "symmetric_difference"):
+        return _is_set_expr(expr.func.value, set_names)
+    return False
+
+
+def _enclosing_func(ctx: FileContext, node: ast.AST) -> ast.AST:
+    cur = node
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = ctx.parent(cur)
+    return ctx.tree
+
+
+def check(ctx: FileContext) -> Iterator[Finding]:
+    if not _in_scope(ctx):
+        return
+    set_envs: Dict[ast.AST, Set[str]] = {}
+
+    def sets_for(node: ast.AST) -> Set[str]:
+        func = _enclosing_func(ctx, node)
+        if func not in set_envs:
+            set_envs[func] = _set_names(func)
+        return set_envs[func]
+
+    for node in ast.walk(ctx.tree):
+        # -- wall clock ------------------------------------------------
+        if isinstance(node, ast.Call):
+            ma = _mod_attr(node.func)
+            if ma in _WALL_CLOCK:
+                yield ctx.finding(
+                    RULE_NAME, node,
+                    "wall-clock read in replay scope — a time.time() "
+                    "value that reaches a solve fingerprint, timeline "
+                    "event, or ledger row diverges every run; thread a "
+                    "recorded/injected clock through instead (or "
+                    "suppress a capture-side provenance stamp with "
+                    "justification)")
+            elif ma is not None and ma[0] in ("datetime", "dt") and \
+                    ma[1] in _DATETIME_NOW:
+                yield ctx.finding(
+                    RULE_NAME, node,
+                    f"datetime.{ma[1]}() in replay scope — same class "
+                    "as time.time(); replay cannot reproduce it")
+            # -- ambient entropy ---------------------------------------
+            elif ma is not None and ma[0] == "random" and \
+                    ma[1] not in ("Random",):
+                yield ctx.finding(
+                    RULE_NAME, node,
+                    f"module-level random.{ma[1]}() — ambient entropy "
+                    "replay cannot reproduce; use a seeded "
+                    "random.Random(seed) instance (the generators' "
+                    "idiom)")
+            elif ma in {("uuid", "uuid1"), ("uuid", "uuid4")}:
+                yield ctx.finding(
+                    RULE_NAME, node,
+                    f"uuid.{ma[1]}() in replay scope — fresh identity "
+                    "per run; derive names from recorded sequence "
+                    "numbers instead")
+            # -- unsorted directory walks ------------------------------
+            elif (ma in _DIR_WALKS or
+                  (isinstance(node.func, ast.Attribute) and
+                   node.func.attr in _PATH_WALK_METHODS and
+                   not isinstance(node.func.value, ast.Name))) and \
+                    not _wrapped_in_sorted(ctx, node):
+                what = f"{ma[0]}.{ma[1]}" if ma else node.func.attr
+                yield ctx.finding(
+                    RULE_NAME, node,
+                    f"unsorted {what}() — filesystem order is "
+                    "kernel-dependent; wrap the call directly in "
+                    "sorted(...) (spill-file stitching order is "
+                    "load-bearing for restart replay)")
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _PATH_WALK_METHODS and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id not in ("glob", "fnmatch", "re") \
+                    and not _wrapped_in_sorted(ctx, node):
+                yield ctx.finding(
+                    RULE_NAME, node,
+                    f"unsorted .{node.func.attr}() — filesystem order "
+                    "is kernel-dependent; wrap the call directly in "
+                    "sorted(...)")
+        # -- id()-keyed containers ------------------------------------
+        if isinstance(node, ast.Subscript):
+            for sub in ast.walk(node.slice):
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Name) and \
+                        sub.func.id == "id":
+                    yield ctx.finding(
+                        RULE_NAME, node,
+                        "id()-keyed container — CPython address order "
+                        "varies per run, so iteration/sort over this "
+                        "container inherits address order; key by a "
+                        "stable name or sequence number")
+                    break
+        if isinstance(node, ast.keyword) and node.arg == "key" and \
+                isinstance(node.value, ast.Name) and node.value.id == "id":
+            yield ctx.finding(
+                RULE_NAME, node.value,
+                "key=id sort — address order varies per run; sort by "
+                "a stable attribute")
+        # -- set iteration --------------------------------------------
+        if isinstance(node, ast.For) and \
+                _is_set_expr(node.iter, sets_for(node)) and \
+                not _wrapped_in_sorted(ctx, node.iter):
+            yield ctx.finding(
+                RULE_NAME, node.iter,
+                "iterating a set in replay scope — str-key order "
+                "varies with PYTHONHASHSEED (the determinism "
+                "harness's double-run compare exists to catch exactly "
+                "this); iterate sorted(...) instead")
+        if isinstance(node, (ast.ListComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            # a SetComp stays a set (order can't leak), and a generator
+            # feeding an order-insensitive reduction is exact whatever
+            # the iteration order — only order-carrying results count
+            par = ctx.parent(node)
+            if isinstance(node, ast.GeneratorExp) and \
+                    isinstance(par, ast.Call) and \
+                    isinstance(par.func, ast.Name) and \
+                    par.func.id in ("sum", "min", "max", "any", "all",
+                                    "len", "sorted", "set", "frozenset"):
+                continue
+            for gen in node.generators:
+                if _is_set_expr(gen.iter, sets_for(node)) and \
+                        not _wrapped_in_sorted(ctx, gen.iter):
+                    yield ctx.finding(
+                        RULE_NAME, gen.iter,
+                        "comprehension over a set in replay scope — "
+                        "str-key order varies with PYTHONHASHSEED; "
+                        "iterate sorted(...) instead")

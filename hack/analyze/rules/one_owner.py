@@ -1,0 +1,223 @@
+"""one-owner-constant: every shared constant has exactly one defining
+module; re-literal'd twins are findings.
+
+Whole-program rule (ISSUE 18).  The registry
+(hack/analyze/constant_registry.py) names one owner per cross-engine
+constant — the fit epsilon, the constraint-class order, the fallback /
+shed / cause vocabularies, the gang trial order, the wire stats-key
+contract.  The failure class is drift-by-re-literal: oracle and kernel
+each spell a vocabulary inline, then one edit moves one copy (PR 8's
+`exist_group_ok` extraction and PR 11's MESH dual-parser fix each
+caught one instance by hand).  Enforced shapes:
+
+  * a binding (assignment or `def`) of a registered NAME outside its
+    owner module — import it instead.  Pure aliases (`EPS = ffd.EPS`)
+    and `from ... import` stay legal: they re-point, they cannot
+    drift.
+  * a literal whose VALUE equals a registered collection's value — a
+    tuple/frozenset re-spelled inline under any name is the drifting
+    twin even when the name differs.  Scalar values (EPS) match only
+    at assignment level and only inside solver/scheduling code, where
+    a bare 1e-3 is slack and not, say, a timeout.
+  * a stale registry row — the owner module no longer binds the name:
+    fails like a stale baseline entry, so the registry can never rot.
+
+Owners under hack/ (kind "lint", e.g. the wire `_STATS_KEYS`) are
+parsed on demand from the repo root, since the default analyzed tree is
+karpenter_tpu/ only; fixture trees that lack an owner entirely stay
+quiet for that row (same convention as the env-knob registry).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from hack.analyze.core import FileContext, Finding
+
+RULE_NAME = "one-owner-constant"
+
+# scalar twins only match inside these prefixes (a float equal to EPS
+# elsewhere in the tree is usually a timeout, not slack)
+_SCALAR_SCOPE = ("karpenter_tpu/solver/", "karpenter_tpu/scheduling/")
+
+_REGISTRY_PATH = "hack/analyze/constant_registry.py"
+
+
+def _lit(expr: ast.AST):
+    """Evaluate the literal subset the registry's constants use:
+    constants, +/- numbers, tuples/lists/sets of literals, and
+    frozenset/set/tuple calls over one literal arg.  Returns a
+    hashable canonical value, or raises ValueError."""
+    if isinstance(expr, ast.Constant):
+        return expr.value
+    if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.USub):
+        v = _lit(expr.operand)
+        if isinstance(v, (int, float)):
+            return -v
+        raise ValueError
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return tuple(_lit(e) for e in expr.elts)
+    if isinstance(expr, ast.Set):
+        return frozenset(_lit(e) for e in expr.elts)
+    if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name) \
+            and expr.func.id in ("frozenset", "set", "tuple") \
+            and len(expr.args) == 1 and not expr.keywords:
+        inner = _lit(expr.args[0])
+        if isinstance(inner, (tuple, frozenset)):
+            return tuple(inner) if expr.func.id == "tuple" \
+                else frozenset(inner)
+    raise ValueError(ast.dump(expr))
+
+
+def _canon(value):
+    """Order-insensitive canonical form for twin comparison: a tuple
+    re-spelled as a set (or vice versa) is still the same vocabulary."""
+    if isinstance(value, (tuple, frozenset)):
+        try:
+            return frozenset(value)
+        except TypeError:
+            return value
+    return value
+
+
+def _owner_binding(tree: ast.AST, name: str) \
+        -> Tuple[bool, Optional[object]]:
+    """(bound, value) for `name` at the owner's module level; value is
+    None when the binding exists but is not literal-evaluable."""
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == name:
+            return True, None
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    try:
+                        return True, _lit(node.value)
+                    except ValueError:
+                        return True, None
+    return False, None
+
+
+def _is_alias(value: ast.AST, name: str) -> bool:
+    """`EPS = ffd.EPS` / `EPS = solver_ffd.EPS` — re-pointing, not
+    re-spelling."""
+    if isinstance(value, ast.Attribute) and value.attr == name:
+        return True
+    return isinstance(value, ast.Name) and value.id == name
+
+
+def check_program(ctxs: List[FileContext], root: str = "") \
+        -> Iterator[Finding]:
+    from hack.analyze.constant_registry import CONSTANTS
+    by_rel: Dict[str, FileContext] = {c.rel: c for c in ctxs}
+
+    def owner_tree(owner: str) -> Optional[ast.AST]:
+        ctx = by_rel.get(owner)
+        if ctx is not None:
+            return ctx.tree
+        path = os.path.join(root, owner)
+        if not os.path.exists(path):
+            return None  # fixture tree without the owner: row inactive
+        try:
+            with open(path, encoding="utf-8") as f:
+                return ast.parse(f.read(), filename=owner)
+        except (SyntaxError, UnicodeDecodeError):
+            return None
+
+    # resolve each registered row against its owner
+    values: Dict[str, object] = {}       # name -> canonical value
+    active: Dict[str, dict] = {}         # rows whose owner was found
+    for name, row in CONSTANTS.items():
+        tree = owner_tree(row["owner"])
+        if tree is None:
+            continue
+        bound, value = _owner_binding(tree, name)
+        if not bound:
+            yield Finding(
+                rule=RULE_NAME, path=_REGISTRY_PATH, line=1,
+                symbol="<registry>",
+                message=f"registry row for `{name}` is stale — its "
+                        f"owner ({row['owner']}) no longer defines it; "
+                        "move the row to the new owner or delete it",
+                snippet="")
+            continue
+        active[name] = row
+        if row["kind"] == "value" and value is not None:
+            values[name] = _canon(value)
+
+    twin_values = {v: n for n, v in values.items()
+                   if isinstance(v, frozenset) and len(v) >= 2}
+
+    for ctx in ctxs:
+        foreign = {n for n, row in active.items()
+                   if ctx.rel != row["owner"]}
+        if not foreign:
+            continue
+        for node in ast.walk(ctx.tree):
+            # -- name re-binding outside the owner --------------------
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in foreign:
+                yield ctx.finding(
+                    RULE_NAME, node,
+                    f"`{node.name}` re-implemented outside its owner "
+                    f"({active[node.name]['owner']}) — two "
+                    "implementations of a shared contract drift; "
+                    "import the owner's")
+                continue
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id in foreign \
+                            and not _is_alias(node.value, t.id):
+                        yield ctx.finding(
+                            RULE_NAME, node,
+                            f"`{t.id}` re-bound outside its owner "
+                            f"({active[t.id]['owner']}) — import it; "
+                            "a second spelling is the PR 8 / PR 11 "
+                            "drift class")
+            # -- value twins (collection vocabularies) ----------------
+            if isinstance(node, (ast.Tuple, ast.List, ast.Set, ast.Call)):
+                try:
+                    v = _canon(_lit(node))
+                except ValueError:
+                    v = None
+                hit = twin_values.get(v) if isinstance(v, frozenset) \
+                    else None
+                if hit and hit in foreign:
+                    # don't double-report the Tuple inside its own
+                    # frozenset((...)) call — the Call already fired
+                    par = ctx.parent(node)
+                    if isinstance(par, ast.Call) and node in par.args:
+                        try:
+                            if twin_values.get(
+                                    _canon(_lit(par))) == hit:
+                                continue
+                        except ValueError:
+                            pass
+                    yield ctx.finding(
+                        RULE_NAME, node,
+                        f"this literal spells `{hit}`'s value inline "
+                        f"(owner: {active[hit]['owner']}) — a "
+                        "re-literal'd vocabulary twin drifts on the "
+                        "next edit; import the owner's constant")
+            # -- scalar twins (assignment-level, solver/sched only) ---
+            if isinstance(node, ast.Assign) and \
+                    any(ctx.rel.startswith(p) for p in _SCALAR_SCOPE):
+                try:
+                    v = _lit(node.value)
+                except ValueError:
+                    v = None
+                if isinstance(v, float):
+                    for name, val in values.items():
+                        tgt = node.targets[0]
+                        tname = tgt.id if isinstance(tgt, ast.Name) \
+                            else "?"
+                        # same-name rebinding already fired above
+                        if name in foreign and val == v and tname != name:
+                            yield ctx.finding(
+                                RULE_NAME, node,
+                                f"`{tname}` re-spells `{name}`'s value "
+                                f"(owner: {active[name]['owner']}) "
+                                "under a new name — alias the owner's "
+                                "constant instead of re-literaling it")

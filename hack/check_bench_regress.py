@@ -57,7 +57,13 @@ _PARITY_KEYS = ("parity", "pass", "nodes_le_oracle",
                 # audit cleanliness, expected-pod reconciliation, and
                 # the seek/checkpoint bit-identity contract
                 "ledger_hex_exact", "zero_gang_atomicity_violations",
-                "audit_clean", "zero_lost_pods", "seek_bit_identical")
+                "audit_clean", "zero_lost_pods", "seek_bit_identical",
+                # the determinism harness (ISSUE 18): once a recording
+                # carries the double-run digest-stable boolean
+                # (hack/determinism_harness.py --bench), a later false
+                # is nondeterminism introduced since — a build failure,
+                # not a perf note
+                "digest_stable")
 _NAME_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 
 

@@ -355,8 +355,12 @@ class Scheduler:
                 avail = it.allocatable() - daemon
                 fit = None
                 for i, r in enumerate(mreq.v):
-                    if r > 1e-9:
-                        k = int((avail.v[i] + 1e-9) // r)
+                    # host float-noise guards for the nearest-miss
+                    # SUGGESTION count, deliberately tighter than the
+                    # kernel's fit EPS: this never gates a placement,
+                    # so aligning it to EPS would only blur the hint
+                    if r > 1e-9:  # kt-lint: disable=dtype-flow
+                        k = int((avail.v[i] + 1e-9) // r)  # kt-lint: disable=dtype-flow
                         fit = k if fit is None else min(fit, k)
                 best_fit = max(best_fit, fit or 0)
         if best_placed <= 0:
@@ -661,7 +665,7 @@ class Scheduler:
         # narrow the claim where a constraint engaged: pick the least-loaded
         # allowed domain so spreading continues to balance
         out_reqs = merged
-        for key in constrained_keys & set(_NARROWABLE_KEYS):
+        for key in sorted(constrained_keys & set(_NARROWABLE_KEYS)):
             cur = out_reqs.get(key)
             if cur is not None and cur.is_finite() and cur.values() <= possible[key] \
                     and len(cur.values()) == 1:

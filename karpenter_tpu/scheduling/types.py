@@ -134,7 +134,8 @@ def fold_volume_topology(pods: List[Pod]) -> List[Pod]:
             out.append(p)
             continue
         pin = Requirements(*(
-            Requirement.make(wellknown.ZONE_LABEL, "In", z) for z in zones))
+            Requirement.make(wellknown.ZONE_LABEL, "In", z)
+            for z in sorted(zones)))
         out.append(dataclasses.replace(
             p, requirements=p.requirements.intersection(pin)))
     return out

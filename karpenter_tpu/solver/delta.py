@@ -185,7 +185,10 @@ class SolveCache:
         consumed=None retires nothing (pure conservatism: stale dirt
         costs one counted fallback, whose full solve then retires it)."""
         with self._lock:
-            self._records[id(cat)] = rec
+            # identity-keyed LRU looked up by `is`-the-same-catalog,
+            # never iterated into outputs: eviction order is insertion
+            # order, so address values cannot leak into any solve
+            self._records[id(cat)] = rec  # kt-lint: disable=nondeterminism-source
             self._records.move_to_end(id(cat))
             while len(self._records) > self.capacity:
                 self._records.popitem(last=False)

@@ -31,6 +31,7 @@ from karpenter_tpu.models.requirements import Requirements
 from karpenter_tpu.models.resources import RESOURCE_AXIS, Resources
 from karpenter_tpu.models.taints import tolerates_all
 from karpenter_tpu.scheduling.topology import TopologyTracker, node_domains_for
+from karpenter_tpu.solver.explain import EPS
 from karpenter_tpu.scheduling.types import (
     ExistingNode,
     ScheduleInput,
@@ -507,7 +508,10 @@ class SharedExistEncoding:
             node = en.node
             if id(node) in self._index:
                 continue
-            self._index[id(node)] = len(self._nodes)
+            # identity-keyed row lookup, never iterated: row order is
+            # add_nodes() call order (the shared snapshot's), so
+            # addresses cannot order anything
+            self._index[id(node)] = len(self._nodes)  # kt-lint: disable=nondeterminism-source
             self._nodes.append(node)
             self._wrappers.append(en)
             self._res_anti.append(_has_required_anti(en.pods))
@@ -575,7 +579,8 @@ class SharedExistEncoding:
 
     def rows(self, existing: Sequence[ExistingNode]) -> np.ndarray:
         """Union row index per ExistingNode (identity-keyed on .node)."""
-        return np.fromiter((self._index[id(en.node)] for en in existing),
+        # identity-keyed lookup in caller-supplied order — see add_nodes
+        return np.fromiter((self._index[id(en.node)] for en in existing),  # kt-lint: disable=nondeterminism-source
                            dtype=np.int64, count=len(existing))
 
     def group_ok(self, rep: Pod) -> np.ndarray:
@@ -964,12 +969,12 @@ class _TopologyEncoder:
             cand &= set(elig)
         if not cand:
             return None
-        cap_by = {d: 0.0 for d in cand}
+        cap_by = {d: 0.0 for d in sorted(cand)}
         for en in self.existing:
             d = en.node.labels.get(key)
             if d in cap_by:
                 cap_by[d] += max(float(en.available.get("cpu") or 0.0), 0.0)
-        price_by = {d: float("inf") for d in cand}
+        price_by = {d: float("inf") for d in sorted(cand)}
         gmask, _ = group_column_mask(self.cat, rep)
         for o_idx in np.nonzero(gmask)[0]:
             col = self.cat.columns[o_idx]
@@ -1212,7 +1217,7 @@ class _TopologyEncoder:
             elif key in _DOM_KEYS:
                 if dyn_key == key:
                     ids = self._dom_ids(key)
-                    for d in blocked:
+                    for d in sorted(blocked):
                         if d in ids:
                             dcap[ids[d]] = 0
                 else:
@@ -1267,7 +1272,7 @@ def _np_fit_count(avail: np.ndarray, req: np.ndarray) -> np.ndarray:
     host-side whole-group-fit verdict never disagrees with the device
     fill."""
     safe = np.where(req > 0, req, 1.0)
-    counts = np.floor((avail + 1e-3) / safe)
+    counts = np.floor((avail + EPS) / safe)
     counts = np.where(req > 0, counts, float(2 ** 30))
     return np.clip(counts.min(axis=-1), 0, 2 ** 30).astype(np.int64)
 

@@ -52,6 +52,15 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+# -- the fit-slack epsilon (one owner, ISSUE 18) ---------------------------
+# Every fit test in the solver — the kernel's `floor((avail + EPS)/req)`
+# and `>= -EPS` subtract-compares, the host recheck rows, the delta
+# seed's whole-group verdicts — uses THIS slack.  It lives here (the
+# solver's jax-free vocabulary module) so the kernel (ffd), the encoder,
+# and the host paths can all import the one spelling; the
+# one-owner-constant rule flags any re-literal'd twin.
+EPS = 1e-3
+
 # -- constraint classes (canonical order) ---------------------------------
 # The elimination vocabulary: why a catalog column cannot take a pod of
 # this group.  Order is a wire contract — the kernel's aux counts rows
@@ -578,7 +587,9 @@ class ExplainStore:
                  trace_id: Optional[str] = None,
                  source: str = "local") -> int:
         n = 0
-        now = time.time()
+        # debug-surface timestamp (GET /debug/explain freshness / TTL
+        # eviction only): never part of a solve output or digest
+        now = time.time()  # kt-lint: disable=nondeterminism-source
         with self._lock:
             for pod, reason in unschedulable.items():
                 entry = {

@@ -51,9 +51,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from karpenter_tpu.solver.explain import KERNEL_CONSTRAINTS
-
-EPS = 1e-3
+from karpenter_tpu.solver.explain import EPS, KERNEL_CONSTRAINTS
 
 # placement-provenance aux (ISSUE 13): the kernel's per-group elimination
 # counts use KERNEL_CONSTRAINTS order (fit, limit, topology, whole_node,

@@ -2722,7 +2722,7 @@ class TPUSolver:
                 if cursor >= len(pods) or enc.exist_cap[gi, ei] <= 0:
                     continue
                 with np.errstate(divide="ignore", invalid="ignore"):
-                    per = np.where(req > 0, np.floor((remaining[ei] + 1e-3) / np.where(req > 0, req, 1)), np.inf)
+                    per = np.where(req > 0, np.floor((remaining[ei] + ffd.EPS) / np.where(req > 0, req, 1)), np.inf)
                 k = int(min(np.min(per), enc.exist_cap[gi, ei],
                             len(pods) - cursor))
                 if k <= 0:
@@ -2760,6 +2760,7 @@ class TPUSolver:
             tn = out["take_new"][gi, :num_active]
             if int((te > 0).sum()) + int((tn > 0).sum()) <= 1:
                 continue
+            metrics.SOLVER_HOST_REPAIRS.inc(kind="whole_node")
             self._strand_group(enc, out, gi, te, tn)
 
     @staticmethod
@@ -2869,6 +2870,8 @@ class TPUSolver:
                     r = min(k, excess - removed)
                     out["take_exist"][gi, ei] -= r
                     removed += r
+                if removed:
+                    metrics.SOLVER_HOST_REPAIRS.inc(kind="topology")
                 out["unsched"][gi] += removed
 
     # -- decode ----------------------------------------------------------
@@ -3076,7 +3079,7 @@ class TPUSolver:
             if fit is None:
                 # same per-element float32 subtract-compare as the full
                 # [O,R] form it replaces, so survivors are bit-identical
-                fit = np.all(alloc_sub - used[ni][None, :R] >= -1e-3,
+                fit = np.all(alloc_sub - used[ni][None, :R] >= -ffd.EPS,
                              axis=-1)
                 fit_rows[fkey] = fit
             keep = fit

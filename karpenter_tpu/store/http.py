@@ -307,14 +307,23 @@ class HttpBackend:
         self._port = u.port or 80
         self._codec = codec or PickleCodec()
         self._lock = threading.Lock()
-        # serializes own writes against 410 relist recovery: the relist's
-        # list-then-diff must not interleave with a concurrent own put,
-        # or the diff can synthesize a spurious delete for a live object
-        self._write_lock = threading.Lock()
         self._events: List[Tuple[str, str, str, Optional[object]]] = []
         self._own_write_ids: set = set()
         self._own_order: List[str] = []
-        self._pending_deletes: set = set()  # (kind, name)
+        # own-delete markers, checkout-style (the discipline PR 11 gave
+        # RemoteBackend._call): registered under the small lock BEFORE
+        # the RPC goes out, resolved after it returns — no lock is ever
+        # held across the wire.  Value is the delete's resourceVersion
+        # once known (0 while the RPC is in flight); _relist_after_gap
+        # uses it to decide whether the DELETED echo is still ahead of
+        # the relist resume horizon (keep the marker) or fell behind it
+        # (drop it, or it would swallow a PEER's later delete).
+        self._pending_deletes: Dict[Tuple[str, str], int] = {}
+        # kind → names whose own put committed while a relist was in
+        # flight for that kind; the relist diff must not synthesize a
+        # delete for them (their create raced the list snapshot)
+        self._relist_touched: Dict[str, set] = {}
+        self._relist_rv: Dict[str, int] = {}
         self._watchers: Dict[str, threading.Thread] = {}
         self._known: Dict[str, set] = {}
         self._closed = False
@@ -423,38 +432,62 @@ class HttpBackend:
         # echo before the HTTP response returns
         self._note_own(write_id)
         item = self._item(kind, name, obj, write_id)
-        with self._write_lock:
-            if verb == "added":
-                status, doc = self._request(
-                    "POST", f"{GROUP_PATH}/{kind}", item)
-                if status == 409:
-                    return False
-            else:
-                status, doc = self._request(
-                    "PUT", f"{GROUP_PATH}/{kind}/{name}", item)
-                if status == 404:
-                    return False
-            if status in (200, 201):
-                with self._lock:
-                    self._known.setdefault(kind, set()).add(name)
-                return True
-            return False
+        # RPC outside any lock (checkout-style, kt-lint lock-discipline);
+        # the commit below records the name in _relist_touched when a
+        # 410 relist is concurrently in flight, which is what keeps the
+        # relist's list-then-diff from synthesizing a spurious delete
+        # for a live object whose create raced the list snapshot
+        if verb == "added":
+            status, doc = self._request(
+                "POST", f"{GROUP_PATH}/{kind}", item)
+            if status == 409:
+                return False
+        else:
+            status, doc = self._request(
+                "PUT", f"{GROUP_PATH}/{kind}/{name}", item)
+            if status == 404:
+                return False
+        if status in (200, 201):
+            with self._lock:
+                self._known.setdefault(kind, set()).add(name)
+                touched = self._relist_touched.get(kind)
+                if touched is not None:
+                    touched.add(name)
+            return True
+        return False
 
     def delete(self, kind: str, name: str) -> None:
-        with self._write_lock:
-            with self._lock:
-                # a marker is only consumable when a watcher is running
-                # for the kind; otherwise it would linger and swallow a
-                # PEER's later delete of the same name
-                if kind in self._watchers:
-                    self._pending_deletes.add((kind, name))
+        with self._lock:
+            # a marker is only consumable when a watcher is running
+            # for the kind; otherwise it would linger and swallow a
+            # PEER's later delete of the same name.  0 = RPC in flight.
+            marked = kind in self._watchers
+            if marked:
+                self._pending_deletes[(kind, name)] = 0
+        try:
             status, doc = self._request(
                 "DELETE", f"{GROUP_PATH}/{kind}/{name}")
+        except Exception:
             with self._lock:
-                if status == 200:
-                    self._known.get(kind, set()).discard(name)
-                else:
-                    self._pending_deletes.discard((kind, name))
+                # a marker for a write that never happened would
+                # swallow a peer's later delete of the same name
+                self._pending_deletes.pop((kind, name), None)
+            raise
+        with self._lock:
+            if status == 200:
+                self._known.get(kind, set()).discard(name)
+                rv = int(doc.get("metadata", {})
+                         .get("resourceVersion", "0") or 0)
+                if marked and (kind, name) in self._pending_deletes:
+                    if rv and rv <= self._relist_rv.get(kind, 0):
+                        # a relist overtook this delete: the DELETED
+                        # echo predates the resume horizon, so the
+                        # watcher will never consume the marker
+                        self._pending_deletes.pop((kind, name), None)
+                    else:
+                        self._pending_deletes[(kind, name)] = rv
+            else:
+                self._pending_deletes.pop((kind, name), None)
 
     def events(self) -> List[Tuple[str, str, str, Optional[object]]]:
         with self._lock:
@@ -522,7 +555,7 @@ class HttpBackend:
                     if event["type"] == "DELETED":
                         with self._lock:
                             if (kind, name) in self._pending_deletes:
-                                self._pending_deletes.discard((kind, name))
+                                self._pending_deletes.pop((kind, name))
                                 continue
                         self._emit(kind, "deleted", name, None)
                         continue
@@ -549,34 +582,63 @@ class HttpBackend:
         names that vanished inside the gap), and resume from the list's
         resourceVersion — informer ListAndWatch recovery.
 
-        Runs under the write lock: a concurrent own put between the list
-        snapshot and the diff would otherwise make the diff synthesize a
-        spurious delete for a live object (whose subsequent ADDED echo
-        the write-id suppression would then swallow)."""
-        with self._write_lock:
-            status, doc = self._request("GET", f"{GROUP_PATH}/{kind}")
-            if status != 200:
-                return 0
+        Checkout-style against concurrent own writes (no lock is held
+        across the list RPC): a _relist_touched window is opened under
+        the small lock before the GET goes out, own puts that commit
+        inside the window record their name there, and the diff skips
+        those names — a create racing the list snapshot must not be
+        synthesized into a delete (its ADDED echo would then be
+        swallowed by write-id suppression, losing the object for good).
+        Own-delete markers are reconciled by resourceVersion: a marker
+        whose DELETED echo predates the list's resourceVersion (the
+        resume horizon) is dropped — the watcher will never consume it,
+        and a lingering marker would swallow a peer's later delete —
+        while markers still in flight or ahead of the horizon are kept,
+        and their names are excluded from the diff so an own delete is
+        never double-reported through gap recovery."""
+        with self._lock:
+            # open the touched window before the list RPC is issued
+            self._relist_touched[kind] = set()
+        status, doc = self._request("GET", f"{GROUP_PATH}/{kind}")
+        if status != 200:
             with self._lock:
-                before = set(self._known.get(kind, set()))
-                # markers for this kind can't be trusted across a gap
-                # (their DELETED echo may have fallen off the log)
-                self._pending_deletes = {
-                    (k, n) for (k, n) in self._pending_deletes
-                    if k != kind}
-            now = {}
-            for item in doc.get("items", []):
-                now[item["metadata"]["name"]] = item
-            for name in before - set(now):
-                self._emit(kind, "deleted", name, None)
-            for name, item in now.items():
-                wid = item["metadata"].get("kt-write-id")
-                with self._lock:
-                    if wid is not None and wid in self._own_write_ids:
-                        continue  # our own write: the cache is current
-                obj = self._codec.decode(item["data"])
-                verb = ("deleting"
-                        if item["metadata"].get("deletionTimestamp")
-                        else "modified")
-                self._emit(kind, verb, name, obj)
-            return int(doc.get("metadata", {}).get("resourceVersion", "0"))
+                self._relist_touched.pop(kind, None)
+            return 0
+        list_rv = int(doc.get("metadata", {}).get("resourceVersion", "0"))
+        now = {}
+        for item in doc.get("items", []):
+            now[item["metadata"]["name"]] = item
+        with self._lock:
+            before = set(self._known.get(kind, set()))
+            touched = self._relist_touched.pop(kind, set())
+            own_deleting = set()
+            kept: Dict[Tuple[str, str], int] = {}
+            for (k, n), drv in self._pending_deletes.items():
+                if k != kind:
+                    kept[(k, n)] = drv
+                    continue
+                own_deleting.add(n)
+                if drv == 0 or drv > list_rv:
+                    # RPC still in flight, or the DELETED echo is ahead
+                    # of the resume horizon: the watcher will consume it
+                    kept[(k, n)] = drv
+                # else: the echo fell behind the horizon — unconsumable
+            self._pending_deletes = kept
+            self._relist_rv[kind] = list_rv
+        for name in sorted(before - set(now)):
+            if name in touched or name in own_deleting:
+                continue
+            self._emit(kind, "deleted", name, None)
+        for name, item in now.items():
+            if name in own_deleting:
+                continue  # mid-own-delete: the snapshot is already stale
+            wid = item["metadata"].get("kt-write-id")
+            with self._lock:
+                if wid is not None and wid in self._own_write_ids:
+                    continue  # our own write: the cache is current
+            obj = self._codec.decode(item["data"])
+            verb = ("deleting"
+                    if item["metadata"].get("deletionTimestamp")
+                    else "modified")
+            self._emit(kind, verb, name, obj)
+        return list_rv

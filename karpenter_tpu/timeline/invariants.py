@@ -216,7 +216,7 @@ def audit_series() -> Dict[str, float]:
 def audit_deltas(before: Dict[str, float],
                  after: Dict[str, float]) -> Dict[str, int]:
     return {k: int(after.get(k, 0) - before.get(k, 0))
-            for k in set(before) | set(after)}
+            for k in sorted(set(before) | set(after))}
 
 
 def state_digest(cluster, pricing=None) -> str:

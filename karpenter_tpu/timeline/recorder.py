@@ -98,7 +98,9 @@ class TimelineRecorder:
         with self._lock:
             self._seq += 1
             rec = TimelineEvent(
-                seq=self._seq, ts=time.time(), pid=os.getpid(),
+                # capture-side provenance stamp: replay rebases ts (the
+                # engine's clock drives ticks) and digests exclude it
+                seq=self._seq, ts=time.time(), pid=os.getpid(),  # kt-lint: disable=nondeterminism-source
                 kind=kind, name=name, data=data,
                 trace_id=tracing.current_trace_id(),
                 flight_seq=flightrecorder.RECORDER.last_seq(),
@@ -127,7 +129,9 @@ class TimelineRecorder:
                 f.flush()
         except OSError:
             # best-effort, like the flight spill: a full disk degrades
-            # the timeline to ring-only, never fails a controller
+            # the timeline to ring-only, never fails a controller —
+            # but counted, so restart replay losing events is visible
+            metrics.SPILL_DEGRADED.inc(recorder="timeline")
             self._spill_failed = True
 
     def tail(self, n: int = 64, kind: Optional[str] = None,
@@ -233,9 +237,11 @@ def _semantic_markers(pod_name: str, annotations: dict) -> None:
 
 
 def load_events(path: str) -> List[dict]:
-    """Parse one spilled timeline-<pid>.jsonl.  Delegates to the flight
-    recorder's torn-line-tolerant loader — the shared code path the
-    ISSUE pins: a crashed process leaves at most one torn tail line,
-    and every record before it must load."""
-    return [r for r in flightrecorder.load_records(path)
+    """Parse one spilled timeline-<pid>.jsonl — or stitch every
+    timeline-*.jsonl under a directory in (mtime, name) order, the
+    multi-process / restart-replay case (ROADMAP item 5).  Delegates to
+    the flight recorder's torn-line-tolerant loader — the shared code
+    path the ISSUE pins: a crashed process leaves at most one torn tail
+    line, and every record before it must load."""
+    return [r for r in flightrecorder.load_records(path, prefix="timeline")
             if isinstance(r, dict) and "kind" in r]

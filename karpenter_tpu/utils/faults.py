@@ -52,6 +52,12 @@ Registered points (grep for ``faults.fire`` to verify):
                                perturbs the sampled solve's live digest,
                                the injected-divergence lever proving the
                                diverged -> capture -> kt_replay loop
+  * ``determinism.digest``   — flight-record canonicalization in
+                               hack/determinism_harness.py: an armed
+                               drop/error stamps a time.time() value
+                               into the canonical record, the drill
+                               proving the double-run digest compare
+                               catches real nondeterminism
 """
 
 from __future__ import annotations

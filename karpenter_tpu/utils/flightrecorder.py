@@ -48,6 +48,8 @@ import time
 from collections import deque
 from typing import List, Optional
 
+from karpenter_tpu.utils import metrics
+
 _ENV_GATE = "KARPENTER_TPU_FLIGHT"
 _ENV_BUFFER = "KARPENTER_TPU_FLIGHT_BUFFER"
 _ENV_DIR = "KARPENTER_TPU_FLIGHT_DIR"
@@ -173,7 +175,9 @@ class FlightRecorder:
             return None
         with self._lock:
             self._seq += 1
-            rec = FlightRecord(seq=self._seq, ts=time.time(),
+            # capture-side provenance stamp: every digest/fingerprint
+            # canonicalization excludes ts (and pid)
+            rec = FlightRecord(seq=self._seq, ts=time.time(),  # kt-lint: disable=nondeterminism-source
                                pid=os.getpid(), **fields)
             self._ring.append(rec)
         self._maybe_spill(rec)
@@ -228,7 +232,9 @@ class FlightRecorder:
                 f.flush()
         except OSError:
             # spill is best-effort: a full disk must degrade the black
-            # box to ring-only, never fail a solve
+            # box to ring-only, never fail a solve — but counted, so a
+            # fleet losing its on-disk tail shows on a dashboard
+            metrics.SPILL_DEGRADED.inc(recorder="flight")
             self._spill_failed = True
 
     def tail(self, n: int = 32,
@@ -270,10 +276,25 @@ class FlightRecorder:
 RECORDER = FlightRecorder()
 
 
-def load_records(path: str) -> List[dict]:
-    """Parse one spilled flight-<pid>.jsonl; malformed lines (a torn
-    write from a crashed process — exactly when the file matters most)
-    are skipped, not fatal."""
+def load_records(path: str, prefix: str = "flight") -> List[dict]:
+    """Parse one spilled <prefix>-<pid>.jsonl, or — when `path` is a
+    DIRECTORY — stitch every <prefix>-*.jsonl in it, ordered by
+    (mtime, name): each process lifetime leaves its own per-pid spill,
+    and a restart replay must see the whole sequence in the order the
+    segments were written, with the filename as the deterministic
+    tie-break (ROADMAP item 5 / ISSUE 18 satellite — an unsorted
+    listdir here is exactly what the nondeterminism-source rule flags).
+    Malformed lines (a torn write from a crashed process — exactly when
+    the file matters most) are skipped, not fatal."""
+    if os.path.isdir(path):
+        spills = sorted(
+            (os.path.join(path, f) for f in os.listdir(path)
+             if f.startswith(prefix + "-") and f.endswith(".jsonl")),
+            key=lambda p: (os.path.getmtime(p), p))
+        out: List[dict] = []
+        for p in spills:
+            out.extend(load_records(p))
+        return out
     out = []
     with open(path, encoding="utf-8") as f:
         for line in f:

@@ -422,6 +422,21 @@ SOLVER_GANG_REPAIRS = _c(
     "(partial or cross-domain placement out of the kernel) — expected "
     "to stay at zero; any increment is a kernel gang-commit bug made "
     "visible instead of a silently split gang.")
+SOLVER_HOST_REPAIRS = _c(
+    "karpenter_tpu_solver_host_repairs_total",
+    "Kernel placements the host-side repair nets rolled back or "
+    "trimmed, by kind: whole_node = a co-location group stranded "
+    "atomically (split across nodes out of the kernel), topology = "
+    "placements stripped above the final skew ceiling.  Each repair "
+    "is a counted degrade (the oracle rescue then re-seats the pods), "
+    "never a silent rewrite.", ("kind",))
+SPILL_DEGRADED = _c(
+    "karpenter_tpu_spill_degraded_total",
+    "Spill-to-disk writes abandoned (OSError — full disk, dead mount) "
+    "by recorder: flight, ledger, timeline.  The black box degrades "
+    "to ring-only and keeps serving; a non-zero rate means restart "
+    "replay is losing its tail and the disk needs attention.",
+    ("recorder",))
 SOLVER_CONSTRAINT_ELIM = _c(
     "karpenter_tpu_solver_constraint_eliminations_total",
     "Catalog-column eliminations attributed per constraint class by the "

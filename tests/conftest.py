@@ -20,6 +20,12 @@ import pytest
 # imports anywhere (utils/faults.py arms from the environment at import).
 os.environ.pop("KARPENTER_TPU_FAULTS", None)
 
+# The kt-lint cache tests assert hit/miss behavior against fixture
+# trees: an inherited KT_LINT_CACHE=off (the CI-debug escape hatch)
+# would flip them to always-miss.  The fixtures use their own tmp roots,
+# so scrubbing the gate costs real runs nothing.
+os.environ.pop("KT_LINT_CACHE", None)
+
 # Tier-1 runs at the explain DEFAULT (counts): an inherited
 # KARPENTER_TPU_EXPLAIN=off/full from a shell that just drove the
 # explain bench would flip every solver's kernel programs and hide the

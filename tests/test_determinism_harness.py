@@ -1,0 +1,82 @@
+"""Unit seams of hack/determinism_harness.py (ISSUE 18).
+
+The full double-run (two subprocesses under different PYTHONHASHSEEDs)
+is `make determinism-smoke`; these tests pin the harness's contract at
+the unit level: canonicalization excludes exactly the capture-side
+provenance fields kt_replay excludes, the ledger canon is the exactness
+chain and nothing else, and the `determinism.digest` fault point (the
+drill) visibly perturbs the digest — so a drill that exits zero can
+only mean the COMPARE lost its teeth, not the perturbation.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from hack import determinism_harness as dh  # noqa: E402
+from karpenter_tpu.utils import faults  # noqa: E402
+
+
+def _rec(**over):
+    rec = {"problem": "abc123", "result_digest": "deadbeef",
+           "price_hex": "0x1.8p+3", "knobs": {"delta": "auto"},
+           # capture-side provenance — excluded from the canonical form
+           "ts": 1.0, "pid": 41, "phase_ms": {"encode": 2.0},
+           "device_memory_peak_bytes": 512, "trace_id": "t-1",
+           "capture": {"pods": []}, "retraces": 1}
+    rec.update(over)
+    return rec
+
+
+def test_canon_excludes_capture_side_provenance():
+    a = _rec()
+    b = _rec(ts=99.0, pid=7, phase_ms={"encode": 9.9},
+             device_memory_peak_bytes=8192, trace_id="t-2",
+             capture=None, retraces=3)
+    assert dh.canon_flight_record(a) == dh.canon_flight_record(b)
+    assert dh.digest([dh.canon_flight_record(a)]) == \
+        dh.digest([dh.canon_flight_record(b)])
+
+
+def test_canon_keeps_replay_relevant_fields():
+    a = dh.canon_flight_record(_rec())
+    moved = dh.canon_flight_record(_rec(price_hex="0x1.9p+3"))
+    assert dh.digest([a]) != dh.digest([moved])
+    for key in ("problem", "result_digest", "price_hex", "knobs"):
+        assert key in a
+    for key in dh.FLIGHT_EXCLUDE:
+        assert key not in a
+
+
+def test_ledger_canon_is_the_exactness_chain():
+    row = {"source": "consolidation", "action": "delete",
+           "reason_code": "consolidation.emptiness",
+           "cost_delta_hex": "-0x1.2p+1",
+           "ts": 5.0, "seq": 3, "fleet_cost_after": 1.25,
+           "pools": ["general"]}
+    c = dh.canon_ledger_row(row)
+    assert set(c) == set(dh.LEDGER_KEYS)
+    # per-run fields (ts, seq, rollups) never move the chain digest
+    assert dh.digest(c) == \
+        dh.digest(dh.canon_ledger_row(dict(row, ts=9.0, seq=8,
+                                           fleet_cost_after=9.0)))
+    # the exactness fields do
+    assert dh.digest(c) != \
+        dh.digest(dh.canon_ledger_row(dict(row,
+                                           cost_delta_hex="-0x1.3p+1")))
+
+
+def test_drill_perturbs_the_canonical_record():
+    base = dh.canon_flight_record(_rec())
+    faults.arm("determinism.digest", "error")
+    try:
+        drilled = dh.canon_flight_record(_rec())
+    finally:
+        faults.disarm()
+    assert "_drill_perturbation" in drilled
+    assert dh.digest([drilled]) != dh.digest([base])
+    # disarmed again: back to the clean canonical form
+    assert dh.canon_flight_record(_rec()) == base

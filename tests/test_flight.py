@@ -731,3 +731,51 @@ class TestDashboardSupervisedTopology:
 
 if __name__ == "__main__":
     sys.exit(pytest.main([__file__, "-v"]))
+
+
+# --------------------------------------------------------------------------
+# multi-spill stitching (ISSUE 18: ROADMAP item 5's restart replay)
+# --------------------------------------------------------------------------
+class TestSpillStitching:
+    def _spill(self, tmp_path, name, seqs, mtime):
+        p = tmp_path / name
+        with open(p, "w") as f:
+            for s in seqs:
+                f.write(json.dumps({"seq": s, "kind": "solve"}) + "\n")
+        os.utime(p, (mtime, mtime))
+        return p
+
+    def test_directory_load_stitches_in_mtime_order(self, tmp_path):
+        # a restarted operator leaves one spill per pid; the loader must
+        # stitch them oldest-first so replay sees one coherent stream
+        self._spill(tmp_path, "flight-200.jsonl", [3, 4], mtime=2000.0)
+        self._spill(tmp_path, "flight-100.jsonl", [1, 2], mtime=1000.0)
+        rows = flightrecorder.load_records(str(tmp_path))
+        assert [r["seq"] for r in rows] == [1, 2, 3, 4]
+
+    def test_directory_load_name_tiebreak_within_one_mtime_granule(
+            self, tmp_path):
+        # two spills written inside one mtime granule must still stitch
+        # the same way on every run — (mtime, name) is the total order
+        self._spill(tmp_path, "flight-9.jsonl", [10], mtime=1000.0)
+        self._spill(tmp_path, "flight-10.jsonl", [20], mtime=1000.0)
+        rows = flightrecorder.load_records(str(tmp_path))
+        assert [r["seq"] for r in rows] == [20, 10]  # "flight-10" < "flight-9"
+
+    def test_directory_load_filters_by_prefix(self, tmp_path):
+        # a shared spill dir can hold flight- and ledger- files; each
+        # loader must only stitch its own
+        self._spill(tmp_path, "flight-1.jsonl", [1], mtime=1000.0)
+        self._spill(tmp_path, "ledger-1.jsonl", [99], mtime=1000.0)
+        (tmp_path / "flight-1.jsonl.tmp").write_text("not a spill")
+        rows = flightrecorder.load_records(str(tmp_path))
+        assert [r["seq"] for r in rows] == [1]
+
+    def test_directory_load_tolerates_a_torn_tail_per_file(self, tmp_path):
+        self._spill(tmp_path, "flight-1.jsonl", [1], mtime=1000.0)
+        with open(tmp_path / "flight-1.jsonl", "a") as f:
+            f.write('{"seq": 2, "trunc')
+        os.utime(tmp_path / "flight-1.jsonl", (1000.0, 1000.0))
+        self._spill(tmp_path, "flight-2.jsonl", [3], mtime=2000.0)
+        rows = flightrecorder.load_records(str(tmp_path))
+        assert [r["seq"] for r in rows] == [1, 3]

@@ -424,3 +424,43 @@ class TestLedgerSurfaces:
             "t", [("rows", [{"reason": "<script>alert(1)</script>"}])])
         assert "<script>alert(1)" not in html
         assert "&lt;script&gt;" in html
+
+
+class TestLedgerSpillStitching:
+    """ISSUE 18: ledger directory loads stitch every ledger-*.jsonl in
+    (mtime, name) order through the shared flightrecorder loader —
+    restart replay needs the full decision trail, not the newest pid's
+    slice."""
+
+    def _spill(self, tmp_path, name, seqs, mtime):
+        p = tmp_path / name
+        with open(p, "w") as f:
+            for s in seqs:
+                f.write(json.dumps({"seq": s, "source": "test"}) + "\n")
+        os.utime(p, (mtime, mtime))
+
+    def test_directory_load_stitches_oldest_first(self, tmp_path):
+        self._spill(tmp_path, "ledger-200.jsonl", [3, 4], mtime=2000.0)
+        self._spill(tmp_path, "ledger-100.jsonl", [1, 2], mtime=1000.0)
+        rows = ledger.load_records(str(tmp_path))
+        assert [r["seq"] for r in rows] == [1, 2, 3, 4]
+
+    def test_directory_load_ignores_foreign_prefixes(self, tmp_path):
+        self._spill(tmp_path, "ledger-1.jsonl", [1], mtime=1000.0)
+        self._spill(tmp_path, "flight-1.jsonl", [99], mtime=1000.0)
+        rows = ledger.load_records(str(tmp_path))
+        assert [r["seq"] for r in rows] == [1]
+
+    def test_cli_directory_load_is_the_union(self, tmp_path):
+        """tools/kt_ledger.py over a spill DIRECTORY must report every
+        pid's rows stitched oldest-first — it used to silently pick only
+        the newest spill, hiding every pre-restart decision."""
+        from tools import kt_ledger
+        self._spill(tmp_path, "ledger-200.jsonl", [1], mtime=2000.0)
+        self._spill(tmp_path, "ledger-100.jsonl", [1, 2], mtime=1000.0)
+        rows = kt_ledger.load(str(tmp_path))
+        assert [r["seq"] for r in rows] == [1, 2, 1]
+
+    def test_cli_empty_directory_is_an_empty_trail(self, tmp_path):
+        from tools import kt_ledger
+        assert kt_ledger.load(str(tmp_path)) == []

@@ -933,15 +933,18 @@ def test_socket_discipline_suppression(tmp_path):
 
 
 # -- baseline workflow -----------------------------------------------------
-def test_baseline_entries_still_resolve():
-    """Every grandfathered entry must match a finding the analyzer still
-    produces — entries whose code was fixed must be deleted."""
-    entries = core.load_baseline()
-    assert entries, "baseline.json should carry the grandfathered findings"
-    raw = core.run(["karpenter_tpu"], root=REPO, baseline=[])
-    for entry in entries:
-        assert any(core.baseline_matches(entry, f) for f in raw.findings), \
-            f"stale baseline entry (fix landed? remove it): {entry}"
+def test_grandfathered_relist_findings_are_fixed():
+    """The four HttpBackend lock-discipline entries the baseline used to
+    grandfather (write RPCs under _write_lock, justified by the relist
+    race) are FIXED — the relist path uses checkout discipline now, so
+    the analyzer must produce ZERO lock-discipline findings in the store
+    and the baseline must stay empty.  If this fires, the race fix
+    regressed; do not re-baseline it (the interleavings are pinned in
+    tests/test_store_http.py::TestRelistRaceWindows)."""
+    assert core.load_baseline() == []
+    raw = core.run(["karpenter_tpu/store"], root=REPO, baseline=[])
+    lock = [f for f in raw.findings if f.rule == "lock-discipline"]
+    assert lock == [], [f.message for f in lock]
 
 
 def test_stale_baseline_entry_is_an_error():
@@ -1611,3 +1614,470 @@ def test_fast_profile_does_not_stale_skipped_family_baselines():
     report = core.run(["karpenter_tpu/utils/knobs.py"], root=REPO,
                       baseline=[entry], rules=[lock_order])
     assert report.stale_baseline == [entry]
+
+
+# -- the determinism families (ISSUE 18) ------------------------------------
+from hack.analyze import cache as lint_cache  # noqa: E402
+from hack.analyze.rules import (  # noqa: E402
+    counted_fallback,
+    dtype_flow,
+    nondeterminism,
+    one_owner,
+)
+
+
+def _check_tree(tmp_path, files, rule):
+    """Multi-file fixture tree for the whole-program families."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    report = core.run([str(tmp_path)], root=str(tmp_path), baseline=[],
+                      rules=[rule])
+    return report.findings, report
+
+
+def test_baseline_is_empty_by_policy():
+    # ISSUE 18 acceptance: zero grandfathered findings — the HttpBackend
+    # lock-discipline quartet was FIXED, not baselined, and nothing may
+    # ride back in
+    with open(os.path.join(REPO, "hack", "analyze", "baseline.json"),
+              encoding="utf-8") as f:
+        assert json.load(f) == {"findings": []}
+
+
+# -- dtype-flow -------------------------------------------------------------
+_DTYPE_BAD = """
+    import numpy as np
+    import jax.numpy as jnp
+    from jax import lax
+
+
+    def widen(xs):
+        a = np.float64(1.5)
+        b = np.array([0.5, 1.5])
+        m = np.mean(xs)
+        c = m + 1.0
+        d = jnp.asarray(m)
+        return a, b, c, d
+
+
+    def slack(x):
+        if x >= -1e-3:
+            return x + 1e-9
+        return x
+
+
+    def mesh_combine(x):
+        return lax.psum(x, "ax")
+"""
+
+
+def test_dtype_flow_flags_widths_epsilons_and_mesh_reduces(tmp_path):
+    findings, _ = _check(tmp_path, _DTYPE_BAD, dtype_flow,
+                         relname="karpenter_tpu/solver/encode.py")
+    msgs = " | ".join(f.message for f in findings)
+    assert "np.float64 scalar" in msgs
+    assert "dtype-less np.array" in msgs
+    assert "float64 provenance" in msgs          # the m + 1.0 / jnp flow
+    assert "re-literal'd fit epsilon" in msgs    # the inline 1e-3
+    assert "ad-hoc additive tolerance" in msgs   # the inline 1e-9
+    assert "float psum" in msgs
+
+
+def test_dtype_flow_negatives(tmp_path):
+    findings, _ = _check(tmp_path, """
+        import numpy as np
+        import jax.numpy as jnp
+        from jax import lax
+
+        from karpenter_tpu.solver.explain import EPS
+
+
+        def ok(xs, arr):
+            b = np.array([0.5, 1.5], dtype=np.float32)
+            z = np.zeros(4, dtype=np.int32)
+            passthrough = np.asarray(arr)
+            return b, z, passthrough
+
+
+        def fits(x):
+            return x >= -EPS
+
+
+        def mesh_count(flags):
+            k = flags.astype(jnp.int32)
+            return lax.psum(k, "ax")
+
+
+        def _axmax(x):
+            return lax.pmax(x, "ax")
+    """, dtype_flow, relname="karpenter_tpu/solver/ffd.py")
+    assert findings == []
+
+
+def test_dtype_flow_scope_is_the_numeric_core_only(tmp_path):
+    findings, _ = _check(tmp_path, _DTYPE_BAD, dtype_flow,
+                         relname="karpenter_tpu/utils/misc.py")
+    assert findings == []
+
+
+def test_dtype_flow_suppression(tmp_path):
+    findings, report = _check(tmp_path, """
+        import numpy as np
+
+        # deliberate host-float64 surface: the oracle's exact arithmetic
+        W = np.float64(1.5)  # kt-lint: disable=dtype-flow
+    """, dtype_flow, relname="karpenter_tpu/scheduling/oracle.py")
+    assert findings == []
+    assert len(report.suppressed) == 1
+
+
+def test_dtype_flow_eps_value_matches_the_owner():
+    # the rule's epsilon fingerprint and the registry owner's binding
+    # are the same number — a drifted rule would hunt the wrong twin
+    from karpenter_tpu.solver import explain
+    assert dtype_flow.EPS_VALUE == explain.EPS
+
+
+# -- nondeterminism-source --------------------------------------------------
+_NONDET_BAD = """
+    import os
+    import random
+    import time
+    import uuid
+
+
+    def stamp(rec):
+        rec["at"] = time.time()
+        return rec
+
+
+    def spills(d):
+        return [f for f in os.listdir(d) if f.endswith(".jsonl")]
+
+
+    def pick(xs):
+        return random.choice(xs)
+
+
+    def fresh_name():
+        return uuid.uuid4().hex
+
+
+    def drain(pending):
+        ready = set(pending)
+        out = []
+        for item in ready:
+            out.append(item)
+        return out
+
+
+    def index(cache, obj):
+        cache[id(obj)] = obj
+"""
+
+
+def test_nondeterminism_flags_clock_entropy_and_order(tmp_path):
+    findings, _ = _check(tmp_path, _NONDET_BAD, nondeterminism,
+                         relname="karpenter_tpu/timeline/thing.py")
+    msgs = " | ".join(f.message for f in findings)
+    assert "wall-clock read" in msgs
+    assert "unsorted os.listdir" in msgs
+    assert "random.choice" in msgs
+    assert "uuid.uuid4" in msgs
+    assert "iterating a set" in msgs
+    assert "id()-keyed container" in msgs
+
+
+def test_nondeterminism_negatives(tmp_path):
+    findings, _ = _check(tmp_path, """
+        import os
+        import random
+
+
+        def spills(d):
+            return sorted(os.listdir(d))
+
+
+        def newest(d):
+            return sorted((f for f in os.listdir(d)
+                           if f.endswith(".jsonl")),
+                          key=len)
+
+
+        def seeded(xs):
+            rng = random.Random(7)
+            return rng.choice(xs)
+
+
+        def total(xs):
+            return sum(x for x in set(xs))
+
+
+        def drain(pending):
+            return [p for p in sorted(set(pending))]
+    """, nondeterminism, relname="karpenter_tpu/solver/thing.py")
+    assert findings == []
+
+
+def test_nondeterminism_replay_scope_exempts_operator_code(tmp_path):
+    # the replay-scope map: operator/HTTP code legitimately reads the
+    # wall clock and walks sockets — only solver/timeline/spill code
+    # feeds replay digests
+    findings, _ = _check(tmp_path, _NONDET_BAD, nondeterminism,
+                         relname="karpenter_tpu/controllers/node.py")
+    assert findings == []
+
+
+def test_nondeterminism_suppression(tmp_path):
+    findings, report = _check(tmp_path, """
+        import time
+
+
+        def provenance_stamp(rec):
+            # capture-side provenance, excluded from replay digests
+            rec["ts"] = time.time()  # kt-lint: disable=nondeterminism-source
+            return rec
+    """, nondeterminism, relname="karpenter_tpu/utils/flightrecorder.py")
+    assert findings == []
+    assert len(report.suppressed) == 1
+
+
+# -- one-owner-constant -----------------------------------------------------
+_EXPLAIN_OWNER = """
+    EPS = 1e-3
+    KERNEL_CONSTRAINTS = ("capacity", "zone")
+    DELTA_FALLBACK_REASONS = frozenset(("grew", "shrunk"))
+    SHED_REASONS = ("admission", "deadline")
+    POOL_CAUSES = ("taint", "selector")
+"""
+
+
+def test_one_owner_flags_rebind_scalar_twin_and_vocab_twin(tmp_path):
+    findings, _ = _check_tree(tmp_path, {
+        "karpenter_tpu/solver/explain.py": _EXPLAIN_OWNER,
+        "karpenter_tpu/solver/bad.py": """
+            EPS = 1e-3
+            SLACK = 1e-3
+            REASONS = ("grew", "shrunk")
+        """,
+    }, one_owner)
+    msgs = " | ".join(f.message for f in findings)
+    assert "re-bound outside its owner" in msgs
+    assert "re-spells `EPS`'s value" in msgs
+    assert "`DELTA_FALLBACK_REASONS`'s value inline" in msgs
+
+
+def test_one_owner_flags_callable_reimplementation(tmp_path):
+    findings, _ = _check_tree(tmp_path, {
+        "karpenter_tpu/scheduling/types.py": """
+            def gang_trial_order(domains):
+                return sorted(domains)
+        """,
+        "karpenter_tpu/scheduling/other.py": """
+            def gang_trial_order(domains):
+                return list(domains)
+        """,
+    }, one_owner)
+    msgs = " | ".join(f.message for f in findings)
+    assert "re-implemented outside its owner" in msgs
+
+
+def test_one_owner_stale_registry_row_fails(tmp_path):
+    # the owner stopped binding SHED_REASONS: the row must fail exactly
+    # like a stale baseline entry, so the registry can never rot
+    owner = _EXPLAIN_OWNER.replace(
+        '    SHED_REASONS = ("admission", "deadline")\n', "")
+    findings, _ = _check_tree(tmp_path, {
+        "karpenter_tpu/solver/explain.py": owner,
+    }, one_owner)
+    assert len(findings) == 1
+    assert "stale" in findings[0].message
+    assert "SHED_REASONS" in findings[0].message
+
+
+def test_one_owner_aliases_and_imports_are_clean(tmp_path):
+    findings, _ = _check_tree(tmp_path, {
+        "karpenter_tpu/solver/explain.py": _EXPLAIN_OWNER,
+        "karpenter_tpu/solver/user.py": """
+            from karpenter_tpu.solver import explain
+            from karpenter_tpu.solver.explain import EPS as _EPS
+
+            EPS = explain.EPS
+            TOL = 2e-3
+            OTHER = ("alpha", "beta")
+        """,
+    }, one_owner)
+    assert findings == []
+
+
+def test_one_owner_suppression(tmp_path):
+    findings, report = _check_tree(tmp_path, {
+        "karpenter_tpu/solver/explain.py": _EXPLAIN_OWNER,
+        "karpenter_tpu/solver/frozen.py": """
+            REASONS = ("grew", "shrunk")  # kt-lint: disable=one-owner-constant
+        """,
+    }, one_owner)
+    assert findings == []
+    assert len(report.suppressed) == 1
+
+
+# -- counted-fallback -------------------------------------------------------
+_FALLBACK_BAD = """
+    class Spiller:
+        def write(self, rec):
+            try:
+                self._emit(rec)
+            except OSError:
+                self._spill_failed = True
+
+
+    def shed_request(req):
+        return None
+"""
+
+
+def test_counted_fallback_flags_silent_degrades(tmp_path):
+    findings, _ = _check(tmp_path, _FALLBACK_BAD, counted_fallback,
+                         relname="karpenter_tpu/solver/thing.py")
+    msgs = " | ".join(f.message for f in findings)
+    assert "degrades without counting" in msgs
+    assert "degrade helper `shed_request` counts nothing" in msgs
+
+
+def test_counted_fallback_counted_branches_are_clean(tmp_path):
+    findings, _ = _check(tmp_path, """
+        from karpenter_tpu.utils import metrics
+
+
+        class Spiller:
+            def write(self, rec):
+                try:
+                    self._emit(rec)
+                except OSError:
+                    metrics.SPILL_DEGRADED.inc(recorder="flight")
+                    self._spill_failed = True
+
+
+        def shed_request(req, sheds):
+            sheds["deadline"] = sheds.get("deadline", 0) + 1
+            return None
+
+
+        def drop_frame(state):
+            state.drop_count += 1
+            state.frame_dead = True
+    """, counted_fallback, relname="karpenter_tpu/service/thing.py")
+    assert findings == []
+
+
+def test_counted_fallback_scope(tmp_path):
+    findings, _ = _check(tmp_path, _FALLBACK_BAD, counted_fallback,
+                         relname="karpenter_tpu/controllers/node.py")
+    assert findings == []
+
+
+def test_counted_fallback_suppression(tmp_path):
+    findings, report = _check(tmp_path, """
+        class Auditor:
+            def disable(self):
+                self._audit_disabled = True  # kt-lint: disable=counted-fallback
+    """, counted_fallback, relname="karpenter_tpu/solver/thing.py")
+    assert findings == []
+    assert len(report.suppressed) == 1
+
+
+# -- the incremental result cache (ISSUE 18) --------------------------------
+_CACHED_SRC = ("import time\n"
+               "\n"
+               "\n"
+               "def f():\n"
+               "    return time.time()\n"
+               "\n"
+               "\n"
+               "def stamp():\n"
+               "    return time.time()  # kt-lint: disable=nondeterminism-source\n")
+
+
+def _cached_run(tmp_path, **kw):
+    return core.run([str(tmp_path)], root=str(tmp_path), baseline=[],
+                    rules=[nondeterminism], use_cache=True, **kw)
+
+
+def test_cache_warm_hit_replays_without_rerunning(tmp_path, monkeypatch):
+    p = tmp_path / "karpenter_tpu" / "solver" / "x.py"
+    p.parent.mkdir(parents=True)
+    p.write_text(_CACHED_SRC)
+    r1 = _cached_run(tmp_path)
+    assert len(r1.findings) == 1
+    assert len(r1.suppressed) == 1       # the suppression verdict caches too
+    assert os.path.exists(lint_cache.default_path(str(tmp_path)))
+
+    # a warm run replays the cached result without invoking the rule:
+    # poison it and rerun — same findings, no explosion
+    def boom(ctx):
+        raise AssertionError("cache miss: rule re-ran on unchanged file")
+    monkeypatch.setattr(nondeterminism, "check", boom)
+    r2 = _cached_run(tmp_path)
+    assert [f.to_dict() for f in r2.findings] == \
+        [f.to_dict() for f in r1.findings]
+    assert len(r2.suppressed) == 1
+
+
+def test_cache_content_change_invalidates(tmp_path):
+    p = tmp_path / "karpenter_tpu" / "solver" / "x.py"
+    p.parent.mkdir(parents=True)
+    p.write_text(_CACHED_SRC)
+    assert len(_cached_run(tmp_path).findings) == 1
+    p.write_text(_CACHED_SRC + "\n\ndef g():\n    return time.time()\n")
+    assert len(_cached_run(tmp_path).findings) == 2
+
+
+def test_cache_env_gate_disables(tmp_path, monkeypatch):
+    monkeypatch.setenv("KT_LINT_CACHE", "off")
+    p = tmp_path / "karpenter_tpu" / "solver" / "x.py"
+    p.parent.mkdir(parents=True)
+    p.write_text(_CACHED_SRC)
+    r = _cached_run(tmp_path)
+    assert len(r.findings) == 1
+    assert not os.path.exists(lint_cache.default_path(str(tmp_path)))
+
+
+def test_cache_program_pass_is_cached(tmp_path, monkeypatch):
+    for rel, src in {
+        "karpenter_tpu/solver/explain.py": _EXPLAIN_OWNER,
+        "karpenter_tpu/solver/bad.py": "REASONS = (\"grew\", \"shrunk\")\n",
+    }.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    r1 = core.run([str(tmp_path)], root=str(tmp_path), baseline=[],
+                  rules=[one_owner], use_cache=True)
+    assert len(r1.findings) == 1
+
+    def boom(ctxs, root=""):
+        raise AssertionError("program pass re-ran on an unchanged tree")
+    monkeypatch.setattr(one_owner, "check_program", boom)
+    r2 = core.run([str(tmp_path)], root=str(tmp_path), baseline=[],
+                  rules=[one_owner], use_cache=True)
+    assert [f.to_dict() for f in r2.findings] == \
+        [f.to_dict() for f in r1.findings]
+
+
+def test_cache_prunes_deleted_files_only(tmp_path):
+    d = tmp_path / "karpenter_tpu" / "solver"
+    d.mkdir(parents=True)
+    (d / "x.py").write_text(_CACHED_SRC)
+    (d / "y.py").write_text("VALUE = 1\n")
+    _cached_run(tmp_path)
+    with open(lint_cache.default_path(str(tmp_path))) as f:
+        assert set(json.load(f)["files"]) == \
+            {"karpenter_tpu/solver/x.py", "karpenter_tpu/solver/y.py"}
+    (d / "y.py").unlink()
+    # a SCOPED rerun over just x.py must not wipe other warm entries —
+    # prune is keyed on on-disk existence, not this run's analyzed set
+    core.run([str(d / "x.py")], root=str(tmp_path), baseline=[],
+             rules=[nondeterminism], use_cache=True)
+    with open(lint_cache.default_path(str(tmp_path))) as f:
+        assert set(json.load(f)["files"]) == {"karpenter_tpu/solver/x.py"}

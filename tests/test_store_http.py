@@ -290,3 +290,92 @@ class TestEnvironmentOnHttpBackend:
             c.name for c in env.cluster.nodeclaims.list()}
         be.close()
         env.close()
+
+
+class TestRelistRaceWindows:
+    """ISSUE 18 (kt-lint lock-discipline applied to HttpBackend): the
+    write RPCs run OUTSIDE any lock, so a 410 relist can interleave
+    with an own put or delete.  These tests drive the exact
+    interleavings deterministically by committing the racing write
+    between the relist's list GET and its diff (no real watcher thread:
+    the marker bookkeeping under test must hold without one)."""
+
+    def _backend(self, server, names):
+        b = HttpBackend(server.url)
+        for n in names:
+            b.put("pods", n, mkpod(n), verb="added")
+        with b._lock:
+            b._known["pods"] = set(names)
+        return b
+
+    def test_put_committing_during_relist_is_not_synthesized_deleted(
+            self, server):
+        # a create racing the list snapshot: its name is missing from
+        # the snapshot but present in _known by diff time.  Without the
+        # touched-window it would be synthesized into a DELETED event —
+        # and its real ADDED echo then swallowed by write-id
+        # suppression, losing the object for good.
+        b = self._backend(server, ["keep"])
+        orig = b._request
+        raced = []
+
+        def racy(method, path, body=None):
+            status, doc = orig(method, path, body)
+            if method == "GET" and path.endswith("/pods") and not raced:
+                raced.append(True)
+                assert b.put("pods", "fresh", mkpod("fresh"),
+                             verb="added")
+            return status, doc
+
+        b._request = racy
+        rv = b._relist_after_gap("pods")
+        assert rv > 0
+        evs = b.events()
+        assert ("pods", "deleted", "fresh", None) not in evs
+        assert "fresh" in b._known["pods"]
+        b.close()
+
+    def test_own_delete_completing_before_relist_drops_its_marker(
+            self, server):
+        # the delete's DELETED echo falls behind the relist resume
+        # horizon: the watcher will never consume the marker, and a
+        # lingering marker would swallow a PEER's later delete of the
+        # same name.  The diff must also not double-report the own
+        # delete as a synthesized DELETED.
+        b = self._backend(server, ["gone", "keep"])
+        b._watchers["pods"] = None  # marker path needs a live watcher
+        b.delete("pods", "gone")
+        assert b._pending_deletes[("pods", "gone")] > 0
+        rv = b._relist_after_gap("pods")
+        assert rv > 0
+        assert ("pods", "gone") not in b._pending_deletes
+        evs = b.events()
+        assert all(n != "gone" for _, _, n, _ in evs)
+        b.close()
+
+    def test_own_delete_committing_during_relist_keeps_its_marker(
+            self, server):
+        # the other order: the list snapshot predates the delete, so
+        # the DELETED echo is AHEAD of the resume horizon and the
+        # watcher WILL deliver it — the marker must survive the relist
+        # (or the echo would surface as a spurious peer delete), and
+        # the stale now-snapshot must not re-emit the mid-delete name.
+        b = self._backend(server, ["doomed", "keep"])
+        b._watchers["pods"] = None
+        orig = b._request
+        raced = []
+
+        def racy(method, path, body=None):
+            status, doc = orig(method, path, body)
+            if method == "GET" and path.endswith("/pods") and not raced:
+                raced.append(True)
+                b.delete("pods", "doomed")
+            return status, doc
+
+        b._request = racy
+        rv = b._relist_after_gap("pods")
+        assert rv > 0
+        assert b._pending_deletes[("pods", "doomed")] > rv
+        evs = b.events()
+        assert all(n != "doomed" for _, _, n, _ in evs)
+        b.close()

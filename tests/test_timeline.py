@@ -86,6 +86,10 @@ class TestRecorder:
     def test_cross_links_stamped(self, fresh_timeline):
         from karpenter_tpu.utils import flightrecorder
         from karpenter_tpu.utils.ledger import LEDGER
+        # the flight ring is module-global and NOT covered by the
+        # conftest autouse reset — clear it so the None-stamp assert
+        # below holds regardless of which test file ran before us
+        flightrecorder.RECORDER.reset()
         e = rec.emit(ev.PRICE_REFRESH)
         # empty neighbor rings stamp None, never a fake 0
         assert e.flight_seq is None and e.ledger_seq is None
@@ -337,3 +341,30 @@ class TestWaitSynced:
     def test_timeout_returns_false(self):
         from karpenter_tpu.cluster import Cluster
         assert Cluster().wait_synced(lambda: False, timeout=0.2) is False
+
+
+class TestTimelineSpillStitching:
+    """ISSUE 18: timeline directory loads stitch timeline-*.jsonl in
+    (mtime, name) order — a day of fleet life that spans an operator
+    restart replays as one stream."""
+
+    def _spill(self, tmp_path, name, names, mtime):
+        p = tmp_path / name
+        with open(p, "w") as f:
+            for n in names:
+                f.write(json.dumps({"kind": "pod.add", "name": n}) + "\n")
+        os.utime(p, (mtime, mtime))
+
+    def test_directory_load_stitches_oldest_first(self, tmp_path):
+        self._spill(tmp_path, "timeline-200.jsonl", ["c", "d"],
+                    mtime=2000.0)
+        self._spill(tmp_path, "timeline-100.jsonl", ["a", "b"],
+                    mtime=1000.0)
+        rows = rec.load_events(str(tmp_path))
+        assert [r["name"] for r in rows] == ["a", "b", "c", "d"]
+
+    def test_directory_load_ignores_foreign_prefixes(self, tmp_path):
+        self._spill(tmp_path, "timeline-1.jsonl", ["a"], mtime=1000.0)
+        self._spill(tmp_path, "flight-1.jsonl", ["zzz"], mtime=1000.0)
+        rows = rec.load_events(str(tmp_path))
+        assert [r["name"] for r in rows] == ["a"]

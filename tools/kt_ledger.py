@@ -44,23 +44,22 @@ def _filter(records, pool=None, since=None, limit=None):
 
 
 def load(path: str):
-    """Records from a spilled JSONL file, or the newest ledger-*.jsonl
-    in a directory.  A directory with no spills yet is the first-run
-    case — an EMPTY trail, reported as such ("no decisions yet" is an
-    answer, exit 0 per the module contract); a path that does not
-    exist at all is unusable input (exit 2), not a traceback."""
+    """Records from a spilled JSONL file, or EVERY ledger-*.jsonl in a
+    directory stitched oldest-first (a restarted operator leaves one
+    spill per pid — the trail is their union, not just the newest).  A
+    directory with no spills yet is the first-run case — an EMPTY
+    trail, reported as such ("no decisions yet" is an answer, exit 0
+    per the module contract); a path that does not exist at all is
+    unusable input (exit 2), not a traceback."""
     from karpenter_tpu.utils import ledger
     if os.path.isdir(path):
-        spills = sorted(
-            (os.path.join(path, f) for f in os.listdir(path)
-             if f.startswith("ledger-") and f.endswith(".jsonl")),
-            key=os.path.getmtime)
+        spills = [f for f in os.listdir(path)
+                  if f.startswith("ledger-") and f.endswith(".jsonl")]
         if not spills:
             print(f"kt-ledger: no ledger-*.jsonl under {path} yet — "
                   "no decisions recorded (was the operator run with "
                   "KARPENTER_TPU_LEDGER_DIR?)", file=sys.stderr)
             return []
-        path = spills[-1]
     try:
         return ledger.load_records(path)
     except OSError as e:
