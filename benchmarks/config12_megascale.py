@@ -1,0 +1,213 @@
+"""BASELINE config #12: megascale cold fleet — the speculative
+chunked G-axis chain's win (ISSUE 19).
+
+A cold fleet asks for ~500k pods across hundreds of distinct pod
+classes (640 classes x 737 pods by default) against the full generated
+catalog.  Every class exactly fills one node on the pods axis (737 =
+the largest type's pod capacity, zero daemon overhead), so the true
+scan is open-new-only — the shape where the speculative chain's
+projections commit and the chunks genuinely overlap.  Each pass solves
+the SAME input twice, in lockstep, spec-on vs spec-off (delta pinned
+off on both so every pass is a full solve, not a cache hit); both
+adaptive node-axis warm starts evolve identically, so the per-pass
+latencies compare apples to apples.
+
+The sequential story pays the full G bucket (640 classes -> a
+2048-step padded scan); the chain pays K chunk programs at one tier
+(5 x 128 by default) — the padded-step collapse is the win, and the
+seeded chunk program's per-step cost is the same as the plain
+program's at an equal node axis.
+
+Passes here are multi-second macro solves, so this bench runs fewer
+timed passes (default 5, env-overridable) than the micro benches'
+>=15-pass noise policy; min/p10/p50 land in the record either way.
+
+Shape knobs (bench-local, NOT solver knobs — see docs/operations.md
+for the KARPENTER_TPU_* registry): KT_BENCH_MEGASCALE_CLASSES,
+KT_BENCH_MEGASCALE_PASSES.
+
+Reported:
+  - `spec_parity`: per-pass node-count + IEEE-hex price equality
+    between the stories, plus one full canonical-result compare on the
+    warm pass (claims, assignments, stranded sets)
+  - zero silent divergences: every timed spec pass must land
+    outcome="spec" in karpenter_tpu_solver_spec_passes_total, and
+    karpenter_tpu_solver_spec_chunks_total must account every chunk
+    boundary as committed or repaired (committed + repaired ==
+    passes x (chunks - 1))
+
+Acceptance (ISSUE 19): spec-on full-solve p50 >= 3x faster than the
+sequential scan at the megascale shape.  `vs_baseline` =
+(p50_off / 3) / p50_on, so >= 1.0 means the bar is met.  Results land
+in BENCH_r13.json via the driver snapshot of this stdout line.
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_CLASSES = int(os.environ.get("KT_BENCH_MEGASCALE_CLASSES", "640"))
+PODS_PER_CLASS = 737          # exactly one full node on the pods axis
+PASSES = int(os.environ.get("KT_BENCH_MEGASCALE_PASSES", "5"))
+
+
+def build_pods():
+    from karpenter_tpu.models import ObjectMeta, Pod, Resources
+    pods = []
+    for g in range(N_CLASSES):
+        # distinct (cpu, mem) per class, every combination sized so the
+        # pods axis (737) binds before cpu (96000m) or memory
+        # (181862Mi) on the largest type — each class is one exactly
+        # full node, so no later class can in-flight fill it
+        cpu = 100 + (g % 31)
+        mem = 150 + (g % 97)
+        for i in range(PODS_PER_CLASS):
+            pods.append(Pod(meta=ObjectMeta(name=f"mg{g}-{i}"),
+                            requests=Resources.parse(
+                                {"cpu": f"{cpu}m", "memory": f"{mem}Mi"})))
+    return pods
+
+
+def canon(res):
+    return (sorted((c.nodepool, tuple(sorted(p.meta.name for p in c.pods)),
+                    tuple(c.instance_type_names), round(c.price, 9))
+                   for c in res.new_claims),
+            dict(res.existing_assignments), set(res.unschedulable))
+
+
+def pct(times, q):
+    return sorted(times)[max(0, int(round(q * len(times))) - 1)]
+
+
+def main():
+    # this bench pins both spec stories itself, and pins delta off on
+    # both solvers (a delta cache hit would turn the lockstep re-solve
+    # into a pure-reuse pass); an inherited "off" is the other benches'
+    # pin and not worth a warning
+    for knob in ("KARPENTER_TPU_SPEC", "KARPENTER_TPU_DELTA"):
+        if os.environ.pop(knob, "off").strip().lower() \
+                not in ("", "off"):
+            print(f"config12: ignoring exported {knob} "
+                  "(this bench pins both stories itself)", file=sys.stderr)
+    from karpenter_tpu.utils.platform import initialize, log_attempt
+    platform = initialize(attempt_log=log_attempt)
+    from karpenter_tpu.models import NodePool, ObjectMeta
+    from karpenter_tpu.providers import generate_catalog
+    from karpenter_tpu.scheduling import ScheduleInput
+    from karpenter_tpu.solver import TPUSolver
+    from karpenter_tpu.utils import metrics
+
+    catalog = generate_catalog()
+    pool = NodePool(meta=ObjectMeta(name="default"))
+    pods = build_pods()
+
+    def mkinput():
+        return ScheduleInput(pods=list(pods), nodepools=[pool],
+                             instance_types={"default": catalog})
+
+    on = TPUSolver(max_nodes=2048, mesh="off", delta="off", spec="on")
+    off = TPUSolver(max_nodes=2048, mesh="off", delta="off", spec="off")
+
+    # cold solves: compiles + the adaptive node-axis warm start.  The
+    # cold walls are recorded (they include XLA compile time, unlike
+    # the timed passes) but gated nowhere — CI hosts compile at wildly
+    # different speeds.
+    t0 = time.perf_counter()
+    r_on = on.solve(mkinput())
+    cold_on = (time.perf_counter() - t0) * 1e3
+    t0 = time.perf_counter()
+    r_off = off.solve(mkinput())
+    cold_off = (time.perf_counter() - t0) * 1e3
+    assert on.last_spec and on.last_spec["outcome"] == "spec", \
+        f"spec chain did not engage: {on.last_spec}"
+    chunks = int(on.last_spec["chunks"])
+
+    # one warm pass per story (retraces at the warm node bucket), with
+    # the full canonical-result parity check — the timed passes then
+    # compare node count + IEEE-hex price per pass
+    r_on = on.solve(mkinput())
+    r_off = off.solve(mkinput())
+    full_canon_parity = canon(r_on) == canon(r_off)
+
+    s0 = metrics.SOLVER_SPEC_PASSES.value(outcome="spec")
+    f0 = metrics.SOLVER_SPEC_PASSES.value(outcome="fallback")
+    c0 = metrics.SOLVER_SPEC_CHUNKS.value(outcome="committed")
+    rp0 = metrics.SOLVER_SPEC_CHUNKS.value(outcome="repaired")
+    on_ms, off_ms = [], []
+    spec_parity = full_canon_parity
+    for _ in range(PASSES):
+        t0 = time.perf_counter()
+        r_on = on.solve(mkinput())
+        on_ms.append((time.perf_counter() - t0) * 1e3)
+        t0 = time.perf_counter()
+        r_off = off.solve(mkinput())
+        off_ms.append((time.perf_counter() - t0) * 1e3)
+        if r_on.node_count() != r_off.node_count() or \
+                float(r_on.total_price()).hex() != \
+                float(r_off.total_price()).hex():
+            spec_parity = False
+    spec_passes = metrics.SOLVER_SPEC_PASSES.value(outcome="spec") - s0
+    fallbacks = metrics.SOLVER_SPEC_PASSES.value(outcome="fallback") - f0
+    committed = metrics.SOLVER_SPEC_CHUNKS.value(outcome="committed") - c0
+    repaired = metrics.SOLVER_SPEC_CHUNKS.value(outcome="repaired") - rp0
+
+    p50_on = statistics.median(on_ms)
+    p50_off = statistics.median(off_ms)
+    line = {
+        "metric": (f"config#12 megascale: {N_CLASSES * PODS_PER_CLASS} "
+                   f"cold pods ({N_CLASSES} classes), spec chain "
+                   f"({chunks} chunks) vs sequential scan"),
+        "value": round(p50_on, 1),
+        "unit": "ms",
+        "p50_ms": round(p50_on, 1),
+        # acceptance: spec-on full-solve p50 >= 3x the sequential scan
+        "vs_baseline": round((p50_off / 3.0) / p50_on, 3),
+        "platform": platform,
+        "passes": PASSES,
+        "pods": N_CLASSES * PODS_PER_CLASS,
+        "classes": N_CLASSES,
+        "chunks": chunks,
+        "spec_on_ms": {"min": round(min(on_ms), 1),
+                       "p10": round(pct(on_ms, 0.10), 1),
+                       "p50": round(p50_on, 1),
+                       "runs": [round(t, 1) for t in on_ms]},
+        "spec_off_ms": {"min": round(min(off_ms), 1),
+                        "p10": round(pct(off_ms, 0.10), 1),
+                        "p50": round(p50_off, 1),
+                        "runs": [round(t, 1) for t in off_ms]},
+        "cold_on_ms": round(cold_on, 1),
+        "cold_off_ms": round(cold_off, 1),
+        "speedup_p50": round(p50_off / p50_on, 2),
+        "speedup_min": round(min(off_ms) / min(on_ms), 2),
+        "spec_parity": spec_parity,
+        "parity": spec_parity,
+        "full_canon_parity": full_canon_parity,
+        "spec_passes": int(spec_passes),
+        "fallbacks": int(fallbacks),
+        "chunks_committed": int(committed),
+        "chunks_repaired": int(repaired),
+        "nodes": r_on.node_count(),
+    }
+    log_attempt({"stage": "config12", **line, "ts": time.time()})
+    print(json.dumps(line))
+    print(f"megascale: on p50={p50_on:.0f}ms off p50={p50_off:.0f}ms "
+          f"({p50_off / p50_on:.2f}x), spec_parity={spec_parity}, "
+          f"spec={int(spec_passes)}/{PASSES} fallbacks={int(fallbacks)}, "
+          f"chunks committed={int(committed)} repaired={int(repaired)}",
+          file=sys.stderr)
+    assert spec_parity, "spec chain diverged from the sequential scan"
+    assert fallbacks == 0, f"{fallbacks} silent spec fallbacks"
+    assert spec_passes == PASSES, \
+        f"only {int(spec_passes)}/{PASSES} timed passes engaged the chain"
+    # every chunk boundary is accounted: committed or counted-repaired
+    assert committed + repaired == PASSES * (chunks - 1), \
+        (f"unaccounted chunk boundaries: {int(committed)}+{int(repaired)} "
+         f"!= {PASSES}x{chunks - 1}")
+
+
+if __name__ == "__main__":
+    main()

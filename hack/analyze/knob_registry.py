@@ -106,6 +106,8 @@ KNOBS = {
         "owner": "karpenter_tpu/operator/options.py", "kind": "value"},
     "KARPENTER_TPU_SERVICE_TIMEOUT": {
         "owner": "karpenter_tpu/operator/options.py", "kind": "value"},
+    "KARPENTER_TPU_SPEC": {
+        "owner": "karpenter_tpu/solver/solve.py", "kind": "spec"},
     "KARPENTER_TPU_SPOT_RISK": {
         "owner": "karpenter_tpu/utils/knobs.py", "kind": "bool"},
     "KARPENTER_TPU_STORE_BACKEND": {

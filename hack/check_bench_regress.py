@@ -63,7 +63,12 @@ _PARITY_KEYS = ("parity", "pass", "nodes_le_oracle",
                 # (hack/determinism_harness.py --bench), a later false
                 # is nondeterminism introduced since — a build failure,
                 # not a perf note
-                "digest_stable")
+                "digest_stable",
+                # config12 (megascale spec chain, ISSUE 19): the
+                # spec-on vs spec-off node-count + IEEE-hex price
+                # parity boolean — a later false means a speculation
+                # divergence escaped the counted-repair discipline
+                "spec_parity")
 _NAME_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 
 

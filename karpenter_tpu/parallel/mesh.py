@@ -170,8 +170,13 @@ class MeshExecutor:
         self.gcol = NamedSharding(mesh, P(None, axis))
         # (kind, nbytes) per host→device commit of a COLUMN-AXIS array:
         # "catalog" (once per catalog identity), "mask-rows" (content
-        # deltas + table growth).  Per-solve problem buffers are not
-        # O-axis and are deliberately not logged here.
+        # deltas + table growth), "delta-seed" (one seed-colmask commit
+        # per suffix solve) and "spec-seed" (one per chunk of the
+        # speculative G-axis chain — the chain's ONLY per-chunk O-axis
+        # traffic; chunk programs themselves are cached in _progs by
+        # (layout, max_nodes) statics, so a K-chunk chain compiles at
+        # most one program per seed-pad tier).  Per-solve problem
+        # buffers are not O-axis and are deliberately not logged here.
         self.transfers: List[Tuple[str, int]] = []
         self._progs: Dict[tuple, object] = {}
 

@@ -763,3 +763,235 @@ def make_record(cat, enc: EncodedProblem, out: dict, inp
         node_fps=node_fps,
         res_anti_any=any(fp.res_anti for fp in node_fps),
         explain_counts=explain_counts)
+
+
+# ---------------------------------------------------------------------------
+# Speculative chunked G-axis pipeline (solver/solve.py _try_spec, ISSUE 19)
+# ---------------------------------------------------------------------------
+# The suffix replay above proves the scan can be re-entered mid-stream
+# from host-replayed state.  The speculative pipeline generalizes the
+# same discipline from "one suffix behind a cached prefix" to ARBITRARY
+# chunk boundaries of a single pass: cut the G axis into K chunks, solve
+# each as a seeded solve whose entry seed is the previous chunk's exit
+# state, and let chunk k+1 dispatch EARLY from a cheap open-new-only
+# projection of chunk k's exit (`project_chunk`).  When chunk k's true
+# output lands, `fold_chunk` materializes the bit-exact exit seed (used
+# and pool straight from the kernel; exist_remaining and colmask by the
+# same op-for-op float32 replay `build` performs) and `seed_digest`
+# compares it against what the speculation dispatched — equal digests
+# mean the in-flight successor consumed IDENTICAL kernel inputs, so its
+# result is the sequential scan's by construction; unequal digests cost
+# one counted re-dispatch, never correctness.
+
+# below this many pod classes a chunked pass can't beat the single
+# program (the smallest split still pays an extra dispatch + seed
+# replay); "auto" mode declines, "on" forces (tests, benches)
+SPEC_MIN_GROUPS = 129
+
+
+@dataclass
+class ChunkSeed:
+    """Mid-scan kernel state at a chunk boundary — exactly the seed
+    operand set of `solve_ffd_delta` (plus the consumed
+    exist_remaining, which rides the problem tuple).  Two ChunkSeeds
+    with equal `seed_digest` produce bit-identical seeded solves."""
+    er: np.ndarray       # [E, R] f32 — exist_remaining after the prefix
+    used: np.ndarray     # [A, R] f32
+    pool: np.ndarray     # [A] i32
+    colmask: np.ndarray  # [A, O_real] bool
+    A: int               # open node slots so far
+
+
+def chunk_entry_seed(enc: EncodedProblem) -> ChunkSeed:
+    """The scan's initial state: no open nodes, untouched existing
+    capacity — chunk 0's entry seed."""
+    O_real = enc.group_mask.shape[1]
+    return ChunkSeed(
+        er=enc.exist_remaining.copy(),
+        used=np.zeros((0, R), dtype=np.float32),
+        pool=np.zeros(0, dtype=np.int32),
+        colmask=np.zeros((0, O_real), dtype=bool), A=0)
+
+
+def _chunk_feas(enc: EncodedProblem, cat, g: int, cache: dict):
+    """`_feas_row`'s chunk-boundary twin — the kernel's open-new column
+    feasibility (group_mask ∧ one-pod-fits) PLUS the per-column fit
+    vector, for group `g` of a LIVE encoding (no DeltaRecord: the spec
+    path seeds from the pass's own enc).  Cached per group index —
+    fold and project both consult it, and a repair re-folds the same
+    groups."""
+    hit = cache.get(g)
+    if hit is None:
+        fit = _np_fit_count(cat.col_alloc - cat.col_daemon,
+                            enc.group_req[g])
+        hit = (enc.group_mask[g] & (fit >= 1), fit)
+        cache[g] = hit
+    return hit
+
+
+def _apply_pt_capacity(colmask: np.ndarray, used: np.ndarray, cat
+                       ) -> np.ndarray:
+    """The kernel's pt-granular capacity mask against a used matrix:
+    colmask ∧ (every resource of the (pool,type) block still fits).
+    Applied to the FINAL used rows — the kernel re-applies it every
+    step, but used only grows, so the last application is the binding
+    one (same argument as build())."""
+    n, O_real = colmask.shape
+    if n == 0:
+        return colmask
+    zc = max(cat.zc, 1)
+    PT = O_real // zc
+    ok_pt = np.all(
+        cat.pt_alloc[None, :, :] - used[:, None, :] >= -EPS,
+        axis=-1)                                         # [n, PT]
+    return colmask & np.broadcast_to(
+        ok_pt[:, :, None], (n, PT, zc)).reshape(n, O_real)
+
+
+def fold_chunk(seed: ChunkSeed, enc: EncodedProblem, cat, lo: int,
+               hi: int, out: dict, feas_cache: dict
+               ) -> "ChunkSeed | None":
+    """The TRUE exit state of groups [lo, hi) given the chunk's kernel
+    output: `used`/`pool` come straight from the kernel (bit-exact, no
+    replay), `exist_remaining` and the surviving-column masks replay
+    host-side with build()'s op-for-op float32 discipline.  Returns
+    None when the output violates a replay invariant (every active
+    node opened by some group, openers monotone) — the caller falls
+    back whole, counted."""
+    req = enc.group_req
+    Gd = hi - lo
+    E = seed.er.shape[0]
+    O_real = len(cat.columns)
+
+    # exist_remaining: same per-group order and the same two ops
+    # (product, subtract) as the kernel's scan step
+    er = seed.er.copy()
+    if E:
+        te = np.asarray(out["take_exist"], dtype=np.float32)
+        for j in range(Gd):
+            row = te[j, :E]
+            if row.any():
+                er -= row[:, None] * req[lo + j]
+
+    na = int(out["num_active"])
+    A0 = seed.A
+    if na < A0:
+        return None  # the kernel never closes a slot: replay invariant
+    used = np.ascontiguousarray(
+        np.asarray(out["used"])[:na], dtype=np.float32)
+    node_pool = np.ascontiguousarray(
+        np.asarray(out["node_pool"])[:na], dtype=np.int32)
+    tn = np.asarray(out["take_new"], dtype=np.float32)[:Gd, :na]
+
+    colmask = np.zeros((na, O_real), dtype=bool)
+    colmask[:A0] = seed.colmask
+    opener_full = np.full(na, -1, dtype=np.int64)
+    if na > A0:
+        nz = tn[:, A0:] > 0
+        if not nz.any(axis=0).all():
+            return None  # an active node nobody filled
+        opener = nz.argmax(axis=0)
+        if (np.diff(opener) < 0).any():
+            return None  # node order not monotone in opener group
+        opener_full[A0:] = opener
+        # opener colmask base: cols_p of the opening group ∩ the
+        # node's pool (the kernel's step-3 new_colmask, pre-capacity)
+        for gi in np.unique(opener):
+            feas, _ = _chunk_feas(enc, cat, lo + int(gi), feas_cache)
+            sel = np.zeros(na, dtype=bool)
+            sel[A0:] = opener == gi
+            colmask[sel] = (feas[None, :]
+                            & (cat.col_pool[None, :]
+                               == node_pool[sel, None]))
+    for j in range(Gd):
+        touched = (tn[j] > 0) & (opener_full != j)
+        if touched.any():
+            # in-flight touch narrows the mask to the group's columns
+            colmask[touched] &= enc.group_mask[lo + j][None, :]
+    colmask = _apply_pt_capacity(colmask, used, cat)
+    return ChunkSeed(er=er, used=used, pool=node_pool,
+                     colmask=colmask, A=na)
+
+
+def project_chunk(seed: ChunkSeed, enc: EncodedProblem, cat, lo: int,
+                  hi: int, max_nodes: int, feas_cache: dict
+                  ) -> "ChunkSeed | None":
+    """SPECULATED exit state of groups [lo, hi): the open-new-only
+    greedy lower bound — every group opens fresh nodes on its first
+    feasible pool, mirroring the kernel's step-3 arithmetic exactly
+    (same fit counts, same ceil-split node fan-out, same float32
+    daemon+k·req order), and predicts NO existing-node or in-flight
+    fills.  When the true scan also places open-new-only (the cold
+    megascale shape), the projection is bit-exact and the speculation
+    commits; any fill it failed to predict surfaces as a digest
+    mismatch and a counted repair — a wrong guess can cost latency,
+    never correctness.  Returns None to DECLINE speculating (existing
+    capacity would absorb pods, no feasible pool, node slots
+    exhausted): the chain then waits for the true seed."""
+    req = enc.group_req
+    E = seed.er.shape[0]
+    O_real = len(cat.columns)
+    P = len(cat.pools)
+    opened_used: List[np.ndarray] = []
+    opened_pool: List[np.ndarray] = []
+    opened_mask: List[np.ndarray] = []
+    opened = 0
+    for g in range(lo, hi):
+        cnt = int(enc.group_count[g])
+        if cnt <= 0:
+            continue
+        if E:
+            ecap = enc.exist_cap[g]
+            if ecap.any():
+                cap_e = np.minimum(_np_fit_count(seed.er, req[g]), ecap)
+                if (cap_e > 0).any():
+                    return None  # step 1 would fill an existing node
+        feas, fit = _chunk_feas(enc, cat, g, feas_cache)
+        cols_p = None
+        for p in range(P):
+            sel = feas & (cat.col_pool == p)
+            if sel.any():
+                cols_p = sel
+                break
+        if cols_p is None:
+            return None  # would strand — let the true solve decide
+        k_full = int(fit[cols_p].max())
+        m = -(-cnt // k_full)
+        if seed.A + opened + m > max_nodes:
+            return None  # slot budget: the truth cascades or strands
+        k_node = np.full(m, k_full, dtype=np.int64)
+        k_node[m - 1] = cnt - (m - 1) * k_full
+        # the kernel's new_used: pool_daemon[p] + k·req, k cast to f32
+        # BEFORE the product — same operand order, same rounding
+        prod = k_node.astype(np.float32)[:, None] * req[g][None, :]
+        opened_used.append(cat.pool_daemon[p][None, :] + prod)
+        opened_pool.append(np.full(m, p, dtype=np.int32))
+        opened_mask.append(np.repeat(cols_p[None, :], m, axis=0))
+        opened += m
+    if not opened:
+        return ChunkSeed(er=seed.er, used=seed.used, pool=seed.pool,
+                         colmask=seed.colmask, A=seed.A)
+    used_new = np.concatenate(opened_used).astype(np.float32)
+    mask_new = _apply_pt_capacity(
+        np.concatenate(opened_mask), used_new, cat)
+    return ChunkSeed(
+        er=seed.er,  # no exist fills predicted (declined above)
+        used=np.concatenate([seed.used, used_new]),
+        pool=np.concatenate([seed.pool, np.concatenate(opened_pool)]),
+        colmask=np.concatenate([seed.colmask, mask_new]),
+        A=seed.A + opened)
+
+
+def seed_digest(seed: ChunkSeed) -> bytes:
+    """Value fingerprint of a chunk-boundary seed: equal digests ⇒ the
+    seeded solves they feed consume bit-identical operands ⇒ identical
+    outputs (the kernel is deterministic) — the commit-time check that
+    makes a committed speculation exact BY CONSTRUCTION."""
+    import hashlib
+    h = hashlib.blake2b(digest_size=16)
+    h.update(np.int64(seed.A).tobytes())
+    h.update(np.ascontiguousarray(seed.er).tobytes())
+    h.update(np.ascontiguousarray(seed.used).tobytes())
+    h.update(np.ascontiguousarray(seed.pool).tobytes())
+    h.update(np.packbits(seed.colmask).tobytes())
+    return h.digest()

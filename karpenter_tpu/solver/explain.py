@@ -252,6 +252,16 @@ DELTA_FALLBACK_REASONS = frozenset((
     # of an earlier-stranded higher band
     "priority", "preempt"))
 
+# speculative-chunk seam fallback vocabulary (solver/solve.py
+# _spec_fallback, ISSUE 19): same registry discipline as the delta
+# seam's — every non-engaged spec pass names one of these.  A subset of
+# the delta vocabulary plus nothing new: the spec path's exactness
+# gates are the delta path's (topology-free, limit-free, single-band,
+# gang-free) applied to the live encoding instead of a cached record
+SPEC_FALLBACK_REASONS = frozenset((
+    "small", "bucket", "topology", "shape", "gang", "priority",
+    "price-cap", "limits", "slots", "stranded", "seed"))
+
 # tenant-scheduler shed vocabulary (service/scheduler.py)
 SHED_ADMISSION = "admission"
 SHED_DEADLINE = "deadline"

@@ -122,3 +122,80 @@ def run_pipeline(items: Iterable, dispatch: Callable, complete: Callable,
         pending = (item, handle)
     if pending is not None:
         complete(*pending)
+
+
+# -- speculative chunked G-axis chain (ISSUE 19) ------------------------
+
+# in-flight speculation slots: chunk k's device step can cover at most
+# depth-1 speculative dispatches ahead of it, so deeper windows only
+# add wasted work on a mispredict — two ahead already hides the host
+# projection + pack + upload of the successors behind the device step
+SPEC_DEPTH = 3
+
+
+def run_spec_chain(n: int, seed0, dispatch: Callable, project: Callable,
+                   commit: Callable, match: Callable,
+                   depth: int = SPEC_DEPTH):
+    """The two-stage pipeline generalized to a K-deep chain of SEEDED
+    solves with speculate-and-repair (the G-axis chunk pipeline).
+
+    - ``dispatch(k, seed) -> handle`` enqueues chunk ``k``'s seeded
+      solve from entry state ``seed`` (async — must not block).
+    - ``project(k, seed) -> seed | None`` speculates chunk ``k``'s EXIT
+      state from its entry, so chunk ``k+1`` can dispatch before ``k``
+      commits; ``None`` declines (the chain stalls until truth).
+    - ``commit(k, seed, handle) -> seed | None`` blocks for chunk
+      ``k``'s output and returns its TRUE exit state; ``None`` aborts
+      the whole chain (replay invariant violation, stranded pods —
+      the caller falls back to the sequential program, counted).
+    - ``match(speculated, true) -> bool`` is the bit-exact seed
+      fingerprint comparison.
+
+    Returns ``(ok, outcomes)`` — ``outcomes`` has one entry per chunk
+    AFTER the first: ``"committed"`` when the successor's speculated
+    entry matched the true exit (its in-flight solve IS the sequential
+    program's, by construction), ``"repaired"`` when it diverged or
+    speculation was declined and the successor (re-)dispatched from
+    the true seed.  Every divergence flushes ALL in-flight successors
+    — their entries derive from the wrong state — so the worst case
+    (every speculation wrong) degrades to the sequential chain plus
+    the abandoned dispatches' latency, bit-exactly.
+    """
+    from collections import deque
+    depth = max(depth, 1)
+    inflight: deque = deque()   # (k, entry_seed, handle)
+    outcomes: List[str] = []
+    next_k, next_entry = 0, seed0
+    while next_k < n or inflight:
+        while (next_k < n and len(inflight) < depth
+               and next_entry is not None):
+            inflight.append((next_k, next_entry,
+                             dispatch(next_k, next_entry)))
+            entry = next_entry
+            next_k += 1
+            next_entry = (project(next_k - 1, entry)
+                          if next_k < n else None)
+        k, entry, handle = inflight.popleft()
+        true_exit = commit(k, entry, handle)
+        if true_exit is None:
+            return False, outcomes
+        if k + 1 < n:
+            if inflight:
+                # chunk k+1 is in flight on a speculated entry
+                if match(inflight[0][1], true_exit):
+                    outcomes.append("committed")
+                else:
+                    # divergence: every in-flight successor chains off
+                    # the wrong state — flush them all and re-dispatch
+                    # from the truth (the counted repair)
+                    outcomes.append("repaired")
+                    inflight.clear()
+                    next_k, next_entry = k + 1, true_exit
+            else:
+                # speculation declined (or the window drained): the
+                # successor never ran ahead — sequential for this
+                # boundary, counted with the repairs so committed +
+                # repaired always sums to chunks - 1
+                outcomes.append("repaired")
+                next_entry = true_exit
+    return True, outcomes

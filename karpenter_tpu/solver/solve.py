@@ -32,6 +32,7 @@ from karpenter_tpu.solver.encode import (
     EncodedProblem,
     SharedExistEncoding,
     Unsupported,
+    _has_required_anti,
     _np_fit_count,
     bucket,
     encode,
@@ -42,6 +43,11 @@ from karpenter_tpu.utils import knobs as _knobs
 R = len(RESOURCE_AXIS)
 
 G_BUCKETS = (1, 4, 8, 16, 32, 128, 512, 2048)
+
+# chunk-count soft cap for the speculative G-axis planner: more
+# chunks collapse more padding waste but pay a dispatch each and
+# deepen a worst-case repair cascade
+SPEC_MAX_CHUNKS = 8
 
 # synthetic claim hostnames, interned: the decode loop stamps one per
 # active node per solve, and the f-string format was a measurable slice
@@ -73,7 +79,8 @@ class UnsupportedPods(Exception):
 
 
 class TPUSolver:
-    def __init__(self, max_nodes: int = 1024, mesh="auto", delta="auto"):
+    def __init__(self, max_nodes: int = 1024, mesh="auto", delta="auto",
+                 spec="auto"):
         """`mesh` selects the multi-chip story (SURVEY §2.3: shard the
         column axis over ICI):
 
@@ -101,6 +108,15 @@ class TPUSolver:
         spec, exactly like KARPENTER_TPU_MESH — it is the operator's
         rollback lever and must beat code defaults wherever the solver
         was built; malformed values degrade to the constructed spec.
+
+        ``spec`` selects the speculative chunked G-axis pipeline
+        (ISSUE 19, _try_spec): "auto" (default) chunks cold/heavy
+        passes with at least ``delta.SPEC_MIN_GROUPS`` pod classes;
+        "on" forces chunking regardless of size (tests, benches);
+        "off"/None disables.  The env knob
+        ``KARPENTER_TPU_SPEC=on/off/auto`` OVERRIDES the constructed
+        spec — same grammar, same rollback discipline as the mesh and
+        delta knobs; malformed values degrade to the constructed spec.
         """
         self.max_nodes = max_nodes
         # relaxation-loop wall-clock budget (seconds; None = unbounded,
@@ -142,6 +158,13 @@ class TPUSolver:
         self._delta_spec = delta
         self._delta_resolved = None
         self._delta_cache = _deltamod.SolveCache()
+        # speculative chunked G-axis pipeline (ISSUE 19): knob spec +
+        # per-pass introspection (kt tools / tests read last_spec; the
+        # flight record stamps the resolved knob and the chunk count)
+        self._spec_spec = spec
+        self._spec_resolved = None
+        self._last_spec_chunks = 0
+        self.last_spec: Optional[Dict] = None
         # per-solve host/device phase breakdown (ms), refreshed by
         # _solve_attempt — the observability the north-star budget needs
         # (encode+decode host share must stay well under the solve time)
@@ -244,6 +267,41 @@ class TPUSolver:
             else:
                 self._delta_resolved = ("auto",)
         return self._delta_resolved[0]
+
+    @staticmethod
+    def _spec_env_spec(spec):
+        """Apply the KARPENTER_TPU_SPEC rollback knob: "off"/"0" forces
+        the single sequential program, "on" forces the chunked chain
+        (no min-size gate), "auto" restores the default gating; unset
+        or malformed leaves the constructed spec alone (the
+        _delta_env_spec grammar, owned here — kt-lint's knob registry
+        points at this file)."""
+        import os as _os
+        raw = _os.environ.get("KARPENTER_TPU_SPEC", "").strip().lower()
+        if not raw:
+            return spec
+        if raw in ("off", "0", "false", "none"):
+            return None
+        if raw in ("on", "1", "true", "yes"):
+            return "on"
+        if raw == "auto":
+            return "auto"
+        return spec
+
+    def _resolve_spec(self):
+        """The speculative-chunking mode for this solver: False
+        (disabled), "auto" (min-size gated), or "on" (forced) —
+        resolved once, a restart-time operator lever like the
+        mesh/delta knobs."""
+        if self._spec_resolved is None:
+            spec = self._spec_env_spec(self._spec_spec)
+            if spec in (None, 0, False, "off", ""):
+                self._spec_resolved = (False,)
+            elif spec == "on":
+                self._spec_resolved = ("on",)
+            else:
+                self._spec_resolved = ("auto",)
+        return self._spec_resolved[0]
 
     def _explain_mode(self) -> int:
         """The resolved KARPENTER_TPU_EXPLAIN mode (0/1/2) — explain.py
@@ -1022,6 +1080,11 @@ class TPUSolver:
                 # pin it so gang solves reproduce bit-for-bit even when
                 # the replaying shell's env disagrees
                 "gang": _knobs.gang_enabled(),
+                # resolved spec knob + this attempt's chunk count (0 on
+                # any non-chunked path): kt_replay/kt_explain pin
+                # spec=off so the replay baseline stays single-program
+                "spec": (self._resolve_spec() or "off"),
+                "spec_chunks": self._last_spec_chunks,
             },
             phase_ms={k: round(v, 3)
                       for k, v in self.last_phase_ms.items()},
@@ -1081,12 +1144,15 @@ class TPUSolver:
         )
 
     def _run_delta(self, prob16, seeds, seed_colmask, dev, mn: int,
-                   mbits: bool):
+                   mbits: bool, kind: str = "delta-seed"):
         """Dispatch one seeded delta solve — shared verbatim by
-        _try_delta and warmup(delta_shapes=...), the same
-        no-drift discipline as _make_run.  `prob16` carries the DENSE
-        group mask (slot 2); packing happens here so the mesh branch
-        can feed the registry the dense rows."""
+        _try_delta, _try_spec (per-chunk dispatch) and
+        warmup(delta_shapes=...), the same no-drift discipline as
+        _make_run.  `prob16` carries the DENSE group mask (slot 2);
+        packing happens here so the mesh branch can feed the registry
+        the dense rows.  `kind` labels the seed-mask transfer in the
+        executor's residency log ("delta-seed" for suffix solves,
+        "spec-seed" for chunk-chain solves — one transfer per chunk)."""
         # delta aux is clamped to counts: the suffix's [G, O] full map
         # would stitch against prefix rows that never had one (and the
         # mesh form is counts-only anyway)
@@ -1097,11 +1163,10 @@ class TPUSolver:
             rows, table = dev["mask_registry"].ensure(prob16[2])
             prob = prob16[:2] + (rows,) + prob16[3:] + seeds
             buf, layout = ffd.pack_problem(prob)
-            # the one per-delta-solve O-axis transfer: the seed column
-            # masks, committed pre-partitioned and LOGGED (kind
-            # "delta-seed") so the residency accounting stays honest
-            cm = ex.put_sharded(seed_colmask, _P(None, ex.axis),
-                                "delta-seed")
+            # the one per-seeded-solve O-axis transfer: the seed column
+            # masks, committed pre-partitioned and LOGGED so the
+            # residency accounting stays honest
+            cm = ex.put_sharded(seed_colmask, _P(None, ex.axis), kind)
             return ex.solve_delta(buf, cm, table, dev, layout, mn,
                                   explain=exc)
         if mbits:
@@ -1265,6 +1330,320 @@ class TPUSolver:
                 consumed=getattr(self, "_delta_consumed", None))
             self._delta_consumed = None
 
+    # -- speculative chunked G-axis pipeline (ISSUE 19) --------------------
+
+    def _spec_fallback(self, reason: str) -> None:
+        """Count one non-chunked pass through the spec seam — same
+        no-silent-fallbacks discipline as _delta_fallback, same
+        registry-owned reason vocabulary (explain.py
+        SPEC_FALLBACK_REASONS)."""
+        assert reason in explainmod.SPEC_FALLBACK_REASONS, reason
+        self.last_spec = {"outcome": "fallback", "reason": reason}
+        metrics.SOLVER_SPEC_PASSES.inc(outcome="fallback")
+        return None
+
+    def _spec_repair_count(self, outcomes) -> None:
+        """Publish the chain's per-chunk speculation verdicts: every
+        chunk after the first either committed (the speculated seed
+        matched the true exit bit-for-bit) or repaired (a counted
+        re-dispatch from the true seed) — the megascale bench's
+        zero-UNcounted-divergences condition reads this counter."""
+        for oc in outcomes:
+            metrics.SOLVER_SPEC_CHUNKS.inc(outcome=oc)
+
+    @staticmethod
+    def _plan_spec_chunks(n_groups: int, mode):
+        """Chunk the G axis into contiguous [lo, hi) ranges, every
+        chunk snapped to ONE G_BUCKETS padding tier below the full
+        problem's (all chunks share a single seeded program per A_pad
+        tier; the ragged tail pads to the same tier).  Gang and
+        priority-band splits never arise at a boundary — the seam's
+        whole-problem gates fall back (counted) before planning runs,
+        so a boundary can only land between independent pod classes.
+        The tier is the SMALLEST bucket keeping the chunk count within
+        SPEC_MAX_CHUNKS — the scan's cost is linear in padded steps,
+        so K x cb beats the full bucket by the padding waste collapsed
+        (600 classes: 5 x 128 = 640 padded steps vs the sequential
+        2048), while the cap bounds per-chunk dispatch overhead and
+        repair-cascade depth.  Returns a registry reason string when
+        chunking can't win: "small" below the auto-mode floor,
+        "bucket" when no tier below the full problem's bucket
+        exists."""
+        from karpenter_tpu.solver import delta as deltam
+        if mode != "on" and n_groups < deltam.SPEC_MIN_GROUPS:
+            return "small"
+        gb = bucket(n_groups, G_BUCKETS)
+        cb = 0
+        for b in G_BUCKETS:
+            if b < gb and -(-n_groups // b) <= SPEC_MAX_CHUNKS:
+                cb = b
+                break
+        if cb == 0:
+            for b in G_BUCKETS:
+                if b < gb:
+                    cb = b  # soft cap unreachable: largest tier wins
+        if cb < 1 or -(-n_groups // cb) < 2:
+            return "bucket"
+        return [(lo, min(lo + cb, n_groups))
+                for lo in range(0, n_groups, cb)]
+
+    def _spec_problem_args(self, enc, lo: int, hi: int,
+                           er: np.ndarray, G: int, E: int, Db: int,
+                           O: int):
+        """One chunk's padded kernel arguments — _delta_problem_args'
+        layout (same slots, dtypes, pad and inactive-encoder values),
+        built from the LIVE encoding's rows [lo, hi) plus the entry
+        seed's consumed exist_remaining.  Substituting the inert
+        topology constants is sound for exactly the delta path's
+        reason: the seam engages only when every group's topology
+        tensors are already inactive (gated, counted)."""
+        Gd = hi - lo
+        D = enc.n_domains
+        return (
+            self._pad(enc.group_req[lo:hi], 0, G),
+            self._pad(enc.group_count[lo:hi], 0, G),
+            self._pad(self._pad(enc.group_mask[lo:hi], 1, O), 0, G),
+            self._pad(self._pad(enc.exist_cap[lo:hi], 1, E), 0, G),
+            self._pad(er, 0, E),
+            enc.pool_limit,
+            self._pad(np.full(Gd, BIG, dtype=np.int32), 0, G),
+            np.zeros(G, dtype=np.int32),
+            np.zeros((G, Db), dtype=np.int32),
+            self._pad(self._pad(
+                np.full((Gd, D), BIG, dtype=np.int32), 1, Db), 0, G),
+            self._pad(np.full(Gd, BIG, dtype=np.int32), 0, G),
+            np.zeros(G, dtype=np.int32),
+            np.zeros((G, Db), dtype=bool),
+            np.zeros(G, dtype=bool),
+            np.zeros(G, dtype=bool),  # group_gang (spec: gang-free
+                                      # by contract — the seam gates)
+            self._pad(enc.exist_zone, 0, E, value=-1),
+            self._pad(enc.exist_ct, 0, E, value=-1),
+        )
+
+    def _try_spec(self, inp: ScheduleInput, cat, enc, groups,
+                  wall0: float, t0: float) -> Optional[ScheduleResult]:
+        """The speculative chunked G-axis pipeline: cut the scan into K
+        seeded chunk solves and run them as a pipelined chain —
+        chunk k+1 dispatches from a SPECULATED exit seed (the
+        open-new-only greedy projection) while chunk k is still on
+        device; commit compares the speculation against the true
+        replayed exit state bit-for-bit and any divergence re-solves
+        the suffix chunk from the truth (counted), so the stitched
+        program is bit-identical to the sequential scan by
+        construction.  Returns None on any conservative fallback
+        (counted) — the caller then runs the ordinary single-program
+        path.  The exactness gates are the delta seam's, applied to
+        the live encoding: topology-free, gang-free, single band, no
+        price cap, no finite limits (a pool limit consumed by a
+        speculated prefix has no exact host replay — the chunk-
+        boundary hazard tests pin each of these)."""
+        self._last_spec_chunks = 0
+        self.last_spec = None
+        mode = self._resolve_spec()
+        if not mode:
+            return None
+        from karpenter_tpu.scheduling.types import priority_of
+        from karpenter_tpu.solver import delta as deltam
+        G = enc.n_groups
+        if enc.group_gang is not None and \
+                np.asarray(enc.group_gang)[:G].any():
+            return self._spec_fallback("gang")
+        if len({priority_of(g[0]) for g in enc.groups}) > 1:
+            return self._spec_fallback("priority")
+        if inp.price_cap is not None:
+            return self._spec_fallback("price-cap")
+        if any(lim is not None
+               for lim in (inp.remaining_limits or {}).values()):
+            return self._spec_fallback("limits")
+        if (enc.group_dsel[:G] != 0).any():
+            return self._spec_fallback("topology")
+        if any(g[0].topology_spread or g[0].pod_affinities
+               or g[0].preferences for g in enc.groups):
+            return self._spec_fallback("topology")
+        if any(_has_required_anti(en.pods) for en in enc.existing):
+            return self._spec_fallback("topology")
+        if (enc.group_ncap[:G] < BIG).any() or \
+                enc.group_whole_node[:G].any():
+            return self._spec_fallback("shape")
+        if any(v is not None for d in enc.static_allowed
+               for v in d.values()):
+            return self._spec_fallback("shape")
+        if any(en.charge_pool is not None for en in enc.existing):
+            return self._spec_fallback("shape")
+        chunks = self._plan_spec_chunks(G, mode)
+        if isinstance(chunks, str):
+            return self._spec_fallback(chunks)
+        import time as _time
+        K = len(chunks)
+        # the chain rides the same node-axis warm start as the plain
+        # path: step cost scales ~linearly with N, so chunking at the
+        # full ceiling while the sequential program runs at its warm
+        # bucket would hand back the whole padded-step win.  The ladder's
+        # mid-chain redo machinery has no seeded equivalent — slot
+        # exhaustion aborts the chain as a counted "slots" fallback and
+        # the plain path's own exhaustion retry takes over
+        mn = self._adaptive_max_nodes()
+        Gp = chunks[0][1] - chunks[0][0]  # the planner's bucket tier
+        E_real = len(enc.existing)
+        E = bucket(E_real, E_BUCKETS)
+        Db = bucket(enc.n_domains, D_BUCKETS)
+        dev = cat.device_args
+        mbits = self._mask_packed()
+        O_real = len(cat.columns)
+        exc = min(self._explain_kernel_mode(), 1)
+        t1 = _time.perf_counter()
+        feas: Dict[int, tuple] = {}
+        outs: List[Optional[dict]] = [None] * K
+        disp_s = dev_s = pull_s = repair_s = 0.0
+        abort = [None]
+        seen: set = set()
+        repair_ks: set = set()
+
+        def dispatch(k, seed):
+            nonlocal disp_s, repair_s
+            if k in seen:
+                repair_ks.add(k)
+            seen.add(k)
+            lo, hi = chunks[k]
+            t_a = _time.perf_counter()
+            prob16 = self._spec_problem_args(enc, lo, hi, seed.er, Gp,
+                                             E, Db, dev["O"])
+            A_pad = min(bucket(max(seed.A, 1), deltam.SEED_BUCKETS), mn)
+            seeds = (self._pad(seed.used, 0, mn),
+                     self._pad(seed.pool, 0, mn),
+                     np.arange(mn) < seed.A)
+            cm = np.zeros((A_pad, dev["O"]), dtype=bool)
+            cm[:seed.A, :O_real] = seed.colmask
+            faults.fire("solver.dispatch")
+            handle = self._run_delta(prob16, seeds, cm, dev, mn, mbits,
+                                     kind="spec-seed")
+            d = _time.perf_counter() - t_a
+            if k in repair_ks:
+                repair_s += d
+            else:
+                disp_s += d
+            return handle
+
+        def commit(k, seed, handle):
+            nonlocal dev_s, pull_s, repair_s
+            lo, hi = chunks[k]
+            Gd = hi - lo
+            t_a = _time.perf_counter()
+            try:
+                handle.block_until_ready()
+            except AttributeError:
+                pass
+            t_b = _time.perf_counter()
+            out = ffd.unpack(np.array(handle), Gp, E, mn, R, Db,
+                             explain=exc)
+            t_c = _time.perf_counter()
+            if k in repair_ks:
+                repair_s += t_c - t_a
+            else:
+                dev_s += t_b - t_a
+                pull_s += t_c - t_b
+            if out["unsched"][:Gd].sum() > 0:
+                abort[0] = ("slots" if int(out["num_active"]) >= mn
+                            else "stranded")
+                return None
+            outs[k] = out
+            folded = deltam.fold_chunk(seed, enc, cat, lo, hi, out,
+                                       feas)
+            if folded is None:
+                abort[0] = "seed"
+            return folded
+
+        def project(k, seed):
+            lo, hi = chunks[k]
+            return deltam.project_chunk(seed, enc, cat, lo, hi, mn,
+                                        feas)
+
+        def match(a, b):
+            return deltam.seed_digest(a) == deltam.seed_digest(b)
+
+        from karpenter_tpu.utils.profiling import trace_solve
+        with trace_solve("ffd-spec-chain"):
+            ok, outcomes = pipelining.run_spec_chain(
+                K, deltam.chunk_entry_seed(enc), dispatch, project,
+                commit, match, depth=min(K, pipelining.SPEC_DEPTH))
+        self._spec_repair_count(outcomes)
+        if not ok:
+            return self._spec_fallback(abort[0] or "stranded")
+        t2 = _time.perf_counter()
+        # stitch the chunk outputs into one full-problem output — the
+        # merge() discipline at every boundary: take rows concatenate
+        # in group order, node rows come from the LAST chunk (its
+        # carry holds the whole chain's nodes)
+        na = int(outs[-1]["num_active"])
+        D = enc.n_domains
+        te = np.concatenate(
+            [np.asarray(outs[k]["take_exist"])[:hi - lo, :E_real]
+             for k, (lo, hi) in enumerate(chunks)], axis=0)
+        tn = np.concatenate(
+            [np.asarray(outs[k]["take_new"])[:hi - lo, :na]
+             for k, (lo, hi) in enumerate(chunks)], axis=0)
+        out_m = dict(
+            take_exist=te, take_new=tn, new_overflow=False,
+            unsched=np.zeros(G, dtype=np.float32),
+            dom_placed=np.zeros((G, D), dtype=np.float32),
+            used=outs[-1]["used"],
+            node_pool=np.asarray(outs[-1]["node_pool"],
+                                 dtype=np.int32),
+            node_zone=np.asarray(outs[-1]["node_zone"],
+                                 dtype=np.int32),
+            node_ct=np.asarray(outs[-1]["node_ct"], dtype=np.int32),
+            num_active=na)
+        if exc and all(o.get("explain_counts") is not None
+                       for o in outs):
+            out_m["explain_counts"] = np.concatenate(
+                [np.asarray(outs[k]["explain_counts"])[:hi - lo]
+                 for k, (lo, hi) in enumerate(chunks)], axis=0)
+        self._repair_whole_node(enc, out_m)
+        self._repair_gang(enc, out_m)
+        self._repair_topology(enc, out_m)
+        self._explain_trees = bool(self._explain_mode())
+        res = self._decode(enc, out_m)
+        self._note_explain(enc, out_m)
+        t3 = _time.perf_counter()
+        self._last_slots_exhausted = False
+        # warm-start continuity + delta-base store: the chain's output
+        # IS the full solve's, so downstream adaptation must not be
+        # able to tell the paths apart
+        self._last_active = na
+        segs = (int((tn[:G, :na] > 0).sum(axis=1).max())
+                if na and G else 0)
+        self._last_new_segments = max(segs, 1)
+        self._delta_store(inp, cat, enc, out_m, groups)
+        self._last_spec_chunks = K
+        self.last_spec = {
+            "outcome": "spec", "chunks": K,
+            "committed": outcomes.count("committed"),
+            "repaired": outcomes.count("repaired")}
+        metrics.SOLVER_SPEC_PASSES.inc(outcome="spec")
+        # phases: `encode` was stamped by the caller before the seam;
+        # dispatch/device/pull aggregate across the chain's chunks
+        # (the full path already aggregates across retries), and
+        # spec_repair is the re-dispatched chunks' total wall share —
+        # always present, 0.0 on a clean chain
+        self.last_phase_ms.update(
+            pad=(t1 - t0) * 1e3, dispatch=disp_s * 1e3,
+            device=dev_s * 1e3, pull=pull_s * 1e3,
+            decode=(t3 - t2) * 1e3, spec_repair=repair_s * 1e3)
+        for phase, lo_t, dur in (
+                ("pad", t0, t1 - t0), ("dispatch", t1, disp_s),
+                ("device", t1 + disp_s, dev_s),
+                ("pull", t1 + disp_s + dev_s, pull_s),
+                ("spec_repair", max(t2 - repair_s, t1), repair_s),
+                ("decode", t2, t3 - t2)):
+            metrics.SOLVER_PHASE_DURATION.observe(
+                dur, phase=phase, path="solve")
+            tracing.record_span(f"solver.phase.{phase}",
+                                wall0 + (lo_t - t0), dur,
+                                spec_chunks=K)
+        self._flight_record(inp, cat, enc, res, "spec")
+        return res
+
     def _solve_attempt(self, inp: ScheduleInput,
                        max_nodes: Optional[int] = None,
                        groups=None) -> ScheduleResult:
@@ -1317,6 +1696,15 @@ class TPUSolver:
                     "purchasable capacity: domain quotas / atomic fills "
                     "need the device solve")
             return self._existing_only(enc)
+
+        if max_nodes is None and groups is not None:
+            # speculative chunked G-axis chain: bit-identical to the
+            # sequential program when it runs, counted fallback here
+            # (and a normal single-program solve below) when it can't
+            res = self._try_spec(inp, cat, enc, groups,
+                                 wall0 + (t1 - t0), t1)
+            if res is not None:
+                return res
 
         G = bucket(enc.n_groups, G_BUCKETS)
         E = bucket(len(enc.existing), E_BUCKETS)
@@ -1672,6 +2060,52 @@ class TPUSolver:
                     cm = np.zeros((A_pad, dev["O"]), bool)
                     packed = self._run_delta(zero16, seeds, cm, dev,
                                              mn, mbits)
+                    try:
+                        packed.block_until_ready()
+                    except AttributeError:
+                        pass
+                    warmed += 1
+        spec_mode = self._resolve_spec()
+        if spec_mode:
+            # chunk-chain programs: the chain pads every chunk to ONE
+            # G tier (the planner's) and walks the seed-pad ladder as
+            # A grows, so warm exactly that program family at the
+            # configured ceiling — an unwarmed tier would put a
+            # compile cliff mid-chain, stalling the pipeline
+            plan = self._plan_spec_chunks(enc.n_groups, spec_mode)
+            if not isinstance(plan, str):
+                from karpenter_tpu.solver import delta as deltam
+                Gp = plan[0][1] - plan[0][0]
+                P = max(len(cat.pools), 1)
+                spec16 = (
+                    np.zeros((Gp, R), np.float32),
+                    np.zeros(Gp, np.int32),
+                    np.zeros((Gp, dev["O"]), bool),
+                    np.zeros((Gp, baseE), np.int32),
+                    np.zeros((baseE, R), np.float32),
+                    np.full((P, R), np.inf, np.float32),
+                    np.zeros(Gp, np.int32),
+                    np.zeros(Gp, np.int32),
+                    np.zeros((Gp, Db), np.int32),
+                    np.zeros((Gp, Db), np.int32),
+                    np.zeros(Gp, np.int32),
+                    np.zeros(Gp, np.int32),
+                    np.zeros((Gp, Db), bool),
+                    np.zeros(Gp, bool),
+                    np.zeros(Gp, bool),  # group_gang (spec: gang-free)
+                    np.full(baseE, -1, np.int32),
+                    np.full(baseE, -1, np.int32),
+                )
+                mn = self.max_nodes
+                for A_pad in sorted({min(b, mn)
+                                     for b in deltam.SEED_BUCKETS}):
+                    seeds = (np.zeros((mn, R), np.float32),
+                             np.zeros(mn, np.int32),
+                             np.zeros(mn, bool))
+                    cm = np.zeros((A_pad, dev["O"]), bool)
+                    packed = self._run_delta(spec16, seeds, cm, dev,
+                                             mn, mbits,
+                                             kind="spec-seed")
                     try:
                         packed.block_until_ready()
                     except AttributeError:

@@ -330,6 +330,26 @@ SOLVER_DELTA_GROUPS_REENCODED = _g(
     "Pod classes freshly re-encoded in the last delta pass (the churn "
     "the pass actually paid for; unchanged suffix classes reuse their "
     "cached rows).")
+# -- speculative chunked G-axis pipeline (solver/solve.py _try_spec,
+# -- ISSUE 19): the chunked-chain seam's observable half, same counted
+# -- discipline as the delta seam — a pass either chunks or names a
+# -- conservative fallback reason, and every speculated chunk either
+# -- commits bit-exactly or pays a counted repair re-dispatch
+SOLVER_SPEC_PASSES = _c(
+    "karpenter_tpu_solver_spec_passes_total",
+    "Passes through the speculative-chunk seam by outcome: spec = the "
+    "G axis ran as a pipelined chain of seeded chunk solves (result "
+    "bit-identical to the sequential program), fallback = a "
+    "conservative exactness guard sent the pass to the single-program "
+    "path.", ("outcome",))
+SOLVER_SPEC_CHUNKS = _c(
+    "karpenter_tpu_solver_spec_chunks_total",
+    "Speculated chunks by commit verdict: committed = the speculated "
+    "entry seed matched the true exit state bit-for-bit (the in-flight "
+    "solve IS the sequential program's), repaired = the seed diverged "
+    "(or speculation was declined) and the chunk re-solved from the "
+    "true seed — every divergence is counted here, never silent.",
+    ("outcome",))
 # -- solver-service availability (ISSUE 7): the crash-isolation story's
 # -- observable half — without these, a daemon crash-loop looks identical
 # -- to a healthy idle service from the operator's scrape
@@ -449,8 +469,9 @@ SOLVER_CONSTRAINT_ELIM = _c(
 FLIGHT_RECORDS = _c(
     "karpenter_tpu_flight_records_total",
     "Flight-recorder records written, by record kind (solve = one "
-    "single-problem attempt, delta = an engaged delta pass, batch = one "
-    "fused solverd batch).", ("kind",))
+    "single-problem attempt, delta = an engaged delta pass, spec = an "
+    "engaged speculative chunk-chain pass, batch = one fused solverd "
+    "batch).", ("kind",))
 TIMELINE_EVENTS = _c(
     "karpenter_tpu_timeline_events_total",
     "Timeline-recorder events written, by event kind (store.<kind>.<op> "

@@ -57,6 +57,13 @@ os.environ.pop("KARPENTER_TPU_TENANT_WEIGHTS_FILE", None)
 os.environ.pop("KARPENTER_TPU_PRIORITY", None)
 os.environ.pop("KARPENTER_TPU_SPOT_RISK", None)
 
+# The speculative chunked G-axis chain runs at its DEFAULT (auto): an
+# inherited KARPENTER_TPU_SPEC=off from a shell that just drove the
+# megascale bench would make every spec parity/fallback test pass
+# vacuously, and a leftover =on would force chunking into small-shape
+# solver tests whose phase/metric assertions expect the single program.
+os.environ.pop("KARPENTER_TPU_SPEC", None)
+
 # The timeline recorder runs at its DEFAULT (on, ring-only): an
 # inherited KARPENTER_TPU_TIMELINE=off would make every recorder test
 # pass vacuously, an inherited _DIR (from a shell that just drove the

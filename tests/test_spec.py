@@ -1,0 +1,370 @@
+"""Speculative chunked G-axis pipeline (ISSUE 19).
+
+Contracts:
+
+- **exactness** — an engaged chunk chain returns a result bit-identical
+  to the spec-off sequential program, asserted in lockstep at every
+  tested shape: when every speculation commits, when every boundary
+  repairs, and in the all-misprediction worst case (existing nodes
+  absorbing pods the projection never predicts) where the chain
+  degrades to the sequential program step by step.
+- **counted verdicts** — every chunk after the first is either
+  `committed` or `repaired` in
+  `karpenter_tpu_solver_spec_chunks_total` (committed + repaired =
+  chunks − 1 per engaged pass), and every non-engaged pass is a
+  counted `fallback` in `karpenter_tpu_solver_spec_passes_total` with
+  a registry-owned reason — gang, priority bands, finite limits,
+  topology, price cap, shape, and the planner's small/bucket declines
+  must all fall back explicitly, never silently degrade exactness.
+- **chunk-boundary hazards** — a gang straddling a boundary and a
+  priority-band split can never happen: the whole-problem gates refuse
+  before the planner cuts; a pool limit consumed by a speculated
+  prefix refuses at the `limits` gate (no exact host replay exists).
+- **knob** — KARPENTER_TPU_SPEC=off/on/auto resolved inside the
+  solver (one grammar owner), beating the constructed spec; conftest
+  scrubs it so tier-1 runs at the default.
+- **observability** — engaged passes stamp the `spec_repair` phase
+  (0.0 on a clean chain), and flight records carry the resolved knob
+  plus the attempt's chunk count so kt_replay/kt_explain can pin the
+  single-program parity baseline.
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.models import (
+    Node,
+    NodePool,
+    ObjectMeta,
+    Pod,
+    Resources,
+    wellknown,
+)
+from karpenter_tpu.providers import generate_catalog
+from karpenter_tpu.providers.catalog import CatalogSpec
+from karpenter_tpu.scheduling import ExistingNode, ScheduleInput
+from karpenter_tpu.solver import TPUSolver
+from karpenter_tpu.solver import delta as deltam
+from karpenter_tpu.solver import explain as explainmod
+from karpenter_tpu.solver.solve import G_BUCKETS
+from karpenter_tpu.utils import flightrecorder, metrics
+
+CATALOG = generate_catalog(CatalogSpec(max_types=10, include_gpu=False))
+
+
+def mkpod(name, cpu_m=500, mem_mi=1024, **kw):
+    return Pod(meta=ObjectMeta(name=name, labels=kw.pop("labels", {}),
+                               annotations=kw.pop("annotations", {})),
+               requests=Resources.parse(
+                   {"cpu": f"{cpu_m}m", "memory": f"{mem_mi}Mi"}), **kw)
+
+
+def mknodes(n, cpu=16000):
+    out = []
+    for i in range(n):
+        node = Node(
+            meta=ObjectMeta(name=f"sn{i}", labels={
+                wellknown.ZONE_LABEL: f"tpu-west-1{'abc'[i % 3]}",
+                wellknown.CAPACITY_TYPE_LABEL:
+                    ["spot", "on-demand"][i % 2],
+                wellknown.NODEPOOL_LABEL: "default",
+                wellknown.HOSTNAME_LABEL: f"sn{i}"}),
+            allocatable=Resources.of(cpu=cpu, memory=32768, pods=58),
+            ready=True)
+        out.append(ExistingNode(node=node, available=node.allocatable,
+                                pods=[]))
+    return out
+
+
+def mkinput(pods, existing=(), **kw):
+    pool = NodePool(meta=ObjectMeta(name="default"))
+    return ScheduleInput(pods=pods, nodepools=[pool],
+                         instance_types={"default": CATALOG},
+                         existing_nodes=list(existing), **kw)
+
+
+def canon(res):
+    return (sorted((c.nodepool, tuple(sorted(p.meta.name for p in c.pods)),
+                    tuple(c.instance_type_names), round(c.price, 9))
+                   for c in res.new_claims),
+            dict(res.existing_assignments), set(res.unschedulable))
+
+
+def varied_pods(n_groups=140, per=2):
+    """Distinct size classes whose open-node residuals absorb later
+    (smaller) classes in FFD order — the true scan does in-flight
+    fills the open-new projection never predicts, so chunk boundaries
+    repair."""
+    pods = []
+    for g in range(n_groups):
+        for i in range(per):
+            pods.append(mkpod(f"v{g}-{i}", cpu_m=200 + (g % 97) * 37,
+                              mem_mi=256 + (g % 53) * 41))
+    return pods
+
+
+def huge_pods(n_groups=140, per=2):
+    """Every pod needs more than half the largest machine: one pod per
+    node, residuals too small for ANY later pod — the true scan is
+    open-new-only, so the projection is bit-exact and every
+    speculation commits."""
+    pods = []
+    for g in range(n_groups):
+        for i in range(per):
+            pods.append(mkpod(f"h{g}-{i}", cpu_m=50000 + g, mem_mi=2048))
+    return pods
+
+
+def spec_counts():
+    return (metrics.SOLVER_SPEC_PASSES.value(outcome="spec"),
+            metrics.SOLVER_SPEC_PASSES.value(outcome="fallback"),
+            metrics.SOLVER_SPEC_CHUNKS.value(outcome="committed"),
+            metrics.SOLVER_SPEC_CHUNKS.value(outcome="repaired"))
+
+
+class TestSpecParity:
+    def test_committed_speculation_is_bit_exact(self):
+        s0, f0, c0, r0 = spec_counts()
+        on = TPUSolver(mesh="off", spec="on")
+        off = TPUSolver(mesh="off", spec="off")
+        pods = huge_pods()
+        r_on = on.solve(mkinput(list(pods)))
+        r_off = off.solve(mkinput(list(pods)))
+        assert canon(r_on) == canon(r_off)
+        assert on.last_spec["outcome"] == "spec"
+        K = on.last_spec["chunks"]
+        assert K >= 2 and on._last_spec_chunks == K
+        assert on.last_spec["committed"] == K - 1
+        assert on.last_spec["repaired"] == 0
+        assert off.last_spec is None
+        s1, f1, c1, r1 = spec_counts()
+        assert s1 - s0 == 1 and c1 - c0 == K - 1 and r1 - r0 == 0
+
+    def test_repaired_divergence_is_bit_exact(self):
+        # varied sizes: the true scan's in-flight fills diverge from
+        # the open-new projection — every divergence is a COUNTED
+        # repair and the stitched result is still the sequential one
+        s0, f0, c0, r0 = spec_counts()
+        on = TPUSolver(mesh="off", spec="on")
+        off = TPUSolver(mesh="off", spec="off")
+        pods = varied_pods()
+        r_on = on.solve(mkinput(list(pods)))
+        r_off = off.solve(mkinput(list(pods)))
+        assert canon(r_on) == canon(r_off)
+        assert on.last_spec["outcome"] == "spec"
+        K = on.last_spec["chunks"]
+        assert on.last_spec["committed"] + on.last_spec["repaired"] \
+            == K - 1
+        s1, f1, c1, r1 = spec_counts()
+        assert (c1 - c0) + (r1 - r0) == K - 1
+
+    def test_all_misprediction_degrades_to_sequential(self):
+        # existing nodes absorb pods at every boundary: the projection
+        # declines to speculate (an existing-node fill is possible), so
+        # the chain serializes chunk by chunk — the worst case IS the
+        # sequential program, bit-exactly, with every boundary counted
+        # as a repair and zero committed speculations
+        on = TPUSolver(mesh="off", spec="on")
+        off = TPUSolver(mesh="off", spec="off")
+        pods = varied_pods()
+        existing = mknodes(12)
+        r_on = on.solve(mkinput(list(pods), mknodes(12)))
+        r_off = off.solve(mkinput(list(pods), existing))
+        assert canon(r_on) == canon(r_off)
+        assert on.last_spec["outcome"] == "spec"
+        assert on.last_spec["committed"] == 0
+        assert on.last_spec["repaired"] == on.last_spec["chunks"] - 1
+
+    def test_spec_output_feeds_the_delta_cache(self):
+        # the chain's stitched output is a first-class full solve:
+        # the NEXT churned pass rides the delta seam off its record
+        on = TPUSolver(mesh="off", spec="on", delta="on")
+        off = TPUSolver(mesh="off", spec="off", delta="off")
+        pods = varied_pods()
+        on.solve(mkinput(list(pods)))
+        assert on.last_spec["outcome"] == "spec"
+        churned = pods[:-2] + [mkpod(f"w-{i}", cpu_m=333, mem_mi=512)
+                               for i in range(2)]
+        r_on = on.solve(mkinput(list(churned)))
+        r_off = off.solve(mkinput(list(churned)))
+        assert on._delta_cache.last_outcome == "delta"
+        assert canon(r_on) == canon(r_off)
+
+
+class TestSpecFallbacks:
+    """Chunk-boundary hazards: each is refused BEFORE the planner can
+    put it on a boundary, with a registry-owned counted reason."""
+
+    def _fallback(self, solver):
+        assert solver.last_spec is not None
+        assert solver.last_spec["outcome"] == "fallback"
+        reason = solver.last_spec["reason"]
+        assert reason in explainmod.SPEC_FALLBACK_REASONS
+        return reason
+
+    @staticmethod
+    def _small(n_groups=6, per=2):
+        return [mkpod(f"s{g}-{i}", cpu_m=1000 + g * 100)
+                for g in range(n_groups) for i in range(per)]
+
+    def test_gang_never_straddles_a_boundary(self):
+        # whole-problem gate: any gang (wherever the planner would cut)
+        # refuses the chain — a straddle cannot be constructed
+        on = TPUSolver(mesh="off", spec="on")
+        pods = self._small()
+        for i in range(4):
+            pods.append(mkpod(
+                f"gg-{i}", cpu_m=4000,
+                annotations={
+                    wellknown.GANG_NAME_ANNOTATION: "gg",
+                    wellknown.GANG_SIZE_ANNOTATION: "4"}))
+        on.solve(mkinput(pods))
+        assert self._fallback(on) == "gang"
+
+    def test_priority_band_split_refused(self):
+        on = TPUSolver(mesh="off", spec="on")
+        pods = self._small()
+        elevated = mkpod("prio-0", cpu_m=3000)
+        elevated.priority = 1000
+        pods.append(elevated)
+        on.solve(mkinput(pods))
+        assert self._fallback(on) == "priority"
+
+    def test_pool_limit_consumed_by_prefix_refused(self):
+        # a finite pool limit has no exact host replay once a
+        # speculated prefix consumed part of it: the limits gate
+        # refuses the whole chain
+        on = TPUSolver(mesh="off", spec="on")
+        inp = mkinput(self._small())
+        inp.remaining_limits = {
+            "default": Resources.of(cpu=10 ** 9, memory=10 ** 9)}
+        on.solve(inp)
+        assert self._fallback(on) == "limits"
+
+    def test_price_cap_refused(self):
+        on = TPUSolver(mesh="off", spec="on")
+        on.solve(mkinput(self._small(), price_cap=1e9))
+        assert self._fallback(on) == "price-cap"
+
+    def test_topology_refused(self):
+        from karpenter_tpu.models import PodAffinityTerm
+        on = TPUSolver(mesh="off", spec="on")
+        pods = self._small()
+        pods[0].pod_affinities = [PodAffinityTerm(
+            label_selector={"app": "a"},
+            topology_key=wellknown.ZONE_LABEL,
+            required=True, anti=True)]
+        on.solve(mkinput(pods))
+        assert self._fallback(on) == "topology"
+
+    def test_auto_mode_declines_small_problems(self):
+        on = TPUSolver(mesh="off", spec="auto")
+        on.solve(mkinput(self._small()))
+        assert self._fallback(on) == "small"
+
+    def test_off_mode_is_uncounted(self):
+        s0, f0, c0, r0 = spec_counts()
+        off = TPUSolver(mesh="off", spec="off")
+        off.solve(mkinput(self._small()))
+        assert off.last_spec is None
+        s1, f1, c1, r1 = spec_counts()
+        assert (s1, f1, c1, r1) == (s0, f0, c0, r0)
+
+
+class TestSpecPlanner:
+    def test_small_floor_in_auto(self):
+        plan = TPUSolver._plan_spec_chunks(
+            deltam.SPEC_MIN_GROUPS - 1, "auto")
+        assert plan == "small"
+
+    def test_on_mode_skips_the_floor(self):
+        plan = TPUSolver._plan_spec_chunks(40, "on")
+        assert not isinstance(plan, str)
+
+    def test_no_tier_below_bucket(self):
+        assert TPUSolver._plan_spec_chunks(1, "on") == "bucket"
+
+    def test_chunks_are_contiguous_one_tier_and_cover(self):
+        for n in (40, 140, 150, 513, 600, 2049):
+            plan = TPUSolver._plan_spec_chunks(n, "on")
+            assert not isinstance(plan, str), n
+            assert len(plan) >= 2
+            cb = plan[0][1] - plan[0][0]
+            assert cb in G_BUCKETS
+            cursor = 0
+            for lo, hi in plan:
+                assert lo == cursor and hi > lo
+                assert hi - lo <= cb
+                cursor = hi
+            assert cursor == n
+            # every full chunk is exactly the tier; only the tail rags
+            assert all(hi - lo == cb for lo, hi in plan[:-1])
+
+
+class TestSpecKnob:
+    def test_env_beats_constructed(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_SPEC", "off")
+        assert TPUSolver(spec="on")._resolve_spec() is False
+        monkeypatch.setenv("KARPENTER_TPU_SPEC", "on")
+        assert TPUSolver(spec="off")._resolve_spec() == "on"
+        monkeypatch.setenv("KARPENTER_TPU_SPEC", "auto")
+        assert TPUSolver(spec="off")._resolve_spec() == "auto"
+
+    def test_malformed_env_degrades_to_constructed(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_SPEC", "bogus")
+        assert TPUSolver(spec="on")._resolve_spec() == "on"
+        assert TPUSolver(spec="off")._resolve_spec() is False
+
+    def test_default_is_auto(self):
+        assert TPUSolver()._resolve_spec() == "auto"
+
+    def test_registry_vocabulary_is_closed(self):
+        with pytest.raises(AssertionError):
+            TPUSolver(spec="off")._spec_fallback("not-a-reason")
+
+
+class TestSpecObservability:
+    def test_spec_repair_phase_always_stamped(self):
+        on = TPUSolver(mesh="off", spec="on")
+        on.solve(mkinput(huge_pods()))
+        assert on.last_spec["outcome"] == "spec"
+        assert "spec_repair" in on.last_phase_ms
+        # clean chain: the phase exists and reports zero repair wall
+        assert on.last_phase_ms["spec_repair"] == 0.0
+        assert {"encode", "pad", "dispatch", "device",
+                "pull", "decode"} <= set(on.last_phase_ms)
+
+    def test_repairs_report_wall_share(self):
+        on = TPUSolver(mesh="off", spec="on")
+        on.solve(mkinput(varied_pods()))
+        assert on.last_spec["outcome"] == "spec"
+        if on.last_spec["repaired"]:
+            assert on.last_phase_ms["spec_repair"] > 0.0
+
+    def test_flight_record_stamps_knob_and_chunks(self, monkeypatch):
+        flightrecorder.RECORDER.reset()
+        try:
+            on = TPUSolver(mesh="off", spec="on")
+            on.solve(mkinput(huge_pods()))
+            tail = flightrecorder.RECORDER.tail(4)
+            assert tail, "spec solve produced no flight record"
+            rec = tail[-1]
+            assert rec["kind"] == "spec"
+            assert rec["knobs"]["spec"] == "on"
+            assert rec["knobs"]["spec_chunks"] == \
+                on.last_spec["chunks"] >= 2
+            assert "spec_repair" in rec["phase_ms"]
+            # non-engaged passes stamp chunks=0 and the resolved mode
+            off = TPUSolver(mesh="off", spec="off")
+            off.solve(mkinput([mkpod("f-0")]))
+            rec = flightrecorder.RECORDER.tail(4)[-1]
+            assert rec["knobs"]["spec"] == "off"
+            assert rec["knobs"]["spec_chunks"] == 0
+        finally:
+            flightrecorder.RECORDER.reset()
+
+    def test_fallback_reasons_registered(self):
+        # the registry vocabulary covers every reason _try_spec emits
+        assert {"small", "bucket", "gang", "priority", "price-cap",
+                "limits", "topology", "shape", "slots", "stranded",
+                "seed"} <= explainmod.SPEC_FALLBACK_REASONS

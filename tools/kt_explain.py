@@ -43,6 +43,10 @@ def explain_capture(payload: dict, knobs: "dict | None" = None) -> dict:
     # on-demand path where the [G, O] detail is worth materializing)
     os.environ["KARPENTER_TPU_FLIGHT"] = "off"
     os.environ["KARPENTER_TPU_DELTA"] = "off"
+    # spec=off: the chunked chain is bit-identical to the single
+    # program BY CONTRACT, so the sequential program is the parity
+    # baseline the recorded digest is checked against
+    os.environ["KARPENTER_TPU_SPEC"] = "off"
     os.environ.setdefault("KARPENTER_TPU_MESH", "off")
     os.environ["KARPENTER_TPU_EXPLAIN"] = "full"
     # gang is semantic (ISSUE 15): resolve it as the recording did so
@@ -56,7 +60,7 @@ def explain_capture(payload: dict, knobs: "dict | None" = None) -> dict:
     from karpenter_tpu.solver import explain as explainmod
     from karpenter_tpu.utils import flightrecorder as fr
     solver = TPUSolver(max_nodes=payload.get("solver_max_nodes", 2048),
-                       mesh="off", delta="off")
+                       mesh="off", delta="off", spec="off")
     res = solver.solve(payload["inp"],
                        max_nodes=payload.get("max_nodes"))
     unsched = {}

@@ -76,6 +76,10 @@ def replay(payload: dict, knobs: "dict | None" = None) -> dict:
     # the parity baseline every other story is asserted against)
     os.environ["KARPENTER_TPU_FLIGHT"] = "off"
     os.environ["KARPENTER_TPU_DELTA"] = "off"
+    # spec=off: the chunked chain is bit-identical to the single
+    # program BY CONTRACT, so the sequential program is the parity
+    # baseline the recorded digest is checked against
+    os.environ["KARPENTER_TPU_SPEC"] = "off"
     os.environ.setdefault("KARPENTER_TPU_MESH", "off")
     # the gang knob is SEMANTIC, not an execution strategy: a solve
     # recorded with gangs disabled placed gang members as plain pods,
@@ -89,7 +93,7 @@ def replay(payload: dict, knobs: "dict | None" = None) -> dict:
     from karpenter_tpu.solver import TPUSolver
     from karpenter_tpu.utils import flightrecorder as fr
     solver = TPUSolver(max_nodes=payload.get("solver_max_nodes", 2048),
-                       mesh="off", delta="off")
+                       mesh="off", delta="off", spec="off")
     res = solver.solve(payload["inp"],
                        max_nodes=payload.get("max_nodes"))
     return fr.result_digest(res)
