@@ -151,7 +151,8 @@ def run_configs(timeout_s: float):
                "config5_burst.py", "config6_interruption.py",
                "config7_churn.py", "config8_saturation.py",
                "config9_gang.py", "config10_priority.py",
-               "config11_rewind.py", "config12_megascale.py"]
+               "config11_rewind.py", "config12_megascale.py",
+               "config13_warm_million.py"]
     env = dict(os.environ)
     # configs share the persistent compile cache (platform bootstrap), so
     # a generous per-probe budget isn't needed — keep failures quick so

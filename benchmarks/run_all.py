@@ -1,4 +1,4 @@
-"""Run all 7 config benchmarks; one JSON line each on stdout.
+"""Run all config benchmarks; one JSON line each on stdout.
 
     python benchmarks/run_all.py            # real device if available
     JAX_PLATFORMS=cpu python benchmarks/run_all.py
@@ -19,7 +19,8 @@ CONFIGS = ["config1_inflate.py", "config2_mixed.py", "config3_topology.py",
            "config4_consolidation.py", "config5_burst.py",
            "config6_interruption.py", "config7_churn.py",
            "config9_gang.py", "config10_priority.py",
-           "config11_rewind.py", "config12_megascale.py"]
+           "config11_rewind.py", "config12_megascale.py",
+           "config13_warm_million.py"]
 TIMEOUT = float(os.environ.get("KARPENTER_TPU_BENCH_TIMEOUT", "600"))
 
 if __name__ == "__main__":

@@ -52,6 +52,8 @@ KNOBS = {
         "owner": "karpenter_tpu/utils/knobs.py", "kind": "bool"},
     "KARPENTER_TPU_HEALTH_PORT": {
         "owner": "karpenter_tpu/operator/operator.py", "kind": "value"},
+    "KARPENTER_TPU_INCR": {
+        "owner": "karpenter_tpu/solver/solve.py", "kind": "spec"},
     "KARPENTER_TPU_LEASE_FILE": {
         "owner": "karpenter_tpu/operator/operator.py", "kind": "value"},
     "KARPENTER_TPU_LEDGER": {

@@ -68,7 +68,13 @@ _PARITY_KEYS = ("parity", "pass", "nodes_le_oracle",
                 # spec-on vs spec-off node-count + IEEE-hex price
                 # parity boolean — a later false means a speculation
                 # divergence escaped the counted-repair discipline
-                "spec_parity")
+                "spec_parity",
+                # config13 (warm-million incr index, ISSUE 20): the
+                # incr-vs-walk lockstep parity at every sweep size, the
+                # flat-wall-time ratio gate (1M churn pass <= 1.25x the
+                # 50k pass), and the every-pass-accounted invariant —
+                # zero uncounted incr/delta fallbacks on timed passes
+                "incr_parity", "flat_ok", "zero_uncounted")
 _NAME_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 
 

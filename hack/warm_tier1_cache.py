@@ -56,6 +56,21 @@ def _mkinput(n_classes: int, n_nodes: int):
                 requests=Resources.parse(
                     {"cpu": f"{100 + 40 * g}m", "memory": "512Mi"}))
             for g in range(n_classes) for i in range(2)]
+    # One adjacency gang in the prototype: warmup() compiles the
+    # with_gang=1 full + batched program variants only when the proto
+    # encoding actually carries a gang, and the suite's gang tests
+    # (test_gang_scheduling, the ISSUE-20 gang-pin delta tests) hit
+    # those shapes.  The SEEDED delta programs need no gang variant:
+    # the seeded kernel always runs with_gang=0 — gang-pin replay works
+    # by domain-narrowed column masks, not a kernel flag — so the
+    # delta_shapes lattice below already warms the gang-pin path.
+    pods += [Pod(meta=ObjectMeta(name=f"warmgang-{i}", annotations={
+                     wellknown.GANG_NAME_ANNOTATION: "warmgang",
+                     wellknown.GANG_SIZE_ANNOTATION: "4",
+                     wellknown.GANG_TOPOLOGY_ANNOTATION: "slice"}),
+                 requests=Resources.parse(
+                     {"cpu": "250m", "memory": "512Mi"}))
+             for i in range(4)]
     nodes = []
     for i in range(n_nodes):
         node = Node(

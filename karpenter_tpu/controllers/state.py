@@ -30,6 +30,7 @@ class SolveCacheFeed:
 
     def __init__(self, cluster: Cluster):
         from karpenter_tpu.utils.logging import ChangeMonitor
+        self._cluster = cluster
         self._watch = cluster.watch()
         self._monitor = ChangeMonitor()
 
@@ -40,28 +41,64 @@ class SolveCacheFeed:
         edge-driven: a dropped node event is a lost invalidation.  A
         full drain therefore reports flood=True and the cache degrades
         to all-dirty (one counted fallback), never a silent miss."""
+        pods, nodes, flood, _claims = self._drain_kinds()
+        return set(pods), set(nodes), flood
+
+    def _drain_kinds(self):
+        """drain() plus the nodeclaim-kind subset of the node names —
+        the incremental index (ISSUE 20) absorbs claim events
+        separately (a claim without a registered node row changes no
+        cached row), while the walk path keeps treating them as node
+        dirt."""
         events = self._watch.drain()
-        pods: set = set()
-        nodes: set = set()
+        # dicts, not sets: the index's member-order contract needs pod
+        # names in FIRST-occurrence event order (== store-append order
+        # for creations); the walk path only reads them as sets
+        pods: dict = {}
+        nodes: dict = {}
+        claims: set = set()
         for ev in events:
             if ev.kind == "pods":
-                pods.add(ev.name)
+                pods.setdefault(ev.name, None)
             elif ev.kind in self._NODE_KINDS:
-                nodes.add(ev.name)
+                nodes.setdefault(ev.name, None)
+                if ev.kind == "nodeclaims":
+                    claims.add(ev.name)
         flood = len(events) >= (self._watch._buffer.maxlen or 0)
-        return pods, nodes, flood
+        return pods, nodes, flood, claims
 
     def feed(self, solver) -> None:
         """Drain and forward to a solver that supports the delta seam
         (the in-process TPUSolver; the remote client's daemon runs its
-        own value-based diff and needs no feed)."""
-        pods, nodes, flood = self.drain()
+        own value-based diff and needs no feed).  Each dirty name is
+        resolved to its CURRENT object (None = deleted) so the
+        solver's incremental index can absorb the event at feed time —
+        an O(churn) store probe here replaces an O(cluster) walk per
+        solve pass.  Claim-kind names resolve through the node store
+        too (a registered claim shares its node's name; an unregistered
+        one resolves to None and only dirties the index if a cached
+        row bears the name)."""
+        pods, nodes, flood, claims = self._drain_kinds()
         if not pods and not nodes and not flood:
             return
         inval = getattr(solver, "delta_invalidate", None)
         if inval is None:
             return
-        inval(pods=pods, nodes=nodes, flood=flood)
+        cl = self._cluster
+        # resolved in event order (pod_objs' insertion order carries
+        # the store-append order the index's member contract needs)
+        pod_objs = {n: cl.pods.get(n) for n in pods}
+        node_objs = {n: cl.nodes.get(n)
+                     for n in nodes if n not in claims}
+        try:
+            inval(pods=set(pods), nodes=set(nodes), flood=flood,
+                  pod_objs=pod_objs, node_objs=node_objs,
+                  claims=tuple(claims))
+        except TypeError:
+            # an older solver seam (remote daemon shim, test double)
+            # that predates the object-bearing feed: name sets carry
+            # all the walk path needs
+            inval(pods=set(pods), nodes=set(nodes), flood=flood)
         from karpenter_tpu.utils.logging import get_logger
         if self._monitor.has_changed(
                 "delta-invalidate", (len(pods), len(nodes), flood)):
@@ -133,10 +170,16 @@ class GatedSolver:
             self.tpu = TPUSolver(
                 max_nodes=options.solver_max_nodes,
                 mesh=getattr(options, "solver_mesh", "auto"),
-                delta=getattr(options, "solver_delta", "auto"))
+                delta=getattr(options, "solver_delta", "auto"),
+                incr=getattr(options, "solver_incr", "auto"))
             # event-driven delta-cache invalidation: cluster watch →
             # dirty pod/node names → TPUSolver.delta_invalidate
             self._delta_feed = SolveCacheFeed(cluster)
+            # the feed delivers OBJECTS with every event from here on,
+            # so the solver's "auto" incremental index may trust the
+            # stream (ISSUE 20) — arming stays strictly tied to the
+            # feed's existence; the remote/degraded solvers never arm
+            self.tpu.incr_arm()
             # warm the native host-ops build at startup, never inside a
             # latency-sensitive solve
             from karpenter_tpu.native import hostops

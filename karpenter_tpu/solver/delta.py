@@ -94,6 +94,12 @@ class _NodeFP:
     deleting: bool
     avail: np.ndarray           # [R] f32 — must match bit-for-bit
     res_anti: bool              # any resident pod carries required anti
+    # the Node object's own allocatable (event-time comparable): the
+    # incremental index (solver/incr.py) absorbs a node watch event as
+    # spurious iff labels/taints/readiness/deleting/allocatable all
+    # match — available capacity moves via resident pod events, which
+    # the index tracks separately, so it is NOT part of this check
+    alloc: Optional[np.ndarray] = None
 
 
 @dataclass
@@ -126,6 +132,19 @@ class DeltaRecord:
     # resolves a small dirty set in O(churn) dict probes instead of the
     # O(cluster × members) per-name scans the prefix walk used to pay
     name_rows: Optional[Dict[str, int]] = None
+    # adjacency-gang node pins (ISSUE 20): the kernel's winning domain
+    # per new node ([NA] i32, -1 = unpinned), recorded so build() can
+    # replay a prefix gang's domain-narrowed colmask and merge() can
+    # stitch the pins back into the output — without them the seeded
+    # merge rebuilt node_zone/ct as -1 and every adjacency gang was a
+    # counted "gang" fallback forever
+    node_zone: Optional[np.ndarray] = None
+    node_ct: Optional[np.ndarray] = None
+    # whether any resident pod carried an in-flight eviction plan at
+    # record time: lets an index-resolved plan() answer the preempt
+    # check without the per-pass O(residents) annotation scan (node
+    # events — including resident pod changes — retire the index first)
+    preempt_any: bool = False
 
     @property
     def n_groups(self) -> int:
@@ -168,6 +187,19 @@ class SolveCache:
         # observability for tests/debug: the last pass's verdict
         self.last_outcome: Optional[str] = None
         self.last_reason: Optional[str] = None
+        # flight stamps (ISSUE 20): the last delta pass's dirty-set
+        # size, suffix re-encode count, and group-reuse fraction — set
+        # by _try_delta so every flight record is self-describing
+        self.last_dirty: Optional[int] = None
+        self.last_reencoded: Optional[int] = None
+        self.last_reuse: Optional[float] = None
+        # event-driven incremental index (ISSUE 20, solver/incr.py):
+        # built lazily at put() time once the solver engages INCR mode
+        # (incr_enabled), maintained at invalidate() time from resolved
+        # objects, retired whole whenever a generation check fails
+        self.incr = None
+        self.incr_enabled = False
+        self.last_incr_reason: Optional[str] = None
 
     def get(self, cat) -> Optional[DeltaRecord]:
         with self._lock:
@@ -176,14 +208,34 @@ class SolveCache:
                 self._records.move_to_end(id(cat))
             return rec
 
-    def put(self, cat, rec: DeltaRecord, consumed=None) -> None:
+    def get_any(self) -> Optional[DeltaRecord]:
+        """Most-recently stored record regardless of catalog identity —
+        introspection/tests only (the solve path always keys by cat)."""
+        with self._lock:
+            for rec in reversed(self._records.values()):
+                return rec
+            return None
+
+    def put(self, cat, rec: DeltaRecord, consumed=None,
+            incr_carry: bool = False) -> None:
         """Publish a fresh record.  `consumed` is the dirty SNAPSHOT the
         solve that built it observed (dirty_snapshot()): only that dirt
         is retired — invalidations that arrived mid-solve (another
         thread's feed) stay dirty, or the next pass could engage
         against state an event flagged and values can't disprove.
         consumed=None retires nothing (pure conservatism: stale dirt
-        costs one counted fallback, whose full solve then retires it)."""
+        costs one counted fallback, whose full solve then retires it).
+
+        The incremental index follows the same generation discipline,
+        but retirement is all-or-nothing: it only (re)builds when NO
+        invalidation raced the solve (`gen == self._gen`) — a partial
+        carry could mis-map a racing event's name to the wrong row,
+        and unlike the name sets there is no value-check backstop.  A
+        raced index drops whole; the next pass is a counted "cold"/
+        "drift" whose walk rebuilds it.  `incr_carry=True` marks a
+        record produced FROM the index's own view (an index-resolved
+        delta pass), allowing the O(churn) structural advance instead
+        of the O(cluster) rebuild."""
         with self._lock:
             # identity-keyed LRU looked up by `is`-the-same-catalog,
             # never iterated into outputs: eviction order is insertion
@@ -192,16 +244,34 @@ class SolveCache:
             self._records.move_to_end(id(cat))
             while len(self._records) > self.capacity:
                 self._records.popitem(last=False)
+            raced = True
             if consumed is not None:
                 pods, nodes, flood, gen = consumed
                 self.dirty_pods -= pods
                 self.dirty_nodes -= nodes
-                if flood and gen == self._gen:
+                raced = gen != self._gen
+                if flood and not raced:
                     # no invalidation landed since the snapshot: the
                     # flood the solve observed is fully absorbed
                     self.all_dirty = False
+            if self.incr_enabled:
+                if raced:
+                    self.incr = None
+                elif (incr_carry and self.incr is not None
+                        and self.incr.advance(rec)):
+                    pass
+                else:
+                    from karpenter_tpu.solver import incr as incrmod
+                    self.incr = incrmod.index_from_record(rec)
 
-    def invalidate(self, pods=(), nodes=(), flood: bool = False) -> None:
+    def invalidate(self, pods=(), nodes=(), flood: bool = False,
+                   pod_objs=None, node_objs=None, claims=()) -> None:
+        """Accumulate event dirt.  `pods`/`nodes` are the classic name
+        sets the walk-based plan consumes.  `pod_objs`/`node_objs`
+        (name → resolved store object or None) and `claims` (nodeclaim
+        names) additionally feed the incremental index; a names-only
+        call marks the index stale (counted "pods" on its next use) —
+        the walk path never needed objects and keeps working as-is."""
         with self._lock:
             self._gen += 1
             self.dirty_pods.update(pods)
@@ -211,6 +281,31 @@ class SolveCache:
                 self.all_dirty = True
                 self.dirty_pods.clear()
                 self.dirty_nodes.clear()
+            idx = self.incr
+            if idx is None:
+                return
+            if flood or self.all_dirty:
+                idx.note_flood()
+                return
+            if pod_objs is not None:
+                for name in pod_objs:
+                    idx.apply_pod(name, pod_objs[name])
+                if any(n not in pod_objs for n in pods):
+                    idx.note_names_only()
+            elif pods:
+                idx.note_names_only()
+            if node_objs is not None:
+                for name in node_objs:
+                    idx.apply_node(name, node_objs[name])
+                if any(n not in node_objs and n not in claims
+                       for n in nodes):
+                    idx.nodes_dirty = True
+            elif nodes:
+                # names-only node dirt: conservative, same verdict the
+                # walk's fingerprint sweep would reach for a real event
+                idx.nodes_dirty = True
+            for name in claims:
+                idx.apply_claim(name)
 
     def dirty_snapshot(self):
         """(dirty_pods, dirty_nodes, all_dirty, gen) as one consistent
@@ -221,12 +316,27 @@ class SolveCache:
                     frozenset(self.dirty_nodes), self.all_dirty,
                     self._gen)
 
+    def incr_snapshot(self):
+        """(index snapshot | None, classic dirty snapshot) taken under
+        ONE lock acquisition: the index-resolved pass must consume the
+        same generation the index view reflects, or put() could retire
+        dirt the group build never saw."""
+        with self._lock:
+            classic = (frozenset(self.dirty_pods),
+                       frozenset(self.dirty_nodes), self.all_dirty,
+                       self._gen)
+            idx = self.incr
+            snap = idx.snapshot() if idx is not None else None
+            dirty_count = idx.dirty_count() if idx is not None else 0
+            return snap, classic, dirty_count
+
     def clear(self) -> None:
         with self._lock:
             self._records.clear()
             self.dirty_pods.clear()
             self.dirty_nodes.clear()
             self.all_dirty = False
+            self.incr = None
 
 
 def _fingerprint(en) -> _NodeFP:
@@ -239,6 +349,7 @@ def _fingerprint(en) -> _NodeFP:
         deleting=node.meta.deleting,
         avail=np.array(en.available.v, dtype=np.float32),
         res_anti=_has_required_anti(en.pods),
+        alloc=np.array(node.allocatable.v, dtype=np.float32),
     )
 
 
@@ -283,35 +394,41 @@ def _same_group(g, prev_g, names) -> bool:
 
 
 def plan(rec: Optional[DeltaRecord], inp, groups, dirty,
-         min_groups: int, g_buckets) -> "DeltaPlan | str":
+         min_groups: int, g_buckets, hints=None) -> "DeltaPlan | str":
     """Diff the new pass against the record.  `dirty` is the caller's
     SolveCache.dirty_snapshot() — taken once per pass so put() can
     retire exactly what this diff observed.  Returns a DeltaPlan, or a
-    fallback-reason string (every string return is counted)."""
-    gang_specs = [gang_of(g[0]) for g in groups]
-    if any(sp is not None and sp.domain_key is not None
-           for sp in gang_specs):
-        # adjacency gangs pin their nodes to a domain; the seeded merge
-        # rebuilds node_zone/ct from the suffix solve alone (always -1
-        # on the topology-free delta path), so the pins would be lost —
-        # and make_record rejects dsel>0 anyway, so no base ever forms.
-        # Checked FIRST so the counted reason names the real cause
-        # instead of an eternal "cold".
-        return "gang"
+    fallback-reason string (every string return is counted).
+
+    With `hints` (an IncrHints from the event-driven index, ISSUE 20)
+    the per-pass cluster walks vanish: the prefix length and suffix
+    reuse map are precomputed from O(churn) probes, node cleanliness
+    was proven at event time (only the O(1) count check remains), and
+    the resident preempt-annotation scan collapses to the record's
+    cached flag.  Everything O(groups) — band, topology, gang, bucket
+    — still verifies live: those checks are cluster-size-independent
+    and each guards an exactness contract."""
     if len({priority_of(g[0]) for g in groups}) > 1:
         # multi-band pass (ISSUE 16): the full path appends the
         # group_prio row and runs with_priority=1; the seeded delta
         # kernel runs with_priority=0 by contract, so band packing and
         # the inversion witness would be silently lost — fall back
-        # whole (counted).  Also checked before "cold" so the reason
-        # names the cause.
+        # whole (counted).  Checked before "cold" so the reason names
+        # the cause.
         return "priority"
-    if any(wellknown.PREEMPT_PLAN_ANNOTATION in p.meta.annotations
-           for en in inp.existing_nodes for p in en.pods):
-        # an in-flight eviction plan: the stamped victims' capacity
-        # frees between this pass and the next, so a prefix seeded
-        # against the pre-eviction base would replay stale headroom —
-        # full pass until the preemption controller settles (counted)
+    if hints is None:
+        if any(wellknown.PREEMPT_PLAN_ANNOTATION in p.meta.annotations
+               for en in inp.existing_nodes for p in en.pods):
+            # an in-flight eviction plan: the stamped victims' capacity
+            # frees between this pass and the next, so a prefix seeded
+            # against the pre-eviction base would replay stale headroom
+            # — full pass until the preemption controller settles
+            # (counted)
+            return "preempt"
+    elif rec is not None and rec.preempt_any:
+        # same verdict from the record's cached flag: the index only
+        # resolves a pass when zero node/resident events arrived, so
+        # the record-time scan is still the truth
         return "preempt"
     if rec is None:
         return "cold"
@@ -325,52 +442,68 @@ def plan(rec: Optional[DeltaRecord], inp, groups, dirty,
         return "limits"
     if len(groups) < min_groups:
         return "small"
+    gang_specs = [gang_of(g[0]) for g in groups]
     for g in groups:
         rep = g[0]
         if rep.topology_spread or rep.pod_affinities or rep.preferences:
             return "topology"
     if rec.res_anti_any:
         return "topology"
-    if not _nodes_unchanged(rec, inp.existing_nodes, dirty_nodes):
+    if hints is None:
+        if not _nodes_unchanged(rec, inp.existing_nodes, dirty_nodes):
+            return "nodes"
+    elif len(inp.existing_nodes) != len(rec.node_fps):
         return "nodes"
 
-    # dirty-set short-circuit (ISSUE 15 satellite): resolve the dirty
-    # names to record ROWS once via the lazily-built name index —
-    # O(churn) dict probes.  A dirty name the record never saw needs no
-    # row: its group (new/renamed member) fails _same_group on its own.
-    # This replaces the per-group any(n in dirty_pods) scans that made
-    # even a single-dirty-pod pass O(cluster × members).
-    dirty_rows: "frozenset | set" = frozenset()
-    if dirty_pods:
-        idx = rec.name_rows
-        if idx is None:
-            idx = {}
-            for i, (_gid, names) in enumerate(rec.gkeys):
-                for n in names:
-                    idx[n] = i
-            rec.name_rows = idx
-        dirty_rows = {idx[n] for n in dirty_pods if n in idx}
+    if hints is not None:
+        # index-resolved prefix: groups[:m] ARE rec.groups[:m] by
+        # reference (the index hands back the record's own lists), so
+        # the per-member walk below would only re-prove identity
+        m = min(hints.m, len(groups), rec.n_groups)
+        suffix = groups[m:]
+        reuse: List[Optional[int]] = list(hints.reuse)
+    else:
+        # dirty-set short-circuit (ISSUE 15 satellite): resolve the
+        # dirty names to record ROWS once via the lazily-built name
+        # index — O(churn) dict probes.  A dirty name the record never
+        # saw needs no row: its group (new/renamed member) fails
+        # _same_group on its own.  This replaces the per-group
+        # any(n in dirty_pods) scans that made even a single-dirty-pod
+        # pass O(cluster × members).
+        dirty_rows: "frozenset | set" = frozenset()
+        if dirty_pods:
+            idx = rec.name_rows
+            if idx is None:
+                idx = {}
+                for i, (_gid, names) in enumerate(rec.gkeys):
+                    for n in names:
+                        idx[n] = i
+                rec.name_rows = idx
+            dirty_rows = {idx[n] for n in dirty_pods if n in idx}
 
-    prev_groups, prev_keys = rec.groups, rec.gkeys
-    m = 0
-    limit = min(len(groups), rec.n_groups)
-    while m < limit:
-        gid, names = prev_keys[m]
-        g = groups[m]
-        if g[0].scheduling_group_id() != gid:
-            break
-        if m in dirty_rows:
-            break
-        if not _same_group(g, prev_groups[m], names):
-            break
-        m += 1
-    suffix = groups[m:]
+        prev_groups, prev_keys = rec.groups, rec.gkeys
+        m = 0
+        limit = min(len(groups), rec.n_groups)
+        while m < limit:
+            gid, names = prev_keys[m]
+            g = groups[m]
+            if g[0].scheduling_group_id() != gid:
+                break
+            if m in dirty_rows:
+                break
+            if not _same_group(g, prev_groups[m], names):
+                break
+            m += 1
+        suffix = groups[m:]
     if any(gang_specs[m + j] is not None
            for j in range(len(suffix))):
         # a gang in the suffix — a dirty gang member, or any gang
         # behind the first changed group: the seeded kernel runs
         # with_gang=0 by contract, so the whole gang's prefix reuse is
-        # invalidated and the pass falls back whole (counted)
+        # invalidated and the pass falls back whole (counted).  A
+        # DOMAIN-STABLE gang (no member churn, ahead of the churn) sits
+        # in the prefix and replays via its recorded node pins — only
+        # domain-churned gangs still pay this fallback.
         return "gang"
     if suffix and (bucket(len(suffix), g_buckets)
                    >= bucket(len(groups), g_buckets)):
@@ -378,17 +511,18 @@ def plan(rec: Optional[DeltaRecord], inp, groups, dirty,
         # no win, and a fresh seeded program compile for nothing
         return "bucket"
 
-    prev_by_gid = {prev_keys[i][0]: i for i in range(m, rec.n_groups)}
-    reuse: List[Optional[int]] = []
-    for g in suffix:
-        i = prev_by_gid.get(g[0].scheduling_group_id())
-        if i is not None:
-            _, names = prev_keys[i]
-            if (i not in dirty_rows
-                    and _same_group(g, prev_groups[i], names)):
-                reuse.append(i)
-                continue
-        reuse.append(None)
+    if hints is None:
+        prev_by_gid = {prev_keys[i][0]: i for i in range(m, rec.n_groups)}
+        reuse = []
+        for g in suffix:
+            i = prev_by_gid.get(g[0].scheduling_group_id())
+            if i is not None:
+                _, names = prev_keys[i]
+                if (i not in dirty_rows
+                        and _same_group(g, prev_groups[i], names)):
+                    reuse.append(i)
+                    continue
+            reuse.append(None)
     return DeltaPlan(record=rec, m=m, new_prefix=groups[:m],
                      suffix=suffix, reuse=reuse)
 
@@ -520,6 +654,33 @@ def build(plan_: DeltaPlan, cat) -> "SuffixProblem | None":
         opener = np.zeros(0, dtype=np.int64)
         A = 0
 
+    # adjacency-gang pin replay (ISSUE 20): a prefix gang with dsel>0
+    # filled its new nodes inside ONE winning domain, and the kernel's
+    # gang branch narrowed those nodes' colmask by the domain's columns
+    # (dcols) at open AND touch time.  Recover each gang's winner from
+    # the recorded node pins and replay the same narrowing — the host
+    # dcols over real columns equals the kernel's slot-expanded mask
+    # because cat.col_zone/col_ct ARE the per-column domain ids.
+    gang_dcols: Dict[int, np.ndarray] = {}
+    gg = enc.group_gang
+    if gg is not None and A and np.asarray(gg[:m]).any():
+        for g in np.nonzero(np.asarray(gg[:m]))[0]:
+            dsel = int(enc.group_dsel[g])
+            if dsel == 0:
+                continue        # domain-free gang: dcols is all-true
+            sel = tn[g, :A] > 0
+            if not sel.any():
+                continue        # exist-only fill: no colmask narrowing
+            pins = rec.node_zone if dsel == 1 else rec.node_ct
+            if pins is None:
+                return None     # pre-pin record: replay invariant
+            doms = np.unique(pins[:A][sel])
+            if doms.size != 1 or int(doms[0]) < 0:
+                return None     # inconsistent pins: replay invariant
+            w = int(doms[0])
+            gang_dcols[int(g)] = ((cat.col_zone == w) if dsel == 1
+                                  else (cat.col_ct == w))
+
     seed_used = np.zeros((A, R), dtype=np.float32)
     seed_pool = rec.node_pool[:A].astype(np.int32, copy=True)
     seed_colmask = np.zeros((A, O_real), dtype=bool)
@@ -527,13 +688,18 @@ def build(plan_: DeltaPlan, cat) -> "SuffixProblem | None":
         pool_rows = cat.pool_daemon[seed_pool]          # [A, R] f32
         opener_a = opener[:A]
         # opener colmask base: cols_p of the opening group ∩ the node's
-        # pool (the kernel's step-3 new_colmask, before capacity)
+        # pool (the kernel's step-3 new_colmask, before capacity); a
+        # gang opener additionally intersects its winning domain's
+        # columns, exactly the kernel's `& dcols`
         for gi in np.unique(opener_a):
             feas = _feas_row(rec, cat, int(gi))
             sel = opener_a == gi
-            seed_colmask[sel] = (feas[None, :]
-                                 & (cat.col_pool[None, :]
-                                    == seed_pool[sel, None]))
+            base = (feas[None, :]
+                    & (cat.col_pool[None, :] == seed_pool[sel, None]))
+            d = gang_dcols.get(int(gi))
+            if d is not None:
+                base &= d[None, :]
+            seed_colmask[sel] = base
         for g in range(m):
             row = tn[g, :A]
             sel = row > 0
@@ -548,7 +714,12 @@ def build(plan_: DeltaPlan, cat) -> "SuffixProblem | None":
             if touched.any():
                 seed_used[touched] = seed_used[touched] + prod[touched]
                 # in-flight touch narrows the mask to the group's columns
-                seed_colmask[touched] &= enc.group_mask[g][None, :]
+                # (a gang touch also narrows to its winning domain)
+                narrow = enc.group_mask[g]
+                d = gang_dcols.get(g)
+                if d is not None:
+                    narrow = narrow & d
+                seed_colmask[touched] &= narrow[None, :]
         # final capacity mask: pt-granular fit against the final used
         # vector (the kernel applies it every step; used only grows, so
         # the final application is the binding one)
@@ -588,8 +759,16 @@ def merge(plan_: DeltaPlan, sp: SuffixProblem, cat, inp,
         tn = rec.out_tn[:m, :A]
         used = sp.seed_used
         node_pool = sp.seed_pool
-        node_dom = np.full(A, -1, dtype=np.int32)
-        node_zone, node_ct = node_dom, node_dom
+        # prefix gang pins survive the merge (ISSUE 20): the recorded
+        # winning-domain per node is the seed's truth — without it the
+        # repair pass and decode's claim pinning would see -1 and
+        # strand every adjacency gang the prefix replayed
+        if rec.node_zone is not None:
+            node_zone = rec.node_zone[:A].copy()
+            node_ct = rec.node_ct[:A].copy()
+        else:
+            node_dom = np.full(A, -1, dtype=np.int32)
+            node_zone, node_ct = node_dom, node_dom
     else:
         num_active = int(out_s["num_active"])
         te = np.concatenate(
@@ -602,6 +781,15 @@ def merge(plan_: DeltaPlan, sp: SuffixProblem, cat, inp,
         node_pool = out_s["node_pool"]
         node_zone = out_s["node_zone"]
         node_ct = out_s["node_ct"]
+        if A and rec.node_zone is not None:
+            # seeded slots re-enter the suffix kernel with node_zone/ct
+            # at their init (-1) — the suffix is gang- and topology-free
+            # by plan() contract, so it never writes them; restore the
+            # prefix's recorded pins over the first A slots
+            node_zone = np.asarray(node_zone, dtype=np.int32).copy()
+            node_ct = np.asarray(node_ct, dtype=np.int32).copy()
+            node_zone[:A] = rec.node_zone[:A]
+            node_ct[:A] = rec.node_ct[:A]
 
     out_m = dict(
         take_exist=te,
@@ -677,7 +865,8 @@ def merge(plan_: DeltaPlan, sp: SuffixProblem, cat, inp,
         # gang rows stitch like every other group tensor; plan()
         # guarantees the SUFFIX is gang-free (counted "gang" fallback
         # otherwise), so the suffix side is always zeros — prefix gangs
-        # (domain-free, fully placed at record time) reuse bit-exactly
+        # (fully placed at record time; adjacency gangs replay their
+        # recorded domain pins through build/merge) reuse bit-exactly
         group_gang=cc(enc_p.group_gang[:m], np.zeros(Gd, dtype=bool)),
         col_zone=cat.col_zone,
         col_ct=cat.col_ct,
@@ -716,13 +905,22 @@ def tables_reusable(old: DeltaRecord, new: DeltaRecord) -> bool:
     return True
 
 
-def make_record(cat, enc: EncodedProblem, out: dict, inp
-                ) -> Optional[DeltaRecord]:
+def make_record(cat, enc: EncodedProblem, out: dict, inp,
+                carry=None) -> Optional[DeltaRecord]:
     """Build a DeltaRecord from a finished solve, or None when the
     solve is ineligible as a delta base: anything stranded, any
     topology activity in the encoding, synthetic charge-pool nodes, or
     finite pool limits (their device arithmetic has no exact host
-    mirror)."""
+    mirror).  Gang groups are the ONE dsel>0 shape admitted (ISSUE 20):
+    their fills carry recorded node pins that build()/merge() replay
+    bit-exactly, so domain-stable gangs stop costing an eternal "cold".
+
+    `carry=(prev_record, plan_)` marks a record built by an ENGAGED
+    delta pass: the group keys stitch from the previous record along
+    the plan's prefix/reuse map (O(groups + churn) instead of the
+    O(cluster) name walk), and the node fingerprints carry whole — the
+    pass only engaged because the node set was verified unchanged, by
+    value (walk) or by event (index)."""
     G = enc.n_groups
     E = len(enc.existing)
     if G == 0:
@@ -735,7 +933,10 @@ def make_record(cat, enc: EncodedProblem, out: dict, inp
     if any(lim is not None
            for lim in (inp.remaining_limits or {}).values()):
         return None
-    if (enc.group_dsel[:G] != 0).any() or \
+    gg = enc.group_gang
+    gang_rows = (np.asarray(gg[:G], dtype=bool) if gg is not None
+                 else np.zeros(G, dtype=bool))
+    if ((np.asarray(enc.group_dsel[:G]) != 0) & ~gang_rows).any() or \
             (enc.group_ncap[:G] < BIG).any() or \
             enc.group_whole_node[:G].any():
         return None
@@ -751,9 +952,31 @@ def make_record(cat, enc: EncodedProblem, out: dict, inp
         np.asarray(out["take_new"])[:G, :na], dtype=np.float32)
     node_pool = np.ascontiguousarray(
         np.asarray(out["node_pool"])[:na], dtype=np.int32)
-    gkeys = [(g[0].scheduling_group_id(),
-              tuple(p.meta.name for p in g)) for g in enc.groups]
-    node_fps = [_fingerprint(en) for en in enc.existing]
+    node_zone = np.ascontiguousarray(
+        np.asarray(out["node_zone"])[:na], dtype=np.int32)
+    node_ct = np.ascontiguousarray(
+        np.asarray(out["node_ct"])[:na], dtype=np.int32)
+    if carry is not None:
+        prev, plan_ = carry
+        m = plan_.m
+        gkeys = list(prev.gkeys[:m])
+        for g, ridx in zip(plan_.suffix, plan_.reuse):
+            if ridx is not None:
+                gkeys.append(prev.gkeys[ridx])
+            else:
+                gkeys.append((g[0].scheduling_group_id(),
+                              tuple(p.meta.name for p in g)))
+        node_fps = prev.node_fps
+        res_anti_any = prev.res_anti_any
+        preempt_any = prev.preempt_any
+    else:
+        gkeys = [(g[0].scheduling_group_id(),
+                  tuple(p.meta.name for p in g)) for g in enc.groups]
+        node_fps = [_fingerprint(en) for en in enc.existing]
+        res_anti_any = any(fp.res_anti for fp in node_fps)
+        preempt_any = any(
+            wellknown.PREEMPT_PLAN_ANNOTATION in p.meta.annotations
+            for en in enc.existing for p in en.pods)
     kc = out.get("explain_counts")
     explain_counts = (np.ascontiguousarray(np.asarray(kc)[:G])
                       if kc is not None else None)
@@ -761,8 +984,10 @@ def make_record(cat, enc: EncodedProblem, out: dict, inp
         cat=cat, enc=enc, groups=list(enc.groups), gkeys=gkeys,
         out_te=te, out_tn=tn, node_pool=node_pool, num_active=na,
         node_fps=node_fps,
-        res_anti_any=any(fp.res_anti for fp in node_fps),
-        explain_counts=explain_counts)
+        res_anti_any=res_anti_any,
+        explain_counts=explain_counts,
+        node_zone=node_zone, node_ct=node_ct,
+        preempt_any=preempt_any)
 
 
 # ---------------------------------------------------------------------------

@@ -282,6 +282,18 @@ def _priority_band_sort(groups: List[List[Pod]]) -> List[List[Pod]]:
     return [groups[i] for i in order]
 
 
+def group_order_key(rep: Pod) -> tuple:
+    """The FFD ordering key of one equivalence class, read off its
+    representative: size descending with the representative's name as
+    the deterministic tiebreak.  The ONE definition shared by the
+    grouping sort below, the native fast path's contract, and the
+    event-driven index (solver/incr.py) — the index proves the order
+    invariant by comparing these keys, so a private copy drifting in
+    either place would let an out-of-order group list engage the
+    seeded replay."""
+    return (rep.requests.sort_key(), rep.meta.name)
+
+
 def group_pods_py(pods: List[Pod]) -> List[List[Pod]]:
     byid: Dict[int, List[Pod]] = {}
     for pod in pods:
@@ -291,8 +303,7 @@ def group_pods_py(pods: List[Pod]) -> List[List[Pod]]:
     # same list, and pods within a class are interchangeable) — the old
     # per-member name sort was ~40% of grouping cost at 50k pods for a
     # purely cosmetic ordering
-    groups.sort(key=lambda g: (g[0].requests.sort_key(), g[0].meta.name),
-                reverse=True)
+    groups.sort(key=lambda g: group_order_key(g[0]), reverse=True)
     return groups
 
 

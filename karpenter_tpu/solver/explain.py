@@ -262,6 +262,29 @@ SPEC_FALLBACK_REASONS = frozenset((
     "small", "bucket", "topology", "shape", "gang", "priority",
     "price-cap", "limits", "slots", "stranded", "seed"))
 
+# incremental-index seam fallback vocabulary (solver/solve.py
+# _incr_fallback / solver/incr.py build_groups, ISSUE 20): every pass
+# where the event-driven group index could have engaged but resolved
+# the dirty set by walking instead names one of these.  Deliberately
+# DISJOINT in meaning from the delta vocabulary — an index fallback
+# degrades only the GROUPING to the O(cluster) walk; the delta seam
+# then makes its own engage/fallback call downstream:
+#   cold  — no index yet (no record stored, or the record was raced
+#           away by an invalidation mid-store and the index dropped)
+#   flood — the watch buffer overflowed (or an all-dirty invalidation
+#           arrived): every event-derived fact is suspect
+#   drift — the index's pod census disagrees with the live input (a
+#           mutation reached the solver without a watch event)
+#   pods  — pod names were invalidated without their objects (a
+#           name-only feed cannot update group membership)
+#   nodes — node-shaped dirt the event-time absorber could not prove
+#           harmless (bind/unbind, allocatable change, unknown
+#           deletion) — the walk's value sweep is the authority
+#   order — the index cannot prove the walk's group order (a new
+#           group key, a band flip, or a non-monotone key sequence)
+INCR_FALLBACK_REASONS = frozenset((
+    "cold", "flood", "drift", "pods", "nodes", "order"))
+
 # tenant-scheduler shed vocabulary (service/scheduler.py)
 SHED_ADMISSION = "admission"
 SHED_DEADLINE = "deadline"

@@ -835,6 +835,15 @@ def _solve_ffd_impl(
             # capacity-type/rack); a domain-free gang (dsel=0) maps
             # every column/node to domain 0 and the machinery
             # degenerates to a single global trial.
+            # REPLAY CONTRACT (ISSUE 20): solver/delta.py build()/
+            # merge() host-replay a prefix gang row from the recorded
+            # winner pins instead of re-running this fill — the
+            # winner-domain column narrowing (dcols below), the
+            # touched-node colmask update, and the node_zone/node_ct
+            # pin writes are mirrored there op-for-op.  Changing the
+            # winner selection, the narrowing masks, or the pin
+            # arithmetic here requires the same change in delta.py or
+            # the seeded merge loses bit parity on gang prefixes.
             exist_rem = carry["exist_rem"]
             used = carry["used"]
             colmask = carry["colmask"]

@@ -80,7 +80,7 @@ class UnsupportedPods(Exception):
 
 class TPUSolver:
     def __init__(self, max_nodes: int = 1024, mesh="auto", delta="auto",
-                 spec="auto"):
+                 spec="auto", incr="auto"):
         """`mesh` selects the multi-chip story (SURVEY §2.3: shard the
         column axis over ICI):
 
@@ -117,6 +117,16 @@ class TPUSolver:
         ``KARPENTER_TPU_SPEC=on/off/auto`` OVERRIDES the constructed
         spec — same grammar, same rollback discipline as the mesh and
         delta knobs; malformed values degrade to the constructed spec.
+
+        ``incr`` selects the event-driven incremental group index
+        (ISSUE 20, solver/incr.py): "auto" (default) engages only once
+        ``incr_arm()`` marks the watch feed live (the index trusts
+        events, so it must not engage for callers that never deliver
+        them); "on" forces engagement (benches, tests); "off"/None
+        disables.  The env knob ``KARPENTER_TPU_INCR=on/off/auto``
+        OVERRIDES the constructed spec — same grammar, same rollback
+        discipline as DELTA/SPEC; malformed values degrade to the
+        constructed spec.
         """
         self.max_nodes = max_nodes
         # relaxation-loop wall-clock budget (seconds; None = unbounded,
@@ -165,6 +175,14 @@ class TPUSolver:
         self._spec_resolved = None
         self._last_spec_chunks = 0
         self.last_spec: Optional[Dict] = None
+        # event-driven incremental group index (ISSUE 20): knob spec +
+        # the armed latch.  Unlike the walk-based delta (value-checked,
+        # correct with zero events), the index TRUSTS the event stream —
+        # "auto" engages only after incr_arm() declares a live feed.
+        self._incr_spec = incr
+        self._incr_resolved = None
+        self._incr_armed = False
+        self._incr_hints = None
         # per-solve host/device phase breakdown (ms), refreshed by
         # _solve_attempt — the observability the north-star budget needs
         # (encode+decode host share must stay well under the solve time)
@@ -303,6 +321,51 @@ class TPUSolver:
                 self._spec_resolved = ("auto",)
         return self._spec_resolved[0]
 
+    @staticmethod
+    def _incr_env_spec(spec):
+        """Apply the KARPENTER_TPU_INCR rollback knob: "off"/"0" forces
+        the walk-based dirty resolution, "on" forces the event-driven
+        index (no armed gate — benches/tests that deliver their own
+        events), "auto" restores the default armed-gated engagement;
+        unset or malformed leaves the constructed spec alone (the
+        _delta_env_spec grammar, owned here — kt-lint's knob registry
+        points at this file)."""
+        import os as _os
+        raw = _os.environ.get("KARPENTER_TPU_INCR", "").strip().lower()
+        if not raw:
+            return spec
+        if raw in ("off", "0", "false", "none"):
+            return None
+        if raw in ("on", "1", "true", "yes"):
+            return "on"
+        if raw == "auto":
+            return "auto"
+        return spec
+
+    def _resolve_incr(self):
+        """The incremental-index mode for this solver: False
+        (disabled), "auto" (armed-gated), or "on" (forced) — resolved
+        once, a restart-time operator lever like the mesh/delta/spec
+        knobs."""
+        if self._incr_resolved is None:
+            spec = self._incr_env_spec(self._incr_spec)
+            if spec in (None, 0, False, "off", ""):
+                self._incr_resolved = (False,)
+            elif spec == "on":
+                self._incr_resolved = ("on",)
+            else:
+                self._incr_resolved = ("auto",)
+        return self._incr_resolved[0]
+
+    def incr_arm(self) -> None:
+        """Declare the event feed live: every pod/node/claim mutation
+        reaches delta_invalidate() with objects from now on, so the
+        "auto" incremental index may trust the stream.  Called by
+        GatedSolver next to wiring SolveCacheFeed; callers that solve
+        without a feed (consolidation sims, ad-hoc scripts) never arm,
+        and auto mode stays silently on the walk path for them."""
+        self._incr_armed = True
+
     def _explain_mode(self) -> int:
         """The resolved KARPENTER_TPU_EXPLAIN mode (0/1/2) — explain.py
         owns the grammar; resolved once per solver, a restart-time
@@ -322,15 +385,27 @@ class TPUSolver:
         return exc
 
     def delta_invalidate(self, pods=(), nodes=(),
-                         flood: bool = False) -> None:
+                         flood: bool = False,
+                         pod_objs=None, node_objs=None,
+                         claims=()) -> None:
         """Event-driven invalidation feed (controllers/state.py
         SolveCacheFeed): pod names whose groups must re-encode, node
         names whose cached rows can no longer be trusted; flood=True
         when the event stream may have dropped entries (watch-buffer
         overflow) — everything is then treated dirty until a full
         solve refreshes the record.  Thread-safe; retired when a solve
-        stores a fresh record against the snapshot it observed."""
-        self._delta_cache.invalidate(pods=pods, nodes=nodes, flood=flood)
+        stores a fresh record against the snapshot it observed.
+
+        ``pod_objs``/``node_objs`` map event names to their CURRENT
+        objects (None = deleted) and ``claims`` lists nodeclaim-kind
+        event names; they feed the incremental group index (ISSUE 20)
+        so it can absorb events at watch time instead of walking the
+        cluster per pass.  Names delivered without objects degrade the
+        index to a counted fallback — the walk path needs only the
+        name sets, exactly as before."""
+        self._delta_cache.invalidate(
+            pods=pods, nodes=nodes, flood=flood,
+            pod_objs=pod_objs, node_objs=node_objs, claims=claims)
 
     def _pt_align(self) -> int:
         """The (pool,type) axis pads to lcm(PT_ALIGN, mesh size): a
@@ -836,7 +911,16 @@ class TPUSolver:
         from karpenter_tpu.solver.encode import group_pods
         wall0 = _time.time()
         t0 = _time.perf_counter()
-        groups = group_pods(inp.pods)
+        # event-driven steady state (ISSUE 20): when the incremental
+        # index can resolve this pass, the O(cluster) grouping walk is
+        # replaced by index-assembled groups (clean rows reused by
+        # reference, dirty ones rebuilt from O(churn) membership
+        # edits).  Real solves only — consolidation sims (max_nodes
+        # set) mutate hypothetical pod sets the index never saw.
+        groups = (self._try_incr_groups(inp)
+                  if max_nodes is None else None)
+        if groups is None:
+            groups = group_pods(inp.pods)
         # grouping belongs to the ENCODE phase even though it runs before
         # _solve_attempt's timer — _solve_attempt folds this in, so the
         # bench's host-share accounting stays honest
@@ -1085,11 +1169,23 @@ class TPUSolver:
                 # spec=off so the replay baseline stays single-program
                 "spec": (self._resolve_spec() or "off"),
                 "spec_chunks": self._last_spec_chunks,
+                # resolved incremental-index knob (ISSUE 20): replays
+                # pin incr=off so the baseline never needs a live feed
+                "incr": (self._resolve_incr() or "off"),
             },
             phase_ms={k: round(v, 3)
                       for k, v in self.last_phase_ms.items()},
+            # the churn self-description (ISSUE 20): dirty-set size is
+            # stamped on EVERY pass through the delta seam; groups
+            # re-encoded + reuse fraction only when the seeded merge
+            # engaged (None otherwise) — a replayed churn pass carries
+            # its own workload shape
             delta={"outcome": getattr(cache, "last_outcome", None),
-                   "reason": getattr(cache, "last_reason", None)},
+                   "reason": getattr(cache, "last_reason", None),
+                   "dirty": getattr(cache, "last_dirty", None),
+                   "reencoded": getattr(cache, "last_reencoded", None),
+                   "reuse": getattr(cache, "last_reuse", None),
+                   "incr": getattr(cache, "last_incr_reason", None)},
             retraces=_ffd.TRACE_COUNT - getattr(self, "_flight_tr0",
                                                 _ffd.TRACE_COUNT),
             device_memory_peak_bytes=mem,
@@ -1110,6 +1206,51 @@ class TPUSolver:
         cache.last_outcome, cache.last_reason = "fallback", reason
         metrics.SOLVER_DELTA_PASSES.inc(outcome="fallback")
         return None
+
+    def _incr_fallback(self, reason: str) -> None:
+        """Count one walk-resolved pass through the incr seam.  Every
+        pass where the index COULD have engaged (knob on / armed auto)
+        is either outcome="incr" or outcome="fallback" — no silent
+        degrades (config13's zero-uncounted-fallbacks condition reads
+        this).  The reason vocabulary is owned by the registry
+        (explain.py INCR_FALLBACK_REASONS)."""
+        assert reason in explainmod.INCR_FALLBACK_REASONS, reason
+        self._delta_cache.last_incr_reason = reason
+        metrics.SOLVER_INCR_PASSES.inc(outcome="fallback")
+        return None
+
+    def _try_incr_groups(self, inp: ScheduleInput):
+        """Resolve this pass's groups from the event-driven index
+        (solver/incr.py): clean kernel rows reused by reference from
+        the cached record, dirty ones rebuilt from O(churn) membership
+        edits — zero cluster walks.  Returns None (walk path) when the
+        seam is off/unarmed (silent — those callers never see the
+        seam) or on any counted index-unusable condition; otherwise
+        the exact groups group_pods(inp.pods) would have produced,
+        plus stashed IncrHints that let plan()/make_record skip their
+        own O(cluster) work downstream."""
+        self._incr_hints = None
+        mode = self._resolve_incr()
+        if not mode or (mode == "auto" and not self._incr_armed):
+            return None
+        cache = self._delta_cache
+        # flip the cache into index maintenance from the first engaged
+        # pass: non-incr users (knob off, unarmed sims) pay zero
+        cache.incr_enabled = True
+        cache.last_incr_reason = None
+        from karpenter_tpu.solver import incr as incrmod
+        snap, consumed, dirty = cache.incr_snapshot()
+        if snap is None:
+            return self._incr_fallback("cold")
+        built = incrmod.build_groups(snap, inp)
+        if isinstance(built, str):
+            return self._incr_fallback(built)
+        groups, m, reuse = built
+        metrics.SOLVER_INCR_PASSES.inc(outcome="incr")
+        self._incr_hints = incrmod.IncrHints(
+            rec=snap.rec, groups=groups, m=m, reuse=reuse,
+            consumed=consumed, dirty_size=dirty)
+        return groups
 
     def _delta_problem_args(self, rec, sp, G: int, E: int, Db: int,
                             O: int):
@@ -1189,6 +1330,13 @@ class TPUSolver:
         conservative fallback (counted) — the caller then runs the
         ordinary full path, whose finished solve refills the cache."""
         self._delta_consumed = None  # never consume a stale snapshot
+        # index-resolved hints from _try_incr_groups, valid only for
+        # the exact (groups, record) pair they were computed against —
+        # a split/relax sub-solve or a raced record swap drops them
+        hints = self._incr_hints
+        self._incr_hints = None
+        if hints is not None and hints.groups is not groups:
+            hints = None
         mode = self._resolve_delta()
         if not mode or not groups:
             return None
@@ -1200,13 +1348,22 @@ class TPUSolver:
         wall0 = _time.time()
         t0 = _time.perf_counter()
         rec = cache.get(cat)
+        if hints is not None and hints.rec is not rec:
+            hints = None
         # ONE dirty snapshot per pass: plan diffs against it, and the
         # eventual record store (here or _delta_store after a fallback)
-        # retires exactly it — mid-solve invalidations stay dirty
-        self._delta_consumed = cache.dirty_snapshot()
+        # retires exactly it — mid-solve invalidations stay dirty.
+        # Hints carry the snapshot taken atomically WITH the index
+        # snapshot, so index-resolved dirt and retired dirt agree.
+        self._delta_consumed = (hints.consumed if hints is not None
+                                else cache.dirty_snapshot())
+        cache.last_dirty = (hints.dirty_size if hints is not None else
+                            len(self._delta_consumed[0])
+                            + len(self._delta_consumed[1]))
+        cache.last_reencoded = cache.last_reuse = None
         ming = 0 if mode == "on" else deltam.DELTA_MIN_GROUPS
         plan = deltam.plan(rec, inp, groups, self._delta_consumed,
-                           ming, G_BUCKETS)
+                           ming, G_BUCKETS, hints=hints)
         if isinstance(plan, str):
             return self._delta_fallback(plan)
         sp = deltam.build(plan, cat)
@@ -1272,14 +1429,21 @@ class TPUSolver:
         segs = (int((out_m["take_new"][:enc_m.n_groups, :na] > 0)
                     .sum(axis=1).max()) if na and enc_m.n_groups else 0)
         self._last_new_segments = max(segs, 1)
-        new_rec = deltam.make_record(cat, enc_m, out_m, inp)
+        # engaged passes stitch the new record from the old one along
+        # the plan's reuse map — O(groups + churn), no cluster walk
+        new_rec = deltam.make_record(cat, enc_m, out_m, inp,
+                                     carry=(plan.record, plan))
         if new_rec is not None:
             # nodes and catalog held — the lazily-built exist tables
             # and opener feasibility rows stay valid; carry them over
             new_rec.exist_tables = plan.record.exist_tables
             new_rec.feas_cache = plan.record.feas_cache
-            cache.put(cat, new_rec, consumed=self._delta_consumed)
+            cache.put(cat, new_rec, consumed=self._delta_consumed,
+                      incr_carry=(hints is not None))
         cache.last_outcome, cache.last_reason = "delta", None
+        Gt = plan.m + len(plan.suffix)
+        cache.last_reencoded = int(sp.reencoded)
+        cache.last_reuse = round(1.0 - sp.reencoded / max(Gt, 1), 4)
         metrics.SOLVER_DELTA_PASSES.inc(outcome="delta")
         metrics.SOLVER_DELTA_GROUPS_REENCODED.set(sp.reencoded)
         enc_ms = (t1 - t0) * 1e3 + getattr(self, "_pregroup_ms", 0.0)

@@ -330,6 +330,18 @@ SOLVER_DELTA_GROUPS_REENCODED = _g(
     "Pod classes freshly re-encoded in the last delta pass (the churn "
     "the pass actually paid for; unchanged suffix classes reuse their "
     "cached rows).")
+# -- event-driven incremental group index (solver/incr.py, ISSUE 20):
+# -- the O(churn) grouping seam's observable half, same counted
+# -- discipline as the delta seam — a pass where the index could have
+# -- engaged either resolves the dirty set with index probes or names a
+# -- conservative fallback reason and walks
+SOLVER_INCR_PASSES = _c(
+    "karpenter_tpu_solver_incr_passes_total",
+    "Passes through the incremental-index seam by outcome: incr = the "
+    "pass's groups were assembled from the event-maintained index "
+    "(bit-identical to the grouping walk), fallback = a conservative "
+    "index-unusable condition (cold/flood/drift/pods/nodes/order) "
+    "degraded the grouping to the O(cluster) walk.", ("outcome",))
 # -- speculative chunked G-axis pipeline (solver/solve.py _try_spec,
 # -- ISSUE 19): the chunked-chain seam's observable half, same counted
 # -- discipline as the delta seam — a pass either chunks or names a

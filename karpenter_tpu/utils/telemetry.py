@@ -167,6 +167,9 @@ def local_snapshot(flight_tail: int = 16) -> dict:
             "passes": _series(metrics.SOLVER_DELTA_PASSES),
             "groups_reencoded":
                 metrics.SOLVER_DELTA_GROUPS_REENCODED.value(),
+            # event-driven incremental index (ISSUE 20): index-resolved
+            # vs walk-resolved grouping passes, same counted discipline
+            "incr_passes": _series(metrics.SOLVER_INCR_PASSES),
         },
         "service": {
             "retries": metrics.SERVICE_RETRIES.value(),
@@ -270,6 +273,12 @@ def merge(snapshots: Dict[str, dict]) -> dict:
         "retries": sum(num(s, "service", "retries")
                        for s in snapshots.values()),
         "delta_passes": {},
+        # the last-pass churn actually paid for, summed across
+        # processes (ISSUE 20): with the index engaged this tracks the
+        # dirty set, not the cluster — the first-glance O(churn) check
+        "delta_groups_reencoded": sum(
+            num(s, "delta", "groups_reencoded")
+            for s in snapshots.values()),
         "spans_dropped": sum(num(s, "spans_dropped")
                              for s in snapshots.values()),
     }

@@ -64,6 +64,13 @@ os.environ.pop("KARPENTER_TPU_SPOT_RISK", None)
 # solver tests whose phase/metric assertions expect the single program.
 os.environ.pop("KARPENTER_TPU_SPEC", None)
 
+# The event-driven incremental index runs at its DEFAULT (auto): an
+# inherited KARPENTER_TPU_INCR=off from a shell that just drove the
+# warm-million bench would make every incr engage/fallback test pass
+# vacuously, and a leftover =on would force armed-only semantics onto
+# solvers whose tests construct them unarmed on purpose.
+os.environ.pop("KARPENTER_TPU_INCR", None)
+
 # The timeline recorder runs at its DEFAULT (on, ring-only): an
 # inherited KARPENTER_TPU_TIMELINE=off would make every recorder test
 # pass vacuously, an inherited _DIR (from a shell that just drove the

@@ -249,10 +249,12 @@ class TestDeltaFallbacks:
 
 
 class TestDeltaGang:
-    """Gang × delta (ISSUE 15): a dirty gang member invalidates the
-    whole gang's prefix reuse; adjacency gangs and suffix gangs are
-    counted "gang" fallbacks; domain-free gangs in the unchanged
-    prefix reuse bit-exactly."""
+    """Gang × delta (ISSUE 15, reworked by ISSUE 20): a dirty gang
+    member invalidates the whole gang's prefix reuse and SUFFIX gangs
+    stay counted "gang" fallbacks — but a domain-stable adjacency gang
+    in the unchanged prefix now engages: the record carries the
+    winning domain's node pins and build()/merge() replay the pinned
+    fills bit-exactly."""
 
     @staticmethod
     def _gang_pods(n=4, cpu_m=4000, dom=None, name="dgang"):
@@ -268,16 +270,38 @@ class TestDeltaGang:
                     {"cpu": f"{cpu_m}m", "memory": "2048Mi"})))
         return out
 
-    def test_adjacency_gang_always_falls_back(self):
+    def test_domain_stable_adjacency_gang_engages(self):
+        # ISSUE 20: the gang's cpu makes it FFD-FIRST (prefix); tail
+        # churn leaves its domain choice untouched, so the pass must
+        # ENGAGE (no "gang" fallback) and replay the pinned K-node
+        # fills bit-identically to the full re-solve
         on = TPUSolver(mesh="off", delta="on")
-        pods = churn_pods(0) + self._gang_pods(dom="slice")
-        on.solve(mkinput(list(pods)))
-        assert outcome(on) == ("fallback", "gang")
-        on.solve(mkinput(list(pods)))
-        assert outcome(on) == ("fallback", "gang")
+        off = TPUSolver(mesh="off", delta="off")
+        for gen in range(3):
+            pods = self._gang_pods(dom="slice") + churn_pods(gen)
+            r_on = on.solve(mkinput(list(pods)))
+            r_off = off.solve(mkinput(list(pods)))
+            assert canon(r_on) == canon(r_off), f"gen {gen}"
+        assert outcome(on) == ("delta", None)
         assert "gang" in __import__(
             "karpenter_tpu.solver.explain",
             fromlist=["x"]).DELTA_FALLBACK_REASONS
+
+    def test_domain_churned_adjacency_gang_falls_back_counted(self):
+        on = TPUSolver(mesh="off", delta="on")
+        pods = self._gang_pods(dom="slice") + churn_pods(0)
+        on.solve(mkinput(list(pods)))
+        on.solve(mkinput(list(pods)))
+        assert outcome(on) == ("delta", None)
+        # a dirty MEMBER drops the gang into the suffix: the recorded
+        # domain pins carry no authority for a re-solved gang, so the
+        # pass is still the counted "gang" fallback — and bit parity
+        # with the full path must hold through the degrade
+        on.delta_invalidate(pods=["dgang-0"])
+        res = on.solve(mkinput(list(pods)))
+        assert outcome(on) == ("fallback", "gang")
+        off = TPUSolver(mesh="off", delta="off")
+        assert canon(res) == canon(off.solve(mkinput(list(pods))))
 
     def test_domain_free_prefix_gang_reuses_exactly(self):
         # the gang's cpu makes it FFD-FIRST (prefix); tail churn

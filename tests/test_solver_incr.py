@@ -1,0 +1,420 @@
+"""Event-driven incremental group index (solver/incr.py, ISSUE 20).
+
+Contracts:
+
+- **exactness** — an index-resolved pass produces groups identical to
+  the ``group_pods`` walk (same lists, same member order) and a solve
+  result bit-identical to the walk-based delta path and the full
+  re-solve, asserted in lockstep.
+- **armed gating** — ``incr="auto"`` engages only after ``incr_arm()``
+  (the GatedSolver wires it next to its SolveCacheFeed); unarmed auto
+  passes are SILENT (no counter) because the seam never promised those
+  callers anything.  ``incr="on"`` forces engagement; the
+  KARPENTER_TPU_INCR env knob beats the constructed spec.
+- **counted fallbacks** — every index-unusable condition names one of
+  ``INCR_FALLBACK_REASONS`` in
+  ``karpenter_tpu_solver_incr_passes_total``: cold cache, watch-drain
+  flood, census drift, names-only invalidation, node dirt, and
+  order-unprovable membership edits all degrade to the walk counted,
+  never silently.
+- **generation-guarded retirement** — an invalidation racing a solve
+  retires the index whole (next pass counted "cold"), exactly the
+  discipline the classic dirty sets use; a racing thread can cost
+  passes, never correctness.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.cluster import Cluster
+from karpenter_tpu.controllers.state import SolveCacheFeed
+from karpenter_tpu.models import (
+    Node,
+    NodePool,
+    ObjectMeta,
+    Pod,
+    Resources,
+    wellknown,
+)
+from karpenter_tpu.providers import generate_catalog
+from karpenter_tpu.providers.catalog import CatalogSpec
+from karpenter_tpu.scheduling import ExistingNode, ScheduleInput
+from karpenter_tpu.solver import TPUSolver
+from karpenter_tpu.solver import explain as explainmod
+from karpenter_tpu.solver import incr as incrmod
+from karpenter_tpu.solver.encode import group_pods
+from karpenter_tpu.utils import metrics
+
+CATALOG = generate_catalog(CatalogSpec(max_types=10, include_gpu=False))
+
+
+def mkpod(name, cpu_m=500, mem_mi=1024, **kw):
+    return Pod(meta=ObjectMeta(name=name, labels=kw.pop("labels", {})),
+               requests=Resources.parse(
+                   {"cpu": f"{cpu_m}m", "memory": f"{mem_mi}Mi"}), **kw)
+
+
+def mkinput(pods, existing=(), **kw):
+    pool = NodePool(meta=ObjectMeta(name="default"))
+    return ScheduleInput(pods=pods, nodepools=[pool],
+                         instance_types={"default": CATALOG},
+                         existing_nodes=list(existing), **kw)
+
+
+def canon(res):
+    return (sorted((c.nodepool, tuple(sorted(p.meta.name for p in c.pods)),
+                    tuple(c.instance_type_names), round(c.price, 9))
+                   for c in res.new_claims),
+            dict(res.existing_assignments), set(res.unschedulable))
+
+
+def churn_pods(gen, n_groups=30, per=4, churn_from=27):
+    """n_groups size classes in FFD order; classes >= churn_from carry
+    generation-stamped names so each gen churns only the tail — and the
+    churned pods sit at the END of the list, exactly where a store
+    delete+create would put them."""
+    pods = []
+    for g in range(n_groups):
+        cpu = 2000 - g * 50
+        stamp = gen if g >= churn_from else 0
+        for i in range(per):
+            pods.append(mkpod(f"c{g}-{i}-{stamp}", cpu_m=cpu))
+    return pods
+
+
+def churn_events(prev, cur):
+    """The watch-feed view of prev → cur: deleted names resolve to
+    None, created names to their object, in store-mutation order
+    (deletes first, creates appended)."""
+    pn = {p.meta.name for p in prev}
+    cn = {p.meta.name for p in cur}
+    objs = {}
+    for p in prev:
+        if p.meta.name not in cn:
+            objs[p.meta.name] = None
+    for p in cur:
+        if p.meta.name not in pn:
+            objs[p.meta.name] = p
+    return objs
+
+
+def feed_churn(solver, prev, cur):
+    objs = churn_events(prev, cur)
+    solver.delta_invalidate(pods=set(objs), pod_objs=objs)
+
+
+def incr_counts():
+    return (metrics.SOLVER_INCR_PASSES.value(outcome="incr"),
+            metrics.SOLVER_INCR_PASSES.value(outcome="fallback"))
+
+
+def last_incr(solver):
+    return solver._delta_cache.last_incr_reason
+
+
+class TestIncrEngage:
+    def test_engages_and_matches_walk_and_full(self):
+        on = TPUSolver(mesh="off", delta="on", incr="on")
+        walk = TPUSolver(mesh="off", delta="on", incr="off")
+        off = TPUSolver(mesh="off", delta="off", incr="off")
+        i0, f0 = incr_counts()
+        prev = None
+        for gen in range(4):
+            pods = churn_pods(gen)
+            if prev is not None:
+                feed_churn(on, prev, pods)
+            r_on = on.solve(mkinput(list(pods)))
+            r_walk = walk.solve(mkinput(list(pods)))
+            r_off = off.solve(mkinput(list(pods)))
+            assert canon(r_on) == canon(r_walk) == canon(r_off), gen
+            prev = pods
+        i1, f1 = incr_counts()
+        assert i1 - i0 == 3          # gens 1..3 index-resolved
+        assert f1 - f0 == 1          # gen 0 was the cold fill
+        assert last_incr(on) is None
+        # ... and the delta seam engaged off the index-built groups
+        assert on._delta_cache.last_outcome == "delta"
+
+    def test_identical_input_is_pure_reuse(self):
+        on = TPUSolver(mesh="off", delta="on", incr="on")
+        pods = churn_pods(0)
+        on.solve(mkinput(list(pods)))
+        i0, _ = incr_counts()
+        on.solve(mkinput(list(pods)))
+        i1, _ = incr_counts()
+        assert i1 - i0 == 1
+        assert on._delta_cache.last_outcome == "delta"
+
+    def test_auto_unarmed_is_silent(self):
+        auto = TPUSolver(mesh="off", delta="on", incr="auto")
+        i0, f0 = incr_counts()
+        for gen in range(2):
+            auto.solve(mkinput(list(churn_pods(gen))))
+        assert incr_counts() == (i0, f0)    # no counter: seam never ran
+        # the walk-based delta path still worked underneath
+        assert auto._delta_cache.last_outcome == "delta"
+
+    def test_arm_engages_auto(self):
+        auto = TPUSolver(mesh="off", delta="on", incr="auto")
+        auto.incr_arm()
+        i0, f0 = incr_counts()
+        pods = churn_pods(0)
+        auto.solve(mkinput(list(pods)))
+        auto.solve(mkinput(list(pods)))
+        i1, f1 = incr_counts()
+        assert (i1 - i0, f1 - f0) == (1, 1)
+
+    def test_env_off_beats_constructed_on(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_INCR", "off")
+        on = TPUSolver(mesh="off", delta="on", incr="on")
+        i0, f0 = incr_counts()
+        on.solve(mkinput(list(churn_pods(0))))
+        assert incr_counts() == (i0, f0)
+
+    def test_env_on_beats_constructed_off(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_INCR", "on")
+        s = TPUSolver(mesh="off", delta="on", incr="off")
+        _, f0 = incr_counts()
+        s.solve(mkinput(list(churn_pods(0))))
+        _, f1 = incr_counts()
+        assert f1 - f0 == 1 and last_incr(s) == "cold"
+
+    def test_malformed_env_degrades_to_constructed(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_INCR", "bogus")
+        s = TPUSolver(mesh="off", delta="on", incr="off")
+        assert s._resolve_incr() is False
+
+
+class TestIncrFallbacks:
+    @staticmethod
+    def _warm(**kw):
+        s = TPUSolver(mesh="off", delta="on", incr="on", **kw)
+        pods = churn_pods(0)
+        s.solve(mkinput(list(pods)))          # cold fill
+        return s, pods
+
+    def test_cold_then_warm(self):
+        s = TPUSolver(mesh="off", delta="on", incr="on")
+        pods = churn_pods(0)
+        s.solve(mkinput(list(pods)))
+        assert last_incr(s) == "cold"
+        s.solve(mkinput(list(pods)))
+        assert last_incr(s) is None
+
+    def test_flood_degrades_counted_then_recovers(self):
+        s, pods = self._warm()
+        s.delta_invalidate(flood=True)
+        s.solve(mkinput(list(pods)))
+        assert last_incr(s) == "flood"
+        # the fallback pass republished a record and rebuilt the index
+        s.solve(mkinput(list(pods)))
+        assert last_incr(s) is None
+
+    def test_names_only_invalidation_counts_pods(self):
+        s, pods = self._warm()
+        s.delta_invalidate(pods=[pods[-1].meta.name])
+        s.solve(mkinput(list(pods)))
+        assert last_incr(s) == "pods"
+
+    def test_node_dirt_counts_nodes(self):
+        s, pods = self._warm()
+        s.delta_invalidate(nodes=["some-node"])
+        s.solve(mkinput(list(pods)))
+        assert last_incr(s) == "nodes"
+
+    def test_bound_pod_event_counts_nodes(self):
+        s, pods = self._warm()
+        bound = mkpod("bound-1", cpu_m=100)
+        bound.node_name = "dn0"
+        s.delta_invalidate(pods={"bound-1"}, pod_objs={"bound-1": bound})
+        s.solve(mkinput(list(pods)))
+        assert last_incr(s) == "nodes"
+
+    def test_census_drift_counts_drift(self):
+        s, pods = self._warm()
+        # a pod reached the input without any watch event
+        s.solve(mkinput(list(pods) + [mkpod("ghost-1", cpu_m=90)]))
+        assert last_incr(s) == "drift"
+
+    def test_new_group_key_counts_order(self):
+        s, pods = self._warm()
+        novel = mkpod("novel-1", cpu_m=777)     # a gid the record lacks
+        s.delta_invalidate(pods={"novel-1"}, pod_objs={"novel-1": novel})
+        s.solve(mkinput(list(pods) + [novel]))
+        assert last_incr(s) == "order"
+
+    def test_same_name_pending_event_counts_order(self):
+        # modify-in-place vs delete+create is unprovable from the
+        # coalesced feed: the member-order contract demands the walk
+        s, pods = self._warm()
+        name = pods[-1].meta.name
+        s.delta_invalidate(pods={name},
+                           pod_objs={name: mkpod(name, cpu_m=650)})
+        s.solve(mkinput(list(pods)))
+        assert last_incr(s) == "order"
+
+    def test_vocabulary_closed(self):
+        assert explainmod.INCR_FALLBACK_REASONS == frozenset(
+            ("cold", "flood", "drift", "pods", "nodes", "order"))
+        s = TPUSolver(mesh="off", delta="on", incr="on")
+        with pytest.raises(AssertionError):
+            s._incr_fallback("made-up-reason")
+
+
+class TestIndexRebuildParity:
+    def test_rebuilt_index_reproduces_the_walk(self):
+        s, pods = TestIncrFallbacks._warm()
+        rec = s._delta_cache.get_any()
+        idx = incrmod.index_from_record(rec)
+        assert idx is not None
+        built = incrmod.build_groups(idx.snapshot(), mkinput(list(pods)))
+        assert not isinstance(built, str)
+        groups, m, reuse = built
+        walk = group_pods(list(pods))
+        assert len(groups) == len(walk)
+        for gi, wi in zip(groups, walk):
+            assert [p.meta.name for p in gi] == [p.meta.name for p in wi]
+        assert m == len(groups) and reuse == []
+
+    def test_multiband_record_declines(self):
+        hi = [mkpod(f"hi-{i}", cpu_m=900) for i in range(3)]
+        for p in hi:
+            p.priority = 10
+        lo = [mkpod(f"lo-{i}", cpu_m=400) for i in range(3)]
+        s = TPUSolver(mesh="off", delta="on", incr="on")
+        s.solve(mkinput(hi + lo))
+        rec = s._delta_cache.get_any()
+        if rec is not None:        # multi-band records never index
+            assert incrmod.index_from_record(rec) is None
+
+    def test_advance_carries_index_across_engaged_pass(self):
+        s = TPUSolver(mesh="off", delta="on", incr="on")
+        prev = churn_pods(0)
+        s.solve(mkinput(list(prev)))
+        idx0 = s._delta_cache.incr
+        assert idx0 is not None
+        cur = churn_pods(1)
+        feed_churn(s, prev, cur)
+        s.solve(mkinput(list(cur)))
+        assert last_incr(s) is None
+        # same index object advanced in place (O(churn)), now clean
+        idx1 = s._delta_cache.incr
+        assert idx1 is idx0 and idx1.dirty_count() == 0
+
+
+class TestGenerationGuard:
+    def test_raced_store_retires_the_index(self):
+        s, pods = TestIncrFallbacks._warm()
+        cache = s._delta_cache
+        assert cache.incr is not None
+        stale = cache.dirty_snapshot()
+        cache.invalidate(pods={"raced-pod"},
+                         pod_objs={"raced-pod": None})
+        rec = cache.get_any()
+        cache.put(rec.cat, rec, consumed=stale)   # gen moved on
+        assert cache.incr is None                 # retired whole
+        s.solve(mkinput(list(pods)))
+        assert last_incr(s) == "cold"             # counted, then rebuilt
+        s.solve(mkinput(list(pods)))
+        assert last_incr(s) is None
+
+    @pytest.mark.slow
+    def test_racing_invalidation_thread_never_breaks_parity(self):
+        s = TPUSolver(mesh="off", delta="on", incr="on")
+        off = TPUSolver(mesh="off", delta="off", incr="off")
+        stop = threading.Event()
+
+        def racer():
+            i = 0
+            while not stop.is_set():
+                i += 1
+                name = f"race-{i}"
+                s.delta_invalidate(pods={name}, pod_objs={name: None})
+
+        t = threading.Thread(target=racer, daemon=True)
+        t.start()
+        try:
+            prev = None
+            for gen in range(6):
+                pods = churn_pods(gen)
+                if prev is not None:
+                    feed_churn(s, prev, pods)
+                r = s.solve(mkinput(list(pods)))
+                assert canon(r) == canon(off.solve(mkinput(list(pods))))
+                prev = pods
+        finally:
+            stop.set()
+            t.join(timeout=10)
+
+
+class TestWatchFeedIntegration:
+    @staticmethod
+    def _cluster_with(pods):
+        cl = Cluster()
+        for p in pods:
+            cl.pods.create(p)
+        return cl
+
+    def test_feed_resolves_objects_and_index_engages(self):
+        prev = churn_pods(0)
+        cl = self._cluster_with(prev)
+        feed = SolveCacheFeed(cl)
+        s = TPUSolver(mesh="off", delta="on", incr="on")
+        feed.feed(s)                                 # drain the creates
+        s.solve(mkinput(cl.pods.list()))             # cold fill
+        cur = churn_pods(1)
+        cn = {p.meta.name for p in cur}
+        for p in list(prev):
+            if p.meta.name not in cn:
+                cl.pods.delete(p.meta.name)
+        pn = {p.meta.name for p in prev}
+        for p in cur:
+            if p.meta.name not in pn:
+                cl.pods.create(p)
+        feed.feed(s)
+        r = s.solve(mkinput(cl.pods.list()))
+        assert last_incr(s) is None
+        off = TPUSolver(mesh="off", delta="off")
+        assert canon(r) == canon(off.solve(mkinput(cl.pods.list())))
+
+    def test_watch_overflow_floods_the_index(self):
+        prev = churn_pods(0)
+        cl = self._cluster_with(prev)
+        feed = SolveCacheFeed(cl)
+        s = TPUSolver(mesh="off", delta="on", incr="on")
+        feed.feed(s)
+        s.solve(mkinput(cl.pods.list()))             # cold fill
+        s.solve(mkinput(cl.pods.list()))
+        assert last_incr(s) is None                  # warm + engaged
+        # overflow the bounded watch buffer: old edges are LOST, the
+        # drain must report flood and the index must degrade all-dirty
+        maxlen = feed._watch._buffer.maxlen
+        for i in range(maxlen + 10):
+            cl.pods.create(mkpod(f"flood-{i}", cpu_m=50))
+            cl.pods.delete(f"flood-{i}")
+        feed.feed(s)
+        r = s.solve(mkinput(cl.pods.list()))
+        assert last_incr(s) == "flood"
+        off = TPUSolver(mesh="off", delta="off")
+        assert canon(r) == canon(off.solve(mkinput(cl.pods.list())))
+
+    def test_drain_keeps_walk_shape(self):
+        cl = Cluster()
+        feed = SolveCacheFeed(cl)
+        for p in churn_pods(0)[:3]:
+            cl.pods.create(p)
+        pods, nodes, flood = feed.drain()
+        assert isinstance(pods, set) and isinstance(nodes, set)
+        assert not flood and len(pods) == 3
+
+    def test_claim_events_ride_the_claims_channel(self):
+        cl = Cluster()
+        feed = SolveCacheFeed(cl)
+        from karpenter_tpu.models import NodeClaim
+        cl.nodeclaims.create(NodeClaim(meta=ObjectMeta(name="claim-1"),
+                                       nodepool="default",
+                                       node_class_ref="default"))
+        pods, nodes, flood, claims = feed._drain_kinds()
+        assert "claim-1" in nodes and "claim-1" in claims
